@@ -1,0 +1,74 @@
+"""horovod_tpu: a TPU-native distributed training framework.
+
+A ground-up rebuild of Horovod 0.16 (reference: SinestroEdmonce/horovod) for
+TPU: same user surface — ``init()/rank()/size()``, named async
+allreduce/allgather/broadcast with tensor fusion, ``DistributedOptimizer``,
+parameter/optimizer-state broadcast, compression, timeline, autotune,
+launcher — with the data plane rebuilt on XLA collectives over an ICI/DCN
+device mesh instead of MPI/NCCL, and the SPMD compiler replacing the
+coordinator for jit-compiled training steps (see SURVEY.md §7).
+
+Typical use, mirroring the reference README:
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    mesh = hvd.parallel.data_parallel_mesh()
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01 * hvd.num_devices()),
+                                   axis_name="data")
+    # ... shard_map/pjit train step over the mesh; psum rides ICI ...
+    params = hvd.broadcast_parameters(params, root_rank=0)
+"""
+
+from . import parallel
+from .basics import (
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_device_count,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    num_devices,
+    rank,
+    shutdown,
+    size,
+)
+from .core.status import HorovodInternalError, NotInitializedError
+from .ops import (
+    Compression,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    broadcast,
+    broadcast_async,
+    poll,
+    release,
+    spmd,
+    synchronize,
+)
+from .optimizers import DistributedOptimizer, allreduce_gradients
+from .state_bcast import (
+    broadcast_global_variables,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "__version__",
+    "init", "shutdown", "is_initialized",
+    "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
+    "local_device_count", "num_devices", "mpi_threads_supported",
+    "allreduce", "allreduce_async", "allgather", "allgather_async",
+    "broadcast", "broadcast_async", "poll", "synchronize", "release",
+    "Compression", "spmd", "parallel",
+    "DistributedOptimizer", "allreduce_gradients",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "broadcast_global_variables", "broadcast_object",
+    "HorovodInternalError", "NotInitializedError",
+]

@@ -1,0 +1,180 @@
+"""Process-level basics: init / shutdown / rank / size / local ranks.
+
+Rebuild of ``HorovodBasics`` (``horovod/common/__init__.py:51-154``) and the
+``extern "C"`` entry points it wraps (``horovod/common/operations.cc:2413-2468``).
+Differences, by design (SURVEY §2.10):
+
+* No MPI. The world comes from the launcher env or the JAX runtime
+  (see ``core.topology``). ``init()`` therefore does not spawn a
+  communication thread for the synchronous API — SPMD jit programs need no
+  negotiation. The background controller for the *eager/async* named-tensor
+  API is started lazily on first use (``ops.engine``).
+* ``init(comm=...)`` — the reference accepts a ranks subset or an mpi4py
+  communicator; neither concept exists here. A ``ranks``/``comm`` argument is
+  accepted and must be None/empty for compatibility with call sites.
+* ``mpi_threads_supported()`` exists for API parity and always returns False
+  (there is no MPI to share with user code).
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Optional
+
+from .core import Config, LOG, NotInitializedError, Topology, discover
+
+
+class _GlobalState:
+    """Python analog of ``HorovodGlobalState`` (``operations.cc:115-249``).
+
+    Holds everything that must be torn down on ``shutdown()``. Unlike the
+    reference there is no background MPI thread to join for the sync path;
+    the async engine registers its own shutdown hook here.
+    """
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.initialized = False
+        self.topology: Optional[Topology] = None
+        self.config: Optional[Config] = None
+        # Set by ops.engine when the eager controller starts; called on
+        # shutdown (analog of joining BackgroundThreadLoop,
+        # operations.cc:2425-2431).
+        self.engine_shutdown_hooks = []
+
+
+_global = _GlobalState()
+
+
+def _state() -> _GlobalState:
+    return _global
+
+
+def init(ranks=None, comm=None) -> None:
+    """Initialize the world. Idempotent, like ``InitializeHorovodOnce``
+    (``operations.cc:2384-2399``): a second call while initialized is a
+    no-op; after ``shutdown()`` re-initialization is allowed."""
+    with _global.lock:
+        if _global.initialized:
+            return
+        if ranks:
+            raise ValueError(
+                "horovod_tpu.init(ranks=...) subset worlds are not supported: "
+                "the world is defined by the TPU pod topology / launcher.")
+        if comm is not None:
+            raise ValueError(
+                "horovod_tpu.init(comm=...) requires MPI, which this build "
+                "intentionally does not use.")
+        _global.config = Config.from_env()
+        if _global.config.hierarchical_allreduce or \
+                _global.config.hierarchical_allgather:
+            LOG.warning(
+                "HOROVOD_HIERARCHICAL_* is not wired into the eager engine "
+                "yet; two-level (dcn, ici) collectives are available via "
+                "horovod_tpu.parallel.hierarchical_mesh for SPMD steps.")
+        if _global.config.autotune:
+            LOG.warning(
+                "HOROVOD_AUTOTUNE is not wired up yet; fusion threshold and "
+                "cycle time come from HOROVOD_FUSION_THRESHOLD / "
+                "HOROVOD_CYCLE_TIME.")
+        _global.topology = discover()
+        _global.initialized = True
+        LOG.debug(
+            "horovod_tpu initialized: rank=%d size=%d local_rank=%d "
+            "local_size=%d devices=%d/%d",
+            _global.topology.rank, _global.topology.size,
+            _global.topology.local_rank, _global.topology.local_size,
+            _global.topology.local_device_count,
+            _global.topology.global_device_count)
+
+
+def shutdown() -> None:
+    """Tear down; mirrors ``horovod_shutdown`` (``operations.cc:2424-2431``)
+    including the "re-init allowed afterwards" semantics."""
+    with _global.lock:
+        if not _global.initialized:
+            return
+        hooks, _global.engine_shutdown_hooks = _global.engine_shutdown_hooks, []
+        for hook in hooks:
+            try:
+                hook()
+            except Exception as exc:  # noqa: BLE001 - teardown must not raise
+                LOG.warning("engine shutdown hook failed: %s", exc)
+        _global.initialized = False
+        _global.topology = None
+        _global.config = None
+
+
+atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    return _global.initialized
+
+
+def _topology() -> Topology:
+    topo = _global.topology
+    if topo is None:
+        raise NotInitializedError()
+    return topo
+
+
+def config() -> Config:
+    cfg = _global.config
+    if cfg is None:
+        raise NotInitializedError()
+    return cfg
+
+
+def rank() -> int:
+    """World rank of this process (``horovod_rank``, ``operations.cc:2437``)."""
+    return _topology().rank
+
+
+def size() -> int:
+    """World size in processes (``horovod_size``, ``operations.cc:2453``)."""
+    return _topology().size
+
+
+def local_rank() -> int:
+    """Rank within this host (``horovod_local_rank``, ``operations.cc:2445``)."""
+    return _topology().local_rank
+
+
+def local_size() -> int:
+    """Processes on this host (``horovod_local_size``, ``operations.cc:2461``)."""
+    return _topology().local_size
+
+
+def cross_rank() -> int:
+    """Host index (split by local_rank in the reference,
+    ``operations.cc:1781-1797``)."""
+    return _topology().cross_rank
+
+
+def cross_size() -> int:
+    return _topology().cross_size
+
+
+def local_device_count() -> int:
+    """TPU chips owned by this process. No reference analog (there, one
+    process drives exactly one GPU); on TPU a process drives a host's worth
+    of chips and the SPMD data plane spans them."""
+    return _topology().local_device_count
+
+
+def num_devices() -> int:
+    """Total data-parallel devices in the world = size() x chips/process.
+
+    This is the factor examples use for linear LR scaling (the reference
+    scales by ``hvd.size()`` because size == accelerator count there)."""
+    return _topology().global_device_count
+
+
+def mpi_threads_supported() -> bool:
+    """API parity with ``horovod_mpi_threads_supported``
+    (``operations.cc:2466``); always False — no MPI in this build."""
+    if not _global.initialized:
+        raise NotInitializedError()
+    return False

@@ -1,0 +1,30 @@
+"""Framework-agnostic core: topology, status, config, logging.
+
+TPU-native rebuild of ``horovod/common/`` (SURVEY §2.1). The reference's
+core is a C++ background thread coordinating MPI ranks; here the core state
+is Python + a native controller (``horovod_tpu/cc``) for the eager/async
+path, while the synchronous data plane is jit-compiled XLA collectives.
+"""
+
+from .config import Config
+from .logging import LOG
+from .status import (
+    HorovodInternalError,
+    NotInitializedError,
+    SHUT_DOWN_ERROR,
+    Status,
+    StatusType,
+)
+from .topology import Topology, discover
+
+__all__ = [
+    "Config",
+    "LOG",
+    "HorovodInternalError",
+    "NotInitializedError",
+    "SHUT_DOWN_ERROR",
+    "Status",
+    "StatusType",
+    "Topology",
+    "discover",
+]

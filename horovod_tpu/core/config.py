@@ -1,0 +1,115 @@
+"""Runtime configuration knobs, all environment variables.
+
+The reference has no config files and no CLI parser in the library: every
+runtime knob is an env var read in ``BackgroundThreadLoop``
+(``horovod/common/operations.cc:1707,1825-1909``; names declared at
+``operations.h:57-66``). We keep the exact same names (HOROVOD_*) so that
+operational muscle memory and docs transfer, and add a small number of
+TPU-specific knobs (controller address, virtual world description) needed
+because our control plane is TCP rather than MPI.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+# --- reference knob names (operations.h:57-66) -------------------------------
+HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
+HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
+HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
+HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
+HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+HOROVOD_LOG_HIDE_TIME = "HOROVOD_LOG_HIDE_TIME"
+
+# --- launcher / control-plane knobs (ours; role of mpirun's env in the ref) --
+HOROVOD_RANK = "HOROVOD_RANK"
+HOROVOD_SIZE = "HOROVOD_SIZE"
+HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+HOROVOD_LOCAL_SIZE = "HOROVOD_LOCAL_SIZE"
+HOROVOD_CROSS_RANK = "HOROVOD_CROSS_RANK"
+HOROVOD_CROSS_SIZE = "HOROVOD_CROSS_SIZE"
+HOROVOD_CONTROLLER_ADDR = "HOROVOD_CONTROLLER_ADDR"
+HOROVOD_CONTROLLER_PORT = "HOROVOD_CONTROLLER_PORT"
+HOROVOD_SECRET_KEY = "HOROVOD_SECRET_KEY"
+HOROVOD_START_TIMEOUT = "HOROVOD_START_TIMEOUT"
+# Data plane selection for eager cross-process collectives:
+#   "auto" — XLA collectives over the global device mesh when a multi-process
+#            JAX runtime is initialized; TCP/host reduction otherwise.
+#   "xla"  — force device collectives.
+#   "host" — force host (numpy-over-TCP) reduction; used by CPU launcher tests.
+HOROVOD_DATA_PLANE = "HOROVOD_DATA_PLANE"
+
+DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:1838
+DEFAULT_CYCLE_TIME_MS = 5.0  # operations.cc:1846
+DEFAULT_START_TIMEOUT_S = 30.0
+STALL_WARNING_TIME_S = 60.0  # operations.cc:258
+
+
+def _env_bool(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() not in ("", "0", "false")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+@dataclass
+class Config:
+    """Snapshot of all runtime knobs, taken once at ``init()`` time.
+
+    The reference reads these in the background thread right after MPI init
+    (``operations.cc:1825-1909``); we read them in ``hvd.init()``.
+    """
+
+    fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
+    cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+    timeline_path: str = ""
+    timeline_mark_cycles: bool = False
+    stall_check_disable: bool = False
+    stall_warning_time_s: float = STALL_WARNING_TIME_S
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    autotune: bool = False
+    autotune_log: str = ""
+    start_timeout_s: float = DEFAULT_START_TIMEOUT_S
+    data_plane: str = "auto"
+
+    @staticmethod
+    def from_env() -> "Config":
+        return Config(
+            fusion_threshold_bytes=_env_int(
+                HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES),
+            cycle_time_ms=_env_float(HOROVOD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS),
+            timeline_path=os.environ.get(HOROVOD_TIMELINE, ""),
+            timeline_mark_cycles=_env_bool(HOROVOD_TIMELINE_MARK_CYCLES),
+            stall_check_disable=_env_bool(HOROVOD_STALL_CHECK_DISABLE),
+            hierarchical_allreduce=_env_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
+            hierarchical_allgather=_env_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
+            autotune=_env_bool(HOROVOD_AUTOTUNE),
+            autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG, ""),
+            start_timeout_s=_env_float(
+                HOROVOD_START_TIMEOUT, DEFAULT_START_TIMEOUT_S),
+            data_plane=os.environ.get(HOROVOD_DATA_PLANE, "auto"),
+        )

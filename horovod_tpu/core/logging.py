@@ -1,0 +1,58 @@
+"""Stream-style leveled logging, env-controlled.
+
+Rebuild of the reference logger (``horovod/common/logging.{h,cc}``): levels
+TRACE..FATAL selected by ``HOROVOD_LOG_LEVEL``, optional timestamp suppression
+via ``HOROVOD_LOG_HIDE_TIME`` (``logging.h:35-56``). We implement it on the
+stdlib ``logging`` module (one logger per process, stderr handler) rather than
+C++ stream macros; the native core (horovod_tpu/cc) logs through the same
+format so interleaved output is uniform.
+"""
+
+from __future__ import annotations
+
+import logging as _pylogging
+import os
+import sys
+
+TRACE = 5
+_pylogging.addLevelName(TRACE, "TRACE")
+
+_LEVELS = {
+    "trace": TRACE,
+    "debug": _pylogging.DEBUG,
+    "info": _pylogging.INFO,
+    "warning": _pylogging.WARNING,
+    "error": _pylogging.ERROR,
+    "fatal": _pylogging.CRITICAL,
+}
+
+
+def min_log_level_from_env() -> int:
+    """Reference: ``MinLogLevelFromEnv`` (``logging.cc``); default WARNING."""
+    raw = os.environ.get("HOROVOD_LOG_LEVEL", "warning").strip().lower()
+    return _LEVELS.get(raw, _pylogging.WARNING)
+
+
+def _build_logger() -> _pylogging.Logger:
+    from .config import _env_bool
+
+    logger = _pylogging.getLogger("horovod_tpu")
+    logger.setLevel(min_log_level_from_env())
+    if not logger.handlers:
+        handler = _pylogging.StreamHandler(sys.stderr)
+        if _env_bool("HOROVOD_LOG_HIDE_TIME"):
+            fmt = "[%(levelname)s] %(message)s"
+        else:
+            fmt = "%(asctime)s [%(levelname)s] %(message)s"
+        handler.setFormatter(_pylogging.Formatter(fmt))
+        logger.addHandler(handler)
+    logger.propagate = False
+    return logger
+
+
+LOG = _build_logger()
+
+
+def log_rank(level: int, rank: int, msg: str) -> None:
+    """``LOG(severity, rank)`` form from the reference macros."""
+    LOG.log(level, "[%d]: %s", rank, msg)

@@ -1,0 +1,96 @@
+"""Status codes and error types.
+
+TPU-native rebuild of the reference's ``Status`` machinery
+(``horovod/common/common.h:28-75``): the reference threads a ``Status`` object
+from the C++ core back through per-framework callbacks; we keep the same
+status taxonomy so the async API (poll/synchronize) and the controller's
+error-response construction can report identical failure classes.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class StatusType(enum.IntEnum):
+    """Mirrors the reference StatusType enum (``common.h:33-39``)."""
+
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+    IN_PROGRESS = 5
+
+
+@dataclass(frozen=True)
+class Status:
+    """Result of a collective operation (``common.h:41-75``)."""
+
+    type: StatusType = StatusType.OK
+    reason: str = ""
+
+    @staticmethod
+    def ok() -> "Status":
+        return Status(StatusType.OK)
+
+    @staticmethod
+    def unknown_error(reason: str) -> "Status":
+        return Status(StatusType.UNKNOWN_ERROR, reason)
+
+    @staticmethod
+    def precondition_error(reason: str) -> "Status":
+        return Status(StatusType.PRECONDITION_ERROR, reason)
+
+    @staticmethod
+    def aborted(reason: str) -> "Status":
+        return Status(StatusType.ABORTED, reason)
+
+    @staticmethod
+    def invalid_argument(reason: str) -> "Status":
+        return Status(StatusType.INVALID_ARGUMENT, reason)
+
+    @staticmethod
+    def in_progress() -> "Status":
+        return Status(StatusType.IN_PROGRESS)
+
+    def __bool__(self) -> bool:
+        return self.type == StatusType.OK
+
+    def raise_if_error(self) -> None:
+        if self.type in (StatusType.OK, StatusType.IN_PROGRESS):
+            return
+        raise HorovodInternalError(self.reason or self.type.name)
+
+
+# The message every outstanding callback receives when the background
+# controller shuts down mid-flight (reference: ``operations.cc:263-268``).
+SHUT_DOWN_ERROR = (
+    "Horovod has been shut down. This was caused by an exception on one of "
+    "the ranks or an attempt to allreduce, allgather or broadcast a tensor "
+    "after one of the ranks finished execution. If the shutdown was caused "
+    "by an exception, you should see the exception in the log before the "
+    "first shutdown message."
+)
+
+
+class HorovodInternalError(RuntimeError):
+    """Raised when a collective completes with a non-OK status.
+
+    The reference surfaces these as framework-specific exceptions from the
+    synchronize/wait path (e.g. ``torch/mpi_ops_v2.cc:228-234``).
+    """
+
+
+class NotInitializedError(ValueError):
+    """Raised when the API is used before ``init()``.
+
+    Mirrors the CheckInitialized precondition (``operations.cc:2472``) and the
+    ``ValueError`` the reference Python wrapper raises on a -1 rank
+    (``horovod/common/__init__.py:90-154``).
+    """
+
+    def __init__(self) -> None:
+        super().__init__(
+            "Horovod has not been initialized; use hvd.init().")

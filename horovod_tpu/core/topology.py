@@ -1,0 +1,120 @@
+"""World topology: rank / size / local_rank / local_size / cross ranks.
+
+The reference derives these from MPI communicators: ``MPI_COMM_WORLD`` rank
+and size, a shared-memory split for the node-local communicator, and a
+local-rank split for the cross-node communicator
+(``horovod/common/operations.cc:1728-1797``). There is no MPI in this build;
+the world is discovered from, in priority order:
+
+1. Launcher environment (``HOROVOD_RANK``/``HOROVOD_SIZE``/...), set by
+   ``horovodrun``/``horovod_tpu.runner`` — the analog of
+   ``OMPI_COMM_WORLD_RANK`` et al. that mpirun exports.
+2. The JAX multi-process runtime (``jax.process_index()``/``process_count()``)
+   on a real TPU pod, where one process per host is the natural deployment.
+3. Single-process default: rank 0 of a world of size 1 (the reference's
+   "single-process MPI self-world" test fixture, SURVEY §4).
+
+A rank is a *process*, exactly as in the reference (one process per
+accelerator there; one process per TPU host here, owning
+``jax.local_device_count()`` chips). ``num_devices()`` reports the total
+data-parallel device count across the world, which is what examples use for
+learning-rate scaling.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass
+
+from . import config as _config
+
+
+@dataclass(frozen=True)
+class Topology:
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+    # Number of accelerator devices owned by this process / by the world.
+    local_device_count: int
+    global_device_count: int
+    hostname: str
+
+    @property
+    def is_homogeneous(self) -> bool:
+        """Reference: allgather of local sizes → is_homogeneous
+        (``operations.cc:1760-1780``). Our worlds are homogeneous by
+        construction (launcher enforces a uniform per-host process count);
+        heterogeneous TPU slices are not a supported deployment."""
+        return True
+
+
+def _jax_counts():
+    # Deferred import: topology must be resolvable before JAX spins up
+    # (the launcher computes ranks without touching devices).
+    import jax
+
+    return (
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def discover(use_jax: bool = True) -> Topology:
+    """Resolve the world, preferring launcher env over the JAX runtime."""
+    env = os.environ
+    hostname = socket.gethostname()
+    if _config.HOROVOD_RANK in env and _config.HOROVOD_SIZE in env:
+        rank = int(env[_config.HOROVOD_RANK])
+        size = int(env[_config.HOROVOD_SIZE])
+        local_rank = int(env.get(_config.HOROVOD_LOCAL_RANK, 0))
+        local_size = int(env.get(_config.HOROVOD_LOCAL_SIZE, 1))
+        cross_rank = int(env.get(_config.HOROVOD_CROSS_RANK, rank // max(local_size, 1)))
+        cross_size = int(env.get(_config.HOROVOD_CROSS_SIZE, size // max(local_size, 1)))
+        if use_jax:
+            _, _, local_devices, _ = 0, 0, _local_devices_safe(), 0
+        else:
+            local_devices = 1
+        return Topology(
+            rank=rank,
+            size=size,
+            local_rank=local_rank,
+            local_size=local_size,
+            cross_rank=cross_rank,
+            cross_size=cross_size,
+            local_device_count=local_devices,
+            global_device_count=local_devices * size,
+            hostname=hostname,
+        )
+    if use_jax:
+        pidx, pcount, local_devices, global_devices = _jax_counts()
+        return Topology(
+            rank=pidx,
+            size=pcount,
+            local_rank=0,
+            local_size=1,
+            cross_rank=pidx,
+            cross_size=pcount,
+            local_device_count=local_devices,
+            global_device_count=global_devices,
+            hostname=hostname,
+        )
+    return Topology(
+        rank=0, size=1, local_rank=0, local_size=1, cross_rank=0,
+        cross_size=1, local_device_count=1, global_device_count=1,
+        hostname=hostname,
+    )
+
+
+def _local_devices_safe() -> int:
+    try:
+        import jax
+
+        return jax.local_device_count()
+    except Exception:  # pragma: no cover - jax missing/broken
+        return 1

@@ -1,0 +1,13 @@
+"""Benchmark / example model zoo.
+
+The reference ships no model code — its examples import torchvision / Keras
+applications (SURVEY §2.8). This environment has no TPU-side model zoo, so
+the models the benchmarks need (ResNet-50/101, a small MNIST convnet) are
+implemented here in flax, sized and configured to match the reference
+benchmark protocol (``examples/pytorch_synthetic_benchmark.py``).
+"""
+
+from .mnist import MnistCNN
+from .resnet import ResNet, ResNet50, ResNet101
+
+__all__ = ["MnistCNN", "ResNet", "ResNet50", "ResNet101"]

@@ -1,0 +1,27 @@
+"""Small MNIST convnet matching the reference example architectures
+(``examples/pytorch_mnist.py:40-55``, ``examples/keras_mnist.py``): two
+convs + max-pool + dropout-free dense head, the model every end-to-end smoke
+example trains data-parallel."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: [batch, 28, 28, 1] NHWC
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes)(x)
+        return x

@@ -1,0 +1,205 @@
+"""Public collective op API: sync + async named-tensor operations.
+
+Mirrors the per-framework op surface of the reference
+(``horovod/torch/mpi_ops.py:73-438``, ``horovod/tensorflow/mpi_ops.py``):
+``allreduce[_async]`` / ``allgather[_async]`` / ``broadcast[_async]`` +
+``poll`` / ``synchronize``, with optional compression. Two dispatch modes:
+
+* **Eager** (default): the named tensor goes through the background engine —
+  negotiation, fusion, timeline — and the result is returned as the same
+  framework type that was passed in (JAX array in, JAX array out).
+* **SPMD** (``axis_name=...``): inside ``shard_map``/``pjit`` the op lowers
+  directly to an XLA collective (``ops.spmd``); no engine, no negotiation —
+  the jit program order plays the role of the coordinator (SURVEY §7).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .. import basics
+from ..core.status import HorovodInternalError
+from . import spmd
+from .compression import Compression
+from .engine import get_engine
+from .messages import OP_NAMES, RequestType
+
+_noname_counter = itertools.count()
+_ctx_lock = threading.Lock()
+_handle_ctx: Dict[int, dict] = {}
+
+
+def _is_jax(tensor: Any) -> bool:
+    import jax
+
+    return isinstance(tensor, jax.Array)
+
+
+def _is_tracer(tensor: Any) -> bool:
+    import jax.core
+
+    return isinstance(tensor, jax.core.Tracer)
+
+
+def _auto_name(op: str, name: Optional[str]) -> str:
+    if name is not None:
+        return name
+    # Reference auto-names by handle ("allreduce.noname.<n>",
+    # ``torch/mpi_ops.py:62-71``).
+    return f"{op}.noname.{next(_noname_counter)}"
+
+
+def _to_numpy(tensor: Any) -> np.ndarray:
+    arr = np.asarray(tensor)
+    if arr.dtype == np.dtype("O"):
+        raise TypeError(f"unsupported tensor type {type(tensor)!r}")
+    return arr
+
+
+def _submit(op: RequestType, tensor: Any, name: Optional[str],
+            root_rank: int = -1, average: bool = False,
+            compression=Compression.none) -> int:
+    if _is_tracer(tensor):
+        raise ValueError(
+            "eager collective called on a traced value inside jit; pass "
+            "axis_name= to use the SPMD collective instead.")
+    name = _auto_name(OP_NAMES[op], name)
+    compressed, comp_ctx = compression.compress(tensor)
+    arr = _to_numpy(compressed)
+    engine = get_engine()
+    handle = engine.enqueue(op, arr, name, root_rank=root_rank)
+    with _ctx_lock:
+        # The handle stays bound to the engine that produced it: a completed
+        # result must remain readable even after that engine stops (e.g. a
+        # peer-initiated coordinated shutdown) — poll/synchronize must never
+        # spin up a fresh engine.
+        _handle_ctx[handle] = {
+            "average": average,
+            "compression": compression,
+            "comp_ctx": comp_ctx,
+            "jax_out": _is_jax(tensor),
+            "engine": engine,
+        }
+        _evict_stale_ctx_locked()
+    return handle
+
+
+# Keep the API-layer context map bounded the same way the engine bounds its
+# result table: abandoned handles are evicted oldest-first.
+_MAX_RETAINED_CTX = 1 << 16
+
+
+def _evict_stale_ctx_locked() -> None:
+    while len(_handle_ctx) > _MAX_RETAINED_CTX:
+        del _handle_ctx[next(iter(_handle_ctx))]
+
+
+def release(handle: int) -> None:
+    """Drop an async handle without waiting on it (the reference exposes
+    ``HandleManager::ReleaseHandle``, ``torch/handle_manager.cc``). The
+    collective still runs; only the result bookkeeping is discarded."""
+    with _ctx_lock:
+        _handle_ctx.pop(handle, None)
+
+
+def _engine_of(handle: int):
+    with _ctx_lock:
+        ctx = _handle_ctx.get(handle)
+    if ctx is None:
+        raise ValueError(f"unknown handle {handle}")
+    return ctx["engine"]
+
+
+def poll(handle: int) -> bool:
+    """True when the async op completed (``torch/mpi_ops.py:406-413``)."""
+    return _engine_of(handle).handles.poll(handle)
+
+
+def synchronize(handle: int) -> Any:
+    """Block until done; raise on coordinator-constructed errors
+    (``torch/mpi_ops.py:422-438`` → ``WaitAndClear``)."""
+    engine = _engine_of(handle)
+    with _ctx_lock:
+        ctx = _handle_ctx.pop(handle, {})
+    result = engine.handles.wait(handle)
+    if result is None:
+        raise HorovodInternalError("collective returned no result")
+    if ctx.get("average"):
+        size = basics.size()
+        if size > 1:
+            orig = result.dtype
+            result = (result / size).astype(orig)
+    out: Any = result
+    if ctx.get("jax_out"):
+        import jax.numpy as jnp
+
+        out = jnp.asarray(result)
+    compression = ctx.get("compression", Compression.none)
+    return compression.decompress(out, ctx.get("comp_ctx"))
+
+
+# -- allreduce ----------------------------------------------------------------
+
+def allreduce(tensor: Any, average: bool = True, name: Optional[str] = None,
+              compression=Compression.none,
+              axis_name: Optional[spmd.AxisName] = None) -> Any:
+    """Average (or sum) across ranks (``torch/mpi_ops.py:110-160``)."""
+    if axis_name is not None:
+        compressed, ctx = compression.compress(tensor)
+        reduced = spmd.allreduce(compressed, axis_name, average=average)
+        return compression.decompress(reduced, ctx)
+    handle = allreduce_async(tensor, average=average, name=name,
+                             compression=compression)
+    return synchronize(handle)
+
+
+def allreduce_async(tensor: Any, average: bool = True,
+                    name: Optional[str] = None,
+                    compression=Compression.none) -> int:
+    return _submit(RequestType.ALLREDUCE, tensor, name,
+                   average=average, compression=compression)
+
+
+# -- allgather ----------------------------------------------------------------
+
+def allgather(tensor: Any, name: Optional[str] = None,
+              axis_name: Optional[spmd.AxisName] = None) -> Any:
+    """Concatenate across ranks along dim 0 (``torch/mpi_ops.py:236-300``).
+    Per-rank first dimensions may differ in eager mode; inside jit they must
+    match (static shapes)."""
+    if axis_name is not None:
+        return spmd.allgather(tensor, axis_name)
+    return synchronize(allgather_async(tensor, name=name))
+
+
+def allgather_async(tensor: Any, name: Optional[str] = None) -> int:
+    return _submit(RequestType.ALLGATHER, tensor, name)
+
+
+# -- broadcast ----------------------------------------------------------------
+
+def broadcast(tensor: Any, root_rank: int, name: Optional[str] = None,
+              axis_name: Optional[spmd.AxisName] = None) -> Any:
+    """All ranks receive root's value (``torch/mpi_ops.py:318-380``)."""
+    if axis_name is not None:
+        return spmd.broadcast(tensor, root_rank, axis_name)
+    return synchronize(broadcast_async(tensor, root_rank, name=name))
+
+
+def broadcast_async(tensor: Any, root_rank: int,
+                    name: Optional[str] = None) -> int:
+    return _submit(RequestType.BROADCAST, tensor, name, root_rank=root_rank)
+
+
+__all__ = [
+    "Compression",
+    "allreduce", "allreduce_async",
+    "allgather", "allgather_async",
+    "broadcast", "broadcast_async",
+    "poll", "synchronize", "release",
+    "spmd",
+]

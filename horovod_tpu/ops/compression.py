@@ -1,0 +1,77 @@
+"""Gradient compression algorithms.
+
+Rebuild of ``horovod/torch/compression.py`` / ``tensorflow/compression.py``
+(identical 74-line files): a ``Compressor`` has ``compress(tensor) ->
+(compressed, ctx)`` and ``decompress(compressed, ctx)``, and ``Compression``
+exposes ``none`` / ``fp16`` selectors. TPU-first addition: ``bf16``, the
+native 16-bit format of the MXU — on TPU it is both faster and safer
+(fp32-range exponent) than fp16, and XLA reduces it natively, so the
+software fp16-sum shim of the reference (``half.cc:43-75``) has no analog
+here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface for compressing and decompressing a tensor
+    (``compression.py:20-33`` in the reference)."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Default no-op compression (``compression.py:36-46``)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    """Cast-down / cast-up compression (``compression.py:49-64``: compress to
+    16 bits before the collective, restore the original dtype after)."""
+
+    WIRE_DTYPE: jnp.dtype
+
+    @classmethod
+    def compress(cls, tensor):
+        ctx = tensor.dtype
+        if jnp.issubdtype(ctx, jnp.floating) and ctx != cls.WIRE_DTYPE:
+            return tensor.astype(cls.WIRE_DTYPE), ctx
+        return tensor, ctx
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            return tensor.astype(ctx)
+        return tensor
+
+
+class FP16Compressor(_CastCompressor):
+    WIRE_DTYPE = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    WIRE_DTYPE = jnp.bfloat16
+
+
+class Compression:
+    """Optional gradient compression algorithm used during allreduce
+    (``compression.py:67-74``)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,442 @@
+"""Coordinator: negotiation, fusion planning, error construction, stalls.
+
+Rebuild of the rank-0 "coordinator" half of ``horovod/common/operations.cc``:
+
+* ``Negotiator`` is the message-table state machine — ``IncrementTensorCount``
+  (``operations.cc:287-319``) plus ``ConstructResponse`` (``:321-523``) plus
+  the fusion-packing loop (``:2154-2266``) plus ``CheckForStalledTensors``
+  (``:1625-1672``). It is pure logic with no I/O, so the same object serves
+  the in-process single-rank world and the TCP controller service.
+* ``ControllerService`` wraps a ``Negotiator`` behind the authenticated TCP
+  wire for multi-process worlds — the role MPI_Gather/MPI_Bcast of
+  Request/ResponseLists plays each cycle in the reference
+  (``operations.cc:2088-2134``, ``:2281-2287``). It also hosts the host-mode
+  payload exchange (gather-reduce-scatter of tensor bytes over the same
+  connections), which replaces the MPI data plane for CPU test worlds; on a
+  real pod the data plane is XLA collectives and only the metadata cycle
+  goes through here.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.logging import LOG
+from ..runner.network import BasicClient, BasicService
+from .messages import (
+    DataType,
+    Request,
+    RequestList,
+    RequestType,
+    Response,
+    ResponseList,
+    ResponseType,
+)
+
+_DTYPE_BYTES = {
+    DataType.UINT8: 1, DataType.INT8: 1, DataType.UINT16: 2,
+    DataType.INT16: 2, DataType.INT32: 4, DataType.INT64: 8,
+    DataType.FLOAT16: 2, DataType.FLOAT32: 4, DataType.FLOAT64: 8,
+    DataType.BOOL: 1, DataType.BFLOAT16: 2,
+}
+
+def _nbytes(req: Request) -> int:
+    n = _DTYPE_BYTES[req.tensor_type]
+    for d in req.tensor_shape:
+        n *= d
+    return n
+
+
+@dataclass
+class _TableEntry:
+    """Per-tensor negotiation state (the message_table of
+    ``operations.cc:271-285``)."""
+
+    requests: Dict[int, Request] = field(default_factory=dict)
+    first_seen: float = field(default_factory=time.monotonic)
+    arrival: int = 0  # order of readiness for deterministic response order
+
+
+class Negotiator:
+    """Tracks which ranks have submitted which named tensors; when all
+    ``size`` ranks have submitted a name, emits a Response for it (fused
+    where legal) or a coordinator-constructed error."""
+
+    def __init__(self, size: int, fusion_threshold_bytes: int,
+                 stall_warning_s: float = 60.0,
+                 stall_check_disable: bool = False) -> None:
+        self._size = size
+        self._fusion_threshold = fusion_threshold_bytes
+        self._stall_warning_s = stall_warning_s
+        self._stall_check_disable = stall_check_disable
+        self._table: Dict[str, _TableEntry] = {}
+        self._ready: List[Tuple[int, str]] = []
+        self._arrivals = 0
+        self._last_stall_check = time.monotonic()
+        self._shutdown = False
+        self._lock = threading.Lock()
+
+    def add_request_list(self, rl: RequestList) -> None:
+        """IncrementTensorCount for every request (``operations.cc:287-319``)."""
+        with self._lock:
+            if rl.shutdown:
+                self._shutdown = True
+            for req in rl.requests:
+                entry = self._table.setdefault(req.tensor_name, _TableEntry())
+                entry.requests[req.request_rank] = req
+                if len(entry.requests) == self._size:
+                    self._arrivals += 1
+                    entry.arrival = self._arrivals
+                    self._ready.append((entry.arrival, req.tensor_name))
+
+    def construct_response_list(self) -> ResponseList:
+        """Drain ready tensors into a deterministic, fused ResponseList
+        (``ConstructResponse`` + the fusion loop of ``:2154-2266``)."""
+        with self._lock:
+            ready = [name for _, name in sorted(self._ready)]
+            self._ready.clear()
+            responses: List[Response] = []
+            for name in ready:
+                entry = self._table.pop(name)
+                resp = self._construct_response(name, entry)
+                # Stash the (rank-0) request on the response for fusion
+                # size/dtype decisions; stripped meaning only, never data.
+                resp._meta = entry.requests[min(entry.requests)]  # type: ignore[attr-defined]
+                responses.append(resp)
+            self._maybe_check_stalls()
+            out = ResponseList(responses=self._fuse(responses),
+                               shutdown=self._shutdown)
+            return out
+
+    @property
+    def shutdown_requested(self) -> bool:
+        return self._shutdown
+
+    # -- response construction -----------------------------------------------
+
+    def _construct_response(self, name: str, entry: _TableEntry) -> Response:
+        reqs = [entry.requests[r] for r in sorted(entry.requests)]
+        first = reqs[0]
+
+        def error(msg: str) -> Response:
+            return Response(ResponseType.ERROR, tensor_names=[name],
+                            error_message=msg)
+
+        for req in reqs[1:]:
+            if req.request_type != first.request_type:
+                return error(
+                    f"Mismatched collective operations: rank "
+                    f"{first.request_rank} requested "
+                    f"{first.request_type.name}, but rank {req.request_rank} "
+                    f"requested {req.request_type.name} for tensor {name}.")
+            if req.tensor_type != first.tensor_type:
+                return error(
+                    f"Mismatched data types: rank {first.request_rank} sent "
+                    f"{first.tensor_type.name}, but rank {req.request_rank} "
+                    f"sent {req.tensor_type.name} for tensor {name}.")
+
+        op = first.request_type
+        if op == RequestType.ALLREDUCE:
+            for req in reqs[1:]:
+                if req.tensor_shape != first.tensor_shape:
+                    return error(
+                        f"Mismatched allreduce tensor shapes: rank "
+                        f"{first.request_rank} sent shape "
+                        f"{list(first.tensor_shape)}, but rank "
+                        f"{req.request_rank} sent shape "
+                        f"{list(req.tensor_shape)} for tensor {name}.")
+            return Response(ResponseType.ALLREDUCE, tensor_names=[name])
+
+        if op == RequestType.BROADCAST:
+            for req in reqs[1:]:
+                if req.root_rank != first.root_rank:
+                    return error(
+                        f"Mismatched broadcast root ranks: rank "
+                        f"{first.request_rank} specified root "
+                        f"{first.root_rank}, but rank {req.request_rank} "
+                        f"specified root {req.root_rank} for tensor {name}.")
+            if not (0 <= first.root_rank < self._size):
+                return error(
+                    f"Invalid broadcast root rank {first.root_rank} for a "
+                    f"world of size {self._size} (tensor {name}).")
+            root_shape = entry.requests[first.root_rank].tensor_shape \
+                if first.root_rank in entry.requests else first.tensor_shape
+            for req in reqs:
+                if req.tensor_shape != root_shape:
+                    return error(
+                        f"Mismatched broadcast tensor shapes: root sent "
+                        f"shape {list(root_shape)}, but rank "
+                        f"{req.request_rank} has shape "
+                        f"{list(req.tensor_shape)} for tensor {name}.")
+            resp = Response(ResponseType.BROADCAST, tensor_names=[name])
+            resp.tensor_sizes = [first.root_rank]
+            return resp
+
+        # ALLGATHER: ragged first dim allowed; all other dims must agree
+        # (``operations.cc:382-430``). tensor_sizes carries per-rank dim0 in
+        # rank order — the recvcounts of the reference.
+        for req in reqs[1:]:
+            if len(req.tensor_shape) != len(first.tensor_shape) or \
+                    req.tensor_shape[1:] != first.tensor_shape[1:]:
+                return error(
+                    f"Mismatched allgather tensor shapes: every dimension "
+                    f"except the first must match; rank {first.request_rank} "
+                    f"sent {list(first.tensor_shape)}, rank "
+                    f"{req.request_rank} sent {list(req.tensor_shape)} for "
+                    f"tensor {name}.")
+        if len(first.tensor_shape) == 0:
+            return error(
+                f"Rank zero tried to allgather a rank-zero tensor "
+                f"({name}); allgather requires at least one dimension.")
+        sizes = [req.tensor_shape[0] for req in reqs]
+        return Response(ResponseType.ALLGATHER, tensor_names=[name],
+                        tensor_sizes=sizes)
+
+    # -- fusion ---------------------------------------------------------------
+
+    def _fuse(self, responses: List[Response]) -> List[Response]:
+        """Greedily join adjacent ALLREDUCE responses of identical dtype up
+        to the fusion threshold (reference lookahead loop
+        ``operations.cc:2154-2266``; only allreduces are buffer-fused)."""
+        fused: List[Response] = []
+        i = 0
+        while i < len(responses):
+            resp = responses[i]
+            if resp.response_type != ResponseType.ALLREDUCE:
+                fused.append(resp)
+                i += 1
+                continue
+            batch = Response(ResponseType.ALLREDUCE,
+                             tensor_names=list(resp.tensor_names))
+            batch._meta = resp._meta  # type: ignore[attr-defined]
+            dtype = self._resp_dtype(resp)
+            total = self._resp_bytes(resp)
+            j = i + 1
+            while j < len(responses):
+                nxt = responses[j]
+                if nxt.response_type != ResponseType.ALLREDUCE or \
+                        self._resp_dtype(nxt) != dtype:
+                    break
+                nbytes = self._resp_bytes(nxt)
+                if total + nbytes > self._fusion_threshold:
+                    break
+                batch.tensor_names.extend(nxt.tensor_names)
+                total += nbytes
+                j += 1
+            fused.append(batch)
+            i = j
+        return fused
+
+    def _resp_dtype(self, resp: Response) -> DataType:
+        return resp._meta.tensor_type  # type: ignore[attr-defined]
+
+    def _resp_bytes(self, resp: Response) -> int:
+        return _nbytes(resp._meta)  # type: ignore[attr-defined]
+
+    # -- stall detection ------------------------------------------------------
+
+    def _maybe_check_stalls(self) -> None:
+        """WARN about tensors some ranks submitted >stall_warning_s ago
+        that other ranks never did (``CheckForStalledTensors``,
+        ``operations.cc:1625-1672``)."""
+        if self._stall_check_disable:
+            return
+        now = time.monotonic()
+        if now - self._last_stall_check < self._stall_warning_s:
+            return
+        self._last_stall_check = now
+        for name, entry in self._table.items():
+            if now - entry.first_seen <= self._stall_warning_s:
+                continue
+            missing = sorted(set(range(self._size)) - set(entry.requests))
+            ready = sorted(entry.requests)
+            LOG.warning(
+                "One or more tensors were submitted to be reduced, gathered "
+                "or broadcasted by subset of ranks and are waiting for "
+                "remainder of ranks for more than %d seconds. This may "
+                "indicate that different ranks are trying to submit "
+                "different tensors or that only subset of ranks is "
+                "submitting tensors, which will cause deadlock. Stalled ops: "
+                "%s [missing ranks: %s] [ready ranks: %s]",
+                int(self._stall_warning_s), name,
+                ", ".join(map(str, missing)), ", ".join(map(str, ready)))
+
+
+def numpy_dtype(dt: DataType):
+    """Wire DataType → numpy dtype; bfloat16 comes from ml_dtypes (the same
+    library JAX itself uses for host-side bf16 arrays)."""
+    import ml_dtypes
+
+    return {
+        DataType.UINT8: np.uint8, DataType.INT8: np.int8,
+        DataType.UINT16: np.uint16, DataType.INT16: np.int16,
+        DataType.INT32: np.int32, DataType.INT64: np.int64,
+        DataType.FLOAT16: np.float16, DataType.FLOAT32: np.float32,
+        DataType.FLOAT64: np.float64, DataType.BOOL: np.bool_,
+        DataType.BFLOAT16: ml_dtypes.bfloat16,
+    }[dt]
+
+
+class _Rendezvous:
+    """Collect one submission per rank for a key, compute a single result,
+    deliver it to every rank. This is the TCP stand-in for the reference's
+    MPI_Gather(+Gatherv) / MPI_Bcast pair that moves Request/ResponseLists
+    each cycle (``operations.cc:2088-2134``, ``:2281-2287``)."""
+
+    def __init__(self, size: int) -> None:
+        self._size = size
+        self._cond = threading.Condition()
+        self._slots: Dict[Any, Dict[int, Any]] = {}
+        self._results: Dict[Any, Any] = {}
+        self._delivered: Dict[Any, int] = {}
+
+    def submit(self, key: Any, rank: int, item: Any,
+               compute: Callable[[Dict[int, Any]], Any]) -> Any:
+        with self._cond:
+            slot = self._slots.setdefault(key, {})
+            slot[rank] = item
+            if len(slot) == self._size:
+                # A compute failure must poison the result for every waiting
+                # rank — swallowing it in one handler thread would leave the
+                # others blocked forever.
+                try:
+                    self._results[key] = ("ok", compute(slot))
+                except Exception as exc:  # noqa: BLE001
+                    self._results[key] = ("error", exc)
+                self._delivered[key] = 0
+                self._cond.notify_all()
+            else:
+                self._cond.wait_for(lambda: key in self._results)
+            kind, result = self._results[key]
+            self._delivered[key] += 1
+            if self._delivered[key] == self._size:
+                del self._slots[key], self._results[key], self._delivered[key]
+            if kind == "error":
+                raise RuntimeError(
+                    f"coordinator-side collective failure: {result}") \
+                    from result
+            return result
+
+
+class ControllerService:
+    """Rank-0 TCP controller: cycle negotiation + host-mode payload exchange.
+
+    Requests on the wire:
+      ("cycle", rank, RequestList)            -> ResponseList
+      ("payload", rank, cycle_no, idx, bytes) -> result bytes
+    Every rank (including rank 0's own engine, via loopback — the reference's
+    coordinator likewise participates in its own MPI_Gather) drives one
+    request at a time over a persistent connection, so cycles stay lockstep.
+    """
+
+    def __init__(self, size: int, negotiator: Negotiator,
+                 secret: Optional[bytes] = None, port: int = 0,
+                 bind_host: str = "127.0.0.1") -> None:
+        self._negotiator = negotiator
+        self._cycles = _Rendezvous(size)
+        self._payloads = _Rendezvous(size)
+        self._cycle_no = 0
+        self._history: Dict[int, ResponseList] = {}
+        self._lock = threading.Lock()
+        self._service = BasicService(
+            "horovod-controller", self._handle, secret=secret, port=port,
+            bind_host=bind_host)
+        self.port = self._service.port
+
+    def _handle(self, req: Any, _sock: Any) -> Any:
+        kind = req[0]
+        if kind == "cycle":
+            _, rank, request_list = req
+            return self._cycles.submit(
+                ("cycle", self._current_cycle(rank)), rank, request_list,
+                self._run_cycle)
+        if kind == "payload":
+            _, rank, cycle_no, idx, data = req
+            resp = self._history[cycle_no].responses[idx]
+            return self._payloads.submit(
+                ("payload", cycle_no, idx), rank, data,
+                lambda slot: _combine(resp, slot))
+        raise ValueError(f"unknown controller request {kind!r}")
+
+    def _current_cycle(self, rank: int) -> int:
+        # Each rank participates in every cycle exactly once, in order; a
+        # per-rank counter keeps the rendezvous keys aligned without a
+        # global clock.
+        with self._lock:
+            counters = getattr(self, "_rank_cycles", None)
+            if counters is None:
+                counters = self._rank_cycles = {}
+            n = counters.get(rank, 0)
+            counters[rank] = n + 1
+            return n
+
+    def _run_cycle(self, slot: Dict[int, RequestList]) -> ResponseList:
+        for rank in sorted(slot):
+            self._negotiator.add_request_list(slot[rank])
+        response_list = self._negotiator.construct_response_list()
+        with self._lock:
+            self._history[self._cycle_no] = response_list
+            # History only needs to survive until the payload exchanges of
+            # that cycle finish; keep a small sliding window.
+            stale = self._cycle_no - 16
+            if stale in self._history:
+                del self._history[stale]
+            self._cycle_no += 1
+        return response_list
+
+    def shutdown(self) -> None:
+        self._service.shutdown()
+
+
+def _combine(resp: Response, slot: Dict[int, bytes]) -> bytes:
+    """Host-mode data plane: the numpy reduction the coordinator applies to
+    the gathered per-rank payloads. Only used for CPU test worlds; the TPU
+    data plane is XLA collectives (SURVEY §2.10: "host fallback via numpy
+    only for tests")."""
+    if resp.response_type == ResponseType.ALLREDUCE:
+        dtype = numpy_dtype(resp._meta.tensor_type)  # type: ignore[attr-defined]
+        total: Optional[np.ndarray] = None
+        for rank in sorted(slot):
+            arr = np.frombuffer(slot[rank], dtype=dtype)
+            total = arr.copy() if total is None else total + arr
+        assert total is not None
+        return total.tobytes()
+    if resp.response_type == ResponseType.ALLGATHER:
+        return b"".join(slot[rank] for rank in sorted(slot))
+    if resp.response_type == ResponseType.BROADCAST:
+        root = resp.tensor_sizes[0]
+        return slot[root]
+    raise ValueError(f"cannot combine payload for {resp.response_type}")
+
+
+class ControllerClient:
+    """Worker-side handle on the controller (one per process)."""
+
+    def __init__(self, addr: Tuple[str, int],
+                 secret: Optional[bytes] = None,
+                 timeout_s: Optional[float] = None,
+                 connect_attempts: int = 100) -> None:
+        # Generous connect window: ranks race the coordinator's service
+        # startup (JAX import time dominates), like orted waiting on the
+        # reference's driver registration (``util/timeout.py``).
+        self._client = BasicClient(addr, secret=secret, timeout_s=timeout_s,
+                                   attempts=connect_attempts)
+        self._cycle_no = 0
+
+    def cycle(self, rank: int, request_list: RequestList) -> ResponseList:
+        out = self._client.request(("cycle", rank, request_list))
+        self._last_cycle = self._cycle_no
+        self._cycle_no += 1
+        return out
+
+    def payload(self, rank: int, response_idx: int, data: bytes) -> bytes:
+        return self._client.request(
+            ("payload", rank, self._last_cycle, response_idx, data))
+
+    def close(self) -> None:
+        self._client.close()

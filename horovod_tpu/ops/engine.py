@@ -1,0 +1,418 @@
+"""Eager collective engine: named-tensor async submission + cycle loop.
+
+Rebuild of the worker half of ``horovod/common/operations.cc``: the
+submission queue + tensor table of ``EnqueueTensorAllreduce/Allgather/
+Broadcast`` (``operations.cc:2472-2591``), the background cycle loop
+``RunLoopOnce`` (``:2030-2380``), op execution ``PerformOperation``
+(``:768-1621``), and the torch-style handle manager
+(``torch/handle_manager.{h,cc}``). Differences by design:
+
+* Tensors are held as host numpy arrays. The eager API exists for Horovod
+  parity and cross-process use; the performance path on TPU is the SPMD
+  ``DistributedOptimizer``/jit route where XLA owns the collectives and none
+  of this machinery runs (SURVEY §7 design stance).
+* The multi-process data plane is the controller's host exchange (numpy over
+  the authenticated TCP wire) — the CPU-world stand-in for MPI. On-device
+  eager collectives across processes ride the same negotiated order; the
+  identical ResponseList on every rank is what makes issuing the same XLA
+  program legal (SURVEY §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import basics
+from ..core import config as _config
+from ..core.logging import LOG
+from ..core.status import SHUT_DOWN_ERROR, Status
+from ..runner.network import default_secret
+from ..utils.timeline import Timeline
+from .controller import (
+    ControllerClient,
+    ControllerService,
+    Negotiator,
+    numpy_dtype,
+)
+from .messages import (
+    OP_NAMES as _OP_NAMES,
+    Request,
+    RequestList,
+    RequestType,
+    Response,
+    ResponseList,
+    ResponseType,
+    dtype_of,
+)
+
+
+@dataclass
+class TensorTableEntry:
+    """In-flight named tensor (``common.h:77-98`` TensorTableEntry)."""
+
+    name: str
+    op: RequestType
+    array: np.ndarray
+    handle: int
+    root_rank: int = -1
+
+
+def _jax_multiprocess() -> bool:
+    try:
+        import jax
+
+        return jax.process_count() > 1
+    except Exception:  # noqa: BLE001 - no jax runtime yet
+        return False
+
+
+# Handle ids are unique across engine generations (an engine can be torn
+# down by shutdown and a fresh one started by re-init); ids must never
+# collide in the API layer's handle→context map.
+_handle_counter = itertools.count()
+
+
+class HandleManager:
+    """Async handles: allocate / mark done / poll / wait
+    (``torch/handle_manager.cc:22-52``). Results carry the numpy output so
+    ``synchronize`` can hand it back to the framework layer. Completed
+    results remain readable after the engine stops — only never-completed
+    entries get flushed with SHUT_DOWN_ERROR."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._done: Dict[int, threading.Event] = {}
+        self._results: Dict[int, tuple] = {}
+
+    def allocate(self) -> int:
+        with self._lock:
+            handle = next(_handle_counter)
+            self._done[handle] = threading.Event()
+            return handle
+
+    # Abandoned handles (fired-and-forgotten async ops) must not grow the
+    # result table without bound in week-long jobs; evict oldest completed
+    # entries past this many outstanding results.
+    MAX_RETAINED = 1 << 16
+
+    def mark_done(self, handle: int, status: Status,
+                  result: Optional[np.ndarray]) -> None:
+        with self._lock:
+            self._results[handle] = (status, result)
+            self._done[handle].set()
+            while len(self._results) > self.MAX_RETAINED:
+                oldest = next(iter(self._results))
+                del self._results[oldest]
+                self._done.pop(oldest, None)
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            event = self._done.get(handle)
+        if event is None:
+            raise ValueError(f"unknown handle {handle}")
+        return event.is_set()
+
+    def wait(self, handle: int, timeout: Optional[float] = None):
+        with self._lock:
+            event = self._done.get(handle)
+        if event is None:
+            raise ValueError(f"unknown handle {handle}")
+        if not event.wait(timeout):
+            raise TimeoutError(f"collective handle {handle} did not complete")
+        with self._lock:
+            status, result = self._results.pop(handle)
+            del self._done[handle]
+        status.raise_if_error()
+        return result
+
+
+class Engine:
+    """One per process; owns the background cycle thread."""
+
+    def __init__(self) -> None:
+        topo = basics._topology()
+        cfg = basics.config()
+        self._rank = topo.rank
+        self._size = topo.size
+        self._cfg = cfg
+        self._lock = threading.Lock()
+        self._submissions: List[TensorTableEntry] = []
+        self._pending: Dict[str, TensorTableEntry] = {}
+        self.handles = HandleManager()
+        self._stop_requested = False
+        self._stopped = threading.Event()
+        self._wake = threading.Event()
+
+        timeline_path = cfg.timeline_path if topo.rank == 0 else ""
+        self.timeline = Timeline(timeline_path, cfg.timeline_mark_cycles)
+
+        self._service: Optional[ControllerService] = None
+        self._client: Optional[ControllerClient] = None
+        self._negotiator: Optional[Negotiator] = None
+        if self._size == 1:
+            self._negotiator = Negotiator(
+                1, cfg.fusion_threshold_bytes,
+                stall_warning_s=cfg.stall_warning_time_s,
+                stall_check_disable=cfg.stall_check_disable)
+        else:
+            if cfg.data_plane == "xla" or (
+                    cfg.data_plane == "auto" and _jax_multiprocess()):
+                # Never silently funnel pod-scale tensors through the host
+                # TCP plane: on a real multi-host runtime the eager data
+                # plane must be device collectives, which are not wired up
+                # yet — fail loudly instead.
+                raise NotImplementedError(
+                    "cross-process device collectives for the eager API are "
+                    "not wired up yet; use the SPMD path (axis_name=...) on "
+                    "pods, or set HOROVOD_DATA_PLANE=host to force the "
+                    "numpy-over-TCP test plane.")
+            secret = default_secret()
+            port = int(os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "0"))
+            addr = os.environ.get(_config.HOROVOD_CONTROLLER_ADDR, "127.0.0.1")
+            if port == 0 and self._rank != 0:
+                raise RuntimeError(
+                    "multi-process world but HOROVOD_CONTROLLER_PORT is not "
+                    "set; the launcher (horovodrun / horovod_tpu.runner) "
+                    "must export the coordinator address to every rank.")
+            if self._rank == 0:
+                negotiator = Negotiator(
+                    self._size, cfg.fusion_threshold_bytes,
+                    stall_warning_s=cfg.stall_warning_time_s,
+                    stall_check_disable=cfg.stall_check_disable)
+                bind_host = os.environ.get(
+                    "HOROVOD_CONTROLLER_BIND", "127.0.0.1")
+                self._service = ControllerService(
+                    self._size, negotiator, secret=secret, port=port,
+                    bind_host=bind_host)
+                port = self._service.port
+            self._client = ControllerClient(
+                (addr, port), secret=secret, timeout_s=None)
+
+        self._thread = threading.Thread(
+            target=self._loop, name="horovod-background", daemon=True)
+        self._thread.start()
+
+    # -- submission (API threads) --------------------------------------------
+
+    def enqueue(self, op: RequestType, array: np.ndarray, name: str,
+                root_rank: int = -1) -> int:
+        """EnqueueTensor* (``operations.cc:2472-2591``): duplicate names are
+        rejected while the previous submission is still in flight, as the
+        reference's tensor_table emplace does."""
+        dtype_of(array)  # validate wire dtype early
+        with self._lock:
+            if self._stop_requested:
+                raise RuntimeError(SHUT_DOWN_ERROR)
+            in_flight = {e.name for e in self._submissions} | set(self._pending)
+            if name in in_flight:
+                raise ValueError(
+                    f"Requested to {_OP_NAMES[op]} a tensor with the same "
+                    f"name as another tensor that is currently being "
+                    f"processed: {name}. Synchronize the outstanding handle "
+                    f"first or pass a unique name.")
+            handle = self.handles.allocate()
+            entry = TensorTableEntry(name=name, op=op, array=array,
+                                     handle=handle, root_rank=root_rank)
+            self._submissions.append(entry)
+        self.timeline.negotiate_start(name, _OP_NAMES[op])
+        # No wake: submissions ride the next cycle tick, preserving the
+        # reference's fusion window (HOROVOD_CYCLE_TIME batches arrivals,
+        # ``operations.cc:2030-2060``). Only shutdown wakes the loop early.
+        return handle
+
+    # -- background loop ------------------------------------------------------
+
+    def _loop(self) -> None:
+        cycle_s = max(self._cfg.cycle_time_ms, 0.1) / 1000.0
+        try:
+            while True:
+                self._wake.wait(timeout=cycle_s)
+                self._wake.clear()
+                self.timeline.mark_cycle_start()
+                stop = self._stop_requested
+                with self._lock:
+                    new_entries, self._submissions = self._submissions, []
+                    for entry in new_entries:
+                        self._pending[entry.name] = entry
+                requests = [self._request_of(e) for e in new_entries]
+                request_list = RequestList(
+                    rank=self._rank, requests=requests, shutdown=stop)
+                if self._negotiator is not None:
+                    self._negotiator.add_request_list(request_list)
+                    response_list = self._negotiator.construct_response_list()
+                else:
+                    assert self._client is not None
+                    response_list = self._client.cycle(self._rank, request_list)
+                for idx, resp in enumerate(response_list.responses):
+                    self._execute(idx, resp)
+                if response_list.shutdown:
+                    break
+        except Exception as exc:  # noqa: BLE001 - propagate to handles
+            LOG.error("background loop failed: %s", exc)
+            self._flush_outstanding(Status.unknown_error(str(exc)))
+        finally:
+            self._flush_outstanding(Status.unknown_error(SHUT_DOWN_ERROR))
+            if self._client is not None:
+                self._client.close()
+            if self._service is not None:
+                self._service.shutdown()
+            self.timeline.close()
+            self._stopped.set()
+
+    def _request_of(self, entry: TensorTableEntry) -> Request:
+        return Request(
+            request_rank=self._rank,
+            request_type=entry.op,
+            tensor_name=entry.name,
+            tensor_type=dtype_of(entry.array),
+            tensor_shape=tuple(entry.array.shape),
+            root_rank=entry.root_rank,
+        )
+
+    def _flush_outstanding(self, status: Status) -> None:
+        """All outstanding callbacks error out on shutdown
+        (``operations.cc:1942-1957``)."""
+        with self._lock:
+            entries = list(self._pending.values()) + self._submissions
+            self._pending.clear()
+            self._submissions = []
+        for entry in entries:
+            self.handles.mark_done(entry.handle, status, None)
+
+    # -- execution ------------------------------------------------------------
+
+    def _execute(self, idx: int, resp: Response) -> None:
+        """PerformOperation (``operations.cc:768-1621``) for one response,
+        possibly a fused allreduce batch."""
+        with self._lock:
+            entries = [self._pending.pop(n) for n in resp.tensor_names]
+        tl = self.timeline
+        for entry in entries:
+            tl.negotiate_end(entry.name)
+
+        if resp.response_type == ResponseType.ERROR:
+            status = Status.precondition_error(resp.error_message)
+            for entry in entries:
+                self.handles.mark_done(entry.handle, status, None)
+            return
+
+        op_name = _OP_NAMES[entries[0].op]
+        for entry in entries:
+            tl.start(entry.name, op_name)
+        try:
+            if resp.response_type == ResponseType.ALLREDUCE:
+                results = self._run_allreduce(idx, entries)
+            elif resp.response_type == ResponseType.ALLGATHER:
+                results = self._run_allgather(idx, entries[0], resp)
+            else:
+                results = self._run_broadcast(idx, entries[0], resp)
+            for entry, result in zip(entries, results):
+                tl.end(entry.name, shape=result.shape)
+                self.handles.mark_done(entry.handle, Status.ok(), result)
+        except Exception as exc:  # noqa: BLE001
+            for entry in entries:
+                tl.end(entry.name)
+                self.handles.mark_done(
+                    entry.handle, Status.unknown_error(str(exc)), None)
+
+    def _run_allreduce(self, idx: int,
+                       entries: List[TensorTableEntry]) -> List[np.ndarray]:
+        fused = len(entries) > 1
+        tl = self.timeline
+        if fused:
+            for e in entries:
+                tl.activity_start(e.name, "MEMCPY_IN_FUSION_BUFFER")
+            buf = np.concatenate([e.array.ravel() for e in entries])
+            for e in entries:
+                tl.activity_end(e.name)
+        else:
+            buf = entries[0].array.ravel()
+        for e in entries:
+            tl.activity_start(e.name, "EXECUTE")
+        if self._client is None:
+            # world of one: sum over a single rank. Copy so results never
+            # alias the caller's input array.
+            out = np.array(buf, copy=True)
+        else:
+            raw = self._client.payload(self._rank, idx,
+                                       np.ascontiguousarray(buf).tobytes())
+            out = np.frombuffer(raw, dtype=buf.dtype).copy()  # writable
+        for e in entries:
+            tl.activity_end(e.name)
+        results = []
+        offset = 0
+        if fused:
+            for e in entries:
+                tl.activity_start(e.name, "MEMCPY_OUT_FUSION_BUFFER")
+        for e in entries:
+            n = e.array.size
+            results.append(out[offset:offset + n].reshape(e.array.shape))
+            offset += n
+        if fused:
+            for e in entries:
+                tl.activity_end(e.name)
+        return results
+
+    def _run_allgather(self, idx: int, entry: TensorTableEntry,
+                       resp: Response) -> List[np.ndarray]:
+        if self._client is None:
+            return [entry.array.copy()]
+        raw = self._client.payload(
+            self._rank, idx, np.ascontiguousarray(entry.array).tobytes())
+        total_first = sum(resp.tensor_sizes)
+        shape = (total_first,) + tuple(entry.array.shape[1:])
+        return [np.frombuffer(raw, dtype=entry.array.dtype)
+                .reshape(shape).copy()]
+
+    def _run_broadcast(self, idx: int, entry: TensorTableEntry,
+                       resp: Response) -> List[np.ndarray]:
+        root = resp.tensor_sizes[0]
+        if self._client is None:
+            return [entry.array.copy()]
+        payload = np.ascontiguousarray(entry.array).tobytes() \
+            if self._rank == root else b""
+        raw = self._client.payload(self._rank, idx, payload)
+        return [np.frombuffer(raw, dtype=entry.array.dtype)
+                .reshape(entry.array.shape).copy()]
+
+    # -- shutdown -------------------------------------------------------------
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Coordinated shutdown: the next cycle carries shutdown=True, the
+        coordinator re-broadcasts it, every rank drains
+        (``operations.cc:2065,2125-2128,2150,2374-2376``)."""
+        self._stop_requested = True
+        self._wake.set()
+        self._stopped.wait(timeout)
+
+
+_engine_lock = threading.Lock()
+_engine: Optional[Engine] = None
+
+
+def get_engine() -> Engine:
+    """Lazy singleton start; registers teardown with ``basics.shutdown``."""
+    global _engine
+    with _engine_lock:
+        if _engine is None or _engine._stopped.is_set():
+            basics._topology()  # raises NotInitializedError when appropriate
+            engine = Engine()
+            basics._state().engine_shutdown_hooks.append(
+                lambda: _shutdown_engine(engine))
+            _engine = engine
+        return _engine
+
+
+def _shutdown_engine(engine: Engine) -> None:
+    global _engine
+    engine.stop()
+    with _engine_lock:
+        if _engine is engine:
+            _engine = None

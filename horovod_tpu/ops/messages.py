@@ -1,0 +1,120 @@
+"""Control-plane message types for the eager collective controller.
+
+Rebuild of ``horovod/common/message.{h,cc}`` + ``wire/message.fbs``: a
+``Request`` describes one named tensor a rank wants to reduce/gather/
+broadcast; a ``Response`` tells every rank what to execute (possibly a fused
+batch) or carries a coordinator-constructed error. The reference serializes
+these with FlatBuffers for the MPI wire (``message.fbs:20-101``); our wire is
+the authenticated pickle channel of ``runner.network`` — the message volume
+is names and shapes at cycle frequency, far below where a zero-copy format
+matters, and the payload data plane never goes through these objects.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+
+class DataType(enum.IntEnum):
+    """Wire dtype ids (``message.h:26-37``); bfloat16 added for TPU."""
+
+    UINT8 = 0
+    INT8 = 1
+    UINT16 = 2
+    INT16 = 3
+    INT32 = 4
+    INT64 = 5
+    FLOAT16 = 6
+    FLOAT32 = 7
+    FLOAT64 = 8
+    BOOL = 9
+    BFLOAT16 = 10
+
+
+_NUMPY_NAMES = {
+    "uint8": DataType.UINT8, "int8": DataType.INT8,
+    "uint16": DataType.UINT16, "int16": DataType.INT16,
+    "int32": DataType.INT32, "int64": DataType.INT64,
+    "float16": DataType.FLOAT16, "float32": DataType.FLOAT32,
+    "float64": DataType.FLOAT64, "bool": DataType.BOOL,
+    "bfloat16": DataType.BFLOAT16,
+}
+
+
+def dtype_of(array) -> DataType:
+    name = str(array.dtype)
+    if name not in _NUMPY_NAMES:
+        raise ValueError(f"unsupported tensor dtype {name!r}")
+    return _NUMPY_NAMES[name]
+
+
+class RequestType(enum.IntEnum):
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+
+
+OP_NAMES = {
+    RequestType.ALLREDUCE: "allreduce",
+    RequestType.ALLGATHER: "allgather",
+    RequestType.BROADCAST: "broadcast",
+}
+
+
+class ResponseType(enum.IntEnum):
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    ERROR = 3
+
+
+@dataclass
+class Request:
+    """One rank's intent for one named tensor (``message.h:44-97``)."""
+
+    request_rank: int
+    request_type: RequestType
+    tensor_name: str
+    tensor_type: DataType
+    tensor_shape: Tuple[int, ...]
+    root_rank: int = -1
+    # Device kind string replaces the reference's CUDA device id
+    # (``common.h:109``: CPU_DEVICE_ID=-1); on TPU all eager tensors live on
+    # the process's device set, so this only distinguishes cpu/tpu paths.
+    device: str = "cpu"
+
+
+@dataclass
+class RequestList:
+    """Everything one rank submits in one cycle (``message.h:99-127``)."""
+
+    rank: int
+    requests: List[Request] = field(default_factory=list)
+    shutdown: bool = False
+
+
+@dataclass
+class Response:
+    """Coordinator's instruction to all ranks (``message.h:129-184``).
+
+    ``tensor_names`` holds >1 entry when allreduces were fused into one
+    batch; ``tensor_sizes`` carries per-rank first-dim sizes for allgather
+    (the recvcounts of ``operations.cc:843-927``).
+    """
+
+    response_type: ResponseType
+    tensor_names: List[str] = field(default_factory=list)
+    error_message: str = ""
+    tensor_sizes: List[int] = field(default_factory=list)
+
+
+@dataclass
+class ResponseList:
+    """All responses for one cycle, in execution order; identical on every
+    rank — the property that makes SPMD data-plane execution legal
+    (``message.h:186-214``)."""
+
+    responses: List[Response] = field(default_factory=list)
+    shutdown: bool = False

@@ -1,0 +1,128 @@
+"""In-jit SPMD collectives: the hot data plane.
+
+The reference executes collectives in a background C++ thread with
+MPI/NCCL calls on fused buffers (``horovod/common/operations.cc:768-1621``).
+On TPU, inside a jit-compiled SPMD program there is no negotiation problem —
+every device executes the same program in the same order by construction —
+so the entire controller disappears and the data plane is just XLA
+collectives keyed by mesh axis name. These functions are meant to be called
+inside ``shard_map``/``pjit`` (or any context with a bound axis name) and are
+the building blocks the ``DistributedOptimizer`` uses.
+
+Name/argument surface mirrors the reference op set (allreduce / allgather /
+broadcast, ``operations.h:108-126``) plus ``reducescatter``, which the
+reference only used internally for hierarchical allreduce
+(``operations.cc:1349-1446``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def _axes(axis_name: AxisName) -> tuple:
+    return (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+
+
+def _axis_size(axis_name: AxisName):
+    size = 1
+    for a in _axes(axis_name):
+        size = size * lax.axis_size(a)
+    return size
+
+
+def _vma_tracking_active(axis_name: AxisName) -> bool:
+    """Whether the surrounding trace tracks varying-manual-axes at all.
+
+    With ``check_rep/check_vma=False`` every value reports an empty vma set,
+    which is indistinguishable from "replicated" by type alone — but in that
+    mode shard_map also does NOT auto-psum cotangents, so legacy psum/pmean
+    semantics are the correct ones. Probe: pvary of a fresh scalar carries
+    the axis in its vma type iff tracking is on."""
+    try:
+        probe = lax.pcast(jnp.zeros(()), _axes(axis_name), to="varying")
+        vma = jax.typeof(probe).vma
+    except Exception:  # noqa: BLE001 - any failure → assume legacy tracing
+        return False
+    return all(a in vma for a in _axes(axis_name))
+
+
+def _varies_over(x, axis_name: AxisName) -> bool:
+    """Whether ``x`` is *varying* (per-shard distinct) along the axis.
+
+    Only meaningful when vma tracking is active (see
+    ``_vma_tracking_active``); callers must fall back to legacy collective
+    semantics otherwise."""
+    try:
+        vma = jax.typeof(x).vma
+    except (AttributeError, TypeError):
+        return True
+    return any(a in vma for a in _axes(axis_name))
+
+
+def allreduce(x: jax.Array, axis_name: AxisName, average: bool = True) -> jax.Array:
+    """Sum (or average) across the named mesh axis.
+
+    Reference semantics: allreduce returns the *average* by default on the
+    framework API layer (sum in the core, divide at the edge —
+    ``torch/mpi_ops_v2.cc:66-72``). Here XLA's pmean fuses the divide.
+
+    TPU/JAX subtlety with no reference analog: under shard_map, the
+    cotangent of a *replicated* parameter is already psum-med across the
+    axis by the transpose rule (JAX's varying-axes type system), i.e. the
+    gradient arrives pre-summed and typed as non-varying. Issuing another
+    psum would multiply by the axis size — the classic double-allreduce bug
+    of naive Horovod-on-SPMD ports. We inspect the operand's vma type: a
+    varying value gets the real collective; a non-varying value is treated
+    as already reduced, so "sum" is the identity and "average" is a local
+    divide. A replicated value that was never reduced (e.g. a constant) has
+    sum == size * x under Horovod semantics; write that explicitly as
+    ``x * hvd.num_devices()`` — it is not an allreduce.
+    """
+    if _varies_over(x, axis_name) or not _vma_tracking_active(axis_name):
+        return lax.pmean(x, axis_name) if average else lax.psum(x, axis_name)
+    return x / _axis_size(axis_name) if average else x
+
+
+def allgather(x: jax.Array, axis_name: AxisName) -> jax.Array:
+    """Concatenate along dim 0 across the axis, like the reference allgather
+    (``operations.cc:843-927``: rank-ordered concat on the first dimension).
+
+    Per-rank first-dim sizes must be equal inside a jit program (static
+    shapes); the eager engine handles the ragged case by padding
+    (``ops.engine``), matching the recvcounts/displacements logic of the
+    reference only where shapes are dynamic.
+    """
+    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def broadcast(x: jax.Array, root_rank: int, axis_name: AxisName) -> jax.Array:
+    """Every participant receives root's value.
+
+    Implemented as a masked psum — one collective, no gather of all shards
+    (SURVEY §2.10: "broadcast = psum of masked value"). The reference uses
+    MPI_Bcast / ncclBcast (``operations.cc:1593-1609``).
+    """
+    idx = lax.axis_index(axis_name)
+    contrib = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
+    return lax.psum(contrib, axis_name)
+
+
+def reducescatter(x: jax.Array, axis_name: AxisName, average: bool = False) -> jax.Array:
+    """psum_scatter along dim 0; the ICI analog of the NCCL ReduceScatter
+    stage of hierarchical allreduce (``operations.cc:1349-1380``)."""
+    out = lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+    if average:
+        out = out / lax.axis_size(axis_name)
+    return out
+
+
+def axis_rank(axis_name: AxisName) -> jax.Array:
+    """This shard's index along the axis (device-level 'rank' inside jit)."""
+    return lax.axis_index(axis_name)

@@ -1,0 +1,15 @@
+"""Mesh construction and multi-axis parallelism utilities (SURVEY §2.10)."""
+
+from .mesh import (
+    DATA_AXIS,
+    DCN_AXIS,
+    ICI_AXIS,
+    data_parallel_mesh,
+    hierarchical_mesh,
+    local_mesh,
+)
+
+__all__ = [
+    "DATA_AXIS", "DCN_AXIS", "ICI_AXIS",
+    "data_parallel_mesh", "hierarchical_mesh", "local_mesh",
+]

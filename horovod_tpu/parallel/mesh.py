@@ -1,0 +1,61 @@
+"""Device-mesh construction: the TPU replacement for communicators.
+
+The reference builds three MPI communicators — world, node-local (shared
+memory split), and cross-node (split by local rank)
+(``horovod/common/operations.cc:1728-1797``) — and routes collectives over
+them. On TPU the equivalent structure is a ``jax.sharding.Mesh`` whose axes
+factor the device set the same way:
+
+* 1-D ``('data',)`` mesh over every chip — the plain data-parallel world
+  (analog of MPI_COMM_WORLD).
+* 2-D ``('dcn', 'ici')`` mesh — hosts x local chips. Collectives factored
+  per axis reproduce hierarchical allreduce/allgather (intra-node NCCL +
+  inter-node MPI in the reference, ``operations.cc:1284-1436``): psum along
+  ``ici`` rides the intra-slice interconnect; psum along ``dcn`` crosses the
+  data-center network between slices.
+
+XLA inserts and schedules the actual collectives; nothing here opens a
+socket or owns a stream.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+ICI_AXIS = "ici"
+DCN_AXIS = "dcn"
+
+
+def data_parallel_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over all devices: the MPI_COMM_WORLD analog."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devs, (DATA_AXIS,))
+
+
+def hierarchical_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """(dcn, ici) mesh: hosts x chips-per-host.
+
+    Analog of the local/cross communicator pair
+    (``operations.cc:1760-1797``). In a single-process world the ``dcn``
+    axis has size 1 and every collective stays on ICI.
+    """
+    if devices is not None:
+        devs = list(devices)
+        n_hosts = 1
+        per_host = len(devs)
+    else:
+        devs = jax.devices()
+        n_hosts = jax.process_count()
+        per_host = jax.local_device_count()
+    grid = np.asarray(devs).reshape(n_hosts, per_host)
+    return Mesh(grid, (DCN_AXIS, ICI_AXIS))
+
+
+def local_mesh() -> Mesh:
+    """Mesh over this process's chips only (node-local communicator analog)."""
+    return Mesh(np.asarray(jax.local_devices()), (DATA_AXIS,))

@@ -1,0 +1,201 @@
+"""Authenticated TCP wire + service/client primitives.
+
+Rebuild of ``horovod/spark/util/network.py``: the reference frames every
+message as HMAC-SHA256 digest + 4-byte length + cloudpickle body
+(``network.py:44-78``), serves requests on a ``ThreadingTCPServer`` bound to
+a random port on all interfaces (``network.py:81-141``), and connects with
+retries (``network.py:144-236``). We keep the same design — it is the control
+plane for both the launcher (driver/task services) and the eager collective
+controller — with a plain-pickle body (cloudpickle only where code objects
+must cross, i.e. ``runner.run``'s function shipping).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_LEN = struct.Struct(">Q")
+_DIGEST_BYTES = hashlib.sha256().digest_size
+
+
+class WireError(RuntimeError):
+    pass
+
+
+class RemoteError:
+    """Marker a service writes back when its handler raised; the client
+    re-raises it as a WireError so request() never silently returns one."""
+
+    def __init__(self, message: str) -> None:
+        self.message = message
+
+
+def default_secret() -> bytes:
+    """Per-job HMAC key (``spark/util/secret.py``): the launcher generates a
+    random key and exports it; standalone single-host runs fall back to a
+    fixed development key."""
+    raw = os.environ.get("HOROVOD_SECRET_KEY", "")
+    if raw:
+        return bytes.fromhex(raw)
+    return b"horovod-tpu-insecure-default-key"
+
+
+def make_secret() -> str:
+    return os.urandom(32).hex()
+
+
+class Wire:
+    """HMAC digest + 8-byte big-endian length + pickled body
+    (reference ``Wire``, ``network.py:44-78``)."""
+
+    def __init__(self, secret: Optional[bytes] = None) -> None:
+        self._secret = secret if secret is not None else default_secret()
+
+    def write(self, obj: Any, sock: socket.socket) -> None:
+        body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        digest = hmac.new(self._secret, body, hashlib.sha256).digest()
+        sock.sendall(digest + _LEN.pack(len(body)) + body)
+
+    def read(self, sock: socket.socket) -> Any:
+        header = _read_exact(sock, _DIGEST_BYTES + _LEN.size)
+        digest, (length,) = header[:_DIGEST_BYTES], _LEN.unpack(header[_DIGEST_BYTES:])
+        body = _read_exact(sock, length)
+        expected = hmac.new(self._secret, body, hashlib.sha256).digest()
+        if not hmac.compare_digest(digest, expected):
+            raise WireError("message HMAC mismatch (wrong or missing secret)")
+        return pickle.loads(body)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise WireError("connection closed mid-message")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def local_addresses() -> Dict[str, Tuple[str, int]]:
+    """Hostname-keyed address map; the reference advertises every NIC
+    (``network.py:117-141``). We advertise hostname + loopback."""
+    host = socket.gethostname()
+    addrs = {"lo": "127.0.0.1"}
+    try:
+        addrs["host"] = socket.gethostbyname(host)
+    except OSError:
+        pass
+    return addrs
+
+
+class BasicService:
+    """Threaded TCP request/response server on a random port
+    (reference ``BasicService``, ``network.py:81-141``).
+
+    ``handler(request, connection)`` returns the response object to write
+    back, or ``None`` for one-way requests.
+    """
+
+    def __init__(self, name: str,
+                 handler: Callable[[Any, socket.socket], Any],
+                 secret: Optional[bytes] = None,
+                 port: int = 0,
+                 bind_host: str = "127.0.0.1") -> None:
+        self.name = name
+        # The wire deserializes pickle: loopback-only by default, and a
+        # non-loopback bind demands a real per-job secret — the hardcoded
+        # development key must never authenticate network peers.
+        if bind_host not in ("127.0.0.1", "localhost") and (
+                secret is None or secret == b"horovod-tpu-insecure-default-key"):
+            raise ValueError(
+                f"refusing to bind service {name!r} on {bind_host!r} with "
+                f"the default development secret; export HOROVOD_SECRET_KEY "
+                f"(the launcher does this automatically).")
+        self._wire = Wire(secret)
+        self._handler = handler
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:  # one connection, many requests
+                sock = self.request
+                try:
+                    while True:
+                        req = outer._wire.read(sock)
+                        try:
+                            resp = outer._handler(req, sock)
+                        except Exception as exc:  # noqa: BLE001
+                            resp = RemoteError(f"{type(exc).__name__}: {exc}")
+                        if resp is not None:
+                            outer._wire.write(resp, sock)
+                except (WireError, OSError):
+                    return
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((bind_host, port), _Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name=f"{name}-service",
+            daemon=True)
+        self._thread.start()
+
+    def addresses(self) -> Dict[str, Tuple[str, int]]:
+        return {k: (v, self.port) for k, v in local_addresses().items()}
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class BasicClient:
+    """Persistent client connection with connect retries
+    (reference ``BasicClient``, ``network.py:144-236``)."""
+
+    def __init__(self, addr: Tuple[str, int],
+                 secret: Optional[bytes] = None,
+                 attempts: int = 10,
+                 retry_delay_s: float = 0.3,
+                 timeout_s: Optional[float] = None) -> None:
+        self._wire = Wire(secret)
+        self._lock = threading.Lock()
+        last_err: Optional[Exception] = None
+        for _ in range(attempts):
+            try:
+                self._sock = socket.create_connection(addr, timeout=timeout_s)
+                self._sock.settimeout(timeout_s)
+                break
+            except OSError as exc:
+                last_err = exc
+                time.sleep(retry_delay_s)
+        else:
+            raise WireError(
+                f"unable to connect to service at {addr}: {last_err}")
+
+    def request(self, obj: Any) -> Any:
+        with self._lock:
+            self._wire.write(obj, self._sock)
+            resp = self._wire.read(self._sock)
+        if isinstance(resp, RemoteError):
+            raise WireError(f"service-side failure: {resp.message}")
+        return resp
+
+    def send(self, obj: Any) -> None:
+        with self._lock:
+            self._wire.write(obj, self._sock)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

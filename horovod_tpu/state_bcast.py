@@ -1,0 +1,98 @@
+"""Parameter / optimizer-state broadcast: consistent (re)starts.
+
+Rebuild of ``horovod/torch/__init__.py:200-348`` (``broadcast_parameters``,
+``broadcast_optimizer_state`` with its scalar→tensor wrapping) and the
+TF-side ``broadcast_variables``/``BroadcastGlobalVariablesHook``
+(``tensorflow/__init__.py:95-148``). The reference's contribution to
+checkpoint/resume is exactly this: push rank 0's state to every rank after
+init or checkpoint restore (SURVEY §5.4); checkpoint *storage* is the
+framework's job (orbax, here).
+
+Works on arbitrary pytrees. Python scalars (ints/floats, e.g. optax step
+counts or hyperparameters captured in state) are wrapped as 0-d arrays for
+the wire and unwrapped to their original type on return — the reference does
+the same dance for torch optimizer hyperparameters
+(``torch/__init__.py:262-310``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import basics, ops
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    """Broadcast an arbitrary picklable object via a uint8 tensor.
+
+    (Horovod grew ``broadcast_object`` in later versions; the 0.16 reference
+    inlines the same pickle-to-tensor trick for optimizer state defaults —
+    ``torch/__init__.py:313-326``.)"""
+    import pickle
+
+    name = name or "broadcast_object"
+    if basics.size() == 1:
+        return obj
+    # Only root contributes bytes; everyone else submits an empty chunk, so
+    # the ragged allgather (coordinator tensor_sizes) moves exactly one copy
+    # of the payload — a broadcast built from allgather, like the reference's
+    # sparse path builds allreduce from two allgathers
+    # (``tensorflow/__init__.py:72-83``).
+    if basics.rank() == root_rank:
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    else:
+        payload = np.zeros(0, dtype=np.uint8)
+    gathered = ops.allgather(payload, name=f"{name}.data")
+    return pickle.loads(np.ascontiguousarray(gathered).tobytes())
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0,
+                         name_prefix: str = "broadcast_parameters") -> Any:
+    """Return the pytree with every array leaf replaced by root's value
+    (``torch/__init__.py:200-229``). Non-array leaves must already agree
+    across ranks and are passed through."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    out = []
+    handles = []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, (int, float, bool, complex)) or leaf is None:
+            handles.append((False, leaf))
+            continue
+        handles.append((True, ops.broadcast_async(
+            leaf, root_rank, name=f"{name_prefix}.{i}")))
+    for is_handle, value in handles:
+        out.append(ops.synchronize(value) if is_handle else value)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
+    """Broadcast optimizer state from root, wrapping scalar leaves as 0-d
+    tensors for the wire (``torch/__init__.py:232-348``)."""
+    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+    out = []
+    for i, leaf in enumerate(leaves):
+        if leaf is None:
+            out.append(leaf)
+            continue
+        scalar_type = None
+        if isinstance(leaf, (bool, int, float)):
+            scalar_type = type(leaf)
+            leaf = np.asarray(leaf)
+        result = ops.broadcast(leaf, root_rank,
+                               name=f"broadcast_optimizer_state.{i}")
+        if scalar_type is not None:
+            result = scalar_type(np.asarray(result).item())
+        out.append(result)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def broadcast_global_variables(root_rank: int = 0, *, variables: Any) -> Any:
+    """TF-parity name (``tensorflow/__init__.py:95-115``); identical to
+    broadcast_parameters on an explicit pytree (JAX has no global variable
+    collection to sweep)."""
+    return broadcast_parameters(variables, root_rank)

@@ -1,0 +1,5 @@
+"""Auxiliary subsystems: timeline tracing, helpers (SURVEY §5)."""
+
+from .timeline import Timeline
+
+__all__ = ["Timeline"]

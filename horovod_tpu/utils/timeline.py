@@ -1,0 +1,126 @@
+"""Horovod Timeline: Chrome-tracing JSON of the eager collective lifecycle.
+
+Rebuild of ``horovod/common/timeline.{h,cc}`` (SURVEY §5.1). Same artifact and
+phase vocabulary: per-tensor NEGOTIATE_<OP> span while ranks agree, <OP>
+top-level span while the collective runs, nested activity spans
+(MEMCPY_IN_FUSION_BUFFER / EXECUTE / MEMCPY_OUT_FUSION_BUFFER), and optional
+CYCLE_START instants (``HOROVOD_TIMELINE_MARK_CYCLES``). Same concurrency
+design: the hot path only enqueues records; a dedicated writer thread owns
+file I/O (the reference uses a boost lock-free SPSC queue feeding
+``TimelineWriter``, ``timeline.h:45-73``; a ``queue.SimpleQueue`` plays that
+role here). Written only where enabled — the engine enables it on rank 0,
+as the reference does (``operations.cc:1825-1829``).
+
+On-device time is not visible from the host path by design; for kernel-level
+traces point ``jax.profiler.start_trace`` at the same run (SURVEY §5.1 TPU
+note).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from typing import Optional
+
+_PHASE_NEGOTIATE = "NEGOTIATE_"
+CYCLE_NAME = "CYCLE_START"
+
+
+class Timeline:
+    """Event producer + background writer. Thread-safe; cheap when disabled."""
+
+    def __init__(self, path: str = "", mark_cycles: bool = False) -> None:
+        self._path = path
+        self._mark_cycles = mark_cycles
+        self._queue: "queue.SimpleQueue[Optional[dict]]" = queue.SimpleQueue()
+        self._tids: dict = {}
+        self._lock = threading.Lock()
+        self._writer: Optional[threading.Thread] = None
+        if path:
+            self._writer = threading.Thread(
+                target=self._write_loop, name="horovod-timeline", daemon=True)
+            self._writer.start()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._path)
+
+    # -- hot-path producers ---------------------------------------------------
+
+    def _ts_us(self) -> float:
+        return time.monotonic_ns() / 1e3
+
+    def _emit(self, record: dict) -> None:
+        if self._path:
+            self._queue.put(record)
+
+    def _tid(self, tensor_name: str) -> int:
+        # The reference gives each tensor its own timeline "thread" row.
+        with self._lock:
+            tid = self._tids.get(tensor_name)
+            if tid is None:
+                tid = len(self._tids) + 1
+                self._tids[tensor_name] = tid
+                self._emit({
+                    "name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+                    "args": {"name": tensor_name},
+                })
+            return tid
+
+    def negotiate_start(self, tensor_name: str, op_name: str) -> None:
+        """Tensor submitted; ranks not yet agreed (``timeline.cc:184-214``)."""
+        self._emit({"name": _PHASE_NEGOTIATE + op_name.upper(), "ph": "B",
+                    "pid": 0, "tid": self._tid(tensor_name),
+                    "ts": self._ts_us()})
+
+    def negotiate_end(self, tensor_name: str) -> None:
+        self._emit({"ph": "E", "pid": 0, "tid": self._tid(tensor_name),
+                    "ts": self._ts_us()})
+
+    def start(self, tensor_name: str, op_name: str) -> None:
+        """Collective execution begins (top-level span, ``timeline.cc:230``)."""
+        self._emit({"name": op_name.upper(), "ph": "B", "pid": 0,
+                    "tid": self._tid(tensor_name), "ts": self._ts_us()})
+
+    def activity_start(self, tensor_name: str, activity: str) -> None:
+        self._emit({"name": activity, "ph": "B", "pid": 0,
+                    "tid": self._tid(tensor_name), "ts": self._ts_us()})
+
+    def activity_end(self, tensor_name: str) -> None:
+        self._emit({"ph": "E", "pid": 0, "tid": self._tid(tensor_name),
+                    "ts": self._ts_us()})
+
+    def end(self, tensor_name: str, shape: Optional[tuple] = None) -> None:
+        args = {"shape": list(shape)} if shape is not None else {}
+        self._emit({"ph": "E", "pid": 0, "tid": self._tid(tensor_name),
+                    "ts": self._ts_us(), "args": args})
+
+    def mark_cycle_start(self) -> None:
+        """Optional cycle instants (``operations.cc:2042-2045``)."""
+        if self._mark_cycles:
+            self._emit({"name": CYCLE_NAME, "ph": "i", "pid": 0, "tid": 0,
+                        "ts": self._ts_us(), "s": "g"})
+
+    # -- writer ---------------------------------------------------------------
+
+    def _write_loop(self) -> None:
+        # Write the real file incrementally so it is inspectable mid-run,
+        # like the reference writer; Chrome tracing tolerates a truncated
+        # array, and close() terminates it properly.
+        with open(self._path, "w", encoding="utf-8") as fh:
+            fh.write("[\n")
+            while True:
+                record = self._queue.get()
+                if record is None:
+                    break
+                fh.write(json.dumps(record) + ",\n")
+                fh.flush()
+            fh.write("{}]\n")
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._queue.put(None)
+            self._writer.join(timeout=5.0)
+            self._writer = None

@@ -1,0 +1,86 @@
+"""Subprocess worker for multi-process eager collective tests.
+
+The analog of running a reference test file under ``mpirun -np N``
+(SURVEY §4): the same assertions, but rank/size/controller address come
+from the launcher env. Exits 0 on success; any assertion error exits
+non-zero and the parent test fails.
+"""
+
+import os
+import sys
+
+# Workers run on CPU with a single device each (one process == one rank,
+# exactly the reference's process model).
+os.environ.pop("JAX_PLATFORMS", None)
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main() -> None:
+    scenario = sys.argv[1]
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    assert size == int(os.environ["HOROVOD_SIZE"])
+    assert rank == int(os.environ["HOROVOD_RANK"])
+
+    if scenario == "allreduce":
+        x = np.full((8, 4), float(rank + 1), dtype=np.float32)
+        out = hvd.allreduce(x, average=False, name="mp.sum")
+        expected = sum(range(1, size + 1))
+        np.testing.assert_array_equal(np.asarray(out), expected)
+        avg = hvd.allreduce(x, average=True, name="mp.avg")
+        np.testing.assert_allclose(np.asarray(avg), expected / size)
+
+    elif scenario == "fused":
+        tensors = [np.full((50,), float(rank + i), np.float32)
+                   for i in range(10)]
+        handles = [hvd.allreduce_async(t, average=False, name=f"mp.fused.{i}")
+                   for i, t in enumerate(tensors)]
+        for i, h in enumerate(handles):
+            out = hvd.synchronize(h)
+            expected = sum(r + i for r in range(size))
+            np.testing.assert_array_equal(np.asarray(out), expected)
+
+    elif scenario == "allgather":
+        # ragged first dims: rank r contributes r+1 rows of value r
+        x = np.full((rank + 1, 3), float(rank), dtype=np.float32)
+        out = np.asarray(hvd.allgather(x, name="mp.gather"))
+        expected = np.concatenate(
+            [np.full((r + 1, 3), float(r), np.float32) for r in range(size)])
+        np.testing.assert_array_equal(out, expected)
+
+    elif scenario == "broadcast":
+        root = size - 1
+        x = np.full((4,), float(rank * 10 + 5), dtype=np.float32)
+        out = np.asarray(hvd.broadcast(x, root_rank=root, name="mp.bcast"))
+        np.testing.assert_array_equal(out, float(root * 10 + 5))
+
+    elif scenario == "mismatch":
+        # rank-dependent shapes must error on ALL ranks
+        # (reference: test_torch.py:270-366)
+        x = np.ones((rank + 2, 2), dtype=np.float32)
+        try:
+            hvd.allreduce(x, name="mp.mismatch")
+        except hvd.HorovodInternalError as exc:
+            assert "Mismatched allreduce tensor shapes" in str(exc)
+        else:
+            raise AssertionError("expected coordinator error on all ranks")
+
+    elif scenario == "object":
+        obj = {"root": "payload", "rank": 0} if rank == 0 else None
+        out = hvd.broadcast_object(obj, root_rank=0)
+        assert out == {"root": "payload", "rank": 0}
+
+    else:
+        raise ValueError(f"unknown scenario {scenario}")
+
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
+    print(f"WORKER-OK {os.environ['HOROVOD_RANK']}")

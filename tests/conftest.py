@@ -1,0 +1,35 @@
+"""Test fixture: a virtual 8-device CPU world.
+
+The reference's fixture is single-process MPI (a self-initialized world of
+size 1) that becomes a true multi-process test under ``mpirun -np N``
+(SURVEY §4). Ours: a single process with 8 virtual XLA CPU devices for SPMD
+collectives, plus subprocess-based launcher tests for true multi-process
+negotiation (``test_multiprocess.py``). Env must be set before jax imports.
+"""
+
+import os
+
+# Force CPU for tests even when the session env points at a real TPU: tests
+# must run on the virtual 8-device mesh and never touch the bench chip. The
+# TPU plugin prepends itself to JAX_PLATFORMS, so the env var alone is not
+# enough — override the config after import, before any backend spins up.
+os.environ.pop("JAX_PLATFORMS", None)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def hvd():
+    import horovod_tpu as hvd_mod
+
+    hvd_mod.init()
+    yield hvd_mod
+    hvd_mod.shutdown()

@@ -1,0 +1,54 @@
+"""Basics: init/rank/size lifecycle (reference: ``test/test_torch.py:59-71``
+rank/size ground truth; ``horovod/common/__init__.py`` error semantics)."""
+
+import pytest
+
+import horovod_tpu as hvd
+
+
+def test_uninitialized_raises():
+    hvd.shutdown()
+    with pytest.raises(ValueError):
+        hvd.rank()
+    with pytest.raises(ValueError):
+        hvd.size()
+
+
+def test_init_rank_size(hvd):
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.local_device_count() == 8  # virtual CPU mesh from conftest
+    assert hvd.num_devices() == 8
+
+
+def test_init_idempotent(hvd):
+    hvd.init()
+    hvd.init()
+    assert hvd.is_initialized()
+    assert hvd.rank() == 0
+
+
+def test_shutdown_and_reinit(hvd):
+    hvd.shutdown()
+    assert not hvd.is_initialized()
+    with pytest.raises(ValueError):
+        hvd.rank()
+    hvd.init()
+    assert hvd.rank() == 0
+
+
+def test_mpi_threads_supported(hvd):
+    # No MPI in this build, by design (SURVEY §2.10).
+    assert hvd.mpi_threads_supported() is False
+
+
+def test_init_rejects_subset_worlds():
+    hvd.shutdown()
+    with pytest.raises(ValueError):
+        hvd.init(ranks=[0, 1])
+    with pytest.raises(ValueError):
+        hvd.init(comm=object())

@@ -1,0 +1,149 @@
+"""Negotiator unit tests: multi-rank coordination logic without processes.
+
+Covers ConstructResponse error semantics (``test_torch.py:270-366``: ranks
+submitting mismatched shapes/dtypes/ops/roots must produce errors on all
+ranks), fusion batching, and allgather size collection — directly against
+the state machine the TCP controller serves.
+"""
+
+import numpy as np
+
+from horovod_tpu.ops.controller import Negotiator
+from horovod_tpu.ops.messages import (
+    DataType,
+    Request,
+    RequestList,
+    RequestType,
+    ResponseType,
+)
+
+
+def _req(rank, name, op=RequestType.ALLREDUCE, dtype=DataType.FLOAT32,
+         shape=(4, 4), root=-1):
+    return Request(request_rank=rank, request_type=op, tensor_name=name,
+                   tensor_type=dtype, tensor_shape=tuple(shape),
+                   root_rank=root)
+
+
+def _negotiate(negotiator, *request_lists):
+    for rl in request_lists:
+        negotiator.add_request_list(rl)
+    return negotiator.construct_response_list()
+
+
+def test_not_ready_until_all_ranks():
+    n = Negotiator(2, 1 << 26)
+    out = _negotiate(n, RequestList(0, [_req(0, "t")]))
+    assert out.responses == []
+    out = _negotiate(n, RequestList(1, [_req(1, "t")]))
+    assert len(out.responses) == 1
+    assert out.responses[0].response_type == ResponseType.ALLREDUCE
+    assert out.responses[0].tensor_names == ["t"]
+
+
+def test_mismatched_shape_error():
+    n = Negotiator(2, 1 << 26)
+    out = _negotiate(
+        n,
+        RequestList(0, [_req(0, "t", shape=(4, 4))]),
+        RequestList(1, [_req(1, "t", shape=(4, 5))]))
+    (resp,) = out.responses
+    assert resp.response_type == ResponseType.ERROR
+    assert "Mismatched allreduce tensor shapes" in resp.error_message
+
+
+def test_mismatched_dtype_error():
+    n = Negotiator(2, 1 << 26)
+    out = _negotiate(
+        n,
+        RequestList(0, [_req(0, "t", dtype=DataType.FLOAT32)]),
+        RequestList(1, [_req(1, "t", dtype=DataType.FLOAT64)]))
+    (resp,) = out.responses
+    assert resp.response_type == ResponseType.ERROR
+    assert "Mismatched data types" in resp.error_message
+
+
+def test_mismatched_op_error():
+    n = Negotiator(2, 1 << 26)
+    out = _negotiate(
+        n,
+        RequestList(0, [_req(0, "t", op=RequestType.ALLREDUCE)]),
+        RequestList(1, [_req(1, "t", op=RequestType.ALLGATHER, shape=(2, 4))]))
+    (resp,) = out.responses
+    assert resp.response_type == ResponseType.ERROR
+    assert "Mismatched collective operations" in resp.error_message
+
+
+def test_broadcast_root_mismatch_error():
+    n = Negotiator(2, 1 << 26)
+    out = _negotiate(
+        n,
+        RequestList(0, [_req(0, "t", op=RequestType.BROADCAST, root=0)]),
+        RequestList(1, [_req(1, "t", op=RequestType.BROADCAST, root=1)]))
+    (resp,) = out.responses
+    assert resp.response_type == ResponseType.ERROR
+    assert "root rank" in resp.error_message
+
+
+def test_allgather_ragged_sizes():
+    n = Negotiator(3, 1 << 26)
+    out = _negotiate(
+        n,
+        RequestList(0, [_req(0, "g", op=RequestType.ALLGATHER, shape=(2, 4))]),
+        RequestList(1, [_req(1, "g", op=RequestType.ALLGATHER, shape=(5, 4))]),
+        RequestList(2, [_req(2, "g", op=RequestType.ALLGATHER, shape=(1, 4))]))
+    (resp,) = out.responses
+    assert resp.response_type == ResponseType.ALLGATHER
+    assert resp.tensor_sizes == [2, 5, 1]  # rank-ordered recvcounts
+
+
+def test_allgather_trailing_dim_mismatch():
+    n = Negotiator(2, 1 << 26)
+    out = _negotiate(
+        n,
+        RequestList(0, [_req(0, "g", op=RequestType.ALLGATHER, shape=(2, 4))]),
+        RequestList(1, [_req(1, "g", op=RequestType.ALLGATHER, shape=(2, 5))]))
+    (resp,) = out.responses
+    assert resp.response_type == ResponseType.ERROR
+    assert "Mismatched allgather tensor shapes" in resp.error_message
+
+
+def test_fusion_batches_same_dtype_under_threshold():
+    # threshold fits exactly two 4x4 f32 tensors (128 bytes)
+    n = Negotiator(1, 128)
+    out = _negotiate(n, RequestList(0, [
+        _req(0, "a"), _req(0, "b"), _req(0, "c"),
+    ]))
+    batches = [r.tensor_names for r in out.responses]
+    assert batches == [["a", "b"], ["c"]]
+
+
+def test_fusion_not_across_dtypes():
+    n = Negotiator(1, 1 << 26)
+    out = _negotiate(n, RequestList(0, [
+        _req(0, "a", dtype=DataType.FLOAT32),
+        _req(0, "b", dtype=DataType.FLOAT64),
+        _req(0, "c", dtype=DataType.FLOAT32),
+    ]))
+    batches = [r.tensor_names for r in out.responses]
+    assert batches == [["a"], ["b"], ["c"]]
+
+
+def test_fusion_not_across_ops():
+    n = Negotiator(1, 1 << 26)
+    out = _negotiate(n, RequestList(0, [
+        _req(0, "a"),
+        _req(0, "g", op=RequestType.ALLGATHER, shape=(2, 2)),
+        _req(0, "b"),
+    ]))
+    types = [r.response_type for r in out.responses]
+    assert types == [ResponseType.ALLREDUCE, ResponseType.ALLGATHER,
+                     ResponseType.ALLREDUCE]
+
+
+def test_shutdown_propagates():
+    n = Negotiator(2, 1 << 26)
+    n.add_request_list(RequestList(0, [], shutdown=True))
+    n.add_request_list(RequestList(1, []))
+    out = n.construct_response_list()
+    assert out.shutdown

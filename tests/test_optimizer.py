@@ -1,0 +1,104 @@
+"""DistributedOptimizer semantics (reference: ``test/test_torch.py`` optimizer
+machinery + ``horovod/torch/__init__.py:65-198``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel import DATA_AXIS, data_parallel_mesh
+
+
+def test_eager_matches_plain_optax(hvd):
+    """Size-1 world: wrapped optimizer must match the inner optimizer."""
+    params = {"w": jnp.ones((3, 3)), "b": jnp.zeros(3)}
+    grads = {"w": jnp.full((3, 3), 0.5), "b": jnp.ones(3)}
+
+    inner = optax.sgd(0.1)
+    dist = hvd.DistributedOptimizer(optax.sgd(0.1))
+
+    s0 = inner.init(params)
+    u0, _ = inner.update(grads, s0, params)
+    s1 = dist.init(params)
+    u1, _ = dist.update(grads, s1, params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b)),
+        u0, u1)
+
+
+def test_spmd_grad_averaging(hvd):
+    """Per-shard gradients differ; updates must equal mean-gradient SGD."""
+    mesh = data_parallel_mesh()
+    dist = hvd.DistributedOptimizer(optax.sgd(1.0), axis_name=DATA_AXIS)
+    grads_per_shard = jnp.arange(8.0, dtype=jnp.float32)  # shard i -> grad i
+
+    def step(g):
+        params = jnp.zeros(())
+        state = dist.init(params)
+        updates, _ = dist.update(g[0], state, params)
+        return updates
+
+    out = jax.jit(shard_map(step, mesh=mesh, in_specs=P(DATA_AXIS),
+                            out_specs=P()))(grads_per_shard)
+    np.testing.assert_allclose(np.asarray(out), -3.5)  # -mean(0..7)
+
+
+def test_backward_passes_per_step_eager(hvd):
+    """Delay-counter accumulation (``torch/__init__.py:71-73,114-130``):
+    no update for N-1 passes, then one update from the accumulated grads."""
+    dist = hvd.DistributedOptimizer(optax.sgd(1.0), backward_passes_per_step=2)
+    params = jnp.zeros(3)
+    state = dist.init(params)
+    g = jnp.ones(3)
+
+    u1, state = dist.update(g, state, params)
+    np.testing.assert_array_equal(np.asarray(u1), 0.0)  # accumulating
+    u2, state = dist.update(g, state, params)
+    np.testing.assert_array_equal(np.asarray(u2), -2.0)  # sum of 2 passes
+    u3, state = dist.update(g, state, params)
+    np.testing.assert_array_equal(np.asarray(u3), 0.0)  # counter reset
+
+
+def test_allreduce_gradients_tree(hvd):
+    grads = {"a": np.ones(4, np.float32), "b": np.full((2, 2), 3.0, np.float32)}
+    out = hvd.allreduce_gradients(grads)
+    np.testing.assert_array_equal(np.asarray(out["a"]), grads["a"])
+    np.testing.assert_array_equal(np.asarray(out["b"]), grads["b"])
+
+
+def test_end_to_end_train_step_spmd(hvd):
+    """Minimum end-to-end slice (SURVEY §7 step 4): data-parallel train step
+    over the 8-device mesh with a tiny MLP; loss must decrease and params
+    must stay replica-identical."""
+    mesh = data_parallel_mesh()
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name=DATA_AXIS)
+
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (4, 1)) * 0.1
+    xs = jax.random.normal(jax.random.PRNGKey(1), (32, 4))
+    ys = xs @ jnp.array([[1.0], [-2.0], [0.5], [3.0]])
+
+    def loss_fn(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    def train_step(w, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(w, x, y)
+        updates, opt_state = opt.update(grads, opt_state, w)
+        # metric averaging across replicas, like MetricAverageCallback
+        loss = jax.lax.pmean(loss, DATA_AXIS)
+        return optax.apply_updates(w, updates), opt_state, loss
+
+    sharded_step = jax.jit(shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(), P())))
+
+    opt_state = opt.init(w)
+    losses = []
+    for _ in range(20):
+        w, opt_state, loss = sharded_step(w, opt_state, xs, ys)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Synthetic benchmark, reproducing the reference measurement protocol.
+
+Reference: ``examples/pytorch_synthetic_benchmark.py:24-110`` — ResNet-50,
+batch 32/device, SGD 0.01, synthetic ImageNet data; 10 warmup batches, then
+``num_iters`` x ``num_batches_per_iter`` timed batches; report img/sec mean
+± 1.96 sigma. Here the training step is the framework's product path: flax
+ResNet-50 (bf16 compute / f32 params), ``hvd.DistributedOptimizer`` over the
+data axis of the device mesh, jit-compiled so gradient averaging is an XLA
+collective on ICI.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": R}
+
+vs_baseline: the reference publishes exactly one absolute throughput figure
+— 1656.82 total img/s for ResNet-101, batch 64/GPU, on 16 Pascal P100s
+(``docs/benchmarks.md:19-38``), i.e. 103.55 img/s per device. That per-device
+figure is the only anchor available (BASELINE.md), so vs_baseline =
+our img/s/device ÷ 103.55 (note: ResNet-50 here vs ResNet-101 there).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_PER_DEVICE_IMG_S = 1656.82 / 16  # docs/benchmarks.md:19-38
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawTextHelpFormatter)
+    parser.add_argument("--model", default="resnet50",
+                        choices=["resnet50", "resnet101"])
+    parser.add_argument("--batch-size", type=int, default=32,
+                        help="batch size per device (reference default 32)")
+    parser.add_argument("--num-warmup-batches", type=int, default=10)
+    parser.add_argument("--num-batches-per-iter", type=int, default=10)
+    parser.add_argument("--num-iters", type=int, default=10)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import ResNet50, ResNet101
+
+    hvd.init()
+    n_dev = hvd.local_device_count()
+    mesh = hvd.parallel.data_parallel_mesh()
+    log = lambda *a: print(*a, file=sys.stderr)  # noqa: E731
+    log(f"Model: {args.model}, batch {args.batch_size}/device, "
+        f"devices: {n_dev} ({jax.devices()[0].platform})")
+
+    model = (ResNet50 if args.model == "resnet50" else ResNet101)(
+        num_classes=1000)
+    global_batch = args.batch_size * n_dev
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.normal(rng, (global_batch, 224, 224, 3), jnp.float32)
+    labels = jax.random.randint(rng, (global_batch,), 0, 1000)
+
+    variables = model.init(jax.random.PRNGKey(1), images[:2])
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01), axis_name="data")
+    opt_state = opt.init(params)
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    def loss_fn(params, batch_stats, x, y):
+        logits, updated = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=True,
+            mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+        return loss, updated["batch_stats"]
+
+    def train_step(params, opt_state, batch_stats, x, y):
+        (_, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, x, y)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        # cross-replica BN statistics averaging (per-replica stats would be
+        # rank-varying; the reference averages metrics the same way)
+        new_stats = jax.tree_util.tree_map(
+            lambda s: jax.lax.pmean(s, "data"), new_stats)
+        return optax.apply_updates(params, updates), opt_state, new_stats
+
+    step = jax.jit(shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P(), P(), P(), P("data"), P("data")),
+        out_specs=(P(), P(), P())),
+        donate_argnums=(0, 1, 2))
+
+    def run_batch():
+        nonlocal params, opt_state, batch_stats
+        params, opt_state, batch_stats = step(
+            params, opt_state, batch_stats, images, labels)
+
+    log(f"Running {args.num_warmup_batches} warmup batches...")
+    for _ in range(args.num_warmup_batches):
+        run_batch()
+    jax.block_until_ready(params)
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            run_batch()
+        jax.block_until_ready(params)
+        dt = time.perf_counter() - t0
+        rate = global_batch * args.num_batches_per_iter / dt
+        img_secs.append(rate)
+        log(f"Iter #{i}: {rate:.1f} img/sec total")
+
+    mean = float(np.mean(img_secs))
+    conf = float(1.96 * np.std(img_secs))
+    per_device = mean / n_dev
+    log(f"Img/sec/device: {per_device:.1f} +- {conf / n_dev:.1f}")
+    log(f"Total img/sec on {n_dev} device(s): {mean:.1f} +- {conf:.1f}")
+
+    print(json.dumps({
+        "metric": f"{args.model}_synthetic_train_images_per_sec_per_device",
+        "value": round(per_device, 2),
+        "unit": "img/s",
+        "vs_baseline": round(per_device / REFERENCE_PER_DEVICE_IMG_S, 3),
+    }))
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,7 @@ Typical use, mirroring the reference README:
     params = hvd.broadcast_parameters(params, root_rank=0)
 """
 
-from . import parallel
+from . import parallel, runner
 from .basics import (
     cross_rank,
     cross_size,

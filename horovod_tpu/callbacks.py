@@ -1,0 +1,206 @@
+"""Training-loop callbacks: metric averaging, LR warmup/schedule, broadcast.
+
+Rebuild of ``horovod/_keras/callbacks.py`` (shared by the keras/tf.keras
+front-ends, SURVEY §2.5). JAX has no Model.fit; these callbacks target the
+explicit training loops JAX users write. Two forms are provided:
+
+* Callback objects with the reference's hook names
+  (``on_train_begin`` / ``on_epoch_end`` / ``on_batch_begin``) driven by a
+  user loop through ``CallbackList`` — a drop-in structural match for code
+  migrating from ``hvd.callbacks.*``.
+* ``warmup_schedule(...)``: the same Goyal et al. gradual-warmup math as
+  ``LearningRateWarmupCallback`` (``_keras/callbacks.py:149-168``) expressed
+  as an optax schedule — the idiomatic JAX form, compiled into the update.
+
+The LR-mutating callbacks require the optimizer be built with
+``optax.inject_hyperparams`` so ``learning_rate`` is a leaf in the optimizer
+state (the analog of Keras's mutable ``optimizer.lr`` the reference pokes).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from . import basics, ops
+from .state_bcast import broadcast_optimizer_state, broadcast_parameters
+
+
+class Callback:
+    """Hook surface (subset of keras.callbacks.Callback the reference uses)."""
+
+    def on_train_begin(self, state: "TrainLoop") -> None: ...
+
+    def on_epoch_begin(self, epoch: int, state: "TrainLoop") -> None: ...
+
+    def on_batch_begin(self, batch: int, state: "TrainLoop") -> None: ...
+
+    def on_epoch_end(self, epoch: int, state: "TrainLoop",
+                     logs: Optional[Dict[str, float]] = None) -> None: ...
+
+
+class TrainLoop:
+    """Minimal mutable loop state the callbacks operate on."""
+
+    def __init__(self, params: Any = None, opt_state: Any = None,
+                 learning_rate: Optional[float] = None) -> None:
+        self.params = params
+        self.opt_state = opt_state
+        self.learning_rate = learning_rate
+        self.epoch = 0
+
+    def set_lr(self, lr: float) -> None:
+        """Update the learning rate in place. Works on a plain float field
+        and, when ``opt_state`` came from ``optax.inject_hyperparams``, on
+        the ``hyperparams['learning_rate']`` leaf."""
+        self.learning_rate = lr
+        hp = getattr(self.opt_state, "hyperparams", None)
+        if hp is not None and "learning_rate" in hp:
+            import jax.numpy as jnp
+
+            hp["learning_rate"] = jnp.asarray(lr, dtype=jnp.float32)
+
+
+class CallbackList:
+    def __init__(self, callbacks: List[Callback]) -> None:
+        self.callbacks = list(callbacks)
+
+    def on_train_begin(self, state: TrainLoop) -> None:
+        for c in self.callbacks:
+            c.on_train_begin(state)
+
+    def on_epoch_begin(self, epoch: int, state: TrainLoop) -> None:
+        state.epoch = epoch
+        for c in self.callbacks:
+            c.on_epoch_begin(epoch, state)
+
+    def on_batch_begin(self, batch: int, state: TrainLoop) -> None:
+        for c in self.callbacks:
+            c.on_batch_begin(batch, state)
+
+    def on_epoch_end(self, epoch: int, state: TrainLoop,
+                     logs: Optional[Dict[str, float]] = None) -> None:
+        for c in self.callbacks:
+            c.on_epoch_end(epoch, state, logs)
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast rank-0 params + optimizer state at train start
+    (``_keras/callbacks.py:20-30``; the consistent-start contract of
+    SURVEY §5.4)."""
+
+    def __init__(self, root_rank: int = 0) -> None:
+        self.root_rank = root_rank
+
+    def on_train_begin(self, state: TrainLoop) -> None:
+        if state.params is not None:
+            state.params = broadcast_parameters(state.params, self.root_rank)
+        if state.opt_state is not None:
+            state.opt_state = broadcast_optimizer_state(
+                state.opt_state, self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch-end metrics over ranks (``_keras/callbacks.py:33-67``).
+    Mutates ``logs`` in place, like the reference mutates Keras logs."""
+
+    def on_epoch_end(self, epoch: int, state: TrainLoop,
+                     logs: Optional[Dict[str, float]] = None) -> None:
+        if not logs or basics.size() == 1:
+            return
+        for key in sorted(logs):
+            value = np.asarray(float(logs[key]), dtype=np.float64)
+            avg = ops.allreduce(value, average=True,
+                                name=f"metric.{key}.epoch{epoch}")
+            logs[key] = float(np.asarray(avg))
+
+
+class LearningRateScheduleCallback(Callback):
+    """LR = initial_lr * multiplier(epoch) within [start_epoch, end_epoch)
+    (``_keras/callbacks.py:70-147``; staircase vs smooth interpolation)."""
+
+    def __init__(self, initial_lr: float,
+                 multiplier: Union[float, Callable[[float], float]],
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True,
+                 steps_per_epoch: Optional[int] = None) -> None:
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+
+    def _in_range(self, epoch: float) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def _adjust(self, epoch: float, state: TrainLoop) -> None:
+        if self._in_range(epoch):
+            state.set_lr(self.initial_lr * self.multiplier(epoch))
+
+    def on_epoch_begin(self, epoch: int, state: TrainLoop) -> None:
+        self.current_epoch = epoch
+        if self.staircase:
+            self._adjust(epoch, state)
+
+    def on_batch_begin(self, batch: int, state: TrainLoop) -> None:
+        if not self.staircase:
+            if self.steps_per_epoch is None:
+                raise ValueError(
+                    "steps_per_epoch is required for smooth (staircase="
+                    "False) schedules, as in the reference.")
+            self._adjust(self.current_epoch + batch / self.steps_per_epoch,
+                         state)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from initial_lr to initial_lr * num_devices over
+    ``warmup_epochs`` (Goyal et al.; ``_keras/callbacks.py:149-168``)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 steps_per_epoch: Optional[int] = None,
+                 target_scale: Optional[float] = None) -> None:
+        self.warmup_epochs = warmup_epochs
+        scale_holder = [target_scale]
+
+        def multiplier(epoch: float) -> float:
+            scale = scale_holder[0]
+            if scale is None:
+                scale = scale_holder[0] = float(basics.num_devices())
+            progress = min(epoch / warmup_epochs, 1.0)
+            return 1.0 + progress * (scale - 1.0)
+
+        super().__init__(initial_lr, multiplier, start_epoch=0,
+                         end_epoch=warmup_epochs + 1, staircase=False,
+                         steps_per_epoch=steps_per_epoch)
+
+
+def warmup_schedule(base_lr: float, steps_per_epoch: int,
+                    warmup_epochs: int = 5,
+                    target_scale: Optional[float] = None,
+                    after: Optional[Callable] = None):
+    """The same warmup as ``LearningRateWarmupCallback`` as an optax
+    schedule (step -> lr), composable with any decay via ``after``."""
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        scale = float(basics.num_devices()) if target_scale is None \
+            else target_scale
+        epoch = step / steps_per_epoch
+        progress = jnp.minimum(epoch / warmup_epochs, 1.0)
+        warm = base_lr * (1.0 + progress * (scale - 1.0))
+        if after is None:
+            return warm
+        return jnp.where(epoch < warmup_epochs, warm,
+                         after(step - warmup_epochs * steps_per_epoch))
+
+    return schedule

@@ -1,0 +1,12 @@
+"""Launcher subsystem: mpirun/Spark-orchestrator replacement (SURVEY §2.6).
+
+* ``horovodrun`` CLI: ``python -m horovod_tpu.runner -np N <cmd>``
+* ``run(fn, np=N)``: ship a function to N ranks, collect per-rank results
+* ``network``: HMAC-authenticated TCP wire shared by the launcher and the
+  eager collective controller
+"""
+
+from .launcher import LaunchError, launch, main
+from .run_api import run
+
+__all__ = ["LaunchError", "launch", "main", "run"]

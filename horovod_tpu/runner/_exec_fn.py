@@ -1,0 +1,59 @@
+"""Per-rank worker entry for ``runner.run``.
+
+Rebuild of ``horovod/spark/task/mpirun_exec_fn.py``: a parent-death watchdog
+thread (``:26-37`` — workers must die with the launcher), fetch the pickled
+fn from the driver, run it, register the result or the exception.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import threading
+import time
+import traceback
+
+import cloudpickle
+
+from .network import BasicClient, default_secret
+from .run_api import _DRIVER_PORT_ENV
+
+
+def _parent_death_watchdog() -> None:
+    """Exit when the launcher dies (reparented to init), like the
+    reference's orphan watchdog."""
+    parent = os.getppid()
+
+    def watch() -> None:
+        while True:
+            if os.getppid() != parent:
+                os._exit(1)
+            time.sleep(0.5)
+
+    threading.Thread(target=watch, name="parent-watchdog",
+                     daemon=True).start()
+
+
+def main() -> int:
+    _parent_death_watchdog()
+    rank = int(os.environ["HOROVOD_RANK"])
+    port = int(os.environ[_DRIVER_PORT_ENV])
+    client = BasicClient(("127.0.0.1", port), secret=default_secret())
+    client.request(("register", rank))
+    _, payload = client.request(("fn",))
+    fn, args, kwargs = cloudpickle.loads(payload)
+    try:
+        result = fn(*args, **kwargs)
+        client.request(("result", rank, True, pickle.dumps(result)))
+        return 0
+    except BaseException:  # noqa: BLE001 - ship the traceback to the driver
+        client.request(("result", rank, False,
+                        pickle.dumps(traceback.format_exc())))
+        return 1
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

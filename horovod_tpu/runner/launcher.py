@@ -1,0 +1,169 @@
+"""Process launcher: the ``mpirun`` replacement.
+
+The reference delegates process launch to ``mpirun`` (docs/running.md) or,
+on Spark clusters, to a driver that herds task services into exec'ing orted
+(``horovod/spark/__init__.py``, SURVEY §3.4). On TPU there is no MPI: this
+launcher spawns one process per rank on the local host with the world
+described in env vars (the role ``OMPI_COMM_WORLD_RANK`` et al. play under
+mpirun), wires every rank to the rank-0 controller port, and generates a
+per-job HMAC secret.
+
+Multi-host TPU pods do not use ssh fan-out: the TPU VM runtime starts one
+process per host running the same program, and ``jax.distributed`` +
+``core.topology`` resolve the world from the pod metadata. This launcher's
+domain is single-host worlds — CPU test rigs and single-host multi-process
+deployments — exactly the niche ``mpirun -np N`` fills on one node.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..core import config as _config
+from .network import make_secret
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def build_rank_env(rank: int, size: int, port: int, secret: str,
+                   base_env: Optional[Dict[str, str]] = None,
+                   host_data_plane: bool = False) -> Dict[str, str]:
+    """Env block one rank needs — the analog of mpirun's exported world."""
+    env = dict(base_env if base_env is not None else os.environ)
+    env.update({
+        _config.HOROVOD_RANK: str(rank),
+        _config.HOROVOD_SIZE: str(size),
+        _config.HOROVOD_LOCAL_RANK: str(rank),
+        _config.HOROVOD_LOCAL_SIZE: str(size),
+        _config.HOROVOD_CROSS_RANK: "0",
+        _config.HOROVOD_CROSS_SIZE: "1",
+        _config.HOROVOD_CONTROLLER_ADDR: "127.0.0.1",
+        _config.HOROVOD_CONTROLLER_PORT: str(port),
+        _config.HOROVOD_SECRET_KEY: secret,
+    })
+    if host_data_plane:
+        env[_config.HOROVOD_DATA_PLANE] = "host"
+    return env
+
+
+class LaunchError(RuntimeError):
+    def __init__(self, rank: int, returncode: int) -> None:
+        super().__init__(
+            f"rank {rank} exited with code {returncode}; terminated "
+            f"remaining ranks.")
+        self.rank = rank
+        self.returncode = returncode
+
+
+def launch(command: Sequence[str], np: int,
+           env_extra: Optional[Dict[str, str]] = None,
+           host_data_plane: bool = False,
+           start_timeout_s: Optional[float] = None) -> int:
+    """Run ``command`` as ``np`` ranks; return 0 or raise LaunchError.
+
+    Failure semantics follow the reference launcher stack: when any rank
+    dies, the rest are terminated (mpirun behavior; also the Spark driver's
+    job-group cancel, ``spark/__init__.py:181-188``), and children die with
+    the launcher via process-group kill
+    (``spark/util/safe_shell_exec.py``)."""
+    if np < 1:
+        raise ValueError("np must be >= 1")
+    port = _free_port()
+    secret = make_secret()
+    procs: List[subprocess.Popen] = []
+    try:
+        for rank in range(np):
+            env = build_rank_env(rank, np, port, secret,
+                                 host_data_plane=host_data_plane)
+            if env_extra:
+                env.update(env_extra)
+            procs.append(subprocess.Popen(
+                list(command), env=env,
+                start_new_session=True))  # own process group for clean kill
+        return _wait_all(procs, start_timeout_s)
+    finally:
+        _terminate_all(procs)
+
+
+def _wait_all(procs: List[subprocess.Popen],
+              timeout_s: Optional[float]) -> int:
+    deadline = time.monotonic() + timeout_s if timeout_s else None
+    remaining = {rank: p for rank, p in enumerate(procs)}
+    while remaining:
+        for rank, proc in list(remaining.items()):
+            code = proc.poll()
+            if code is None:
+                continue
+            del remaining[rank]
+            if code != 0:
+                raise LaunchError(rank, code)
+        if deadline and time.monotonic() > deadline:
+            raise TimeoutError(
+                f"ranks {sorted(remaining)} still running after timeout; "
+                f"terminating. (Increase HOROVOD_START_TIMEOUT or check "
+                f"for a stalled collective — see the stall warning in the "
+                f"rank 0 log.)")
+        time.sleep(0.05)
+    return 0
+
+
+def _terminate_all(procs: List[subprocess.Popen]) -> None:
+    for proc in procs:
+        if proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+    deadline = time.monotonic() + 5.0
+    for proc in procs:
+        while proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``horovodrun`` CLI: ``python -m horovod_tpu.runner -np 4 python x.py``."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="horovodrun",
+        description="Launch a horovod_tpu job: one process per rank on this "
+                    "host (mpirun replacement; TPU pods use one process per "
+                    "host via the TPU VM runtime instead).")
+    parser.add_argument("-np", "--num-proc", type=int, required=True,
+                        help="number of ranks to spawn")
+    parser.add_argument("--host-data-plane", action="store_true",
+                        help="force the numpy-over-TCP eager data plane "
+                             "(CPU test worlds)")
+    parser.add_argument("--start-timeout", type=float, default=None,
+                        help="seconds to wait for ranks before giving up")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="program and args to run per rank")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    try:
+        return launch(args.command, args.num_proc,
+                      host_data_plane=args.host_data_plane,
+                      start_timeout_s=args.start_timeout)
+    except LaunchError as exc:
+        print(f"horovodrun: {exc}", file=sys.stderr)
+        return exc.returncode or 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

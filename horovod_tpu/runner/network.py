@@ -1,4 +1,4 @@
-"""Authenticated TCP wire + service/client primitives.
+"""Authenticated TCP wire + service/client primitives (control plane).
 
 Rebuild of ``horovod/spark/util/network.py``: the reference frames every
 message as HMAC-SHA256 digest + 4-byte length + cloudpickle body

@@ -1,0 +1,151 @@
+"""Programmatic job API: ``run(fn, args=..., np=N) -> [result per rank]``.
+
+Rebuild of the Spark orchestrator's contract (``horovod/spark/__init__.py:80-196``,
+SURVEY §3.4) without Spark: the caller's function is cloudpickled, shipped
+to one worker process per rank over the driver's authenticated TCP service,
+executed with the world initialized (workers call ``hvd.init()`` themselves,
+exactly like reference user fns), and per-rank return values are collected
+back. The driver/task split mirrors ``driver_service.py``/``task_service.py``:
+registration handshake, code distribution, result registration, and
+timeouts with actionable messages (``util/timeout.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Any, List, Optional, Tuple
+
+import cloudpickle
+
+from .launcher import LaunchError, launch
+from .network import BasicService, default_secret, make_secret
+
+_DRIVER_PORT_ENV = "HOROVOD_DRIVER_PORT"
+
+
+def _dumps_by_value(fn, args: Tuple, kwargs: dict) -> bytes:
+    """Serialize the job function *by value*: workers need not import the
+    caller's module — the launcher ships the code, as the reference driver
+    does (code distribution, ``spark/driver/driver_service.py``)."""
+    import sys
+
+    module = sys.modules.get(getattr(fn, "__module__", None) or "")
+    registered = False
+    if module is not None and module.__name__ != "__main__":
+        try:
+            cloudpickle.register_pickle_by_value(module)
+            registered = True
+        except Exception:  # noqa: BLE001 - fall back to by-reference
+            pass
+    try:
+        return cloudpickle.dumps((fn, args, kwargs))
+    finally:
+        if registered:
+            cloudpickle.unregister_pickle_by_value(module)
+
+
+class _Driver:
+    """Registration + code distribution + result collection service."""
+
+    def __init__(self, np: int, fn, args: Tuple, kwargs: dict,
+                 secret: bytes) -> None:
+        self._np = np
+        self._payload = _dumps_by_value(fn, args, kwargs)
+        self._results: dict = {}
+        self._registered: set = set()
+        self._cond = threading.Condition()
+        self._service = BasicService("horovod-driver", self._handle,
+                                     secret=secret)
+        self.port = self._service.port
+
+    def _handle(self, req: Any, _sock) -> Any:
+        kind = req[0]
+        if kind == "register":
+            with self._cond:
+                self._registered.add(req[1])
+                self._cond.notify_all()
+            return ("ok",)
+        if kind == "fn":
+            return ("fn", self._payload)
+        if kind == "result":
+            _, rank, ok, payload = req
+            with self._cond:
+                self._results[rank] = (ok, payload)
+                self._cond.notify_all()
+            return ("ok",)
+        raise ValueError(f"unknown driver request {req[0]!r}")
+
+    def wait_results(self, timeout_s: float,
+                     abort_check=None) -> List[Any]:
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while len(self._results) < self._np:
+                if abort_check is not None:
+                    abort_check()
+                if time.monotonic() > deadline:
+                    missing = sorted(
+                        set(range(self._np)) - set(self._results))
+                    raise TimeoutError(
+                        f"timed out waiting for results from ranks "
+                        f"{missing}. Check worker logs; a rank may have "
+                        f"stalled in a collective (see the coordinator "
+                        f"stall warning).")
+                self._cond.wait(timeout=0.2)
+        out = []
+        for rank in range(self._np):
+            ok, payload = self._results[rank]
+            value = pickle.loads(payload)
+            if not ok:
+                raise RuntimeError(
+                    f"run(fn) failed on rank {rank}: {value}")
+            out.append(value)
+        return out
+
+    def shutdown(self) -> None:
+        self._service.shutdown()
+
+
+def run(fn, args: Tuple = (), kwargs: Optional[dict] = None, np: int = 1,
+        timeout_s: float = 300.0, use_host_data_plane: bool = True) -> List[Any]:
+    """Execute ``fn(*args, **kwargs)`` on ``np`` ranks; return results in
+    rank order (the reference returns the same, ``spark/__init__.py:192-196``)."""
+    import sys
+
+    kwargs = kwargs or {}
+    secret = make_secret()
+    driver = _Driver(np, fn, args, kwargs, bytes.fromhex(secret))
+    try:
+        worker_cmd = [sys.executable, "-m", "horovod_tpu.runner._exec_fn"]
+        env_extra = {_DRIVER_PORT_ENV: str(driver.port),
+                     "HOROVOD_SECRET_KEY": secret}
+        launch_err: List[BaseException] = []
+
+        def _launch() -> None:
+            try:
+                launch(worker_cmd, np, env_extra=env_extra,
+                       host_data_plane=use_host_data_plane)
+            except BaseException as exc:  # noqa: BLE001
+                launch_err.append(exc)
+
+        thread = threading.Thread(target=_launch, daemon=True)
+        thread.start()
+
+        def _abort_on_launch_failure() -> None:
+            # A dead rank means results will never arrive; surface the
+            # launcher's error instead of waiting out the timeout (the
+            # reference cancels the Spark job group the same way,
+            # ``spark/__init__.py:181-188``).
+            if launch_err:
+                raise launch_err[0]
+
+        results = driver.wait_results(timeout_s, _abort_on_launch_failure)
+        thread.join(timeout=30.0)
+        if launch_err:
+            raise launch_err[0]
+        return results
+    finally:
+        driver.shutdown()

@@ -1,0 +1,295 @@
+"""PyTorch front-end: gradient-averaging optimizer wrapper + state broadcast.
+
+Rebuild of ``horovod/torch/__init__.py`` on the TPU-native engine: the
+``_DistributedOptimizer`` registers per-parameter hooks that fire an async
+allreduce as each gradient is produced (``torch/__init__.py:95-130``),
+``synchronize()`` waits and installs the averaged gradients
+(``:132-147``), ``step()`` = synchronize + inner step (``:149-151``), and
+``backward_passes_per_step`` delays the allreduce across N backward passes
+(``:71-73,114-130``). Tensor handoff is zero-copy where torch allows
+(``Tensor.numpy()`` shares memory for CPU tensors); bfloat16 — which numpy
+lacks — goes through an explicit f32 view on the wire.
+
+Per BASELINE.json, gradients are handed to the XLA-compiled fused allreduce
+rather than enqueued as CUDA NCCL ops; in multi-process CPU worlds the host
+plane carries them (the engine decides, ``ops.engine``).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any, Iterator, Optional, Tuple
+
+import numpy as np
+import torch
+
+from .. import basics
+from .. import ops as _ops
+from ..ops.compression import Compression
+
+__all__ = [
+    "DistributedOptimizer",
+    "broadcast_parameters",
+    "broadcast_optimizer_state",
+    "allreduce", "allreduce_async", "allgather", "broadcast",
+    "synchronize", "poll",
+]
+
+
+def _to_numpy(tensor: torch.Tensor) -> Tuple[np.ndarray, Optional[torch.dtype]]:
+    """CPU torch tensor → numpy (shared memory when possible). bfloat16 is
+    widened to f32 for the wire; the caller narrows back."""
+    t = tensor.detach()
+    if t.dtype == torch.bfloat16:
+        return t.float().numpy(), torch.bfloat16
+    return t.numpy(), None
+
+
+def _from_numpy(arr: np.ndarray, narrow_to: Optional[torch.dtype]) -> torch.Tensor:
+    out = torch.from_numpy(np.ascontiguousarray(arr))
+    if narrow_to is not None:
+        out = out.to(narrow_to)
+    return out
+
+
+# -- eager ops on torch tensors ----------------------------------------------
+
+def allreduce_async(tensor: torch.Tensor, average: bool = True,
+                    name: Optional[str] = None,
+                    compression=Compression.none) -> int:
+    arr, narrow = _to_numpy(tensor)
+    handle = _ops.allreduce_async(arr, average=average, name=name,
+                                  compression=compression)
+    _narrow_map[handle] = narrow
+    return handle
+
+
+def allreduce(tensor: torch.Tensor, average: bool = True,
+              name: Optional[str] = None,
+              compression=Compression.none) -> torch.Tensor:
+    return synchronize(allreduce_async(tensor, average, name, compression))
+
+
+def allgather(tensor: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
+    arr, narrow = _to_numpy(tensor)
+    handle = _ops.allgather_async(arr, name=name)
+    _narrow_map[handle] = narrow
+    return synchronize(handle)
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None) -> torch.Tensor:
+    arr, narrow = _to_numpy(tensor)
+    handle = _ops.broadcast_async(arr, root_rank, name=name)
+    _narrow_map[handle] = narrow
+    return synchronize(handle)
+
+
+_narrow_map: dict = {}
+
+
+def poll(handle: int) -> bool:
+    return _ops.poll(handle)
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    narrow = _narrow_map.pop(handle, None)
+    result = _ops.synchronize(handle)
+    return _from_numpy(np.asarray(result), narrow)
+
+
+# -- DistributedOptimizer ------------------------------------------------------
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step) -> None:
+        # These methods are transplanted into a dynamic subclass of the
+        # user's optimizer class (see DistributedOptimizer below), so
+        # zero-arg super() would bind the wrong class cell; the explicit
+        # two-arg form resolves to the wrapped optimizer class, exactly as
+        # the reference does (``torch/__init__.py:66-69``).
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            # fall back to positional names, as the reference warns about
+            # (``torch/__init__.py:77-90``)
+            named_parameters = [
+                (f"allreduce.noname.{i}", v)
+                for param_group in self.param_groups
+                for i, v in enumerate(param_group["params"])]
+        dups = _find_duplicates([name for name, _ in named_parameters])
+        if dups:
+            raise ValueError(
+                f"Parameter names in named_parameters must be unique; "
+                f"found duplicates: {sorted(dups)}")
+        self._parameter_names = {v: name for name, v in named_parameters}
+        self.backward_passes_per_step = backward_passes_per_step
+        self._allreduce_delay = {}
+        self._handles = {}
+        self._grad_accs = []
+        self._requires_update = set()
+        self._synchronized = False
+        self._should_synchronize = True
+        if basics.size() > 1:
+            self._register_hooks()
+
+    def _register_hooks(self) -> None:
+        for param_group in self.param_groups:
+            for p in param_group["params"]:
+                if p.requires_grad:
+                    self._requires_update.add(p)
+                    self._allreduce_delay[p] = self.backward_passes_per_step
+                    p.register_post_accumulate_grad_hook(self._make_hook(p))
+
+    def _allreduce_grad_async(self, p: torch.Tensor) -> int:
+        name = self._parameter_names.get(p)
+        return allreduce_async(p.grad, average=True, name=name,
+                               compression=self._compression)
+
+    def _make_hook(self, p: torch.Tensor):
+        def hook(*ignore):
+            if p in self._handles and self._handles[p] is not None:
+                if self._allreduce_delay[p] <= 0:
+                    raise AssertionError(
+                        "Gradients were computed more than "
+                        "backward_passes_per_step times before call to "
+                        "step(). Increase backward_passes_per_step to "
+                        "accumulate gradients locally.")
+            assert not p.grad.requires_grad
+            assert self._allreduce_delay[p] > 0
+            self._allreduce_delay[p] -= 1
+            if self._allreduce_delay[p] == 0:
+                self._handles[p] = self._allreduce_grad_async(p)
+
+        return hook
+
+    def synchronize(self) -> None:
+        """Wait for all outstanding allreduces and install averaged grads
+        (``torch/__init__.py:132-147``)."""
+        missing = [p for p in self._requires_update if p not in self._handles]
+        for p in missing:
+            # force allreduce of unused grads (reference
+            # ``test_force_allreduce`` semantics): a rank must not skip a
+            # collective other ranks will wait on
+            if p.grad is None:
+                p.grad = p.data.new_zeros(p.shape)
+            self._handles[p] = self._allreduce_grad_async(p)
+        for p, handle in list(self._handles.items()):
+            if handle is None:
+                continue
+            output = synchronize(handle)
+            self._allreduce_delay[p] = self.backward_passes_per_step
+            p.grad.copy_(output.reshape(p.grad.shape))
+        self._handles.clear()
+        self._synchronized = True
+
+    @contextmanager
+    def skip_synchronize(self) -> Iterator[None]:
+        """Let the caller run ``synchronize()`` manually before ``step()``
+        (reference API, ``torch/__init__.py:153-160``)."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if basics.size() > 1 and self._should_synchronize:
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+
+def _find_duplicates(names):
+    seen, dups = set(), set()
+    for n in names:
+        if n in seen:
+            dups.add(n)
+        seen.add(n)
+    return dups
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1):
+    """Wrap a torch optimizer so ``step()`` applies world-averaged gradients
+    (``torch/__init__.py:163-198``: a dynamic subclass of the user's
+    optimizer class, initialized from its param_groups so per-group
+    hyperparameters carry over)."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step)
+
+
+# -- state broadcast -----------------------------------------------------------
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place broadcast of a state_dict or named-parameter iterable
+    (``torch/__init__.py:200-229``)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    if basics.size() == 1:
+        return
+    handles = []
+    for name, p in items:
+        if not isinstance(p, torch.Tensor):
+            continue
+        arr, narrow = _to_numpy(p)
+        h = _ops.broadcast_async(arr, root_rank,
+                                 name=f"broadcast_parameters.{name}")
+        _narrow_map[h] = narrow
+        handles.append((p, h))
+    for p, h in handles:
+        out = synchronize(h)
+        with torch.no_grad():
+            p.copy_(out.reshape(p.shape))
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast optimizer hyperparameters and per-parameter state from
+    root, wrapping scalars as tensors for the wire
+    (``torch/__init__.py:232-348``)."""
+    from ..state_bcast import broadcast_object
+
+    if basics.size() == 1:
+        return
+    state_dict = optimizer.state_dict()
+    # Scalars (step counters, lr, momentum etc.) travel pickled; tensor
+    # state travels as broadcasts. The reference rebuilds scalars with
+    # recursive cast callbacks; pickling preserves types directly.
+    tensors = {}
+    scalars: dict = {"param_groups": state_dict["param_groups"], "state": {}}
+    for pid, pstate in state_dict["state"].items():
+        scalars["state"][pid] = {}
+        for key, value in pstate.items():
+            if isinstance(value, torch.Tensor):
+                tensors[f"{pid}.{key}"] = value
+            else:
+                scalars["state"][pid][key] = value
+    scalars = broadcast_object(scalars, root_rank,
+                               name="broadcast_optimizer_state.meta")
+    for key in sorted(tensors):
+        t = tensors[key]
+        arr, narrow = _to_numpy(t)
+        h = _ops.broadcast_async(arr, root_rank,
+                                 name=f"broadcast_optimizer_state.{key}")
+        _narrow_map[h] = narrow
+        out = synchronize(h)
+        with torch.no_grad():
+            t.copy_(out.reshape(t.shape))
+    for pid, pstate in state_dict["state"].items():
+        for key, value in scalars["state"][pid].items():
+            pstate[key] = value
+    for group, meta in zip(state_dict["param_groups"],
+                           scalars["param_groups"]):
+        for key, value in meta.items():
+            if key != "params":
+                group[key] = value
+    optimizer.load_state_dict(state_dict)

@@ -10,8 +10,13 @@ import os
 import sys
 
 # Workers run on CPU with a single device each (one process == one rank,
-# exactly the reference's process model).
+# exactly the reference's process model). The TPU plugin prepends itself to
+# JAX_PLATFORMS, so pin the platform via config before any backend starts —
+# N worker processes must never contend for the single real chip.
 os.environ.pop("JAX_PLATFORMS", None)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 
@@ -69,6 +74,49 @@ def main() -> None:
             assert "Mismatched allreduce tensor shapes" in str(exc)
         else:
             raise AssertionError("expected coordinator error on all ranks")
+
+    elif scenario == "torch":
+        import torch
+
+        import horovod_tpu.torch as hvd_torch
+
+        torch.manual_seed(1234)  # same init on all ranks
+        model = torch.nn.Linear(4, 2)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=1.0),
+            named_parameters=model.named_parameters())
+        hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+        hvd_torch.broadcast_optimizer_state(opt, root_rank=0)
+        before = {k: v.clone() for k, v in model.state_dict().items()}
+
+        # rank-dependent input -> rank-dependent grads; step must apply the
+        # world-averaged gradient on every rank
+        x = torch.full((8, 4), float(rank + 1))
+        loss = model(x).sum()
+        loss.backward()
+        opt.step()
+
+        # replicate the expected mean gradient locally
+        ref = torch.nn.Linear(4, 2)
+        ref.load_state_dict(before)
+        grads = []
+        for r in range(size):
+            ref.zero_grad()
+            loss_r = ref(torch.full((8, 4), float(r + 1))).sum()
+            loss_r.backward()
+            grads.append([p.grad.clone() for p in ref.parameters()])
+        mean_grads = [sum(gs) / size for gs in zip(*grads)]
+        for p, g, b in zip(model.parameters(), mean_grads,
+                           [before["weight"], before["bias"]]):
+            np.testing.assert_allclose(
+                p.detach().numpy(), (b - 1.0 * g).numpy(), rtol=1e-5)
+
+        # torch eager ops incl. bf16 wire
+        t = torch.full((4,), float(rank), dtype=torch.bfloat16)
+        out = hvd_torch.allreduce(t, average=True, name="mp.torch.bf16")
+        assert out.dtype == torch.bfloat16
+        np.testing.assert_allclose(out.float().numpy(),
+                                   sum(range(size)) / size, rtol=1e-2)
 
     elif scenario == "object":
         obj = {"root": "payload", "rank": 0} if rank == 0 else None

@@ -1,0 +1,84 @@
+"""Launcher tests (reference: ``test/test_spark.py:41-110`` — happy path
+with per-rank results, fast failure on a broken command, failure
+propagation when a rank dies)."""
+
+import os
+import sys
+
+import pytest
+
+from horovod_tpu.runner import LaunchError, launch, run
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "_mp_worker.py")
+
+
+def test_launch_allreduce_world():
+    rc = launch([sys.executable, _WORKER, "allreduce"], np=2,
+                host_data_plane=True)
+    assert rc == 0
+
+
+def test_launch_propagates_rank_failure():
+    with pytest.raises(LaunchError) as excinfo:
+        launch([sys.executable, "-c",
+                "import os, sys; sys.exit(3 if os.environ['HOROVOD_RANK'] == '1' else 0)"],
+               np=2)
+    assert excinfo.value.rank == 1
+    assert excinfo.value.returncode == 3
+
+
+def test_launch_missing_binary_fails_fast():
+    with pytest.raises(FileNotFoundError):
+        launch(["definitely-not-a-real-binary-xyz"], np=2)
+
+
+def _worker_fn(scale):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    out = hvd.allreduce(np.full(3, float(hvd.rank() + 1), np.float32),
+                        average=False, name="runfn.sum")
+    total = float(np.asarray(out)[0])
+    return {"rank": hvd.rank(), "sum": total, "scaled": hvd.rank() * scale}
+
+
+def test_run_fn_collects_rank_results():
+    results = run(_worker_fn, args=(10,), np=2, timeout_s=120.0)
+    assert [r["rank"] for r in results] == [0, 1]
+    assert all(r["sum"] == 3.0 for r in results)  # 1 + 2
+    assert [r["scaled"] for r in results] == [0, 10]
+
+
+def _failing_fn():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+
+    hvd.init()
+    if hvd.rank() == 1:
+        raise RuntimeError("intentional rank failure")
+    return "ok"
+
+
+def test_run_fn_propagates_worker_exception():
+    with pytest.raises((RuntimeError, LaunchError)) as excinfo:
+        run(_failing_fn, np=2, timeout_s=120.0)
+    assert "rank 1" in str(excinfo.value) or "intentional" in str(excinfo.value)
+
+
+def test_horovodrun_cli():
+    import subprocess
+
+    rc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         "--host-data-plane", sys.executable, _WORKER, "broadcast"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert rc.returncode == 0, rc.stderr

@@ -1,0 +1,74 @@
+"""PyTorch front-end (reference: ``test/test_torch.py`` optimizer and op
+tests, run against the TPU-native engine)."""
+
+import numpy as np
+import pytest
+import torch
+
+import horovod_tpu as hvd
+import horovod_tpu.torch as hvd_torch
+
+
+def test_torch_allreduce_roundtrip(hvd):
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    out = hvd_torch.allreduce(t, average=False, name="t.ar")
+    assert isinstance(out, torch.Tensor)
+    np.testing.assert_array_equal(out.numpy(), t.numpy())
+
+
+def test_torch_bf16_roundtrip(hvd):
+    t = torch.ones(4, dtype=torch.bfloat16)
+    out = hvd_torch.allreduce(t, average=True, name="t.bf16")
+    assert out.dtype == torch.bfloat16
+    np.testing.assert_array_equal(out.float().numpy(), 1.0)
+
+
+def test_torch_broadcast_and_allgather(hvd):
+    t = torch.full((3,), 5.0)
+    np.testing.assert_array_equal(
+        hvd_torch.broadcast(t, 0, name="t.b").numpy(), 5.0)
+    np.testing.assert_array_equal(
+        hvd_torch.allgather(t, name="t.g").numpy(), t.numpy())
+
+
+def test_distributed_optimizer_size1_matches_sgd(hvd):
+    torch.manual_seed(0)
+    model = torch.nn.Linear(4, 2)
+    ref = torch.nn.Linear(4, 2)
+    ref.load_state_dict(model.state_dict())
+
+    opt = hvd_torch.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    ref_opt = torch.optim.SGD(ref.parameters(), lr=0.1)
+
+    x = torch.randn(8, 4)
+    model(x).sum().backward()
+    ref(x).sum().backward()
+    opt.step()
+    ref_opt.step()
+    for p, q in zip(model.parameters(), ref.parameters()):
+        np.testing.assert_allclose(p.detach().numpy(), q.detach().numpy(),
+                                   rtol=1e-6)
+
+
+def test_distributed_optimizer_duplicate_names_rejected(hvd):
+    model = torch.nn.Linear(2, 2)
+    with pytest.raises(ValueError, match="unique"):
+        hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=[("same", p) for p in model.parameters()])
+
+
+def test_broadcast_parameters_state_dict(hvd):
+    model = torch.nn.Linear(2, 2)
+    before = {k: v.clone() for k, v in model.state_dict().items()}
+    hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+    for k, v in model.state_dict().items():
+        np.testing.assert_array_equal(v.numpy(), before[k].numpy())
+
+
+def test_torch_multiprocess_world():
+    from test_multiprocess import _run_world
+
+    _run_world("torch", 2, timeout=120.0)

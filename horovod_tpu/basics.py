@@ -73,11 +73,6 @@ def init(ranks=None, comm=None) -> None:
                 "HOROVOD_HIERARCHICAL_* is not wired into the eager engine "
                 "yet; two-level (dcn, ici) collectives are available via "
                 "horovod_tpu.parallel.hierarchical_mesh for SPMD steps.")
-        if _global.config.autotune:
-            LOG.warning(
-                "HOROVOD_AUTOTUNE is not wired up yet; fusion threshold and "
-                "cycle time come from HOROVOD_FUSION_THRESHOLD / "
-                "HOROVOD_CYCLE_TIME.")
         _global.topology = discover()
         _global.initialized = True
         LOG.debug(

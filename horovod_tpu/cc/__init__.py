@@ -1,0 +1,240 @@
+"""ctypes binding for the native core (``libhtpu_core.so``).
+
+The reference binds C++ to Python through per-framework FFI (TF custom op
+loading, torch pybind11/cffi, mxnet ctypes — SURVEY L2/L3). This build has
+one framework-agnostic shared library and one binding mechanism: ctypes on
+an ``extern "C"`` API (pybind11 is not in the image, per the environment
+contract). The library is rebuilt on demand when sources are newer than the
+binary — the role setup.py's extension builders play in the reference.
+
+Exports:
+* ``NativeNegotiator`` — drop-in for ``ops.controller.Negotiator``
+* ``NativeParameterManager`` — GP/Bayesian autotuner (parameter_manager.cc)
+* ``NativeTimelineWriter`` — background-thread trace writer (timeline.cc)
+* ``available()`` — whether the native core loaded
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "build", "libhtpu_core.so")
+_SOURCES = ("negotiator.cc", "autotune.cc", "timeline_writer.cc", "Makefile")
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_error: Optional[str] = None
+
+
+def _build_locked() -> None:
+    """Serialize builds across processes: every rank of a fresh checkout may
+    race into the first build (the launcher spawns them together); an
+    exclusive flock makes one rank build while the rest wait, then re-check."""
+    import fcntl
+
+    os.makedirs(os.path.join(_DIR, "build"), exist_ok=True)
+    lock_path = os.path.join(_DIR, "build", ".build.lock")
+    with open(lock_path, "w", encoding="utf-8") as lock_fh:
+        fcntl.flock(lock_fh, fcntl.LOCK_EX)
+        try:
+            if _needs_build():
+                subprocess.run(["make", "-C", _DIR], check=True,
+                               capture_output=True, text=True, timeout=120)
+        finally:
+            fcntl.flock(lock_fh, fcntl.LOCK_UN)
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(_DIR, src)) > lib_mtime
+        for src in _SOURCES if os.path.exists(os.path.join(_DIR, src)))
+
+
+def _load():
+    global _lib, _load_error
+    with _lib_lock:
+        if _lib is not None or _load_error is not None:
+            return _lib
+        try:
+            if _needs_build():
+                _build_locked()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except (OSError, subprocess.SubprocessError) as exc:
+            _load_error = str(exc)
+            return None
+        _declare(lib)
+        _lib = lib
+        return _lib
+
+
+def _declare(lib) -> None:
+    c = ctypes
+    lib.htpu_negotiator_new.restype = c.c_void_p
+    lib.htpu_negotiator_new.argtypes = [c.c_int, c.c_longlong, c.c_double,
+                                        c.c_int]
+    lib.htpu_negotiator_free.argtypes = [c.c_void_p]
+    lib.htpu_negotiator_add_request.argtypes = [
+        c.c_void_p, c.c_int, c.c_int, c.c_int, c.c_char_p, c.c_int, c.c_int,
+        c.POINTER(c.c_longlong)]
+    lib.htpu_negotiator_shutdown.argtypes = [c.c_void_p]
+    lib.htpu_negotiator_set_fusion_threshold.argtypes = [c.c_void_p,
+                                                         c.c_longlong]
+    lib.htpu_negotiator_construct.restype = c.c_void_p  # manual free
+    lib.htpu_negotiator_construct.argtypes = [c.c_void_p]
+    lib.htpu_free.argtypes = [c.c_void_p]
+
+    lib.htpu_param_manager_new.restype = c.c_void_p
+    lib.htpu_param_manager_new.argtypes = [c.c_double, c.c_double, c.c_int,
+                                           c.c_int]
+    lib.htpu_param_manager_free.argtypes = [c.c_void_p]
+    lib.htpu_param_manager_update.restype = c.c_int
+    lib.htpu_param_manager_update.argtypes = [c.c_void_p, c.c_double,
+                                              c.c_double]
+    for fn in ("fusion_bytes", "cycle_ms", "best_fusion_bytes",
+               "best_cycle_ms", "best_score"):
+        getattr(lib, f"htpu_param_manager_{fn}").restype = c.c_double
+        getattr(lib, f"htpu_param_manager_{fn}").argtypes = [c.c_void_p]
+
+    lib.htpu_timeline_open.restype = c.c_void_p
+    lib.htpu_timeline_open.argtypes = [c.c_char_p]
+    lib.htpu_timeline_write.argtypes = [c.c_void_p, c.c_char_p]
+    lib.htpu_timeline_close.argtypes = [c.c_void_p]
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def load_error() -> Optional[str]:
+    _load()
+    return _load_error
+
+
+class NativeNegotiator:
+    """Same interface as ``ops.controller.Negotiator``, backed by C++."""
+
+    def __init__(self, size: int, fusion_threshold_bytes: int,
+                 stall_warning_s: float = 60.0,
+                 stall_check_disable: bool = False) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native core unavailable: {_load_error}")
+        self._lib = lib
+        self._handle = lib.htpu_negotiator_new(
+            size, fusion_threshold_bytes, stall_warning_s,
+            1 if stall_check_disable else 0)
+
+    def set_fusion_threshold(self, threshold_bytes: int) -> None:
+        self._lib.htpu_negotiator_set_fusion_threshold(
+            self._handle, int(threshold_bytes))
+
+    def add_request_list(self, rl) -> None:
+        if rl.shutdown:
+            self._lib.htpu_negotiator_shutdown(self._handle)
+        for req in rl.requests:
+            dims = (ctypes.c_longlong * len(req.tensor_shape))(
+                *req.tensor_shape)
+            self._lib.htpu_negotiator_add_request(
+                self._handle, req.request_rank, int(req.request_type),
+                int(req.tensor_type), req.tensor_name.encode("utf-8"),
+                req.root_rank, len(req.tensor_shape), dims)
+
+    def construct_response_list(self):
+        from ..core.logging import LOG
+        from .messages_adapter import parse_response_json
+
+        ptr = self._lib.htpu_negotiator_construct(self._handle)
+        try:
+            raw = ctypes.string_at(ptr).decode("utf-8")
+        finally:
+            self._lib.htpu_free(ptr)
+        doc = json.loads(raw)
+        for warning in doc.get("stall_warnings", []):
+            LOG.warning("%s", warning)
+        return parse_response_json(doc)
+
+    def __del__(self) -> None:
+        handle = getattr(self, "_handle", None)
+        if handle and getattr(self, "_lib", None) is not None:
+            self._lib.htpu_negotiator_free(handle)
+            self._handle = None
+
+
+class NativeParameterManager:
+    """GP/Bayesian autotuner over (fusion threshold, cycle time)."""
+
+    def __init__(self, fusion_bytes: float, cycle_ms: float,
+                 fusion_fixed: bool = False, cycle_fixed: bool = False) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native core unavailable: {_load_error}")
+        self._lib = lib
+        self._handle = lib.htpu_param_manager_new(
+            fusion_bytes / (1024.0 * 1024.0), cycle_ms,
+            1 if fusion_fixed else 0, 1 if cycle_fixed else 0)
+
+    def update(self, bytes_processed: float, microseconds: float) -> bool:
+        """Record a sample window; True when the knobs moved."""
+        return bool(self._lib.htpu_param_manager_update(
+            self._handle, bytes_processed, microseconds))
+
+    @property
+    def fusion_threshold_bytes(self) -> int:
+        return int(self._lib.htpu_param_manager_fusion_bytes(self._handle))
+
+    @property
+    def cycle_time_ms(self) -> float:
+        return self._lib.htpu_param_manager_cycle_ms(self._handle)
+
+    @property
+    def best(self) -> dict:
+        return {
+            "fusion_threshold_bytes": int(
+                self._lib.htpu_param_manager_best_fusion_bytes(self._handle)),
+            "cycle_time_ms":
+                self._lib.htpu_param_manager_best_cycle_ms(self._handle),
+            "score_bytes_per_us":
+                self._lib.htpu_param_manager_best_score(self._handle),
+        }
+
+    def __del__(self) -> None:
+        handle = getattr(self, "_handle", None)
+        if handle and getattr(self, "_lib", None) is not None:
+            self._lib.htpu_param_manager_free(handle)
+            self._handle = None
+
+
+class NativeTimelineWriter:
+    """Background-thread trace writer; records are preformatted JSON."""
+
+    def __init__(self, path: str) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native core unavailable: {_load_error}")
+        self._lib = lib
+        self._handle = lib.htpu_timeline_open(path.encode("utf-8"))
+        if not self._handle:
+            raise OSError(f"cannot open timeline file {path!r}")
+
+    def write(self, record: str) -> None:
+        self._lib.htpu_timeline_write(self._handle, record.encode("utf-8"))
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.htpu_timeline_close(self._handle)
+            self._handle = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass

@@ -1,0 +1,324 @@
+// Native autotuner: Gaussian-process surrogate + expected-improvement
+// Bayesian optimization over (fusion threshold, cycle time).
+//
+// TPU-native rebuild of horovod/common/parameter_manager.{h,cc} with
+// optim/gaussian_process.{h,cc} (RBF kernel + Cholesky regression) and
+// optim/bayesian_optimization.{h,cc} (EI acquisition). The reference uses
+// Eigen + LBFGS; this build vendors nothing — the GP works on small dense
+// matrices (tens of samples) with a hand-rolled Cholesky, and the kernel
+// length-scale is fixed rather than LBFGS-optimized (the reference tunes 2
+// parameters over ~dozens of samples; marginal-likelihood optimization
+// buys little at that scale).
+//
+// Scoring protocol matches parameter_manager.cc:145-171: the score of a
+// parameter point is throughput in bytes/microsecond accumulated over a
+// sample window, and each point is scored as the median of several windows
+// before the optimizer moves on.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <vector>
+
+namespace {
+
+// ---- tiny dense linear algebra (row-major) ---------------------------------
+
+using Vec = std::vector<double>;
+using Mat = std::vector<Vec>;
+
+// Cholesky decomposition of a symmetric positive-definite matrix.
+// Returns false if the matrix is not SPD (caller bumps the jitter).
+bool Cholesky(const Mat& a, Mat* l_out) {
+  const size_t n = a.size();
+  Mat l(n, Vec(n, 0.0));
+  for (size_t i = 0; i < n; ++i) {
+    for (size_t j = 0; j <= i; ++j) {
+      double sum = a[i][j];
+      for (size_t k = 0; k < j; ++k) sum -= l[i][k] * l[j][k];
+      if (i == j) {
+        if (sum <= 0.0) return false;
+        l[i][i] = std::sqrt(sum);
+      } else {
+        l[i][j] = sum / l[j][j];
+      }
+    }
+  }
+  *l_out = std::move(l);
+  return true;
+}
+
+Vec CholSolve(const Mat& l, const Vec& b) {
+  const size_t n = l.size();
+  Vec y(n), x(n);
+  for (size_t i = 0; i < n; ++i) {  // forward: L y = b
+    double sum = b[i];
+    for (size_t k = 0; k < i; ++k) sum -= l[i][k] * y[k];
+    y[i] = sum / l[i][i];
+  }
+  for (size_t i = n; i-- > 0;) {  // backward: L^T x = y
+    double sum = y[i];
+    for (size_t k = i + 1; k < n; ++k) sum -= l[k][i] * x[k];
+    x[i] = sum / l[i][i];
+  }
+  return x;
+}
+
+// ---- Gaussian process regressor (RBF kernel) -------------------------------
+// Port of the regressor design in optim/gaussian_process.cc (itself a port
+// of sklearn's GPR): posterior mean/variance at test points given noisy
+// observations, kernel k(a,b) = sf2 * exp(-|a-b|^2 / (2 l^2)).
+
+class GaussianProcess {
+ public:
+  GaussianProcess(double length_scale, double signal_var, double noise_var)
+      : l2_(length_scale * length_scale), sf2_(signal_var), sn2_(noise_var) {}
+
+  void Fit(const Mat& x, const Vec& y) {
+    x_ = x;
+    const size_t n = x.size();
+    Mat k(n, Vec(n));
+    for (size_t i = 0; i < n; ++i)
+      for (size_t j = 0; j < n; ++j) k[i][j] = Kernel(x[i], x[j]);
+    double jitter = sn2_;
+    for (int attempt = 0; attempt < 8; ++attempt) {
+      Mat ky = k;
+      for (size_t i = 0; i < n; ++i) ky[i][i] += jitter;
+      if (Cholesky(ky, &l_)) {
+        alpha_ = CholSolve(l_, y);
+        return;
+      }
+      jitter *= 10.0;
+    }
+    // Degenerate data: fall back to zero-mean prior.
+    alpha_.assign(n, 0.0);
+    l_.assign(n, Vec(n, 0.0));
+    for (size_t i = 0; i < n; ++i) l_[i][i] = 1.0;
+  }
+
+  void Predict(const Vec& xs, double* mean, double* var) const {
+    const size_t n = x_.size();
+    if (n == 0) {
+      *mean = 0.0;
+      *var = sf2_;
+      return;
+    }
+    Vec ks(n);
+    for (size_t i = 0; i < n; ++i) ks[i] = Kernel(xs, x_[i]);
+    double m = 0.0;
+    for (size_t i = 0; i < n; ++i) m += ks[i] * alpha_[i];
+    // var = k(x*,x*) - k*^T (K+sn2 I)^-1 k*  via v = L^-1 k*
+    Vec v(n);
+    for (size_t i = 0; i < n; ++i) {
+      double sum = ks[i];
+      for (size_t k = 0; k < i; ++k) sum -= l_[i][k] * v[k];
+      v[i] = sum / l_[i][i];
+    }
+    double vv = 0.0;
+    for (size_t i = 0; i < n; ++i) vv += v[i] * v[i];
+    *mean = m;
+    *var = std::max(1e-12, sf2_ - vv);
+  }
+
+ private:
+  double Kernel(const Vec& a, const Vec& b) const {
+    double d2 = 0.0;
+    for (size_t i = 0; i < a.size(); ++i) {
+      double d = a[i] - b[i];
+      d2 += d * d;
+    }
+    return sf2_ * std::exp(-d2 / (2.0 * l2_));
+  }
+
+  double l2_, sf2_, sn2_;
+  Mat x_;
+  Mat l_;
+  Vec alpha_;
+};
+
+// ---- Bayesian optimizer (expected improvement) -----------------------------
+// bayesian_optimization.cc: suggest the next test point by maximizing EI
+// over the GP posterior; candidates come from random sampling in the unit
+// box (the reference maximizes with LBFGS restarts; random search over a
+// 2-D box with hundreds of candidates is equivalent in practice).
+
+double NormPdf(double z) {
+  return std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+}
+
+double NormCdf(double z) { return 0.5 * std::erfc(-z / std::sqrt(2.0)); }
+
+class BayesianOptimizer {
+ public:
+  explicit BayesianOptimizer(int dims, unsigned seed = 17)
+      : dims_(dims), gp_(0.25, 1.0, 1e-4), rng_(seed) {}
+
+  void AddSample(const Vec& x, double y) {
+    xs_.push_back(x);
+    ys_raw_.push_back(y);
+  }
+
+  // Next point to test, in the unit box.
+  Vec Suggest() {
+    if (xs_.empty()) return RandomPoint();
+    // normalize scores to zero mean / unit variance for the GP
+    double mu = 0.0, sd = 0.0;
+    for (double y : ys_raw_) mu += y;
+    mu /= ys_raw_.size();
+    for (double y : ys_raw_) sd += (y - mu) * (y - mu);
+    sd = std::sqrt(sd / ys_raw_.size());
+    if (sd < 1e-12) sd = 1.0;
+    Vec ys;
+    ys.reserve(ys_raw_.size());
+    double best = -1e300;
+    for (double y : ys_raw_) {
+      double z = (y - mu) / sd;
+      ys.push_back(z);
+      best = std::max(best, z);
+    }
+    gp_.Fit(xs_, ys);
+
+    Vec best_x = RandomPoint();
+    double best_ei = -1.0;
+    const double xi = 0.01;  // exploration jitter (reference default)
+    for (int c = 0; c < 512; ++c) {
+      Vec cand = RandomPoint();
+      double m, v;
+      gp_.Predict(cand, &m, &v);
+      double s = std::sqrt(v);
+      double z = (m - best - xi) / s;
+      double ei = (m - best - xi) * NormCdf(z) + s * NormPdf(z);
+      if (ei > best_ei) {
+        best_ei = ei;
+        best_x = cand;
+      }
+    }
+    return best_x;
+  }
+
+ private:
+  Vec RandomPoint() {
+    std::uniform_real_distribution<double> u(0.0, 1.0);
+    Vec p(dims_);
+    for (int i = 0; i < dims_; ++i) p[i] = u(rng_);
+    return p;
+  }
+
+  int dims_;
+  GaussianProcess gp_;
+  std::mt19937 rng_;
+  Mat xs_;
+  Vec ys_raw_;
+};
+
+// ---- parameter manager ------------------------------------------------------
+// parameter_manager.cc: knobs = (fusion threshold bytes, cycle time ms),
+// jointly tuned; score = bytes/us over a sample window, median-of-k per
+// point. Knobs explicitly pinned by env are "fixed" and never moved
+// (SetValue(..., fixed=true) pattern, parameter_manager.cc:329-336).
+
+class ParameterManager {
+ public:
+  static constexpr double kMaxFusionMiB = 256.0;
+  static constexpr double kMaxCycleMs = 25.0;
+  static constexpr int kSamplesPerPoint = 5;  // median-of-5 (reference)
+  static constexpr int kWarmups = 3;          // discarded leading windows
+
+  ParameterManager(double fusion_mib, double cycle_ms, bool fusion_fixed,
+                   bool cycle_fixed)
+      : opt_(2),
+        fusion_mib_(fusion_mib),
+        cycle_ms_(cycle_ms),
+        best_fusion_mib_(fusion_mib),
+        best_cycle_ms_(cycle_ms),
+        fusion_fixed_(fusion_fixed),
+        cycle_fixed_(cycle_fixed) {}
+
+  // Record one completed sample window. Returns 1 if parameters changed.
+  int Update(double bytes, double microseconds) {
+    if (fusion_fixed_ && cycle_fixed_) return 0;
+    if (microseconds <= 0.0) return 0;
+    if (warmups_remaining_ > 0) {
+      --warmups_remaining_;
+      return 0;
+    }
+    scores_.push_back(bytes / microseconds);
+    if (static_cast<int>(scores_.size()) < kSamplesPerPoint) return 0;
+    std::sort(scores_.begin(), scores_.end());
+    double median = scores_[scores_.size() / 2];
+    scores_.clear();
+    if (median > best_score_) {
+      best_score_ = median;
+      best_fusion_mib_ = fusion_mib_;
+      best_cycle_ms_ = cycle_ms_;
+    }
+    opt_.AddSample(CurrentPoint(), median);
+    Vec next = opt_.Suggest();
+    if (!fusion_fixed_) fusion_mib_ = std::max(1.0, next[0] * kMaxFusionMiB);
+    if (!cycle_fixed_) cycle_ms_ = std::max(0.5, next[1] * kMaxCycleMs);
+    return 1;
+  }
+
+  double fusion_bytes() const { return fusion_mib_ * 1024.0 * 1024.0; }
+  double cycle_ms() const { return cycle_ms_; }
+  double best_fusion_bytes() const {
+    return best_fusion_mib_ * 1024.0 * 1024.0;
+  }
+  double best_cycle_ms() const { return best_cycle_ms_; }
+  double best_score() const { return best_score_; }
+
+ private:
+  Vec CurrentPoint() const {
+    return {fusion_mib_ / kMaxFusionMiB, cycle_ms_ / kMaxCycleMs};
+  }
+
+  BayesianOptimizer opt_;
+  Vec scores_;
+  double fusion_mib_, cycle_ms_;
+  double best_fusion_mib_, best_cycle_ms_;
+  double best_score_ = -1e300;
+  bool fusion_fixed_, cycle_fixed_;
+  int warmups_remaining_ = kWarmups;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* htpu_param_manager_new(double fusion_mib, double cycle_ms,
+                             int fusion_fixed, int cycle_fixed) {
+  return new ParameterManager(fusion_mib, cycle_ms, fusion_fixed != 0,
+                              cycle_fixed != 0);
+}
+
+void htpu_param_manager_free(void* h) {
+  delete static_cast<ParameterManager*>(h);
+}
+
+int htpu_param_manager_update(void* h, double bytes, double microseconds) {
+  return static_cast<ParameterManager*>(h)->Update(bytes, microseconds);
+}
+
+double htpu_param_manager_fusion_bytes(void* h) {
+  return static_cast<ParameterManager*>(h)->fusion_bytes();
+}
+
+double htpu_param_manager_cycle_ms(void* h) {
+  return static_cast<ParameterManager*>(h)->cycle_ms();
+}
+
+double htpu_param_manager_best_fusion_bytes(void* h) {
+  return static_cast<ParameterManager*>(h)->best_fusion_bytes();
+}
+
+double htpu_param_manager_best_cycle_ms(void* h) {
+  return static_cast<ParameterManager*>(h)->best_cycle_ms();
+}
+
+double htpu_param_manager_best_score(void* h) {
+  return static_cast<ParameterManager*>(h)->best_score();
+}
+
+}  // extern "C"

@@ -1,0 +1,20 @@
+"""JSON → message-object adapter for the native negotiator's wire format."""
+
+from __future__ import annotations
+
+from ..ops.messages import DataType, Response, ResponseList, ResponseType
+
+
+def parse_response_json(doc: dict) -> ResponseList:
+    responses = []
+    for item in doc.get("responses", []):
+        responses.append(Response(
+            response_type=ResponseType(item["type"]),
+            tensor_names=list(item["names"]),
+            error_message=item.get("error", ""),
+            tensor_sizes=list(item.get("sizes", [])),
+            tensor_dtype=DataType(item["dtype"]),
+            payload_bytes=int(item.get("bytes", 0)),
+        ))
+    return ResponseList(responses=responses,
+                        shutdown=bool(doc.get("shutdown", 0)))

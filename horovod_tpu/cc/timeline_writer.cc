@@ -1,0 +1,104 @@
+// Native timeline writer: dedicated I/O thread fed by a producer queue.
+//
+// Rebuild of TimelineWriter in horovod/common/timeline.{h,cc}: the hot path
+// only enqueues records; one background thread owns all file I/O, so
+// submitting a collective never blocks on disk (the reference uses a boost
+// lock-free SPSC queue; a mutex+condvar queue is equivalent at
+// cycle-frequency record rates). Records arrive as preformatted Chrome-trace
+// JSON objects from the Python Timeline producer.
+
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+
+namespace {
+
+class TimelineWriter {
+ public:
+  explicit TimelineWriter(const std::string& path) {
+    file_ = std::fopen(path.c_str(), "w");
+    if (file_ != nullptr) {
+      std::fputs("[\n", file_);
+      thread_ = std::thread(&TimelineWriter::Loop, this);
+    }
+  }
+
+  ~TimelineWriter() { Close(); }
+
+  void Write(const char* record) {
+    if (file_ == nullptr) return;
+    {
+      std::lock_guard<std::mutex> guard(mutex_);
+      queue_.emplace_back(record);
+    }
+    cv_.notify_one();
+  }
+
+  void Close() {
+    if (file_ == nullptr) return;
+    {
+      std::lock_guard<std::mutex> guard(mutex_);
+      closing_ = true;
+    }
+    cv_.notify_one();
+    if (thread_.joinable()) thread_.join();
+    std::fputs("{}]\n", file_);
+    std::fclose(file_);
+    file_ = nullptr;
+  }
+
+  bool ok() const { return file_ != nullptr; }
+
+ private:
+  void Loop() {
+    for (;;) {
+      std::deque<std::string> batch;
+      {
+        std::unique_lock<std::mutex> lock(mutex_);
+        cv_.wait(lock, [&] { return closing_ || !queue_.empty(); });
+        std::swap(batch, queue_);
+        if (batch.empty() && closing_) return;
+      }
+      for (const std::string& record : batch) {
+        std::fputs(record.c_str(), file_);
+        std::fputs(",\n", file_);
+      }
+      std::fflush(file_);
+    }
+  }
+
+  std::FILE* file_ = nullptr;
+  std::thread thread_;
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  std::deque<std::string> queue_;
+  bool closing_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* htpu_timeline_open(const char* path) {
+  TimelineWriter* writer = new TimelineWriter(path);
+  if (!writer->ok()) {
+    delete writer;
+    return nullptr;
+  }
+  return writer;
+}
+
+void htpu_timeline_write(void* handle, const char* record) {
+  static_cast<TimelineWriter*>(handle)->Write(record);
+}
+
+void htpu_timeline_close(void* handle) {
+  TimelineWriter* writer = static_cast<TimelineWriter*>(handle);
+  writer->Close();
+  delete writer;
+}
+
+}  // extern "C"

@@ -95,10 +95,17 @@ class Config:
     autotune_log: str = ""
     start_timeout_s: float = DEFAULT_START_TIMEOUT_S
     data_plane: str = "auto"
+    # An explicitly-set env knob is pinned: the autotuner treats it as fixed
+    # (reference SetValue(..., fixed=true), ``parameter_manager.cc:329-336``).
+    fusion_threshold_explicit: bool = False
+    cycle_time_explicit: bool = False
 
     @staticmethod
     def from_env() -> "Config":
         return Config(
+            fusion_threshold_explicit=bool(
+                os.environ.get(HOROVOD_FUSION_THRESHOLD)),
+            cycle_time_explicit=bool(os.environ.get(HOROVOD_CYCLE_TIME)),
             fusion_threshold_bytes=_env_int(
                 HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES),
             cycle_time_ms=_env_float(HOROVOD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS),

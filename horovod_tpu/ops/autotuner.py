@@ -1,0 +1,89 @@
+"""Autotuner wiring: score cycles, retune fusion threshold + cycle time.
+
+Rebuild of the runtime side of ``horovod/common/parameter_manager.cc``: when
+``HOROVOD_AUTOTUNE=1``, each completed cycle contributes (bytes processed,
+elapsed microseconds); the native GP/Bayesian optimizer
+(``cc/autotune.cc``) scores points as bytes/us (median-of-5 windows) and
+proposes the next (fusion threshold, cycle time) to try. Knobs explicitly
+pinned via env stay fixed. ``HOROVOD_AUTOTUNE_LOG`` appends a CSV of
+parameter/score history (``parameter_manager.cc:255-293``).
+
+Placement differs from the reference by design: the reference tunes on the
+coordinator and broadcasts a Params struct over MPI; here the tuner lives
+wherever the negotiator lives — in-process for size-1 worlds, on the rank-0
+controller service for multi-process worlds, which piggybacks the tuned
+cycle time on the ResponseList (``messages.ResponseList.tuned_cycle_ms``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+from ..core.config import Config
+from ..core.logging import LOG
+
+
+class Autotuner:
+    """Feeds cycle measurements to the native parameter manager and reports
+    knob changes. Returns None from ``record`` until the knobs move."""
+
+    def __init__(self, cfg: Config) -> None:
+        from .. import cc
+
+        if not cc.available():
+            raise RuntimeError(
+                f"HOROVOD_AUTOTUNE=1 requires the native core "
+                f"(horovod_tpu/cc): {cc.load_error()}")
+        self._pm = cc.NativeParameterManager(
+            float(cfg.fusion_threshold_bytes), float(cfg.cycle_time_ms),
+            fusion_fixed=cfg.fusion_threshold_explicit,
+            cycle_fixed=cfg.cycle_time_explicit)
+        self._last_cycle_ts = time.monotonic()
+        self._log = open(cfg.autotune_log, "a", encoding="utf-8") \
+            if cfg.autotune_log else None
+        if self._log is not None:
+            self._log.write("timestamp,fusion_threshold_bytes,cycle_time_ms,"
+                            "bytes,microseconds,score_bytes_per_us\n")
+            self._log.flush()
+
+    def observe_cycle(self, response_list) -> Optional[Tuple[int, float]]:
+        """Score one completed cycle (bytes of non-error responses over the
+        wall time since the previous cycle) and return
+        (fusion_threshold_bytes, cycle_ms) when the optimizer moved the
+        knobs. Exactly one component owns an Autotuner per process — the
+        engine in local worlds, the controller service on rank 0 of
+        multi-process worlds — so the timestamp state lives here."""
+        from .messages import ResponseType
+
+        now = time.monotonic()
+        microseconds = (now - self._last_cycle_ts) * 1e6
+        self._last_cycle_ts = now
+        bytes_processed = sum(
+            r.payload_bytes for r in response_list.responses
+            if r.response_type != ResponseType.ERROR)
+        if bytes_processed <= 0 or microseconds <= 0:
+            return None
+        if self._log is not None:
+            self._log.write(f"{time.time():.3f},"
+                            f"{self._pm.fusion_threshold_bytes},"
+                            f"{self._pm.cycle_time_ms:.3f},"
+                            f"{bytes_processed:.0f},{microseconds:.1f},"
+                            f"{bytes_processed / microseconds:.3f}\n")
+            self._log.flush()
+        if not self._pm.update(bytes_processed, microseconds):
+            return None
+        new_threshold = self._pm.fusion_threshold_bytes
+        new_cycle = self._pm.cycle_time_ms
+        LOG.debug("autotune: fusion_threshold=%d cycle_time=%.2fms",
+                  new_threshold, new_cycle)
+        return new_threshold, new_cycle
+
+    def close(self) -> None:
+        if self._log is not None:
+            self._log.close()
+            self._log = None
+
+    @property
+    def best(self) -> dict:
+        return self._pm.best

@@ -52,6 +52,29 @@ def _nbytes(req: Request) -> int:
     return n
 
 
+def make_negotiator(size: int, cfg) -> "Negotiator":
+    """Prefer the native (C++) negotiation core; fall back to Python.
+
+    The reference's negotiation logic is C++ only (operations.cc); here the
+    two implementations share one behavior contract and one test suite, with
+    ``HOROVOD_NATIVE_CORE=0`` forcing the Python path."""
+    import os
+
+    if os.environ.get("HOROVOD_NATIVE_CORE", "1") != "0":
+        from .. import cc
+
+        if cc.available():
+            return cc.NativeNegotiator(
+                size, cfg.fusion_threshold_bytes,
+                stall_warning_s=cfg.stall_warning_time_s,
+                stall_check_disable=cfg.stall_check_disable)
+        LOG.warning("native core unavailable (%s); using Python negotiator",
+                    cc.load_error())
+    return Negotiator(size, cfg.fusion_threshold_bytes,
+                      stall_warning_s=cfg.stall_warning_time_s,
+                      stall_check_disable=cfg.stall_check_disable)
+
+
 @dataclass
 class _TableEntry:
     """Per-tensor negotiation state (the message_table of
@@ -94,6 +117,11 @@ class Negotiator:
                     entry.arrival = self._arrivals
                     self._ready.append((entry.arrival, req.tensor_name))
 
+    def set_fusion_threshold(self, threshold_bytes: int) -> None:
+        """Autotuner hook (``parameter_manager.cc`` Tune/SyncParams)."""
+        with self._lock:
+            self._fusion_threshold = threshold_bytes
+
     def construct_response_list(self) -> ResponseList:
         """Drain ready tensors into a deterministic, fused ResponseList
         (``ConstructResponse`` + the fusion loop of ``:2154-2266``)."""
@@ -104,9 +132,9 @@ class Negotiator:
             for name in ready:
                 entry = self._table.pop(name)
                 resp = self._construct_response(name, entry)
-                # Stash the (rank-0) request on the response for fusion
-                # size/dtype decisions; stripped meaning only, never data.
-                resp._meta = entry.requests[min(entry.requests)]  # type: ignore[attr-defined]
+                first = entry.requests[min(entry.requests)]
+                resp.tensor_dtype = first.tensor_type
+                resp.payload_bytes = _nbytes(first)
                 responses.append(resp)
             self._maybe_check_stalls()
             out = ResponseList(responses=self._fuse(responses),
@@ -212,31 +240,26 @@ class Negotiator:
                 i += 1
                 continue
             batch = Response(ResponseType.ALLREDUCE,
-                             tensor_names=list(resp.tensor_names))
-            batch._meta = resp._meta  # type: ignore[attr-defined]
-            dtype = self._resp_dtype(resp)
-            total = self._resp_bytes(resp)
+                             tensor_names=list(resp.tensor_names),
+                             tensor_dtype=resp.tensor_dtype,
+                             payload_bytes=resp.payload_bytes)
+            dtype = resp.tensor_dtype
+            total = resp.payload_bytes
             j = i + 1
             while j < len(responses):
                 nxt = responses[j]
                 if nxt.response_type != ResponseType.ALLREDUCE or \
-                        self._resp_dtype(nxt) != dtype:
+                        nxt.tensor_dtype != dtype:
                     break
-                nbytes = self._resp_bytes(nxt)
-                if total + nbytes > self._fusion_threshold:
+                if total + nxt.payload_bytes > self._fusion_threshold:
                     break
                 batch.tensor_names.extend(nxt.tensor_names)
-                total += nbytes
+                total += nxt.payload_bytes
                 j += 1
+            batch.payload_bytes = total
             fused.append(batch)
             i = j
         return fused
-
-    def _resp_dtype(self, resp: Response) -> DataType:
-        return resp._meta.tensor_type  # type: ignore[attr-defined]
-
-    def _resp_bytes(self, resp: Response) -> int:
-        return _nbytes(resp._meta)  # type: ignore[attr-defined]
 
     # -- stall detection ------------------------------------------------------
 
@@ -336,13 +359,16 @@ class ControllerService:
 
     def __init__(self, size: int, negotiator: Negotiator,
                  secret: Optional[bytes] = None, port: int = 0,
-                 bind_host: str = "127.0.0.1") -> None:
+                 bind_host: str = "127.0.0.1",
+                 autotuner=None) -> None:
         self._negotiator = negotiator
         self._cycles = _Rendezvous(size)
         self._payloads = _Rendezvous(size)
         self._cycle_no = 0
         self._history: Dict[int, ResponseList] = {}
         self._lock = threading.Lock()
+        self._autotuner = autotuner
+        self._tuned_cycle_ms: Optional[float] = None
         self._service = BasicService(
             "horovod-controller", self._handle, secret=secret, port=port,
             bind_host=bind_host)
@@ -379,6 +405,7 @@ class ControllerService:
         for rank in sorted(slot):
             self._negotiator.add_request_list(slot[rank])
         response_list = self._negotiator.construct_response_list()
+        self._maybe_autotune(response_list)
         with self._lock:
             self._history[self._cycle_no] = response_list
             # History only needs to survive until the payload exchanges of
@@ -388,6 +415,19 @@ class ControllerService:
                 del self._history[stale]
             self._cycle_no += 1
         return response_list
+
+    def _maybe_autotune(self, response_list: ResponseList) -> None:
+        """Apply retuned knobs: fusion threshold directly on the negotiator,
+        cycle time piggybacked to every rank on the response (the Params
+        broadcast of ``parameter_manager.cc:213``)."""
+        if self._autotuner is None:
+            return
+        tuned = self._autotuner.observe_cycle(response_list)
+        if tuned is not None:
+            threshold, cycle_ms = tuned
+            self._negotiator.set_fusion_threshold(threshold)
+            self._tuned_cycle_ms = cycle_ms
+        response_list.tuned_cycle_ms = self._tuned_cycle_ms
 
     def shutdown(self) -> None:
         self._service.shutdown()
@@ -399,7 +439,7 @@ def _combine(resp: Response, slot: Dict[int, bytes]) -> bytes:
     data plane is XLA collectives (SURVEY §2.10: "host fallback via numpy
     only for tests")."""
     if resp.response_type == ResponseType.ALLREDUCE:
-        dtype = numpy_dtype(resp._meta.tensor_type)  # type: ignore[attr-defined]
+        dtype = numpy_dtype(resp.tensor_dtype)
         total: Optional[np.ndarray] = None
         for rank in sorted(slot):
             arr = np.frombuffer(slot[rank], dtype=dtype)

@@ -35,11 +35,11 @@ from ..core.logging import LOG
 from ..core.status import SHUT_DOWN_ERROR, Status
 from ..runner.network import default_secret
 from ..utils.timeline import Timeline
+from .autotuner import Autotuner
 from .controller import (
     ControllerClient,
     ControllerService,
-    Negotiator,
-    numpy_dtype,
+    make_negotiator,
 )
 from .messages import (
     OP_NAMES as _OP_NAMES,
@@ -155,12 +155,12 @@ class Engine:
 
         self._service: Optional[ControllerService] = None
         self._client: Optional[ControllerClient] = None
-        self._negotiator: Optional[Negotiator] = None
+        self._negotiator = None
+        self._autotuner: Optional[Autotuner] = None
+        if cfg.autotune and self._rank == 0:
+            self._autotuner = Autotuner(cfg)
         if self._size == 1:
-            self._negotiator = Negotiator(
-                1, cfg.fusion_threshold_bytes,
-                stall_warning_s=cfg.stall_warning_time_s,
-                stall_check_disable=cfg.stall_check_disable)
+            self._negotiator = make_negotiator(1, cfg)
         else:
             if cfg.data_plane == "xla" or (
                     cfg.data_plane == "auto" and _jax_multiprocess()):
@@ -182,15 +182,12 @@ class Engine:
                     "set; the launcher (horovodrun / horovod_tpu.runner) "
                     "must export the coordinator address to every rank.")
             if self._rank == 0:
-                negotiator = Negotiator(
-                    self._size, cfg.fusion_threshold_bytes,
-                    stall_warning_s=cfg.stall_warning_time_s,
-                    stall_check_disable=cfg.stall_check_disable)
+                negotiator = make_negotiator(self._size, cfg)
                 bind_host = os.environ.get(
                     "HOROVOD_CONTROLLER_BIND", "127.0.0.1")
                 self._service = ControllerService(
                     self._size, negotiator, secret=secret, port=port,
-                    bind_host=bind_host)
+                    bind_host=bind_host, autotuner=self._autotuner)
                 port = self._service.port
             self._client = ControllerClient(
                 (addr, port), secret=secret, timeout_s=None)
@@ -252,6 +249,16 @@ class Engine:
                     response_list = self._client.cycle(self._rank, request_list)
                 for idx, resp in enumerate(response_list.responses):
                     self._execute(idx, resp)
+                # autotune: local worlds score here; multi-process worlds
+                # score on the coordinator and ship cycle time back
+                if self._negotiator is not None and self._autotuner is not None:
+                    tuned = self._autotuner.observe_cycle(response_list)
+                    if tuned is not None:
+                        threshold, cycle_ms = tuned
+                        self._negotiator.set_fusion_threshold(threshold)
+                        cycle_s = max(cycle_ms, 0.1) / 1000.0
+                elif response_list.tuned_cycle_ms is not None:
+                    cycle_s = max(response_list.tuned_cycle_ms, 0.1) / 1000.0
                 if response_list.shutdown:
                     break
         except Exception as exc:  # noqa: BLE001 - propagate to handles
@@ -263,6 +270,8 @@ class Engine:
                 self._client.close()
             if self._service is not None:
                 self._service.shutdown()
+            if self._autotuner is not None:
+                self._autotuner.close()
             self.timeline.close()
             self._stopped.set()
 

@@ -101,20 +101,27 @@ class Response:
 
     ``tensor_names`` holds >1 entry when allreduces were fused into one
     batch; ``tensor_sizes`` carries per-rank first-dim sizes for allgather
-    (the recvcounts of ``operations.cc:843-927``).
+    (the recvcounts of ``operations.cc:843-927``) and the root rank for
+    broadcast. ``tensor_dtype``/``payload_bytes`` let the data plane and the
+    fusion planner work without re-deriving tensor metadata.
     """
 
     response_type: ResponseType
     tensor_names: List[str] = field(default_factory=list)
     error_message: str = ""
     tensor_sizes: List[int] = field(default_factory=list)
+    tensor_dtype: Optional[DataType] = None
+    payload_bytes: int = 0
 
 
 @dataclass
 class ResponseList:
     """All responses for one cycle, in execution order; identical on every
     rank — the property that makes SPMD data-plane execution legal
-    (``message.h:186-214``)."""
+    (``message.h:186-214``). ``tuned_cycle_ms`` piggybacks autotuner
+    decisions to every rank, the role the coordinator's Params broadcast
+    plays in the reference (``parameter_manager.cc:213`` SyncParams)."""
 
     responses: List[Response] = field(default_factory=list)
     shutdown: bool = False
+    tuned_cycle_ms: Optional[float] = None

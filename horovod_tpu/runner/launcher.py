@@ -22,6 +22,7 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -65,16 +66,23 @@ class LaunchError(RuntimeError):
         self.returncode = returncode
 
 
+class LaunchCancelled(RuntimeError):
+    pass
+
+
 def launch(command: Sequence[str], np: int,
            env_extra: Optional[Dict[str, str]] = None,
            host_data_plane: bool = False,
-           start_timeout_s: Optional[float] = None) -> int:
+           job_timeout_s: Optional[float] = None,
+           cancel_event: Optional["threading.Event"] = None) -> int:
     """Run ``command`` as ``np`` ranks; return 0 or raise LaunchError.
 
-    Failure semantics follow the reference launcher stack: when any rank
-    dies, the rest are terminated (mpirun behavior; also the Spark driver's
-    job-group cancel, ``spark/__init__.py:181-188``), and children die with
-    the launcher via process-group kill
+    ``job_timeout_s`` bounds the WHOLE job (leave None for training runs);
+    ``cancel_event`` lets an owner (e.g. ``run()``'s driver) tear the world
+    down early. Failure semantics follow the reference launcher stack: when
+    any rank dies, the rest are terminated (mpirun behavior; also the Spark
+    driver's job-group cancel, ``spark/__init__.py:181-188``), and children
+    die with the launcher via process-group kill
     (``spark/util/safe_shell_exec.py``)."""
     if np < 1:
         raise ValueError("np must be >= 1")
@@ -90,13 +98,14 @@ def launch(command: Sequence[str], np: int,
             procs.append(subprocess.Popen(
                 list(command), env=env,
                 start_new_session=True))  # own process group for clean kill
-        return _wait_all(procs, start_timeout_s)
+        return _wait_all(procs, job_timeout_s, cancel_event)
     finally:
         _terminate_all(procs)
 
 
 def _wait_all(procs: List[subprocess.Popen],
-              timeout_s: Optional[float]) -> int:
+              timeout_s: Optional[float],
+              cancel_event: Optional["threading.Event"] = None) -> int:
     deadline = time.monotonic() + timeout_s if timeout_s else None
     remaining = {rank: p for rank, p in enumerate(procs)}
     while remaining:
@@ -107,12 +116,14 @@ def _wait_all(procs: List[subprocess.Popen],
             del remaining[rank]
             if code != 0:
                 raise LaunchError(rank, code)
+        if cancel_event is not None and cancel_event.is_set():
+            raise LaunchCancelled("job cancelled by owner")
         if deadline and time.monotonic() > deadline:
             raise TimeoutError(
-                f"ranks {sorted(remaining)} still running after timeout; "
-                f"terminating. (Increase HOROVOD_START_TIMEOUT or check "
-                f"for a stalled collective — see the stall warning in the "
-                f"rank 0 log.)")
+                f"ranks {sorted(remaining)} still running after "
+                f"{timeout_s:.0f}s job timeout; terminating. (Check for a "
+                f"stalled collective — see the stall warning in the rank 0 "
+                f"log.)")
         time.sleep(0.05)
     return 0
 
@@ -149,8 +160,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--host-data-plane", action="store_true",
                         help="force the numpy-over-TCP eager data plane "
                              "(CPU test worlds)")
-    parser.add_argument("--start-timeout", type=float, default=None,
-                        help="seconds to wait for ranks before giving up")
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="bound the WHOLE job to this many seconds "
+                             "(default: unbounded, as for training runs)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="program and args to run per rank")
     args = parser.parse_args(argv)
@@ -159,7 +171,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     try:
         return launch(args.command, args.num_proc,
                       host_data_plane=args.host_data_plane,
-                      start_timeout_s=args.start_timeout)
+                      job_timeout_s=args.timeout)
     except LaunchError as exc:
         print(f"horovodrun: {exc}", file=sys.stderr)
         return exc.returncode or 1

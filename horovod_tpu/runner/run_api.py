@@ -19,8 +19,8 @@ from typing import Any, List, Optional, Tuple
 
 import cloudpickle
 
-from .launcher import LaunchError, launch
-from .network import BasicService, default_secret, make_secret
+from .launcher import LaunchCancelled, LaunchError, launch
+from .network import BasicService, make_secret
 
 _DRIVER_PORT_ENV = "HOROVOD_DRIVER_PORT"
 
@@ -77,6 +77,27 @@ class _Driver:
             return ("ok",)
         raise ValueError(f"unknown driver request {req[0]!r}")
 
+    def wait_registered(self, timeout_s: float, abort_check=None) -> None:
+        """Start timeout proper: every rank must check in within
+        ``timeout_s`` (the reference's registration timeout with an
+        actionable message, ``util/timeout.py:21-34``)."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while len(self._registered) < self._np:
+                if abort_check is not None:
+                    abort_check()
+                if time.monotonic() > deadline:
+                    missing = sorted(
+                        set(range(self._np)) - self._registered)
+                    raise TimeoutError(
+                        f"ranks {missing} did not register with the driver "
+                        f"within {timeout_s:.0f}s. Check that worker "
+                        f"processes can start (imports, device "
+                        f"availability) and reach the driver port.")
+                self._cond.wait(timeout=0.2)
+
     def wait_results(self, timeout_s: float,
                      abort_check=None) -> List[Any]:
         import time
@@ -110,14 +131,21 @@ class _Driver:
 
 
 def run(fn, args: Tuple = (), kwargs: Optional[dict] = None, np: int = 1,
-        timeout_s: float = 300.0, use_host_data_plane: bool = True) -> List[Any]:
+        timeout_s: float = 300.0, start_timeout_s: float = 60.0,
+        use_host_data_plane: bool = True) -> List[Any]:
     """Execute ``fn(*args, **kwargs)`` on ``np`` ranks; return results in
-    rank order (the reference returns the same, ``spark/__init__.py:192-196``)."""
+    rank order (the reference returns the same, ``spark/__init__.py:192-196``).
+
+    ``start_timeout_s`` bounds worker registration (reference
+    HOROVOD_SPARK_START_TIMEOUT semantics); ``timeout_s`` bounds the whole
+    job. On either timeout the workers are torn down, not orphaned."""
     import sys
 
     kwargs = kwargs or {}
     secret = make_secret()
     driver = _Driver(np, fn, args, kwargs, bytes.fromhex(secret))
+    cancel = threading.Event()
+    thread = None
     try:
         worker_cmd = [sys.executable, "-m", "horovod_tpu.runner._exec_fn"]
         env_extra = {_DRIVER_PORT_ENV: str(driver.port),
@@ -127,7 +155,10 @@ def run(fn, args: Tuple = (), kwargs: Optional[dict] = None, np: int = 1,
         def _launch() -> None:
             try:
                 launch(worker_cmd, np, env_extra=env_extra,
-                       host_data_plane=use_host_data_plane)
+                       host_data_plane=use_host_data_plane,
+                       cancel_event=cancel)
+            except LaunchCancelled:
+                pass
             except BaseException as exc:  # noqa: BLE001
                 launch_err.append(exc)
 
@@ -142,10 +173,16 @@ def run(fn, args: Tuple = (), kwargs: Optional[dict] = None, np: int = 1,
             if launch_err:
                 raise launch_err[0]
 
+        driver.wait_registered(start_timeout_s, _abort_on_launch_failure)
         results = driver.wait_results(timeout_s, _abort_on_launch_failure)
         thread.join(timeout=30.0)
         if launch_err:
             raise launch_err[0]
         return results
     finally:
+        # Tear down any still-running ranks (timeout or exception path);
+        # the launcher's finally SIGTERMs the process groups.
+        cancel.set()
+        if thread is not None:
+            thread.join(timeout=30.0)
         driver.shutdown()

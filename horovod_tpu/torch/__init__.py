@@ -254,42 +254,76 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
 def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
                               root_rank: int = 0) -> None:
     """Broadcast optimizer hyperparameters and per-parameter state from
-    root, wrapping scalars as tensors for the wire
-    (``torch/__init__.py:232-348``)."""
+    root (``torch/__init__.py:232-348``).
+
+    Root's state STRUCTURE is broadcast first, and every rank conforms to it
+    before any tensor collective is posted — so a root that restored a
+    checkpoint (populated momentum buffers) and workers with freshly
+    constructed optimizers (empty state) still issue identical collectives;
+    missing tensors are materialized as zeros and filled by the broadcast,
+    extra local entries are dropped. The reference achieves the same
+    alignment with its scalar-wrapping + recursive cast callbacks over
+    root's structure."""
     from ..state_bcast import broadcast_object
 
     if basics.size() == 1:
         return
     state_dict = optimizer.state_dict()
-    # Scalars (step counters, lr, momentum etc.) travel pickled; tensor
-    # state travels as broadcasts. The reference rebuilds scalars with
-    # recursive cast callbacks; pickling preserves types directly.
-    tensors = {}
-    scalars: dict = {"param_groups": state_dict["param_groups"], "state": {}}
-    for pid, pstate in state_dict["state"].items():
-        scalars["state"][pid] = {}
-        for key, value in pstate.items():
-            if isinstance(value, torch.Tensor):
-                tensors[f"{pid}.{key}"] = value
+
+    # 1) ship root's structure: param_groups + per-parameter state specs
+    meta: Optional[dict] = None
+    if basics.rank() == root_rank:
+        meta = {"param_groups": state_dict["param_groups"], "state": {}}
+        for pid, pstate in state_dict["state"].items():
+            specs = {}
+            for key, value in pstate.items():
+                if isinstance(value, torch.Tensor):
+                    specs[key] = ("tensor", list(value.shape),
+                                  str(value.dtype))
+                else:
+                    specs[key] = ("scalar", value)
+            meta["state"][pid] = specs
+    meta = broadcast_object(meta, root_rank,
+                            name="broadcast_optimizer_state.meta")
+
+    # 2) conform local state to root's structure
+    new_state: dict = {}
+    for pid, specs in meta["state"].items():
+        entry: dict = {}
+        for key, spec in specs.items():
+            if spec[0] == "scalar":
+                entry[key] = spec[1]
+                continue
+            _, shape, dtype_str = spec
+            dtype = getattr(torch, dtype_str.replace("torch.", ""))
+            local = state_dict["state"].get(pid, {}).get(key)
+            if isinstance(local, torch.Tensor) and \
+                    list(local.shape) == shape and local.dtype == dtype:
+                entry[key] = local
             else:
-                scalars["state"][pid][key] = value
-    scalars = broadcast_object(scalars, root_rank,
-                               name="broadcast_optimizer_state.meta")
-    for key in sorted(tensors):
-        t = tensors[key]
-        arr, narrow = _to_numpy(t)
-        h = _ops.broadcast_async(arr, root_rank,
-                                 name=f"broadcast_optimizer_state.{key}")
-        _narrow_map[h] = narrow
+                entry[key] = torch.zeros(shape, dtype=dtype)
+        new_state[pid] = entry
+
+    # 3) identical tensor collectives on every rank, in deterministic order
+    handles = []
+    for pid in sorted(new_state):
+        for key in sorted(k for k, s in meta["state"][pid].items()
+                          if s[0] == "tensor"):
+            t = new_state[pid][key]
+            arr, narrow = _to_numpy(t)
+            h = _ops.broadcast_async(
+                arr, root_rank, name=f"broadcast_optimizer_state.{pid}.{key}")
+            _narrow_map[h] = narrow
+            handles.append((t, h))
+    for t, h in handles:
         out = synchronize(h)
         with torch.no_grad():
             t.copy_(out.reshape(t.shape))
-    for pid, pstate in state_dict["state"].items():
-        for key, value in scalars["state"][pid].items():
-            pstate[key] = value
-    for group, meta in zip(state_dict["param_groups"],
-                           scalars["param_groups"]):
-        for key, value in meta.items():
+
+    state_dict["state"] = new_state
+    for group, group_meta in zip(state_dict["param_groups"],
+                                 meta["param_groups"]):
+        for key, value in group_meta.items():
             if key != "params":
                 group[key] = value
     optimizer.load_state_dict(state_dict)

@@ -38,10 +38,32 @@ class Timeline:
         self._tids: dict = {}
         self._lock = threading.Lock()
         self._writer: Optional[threading.Thread] = None
+        self._native = None
         if path:
-            self._writer = threading.Thread(
-                target=self._write_loop, name="horovod-timeline", daemon=True)
-            self._writer.start()
+            self._native = self._try_native(path)
+            if self._native is None:
+                self._writer = threading.Thread(
+                    target=self._write_loop, name="horovod-timeline",
+                    daemon=True)
+                self._writer.start()
+
+    @staticmethod
+    def _try_native(path: str):
+        """Prefer the C++ writer thread (``cc/timeline_writer.cc``), the
+        direct analog of the reference's TimelineWriter; the Python thread
+        below is the fallback when the native core isn't built."""
+        import os
+
+        if os.environ.get("HOROVOD_NATIVE_CORE", "1") == "0":
+            return None
+        try:
+            from ..cc import NativeTimelineWriter, available
+
+            if available():
+                return NativeTimelineWriter(path)
+        except Exception:  # noqa: BLE001 - fall back to the Python writer
+            return None
+        return None
 
     @property
     def enabled(self) -> bool:
@@ -53,7 +75,9 @@ class Timeline:
         return time.monotonic_ns() / 1e3
 
     def _emit(self, record: dict) -> None:
-        if self._path:
+        if self._native is not None:
+            self._native.write(json.dumps(record))
+        elif self._path:
             self._queue.put(record)
 
     def _tid(self, tensor_name: str) -> int:
@@ -120,6 +144,9 @@ class Timeline:
             fh.write("{}]\n")
 
     def close(self) -> None:
+        if self._native is not None:
+            self._native.close()
+            self._native = None
         if self._writer is not None:
             self._queue.put(None)
             self._writer.join(timeout=5.0)

@@ -118,6 +118,27 @@ def main() -> None:
         np.testing.assert_allclose(out.float().numpy(),
                                    sum(range(size)) / size, rtol=1e-2)
 
+    elif scenario == "torch_state":
+        # divergent optimizer state: root restored (momentum populated),
+        # workers fresh (state empty) — must NOT deadlock, and workers must
+        # adopt root's buffers
+        import torch
+
+        import horovod_tpu.torch as hvd_torch
+
+        torch.manual_seed(7)
+        model = torch.nn.Linear(3, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.5, momentum=0.9)
+        if rank == 0:
+            model(torch.ones(4, 3)).sum().backward()
+            opt.step()  # populates momentum buffers on root only
+        hvd_torch.broadcast_optimizer_state(opt, root_rank=0)
+        state = opt.state_dict()["state"]
+        assert len(state) > 0, "workers did not adopt root's state"
+        for pstate in state.values():
+            buf = pstate.get("momentum_buffer")
+            assert buf is not None and float(buf.abs().sum()) > 0
+
     elif scenario == "object":
         obj = {"root": "payload", "rank": 0} if rank == 0 else None
         out = hvd.broadcast_object(obj, root_rank=0)

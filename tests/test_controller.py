@@ -7,8 +7,9 @@ the state machine the TCP controller serves.
 """
 
 import numpy as np
+import pytest
 
-from horovod_tpu.ops.controller import Negotiator
+from horovod_tpu.ops.controller import Negotiator as PyNegotiator
 from horovod_tpu.ops.messages import (
     DataType,
     Request,
@@ -16,6 +17,23 @@ from horovod_tpu.ops.messages import (
     RequestType,
     ResponseType,
 )
+
+
+def _native_negotiator(size, threshold):
+    import horovod_tpu.cc as cc
+
+    if not cc.available():
+        pytest.skip(f"native core unavailable: {cc.load_error()}")
+    return cc.NativeNegotiator(size, threshold)
+
+
+@pytest.fixture(params=["python", "native"])
+def Negotiator(request):
+    """Both negotiation cores must satisfy the same behavior contract,
+    including identical error strings."""
+    if request.param == "python":
+        return PyNegotiator
+    return _native_negotiator
 
 
 def _req(rank, name, op=RequestType.ALLREDUCE, dtype=DataType.FLOAT32,
@@ -31,7 +49,7 @@ def _negotiate(negotiator, *request_lists):
     return negotiator.construct_response_list()
 
 
-def test_not_ready_until_all_ranks():
+def test_not_ready_until_all_ranks(Negotiator):
     n = Negotiator(2, 1 << 26)
     out = _negotiate(n, RequestList(0, [_req(0, "t")]))
     assert out.responses == []
@@ -41,7 +59,7 @@ def test_not_ready_until_all_ranks():
     assert out.responses[0].tensor_names == ["t"]
 
 
-def test_mismatched_shape_error():
+def test_mismatched_shape_error(Negotiator):
     n = Negotiator(2, 1 << 26)
     out = _negotiate(
         n,
@@ -52,7 +70,7 @@ def test_mismatched_shape_error():
     assert "Mismatched allreduce tensor shapes" in resp.error_message
 
 
-def test_mismatched_dtype_error():
+def test_mismatched_dtype_error(Negotiator):
     n = Negotiator(2, 1 << 26)
     out = _negotiate(
         n,
@@ -63,7 +81,7 @@ def test_mismatched_dtype_error():
     assert "Mismatched data types" in resp.error_message
 
 
-def test_mismatched_op_error():
+def test_mismatched_op_error(Negotiator):
     n = Negotiator(2, 1 << 26)
     out = _negotiate(
         n,
@@ -74,7 +92,7 @@ def test_mismatched_op_error():
     assert "Mismatched collective operations" in resp.error_message
 
 
-def test_broadcast_root_mismatch_error():
+def test_broadcast_root_mismatch_error(Negotiator):
     n = Negotiator(2, 1 << 26)
     out = _negotiate(
         n,
@@ -85,7 +103,7 @@ def test_broadcast_root_mismatch_error():
     assert "root rank" in resp.error_message
 
 
-def test_allgather_ragged_sizes():
+def test_allgather_ragged_sizes(Negotiator):
     n = Negotiator(3, 1 << 26)
     out = _negotiate(
         n,
@@ -97,7 +115,7 @@ def test_allgather_ragged_sizes():
     assert resp.tensor_sizes == [2, 5, 1]  # rank-ordered recvcounts
 
 
-def test_allgather_trailing_dim_mismatch():
+def test_allgather_trailing_dim_mismatch(Negotiator):
     n = Negotiator(2, 1 << 26)
     out = _negotiate(
         n,
@@ -108,7 +126,7 @@ def test_allgather_trailing_dim_mismatch():
     assert "Mismatched allgather tensor shapes" in resp.error_message
 
 
-def test_fusion_batches_same_dtype_under_threshold():
+def test_fusion_batches_same_dtype_under_threshold(Negotiator):
     # threshold fits exactly two 4x4 f32 tensors (128 bytes)
     n = Negotiator(1, 128)
     out = _negotiate(n, RequestList(0, [
@@ -118,7 +136,7 @@ def test_fusion_batches_same_dtype_under_threshold():
     assert batches == [["a", "b"], ["c"]]
 
 
-def test_fusion_not_across_dtypes():
+def test_fusion_not_across_dtypes(Negotiator):
     n = Negotiator(1, 1 << 26)
     out = _negotiate(n, RequestList(0, [
         _req(0, "a", dtype=DataType.FLOAT32),
@@ -129,7 +147,7 @@ def test_fusion_not_across_dtypes():
     assert batches == [["a"], ["b"], ["c"]]
 
 
-def test_fusion_not_across_ops():
+def test_fusion_not_across_ops(Negotiator):
     n = Negotiator(1, 1 << 26)
     out = _negotiate(n, RequestList(0, [
         _req(0, "a"),
@@ -141,7 +159,7 @@ def test_fusion_not_across_ops():
                      ResponseType.ALLREDUCE]
 
 
-def test_shutdown_propagates():
+def test_shutdown_propagates(Negotiator):
     n = Negotiator(2, 1 << 26)
     n.add_request_list(RequestList(0, [], shutdown=True))
     n.add_request_list(RequestList(1, []))

@@ -72,3 +72,11 @@ def test_torch_multiprocess_world():
     from test_multiprocess import _run_world
 
     _run_world("torch", 2, timeout=120.0)
+
+
+def test_torch_divergent_optimizer_state_multiprocess():
+    """Root restored from checkpoint, workers fresh: structure must sync
+    without deadlock (coordinator-matched collectives)."""
+    from test_multiprocess import _run_world
+
+    _run_world("torch_state", 2, timeout=120.0)

@@ -1,0 +1,15 @@
+"""Synthetic throughput benchmark — the reference's
+``examples/pytorch_synthetic_benchmark.py`` / ``tensorflow_synthetic_benchmark.py``
+protocol. The canonical implementation lives at the repo root as
+``bench.py`` (the driver-facing entry point); this example forwards to it so
+the examples directory mirrors the reference layout.
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench import main  # noqa: E402
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,7 @@ Typical use, mirroring the reference README:
     params = hvd.broadcast_parameters(params, root_rank=0)
 """
 
-from . import parallel, runner
+from . import callbacks, checkpoint, parallel, runner
 from .basics import (
     cross_rank,
     cross_size,
@@ -49,6 +49,7 @@ from .ops import (
     spmd,
     synchronize,
 )
+from .ops.sparse import IndexedSlices, allreduce_sparse
 from .optimizers import DistributedOptimizer, allreduce_gradients
 from .state_bcast import (
     broadcast_global_variables,
@@ -66,7 +67,8 @@ __all__ = [
     "local_device_count", "num_devices", "mpi_threads_supported",
     "allreduce", "allreduce_async", "allgather", "allgather_async",
     "broadcast", "broadcast_async", "poll", "synchronize", "release",
-    "Compression", "spmd", "parallel",
+    "Compression", "spmd", "parallel", "callbacks", "checkpoint",
+    "IndexedSlices", "allreduce_sparse",
     "DistributedOptimizer", "allreduce_gradients",
     "broadcast_parameters", "broadcast_optimizer_state",
     "broadcast_global_variables", "broadcast_object",

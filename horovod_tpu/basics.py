@@ -67,12 +67,6 @@ def init(ranks=None, comm=None) -> None:
                 "horovod_tpu.init(comm=...) requires MPI, which this build "
                 "intentionally does not use.")
         _global.config = Config.from_env()
-        if _global.config.hierarchical_allreduce or \
-                _global.config.hierarchical_allgather:
-            LOG.warning(
-                "HOROVOD_HIERARCHICAL_* is not wired into the eager engine "
-                "yet; two-level (dcn, ici) collectives are available via "
-                "horovod_tpu.parallel.hierarchical_mesh for SPMD steps.")
         _global.topology = discover()
         _global.initialized = True
         LOG.debug(

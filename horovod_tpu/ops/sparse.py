@@ -1,0 +1,68 @@
+"""Sparse (indexed-slices) gradient support via allgather.
+
+Rebuild of the reference's only sparse path: TF ``tf.IndexedSlices``
+gradients are allreduced as allgather(values) + allgather(indices)
+(``tensorflow/__init__.py:72-83``) — summing is deferred to whoever applies
+the slices, and duplicate indices across ranks are legal. JAX has no
+IndexedSlices type; embedding-style gradients appear as (indices, values)
+pairs, modeled here by ``IndexedSlices``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import allgather, allgather_async, spmd, synchronize
+
+
+@dataclass
+class IndexedSlices:
+    """A sparse tensor: ``values[i]`` belongs to row ``indices[i]`` of a
+    dense tensor of shape ``dense_shape`` (mirror of tf.IndexedSlices)."""
+
+    indices: Any   # int array [n]
+    values: Any    # array [n, ...]
+    dense_shape: Tuple[int, ...]
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape,
+                        dtype=jnp.asarray(self.values).dtype)
+        return out.at[jnp.asarray(self.indices)].add(
+            jnp.asarray(self.values))
+
+
+def allreduce_sparse(slices: IndexedSlices, average: bool = True,
+                     name: Optional[str] = None,
+                     axis_name: Optional[spmd.AxisName] = None) -> IndexedSlices:
+    """Allreduce an IndexedSlices by gathering every rank's (indices,
+    values); duplicate rows sum when densified. ``average`` scales values by
+    1/size, matching the dense allreduce contract
+    (``tensorflow/__init__.py:76-83``)."""
+    name = name or "allreduce_sparse"
+    if axis_name is not None:
+        gathered_values = spmd.allgather(slices.values, axis_name)
+        gathered_indices = spmd.allgather(
+            jnp.asarray(slices.indices).reshape(-1, 1), axis_name).reshape(-1)
+        if average:
+            from jax import lax
+
+            gathered_values = gathered_values / lax.axis_size(
+                axis_name if isinstance(axis_name, str) else axis_name[0])
+        return IndexedSlices(gathered_indices, gathered_values,
+                             slices.dense_shape)
+
+    from .. import basics
+
+    values_handle = allgather_async(slices.values, name=f"{name}.values")
+    indices_handle = allgather_async(
+        np.asarray(slices.indices).reshape(-1, 1), name=f"{name}.indices")
+    values = synchronize(values_handle)
+    indices = np.asarray(synchronize(indices_handle)).reshape(-1)
+    if average:
+        values = values / basics.size()
+    return IndexedSlices(indices, values, slices.dense_shape)

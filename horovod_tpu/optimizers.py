@@ -34,15 +34,46 @@ from . import basics, ops
 from .ops.compression import Compression
 
 
+def _use_hierarchical(axis_name, hierarchical) -> bool:
+    if hierarchical is not None:
+        return hierarchical
+    if isinstance(axis_name, str) or axis_name is None or \
+            len(tuple(axis_name)) != 2:
+        return False
+    # HOROVOD_HIERARCHICAL_ALLREDUCE knob, as in the reference
+    # (operations.cc:1880-1890); requires an initialized world.
+    return basics.is_initialized() and basics.config().hierarchical_allreduce
+
+
 def allreduce_gradients(grads: Any, axis_name=None, average: bool = True,
-                        compression=Compression.none) -> Any:
+                        compression=Compression.none,
+                        hierarchical: Optional[bool] = None) -> Any:
     """Average a gradient pytree across the world.
 
     The DistributedGradientTape analog
     (``tensorflow/__init__.py:252-326``): apply to any grads pytree before
-    feeding an optimizer."""
+    feeding an optimizer. With a two-axis ``axis_name`` (dcn, ici) and
+    ``hierarchical`` (or ``HOROVOD_HIERARCHICAL_ALLREDUCE``), varying
+    gradients take the factored reduce_scatter/allreduce/all_gather route
+    of ``parallel.hierarchical``."""
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if axis_name is not None:
+        if _use_hierarchical(axis_name, hierarchical):
+            from .ops.spmd import _varies_over
+            from .parallel.hierarchical import hierarchical_grad_allreduce
+
+            dcn_axis, ici_axis = tuple(axis_name)
+            reduced = []
+            for g in leaves:
+                comp, ctx = compression.compress(g)
+                if _varies_over(comp, axis_name):
+                    red = hierarchical_grad_allreduce(
+                        comp, dcn_axis, ici_axis, average=average)
+                else:
+                    # pre-summed cotangent (see ops.spmd.allreduce)
+                    red = ops.spmd.allreduce(comp, axis_name, average=average)
+                reduced.append(compression.decompress(red, ctx))
+            return jax.tree_util.tree_unflatten(treedef, reduced)
         reduced = [
             ops.allreduce(g, average=average, compression=compression,
                           axis_name=axis_name)
@@ -74,6 +105,7 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          compression=Compression.none,
                          average: bool = True,
                          backward_passes_per_step: int = 1,
+                         hierarchical: Optional[bool] = None,
                          ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates are computed from world-averaged
     gradients. ``backward_passes_per_step`` accumulates N passes locally
@@ -95,7 +127,8 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
 
     def _reduce(grads):
         return allreduce_gradients(grads, axis_name=axis_name,
-                                   average=average, compression=compression)
+                                   average=average, compression=compression,
+                                   hierarchical=hierarchical)
 
     def update_fn(grads, state, params=None):
         if n_acc == 1:

@@ -1,5 +1,10 @@
 """Mesh construction and multi-axis parallelism utilities (SURVEY §2.10)."""
 
+from .hierarchical import (
+    hierarchical_allgather,
+    hierarchical_allreduce,
+    hierarchical_grad_allreduce,
+)
 from .mesh import (
     DATA_AXIS,
     DCN_AXIS,
@@ -12,4 +17,6 @@ from .mesh import (
 __all__ = [
     "DATA_AXIS", "DCN_AXIS", "ICI_AXIS",
     "data_parallel_mesh", "hierarchical_mesh", "local_mesh",
+    "hierarchical_allreduce", "hierarchical_allgather",
+    "hierarchical_grad_allreduce",
 ]

@@ -1,0 +1,68 @@
+"""Hierarchical collectives: factored two-level reductions over (dcn, ici).
+
+Rebuild of the reference's hierarchical allreduce/allgather
+(``operations.cc:1284-1436``: NCCL ReduceScatter within the node → parallel
+cross-node MPI_Allreduce → NCCL Allgather; ``:929-1033``: shared-memory
+node-local allgather + cross-node Allgatherv). On TPU the same factoring is
+expressed per mesh axis: the fast axis (``ici``, intra-slice interconnect)
+does the scatter/gather legs; the slow axis (``dcn``, cross-slice data
+center network) carries only the 1/|ici| reduced shard — exactly the
+bandwidth shape the reference's hierarchy buys on GPU clusters.
+
+Enabled the same way (``HOROVOD_HIERARCHICAL_ALLREDUCE``), or explicitly by
+passing both axis names. XLA would often discover an equivalent schedule for
+a flat psum over both axes; the explicit factoring guarantees it and makes
+the knob meaningful on mixed ICI/DCN topologies.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def hierarchical_allreduce(x: jax.Array, dcn_axis: str = "dcn",
+                           ici_axis: str = "ici",
+                           average: bool = True) -> jax.Array:
+    """reduce_scatter(ici) → allreduce(dcn) → all_gather(ici).
+
+    The cross-slice leg moves |x| / |ici| bytes per chip instead of |x| —
+    the factored form of ``operations.cc:1284-1436``. Requires the leading
+    dimension be divisible by the ici axis size (pad upstream otherwise;
+    the DistributedOptimizer flattens to 1-D multiples automatically)."""
+    shard = lax.psum_scatter(x, ici_axis, scatter_dimension=0, tiled=True)
+    shard = lax.psum(shard, dcn_axis)
+    out = lax.all_gather(shard, ici_axis, axis=0, tiled=True)
+    if average:
+        out = out / (lax.axis_size(ici_axis) * lax.axis_size(dcn_axis))
+    return out
+
+
+def hierarchical_allgather(x: jax.Array, dcn_axis: str = "dcn",
+                           ici_axis: str = "ici") -> jax.Array:
+    """all_gather(ici) then all_gather(dcn), concatenated in global rank
+    order (node-local shared-memory gather + cross-node Allgatherv,
+    ``operations.cc:929-1033``)."""
+    local = lax.all_gather(x, ici_axis, axis=0, tiled=True)
+    return lax.all_gather(local, dcn_axis, axis=0, tiled=True)
+
+
+def hierarchical_grad_allreduce(grads, dcn_axis: str = "dcn",
+                                ici_axis: str = "ici",
+                                average: bool = True):
+    """Apply hierarchical_allreduce leaf-wise to a gradient pytree, padding
+    each flattened leaf to a multiple of the ici axis size."""
+    import jax.numpy as jnp
+
+    def reduce_leaf(g):
+        flat = g.reshape(-1)
+        ici = lax.axis_size(ici_axis)
+        pad = (-flat.shape[0]) % ici
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        reduced = hierarchical_allreduce(flat, dcn_axis, ici_axis, average)
+        if pad:
+            reduced = reduced[:-pad]
+        return reduced.reshape(g.shape)
+
+    return jax.tree_util.tree_map(reduce_leaf, grads)

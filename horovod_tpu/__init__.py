@@ -49,6 +49,7 @@ from .ops import (
     spmd,
     synchronize,
 )
+from .ops.pallas_attention import flash_attention
 from .ops.sparse import IndexedSlices, allreduce_sparse
 from .optimizers import DistributedOptimizer, allreduce_gradients
 from .state_bcast import (
@@ -68,7 +69,7 @@ __all__ = [
     "allreduce", "allreduce_async", "allgather", "allgather_async",
     "broadcast", "broadcast_async", "poll", "synchronize", "release",
     "Compression", "spmd", "parallel", "callbacks", "checkpoint",
-    "IndexedSlices", "allreduce_sparse",
+    "IndexedSlices", "allreduce_sparse", "flash_attention",
     "DistributedOptimizer", "allreduce_gradients",
     "broadcast_parameters", "broadcast_optimizer_state",
     "broadcast_global_variables", "broadcast_object",

@@ -1,0 +1,154 @@
+"""Pallas flash-attention forward kernel for TPU.
+
+The reference contains no kernels at all — device math is delegated to
+NCCL/MPI (SURVEY §2: "no CUDA kernels"). On TPU the hot op worth a custom
+kernel in this framework's domain is attention (the long-context extension,
+``parallel.ring_attention``): a fused blockwise softmax(QK^T)V that never
+materializes the [T, T] score matrix in HBM and streams K/V through VMEM
+one block at a time.
+
+Design (per pallas_guide.md): 3-D grid (batch*heads, q-blocks, k-blocks)
+with the k dimension innermost and sequential ("arbitrary" semantics); the
+flash-attention accumulators (output, running max, running denominator)
+live in VMEM scratch and persist across the k iterations of one q block.
+Per-program VMEM footprint is O(block_q * d + block_k * d) — independent of
+sequence length, so 16k+ contexts fit. Matmuls hit the MXU with f32
+accumulation; masking and rescaling ride the VPU. Causal q-blocks skip
+fully-masked k-blocks (`pl.when`), halving causal work.
+
+``interpret=True`` (automatic off-TPU) runs the same kernel through the
+Pallas interpreter, which is how the CPU test suite validates it.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _attention_kernel(q_ref, k_ref, v_ref, o_ref, o_acc, m_acc, l_acc, *,
+                      scale: float, causal: bool, q_offset_blocks: int,
+                      num_k_blocks: int, block_q: int, block_k: int):
+    # program_id must be read at kernel top level: inside a pl.when body it
+    # escapes the interpreter's scope (breaks interpret=True on CPU)
+    kk = pl.program_id(2)
+    q_idx = pl.program_id(1)
+
+    @pl.when(kk == 0)
+    def _init():
+        o_acc[...] = jnp.zeros_like(o_acc)
+        m_acc[...] = jnp.full_like(m_acc, _NEG_INF)
+        l_acc[...] = jnp.zeros_like(l_acc)
+
+    def _update():
+        q_block = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
+        k_blk = k_ref[0].astype(jnp.float32)            # [block_k, d]
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(  # [block_q, block_k] on the MXU
+            q_block, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = (q_idx + q_offset_blocks) * block_q + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+            k_pos = kk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m = m_acc[...]
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        corr = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - m_new))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(m_new == _NEG_INF, 0.0, p)
+        l_acc[...] = l_acc[...] * corr + p.sum(axis=1, keepdims=True)
+        m_acc[...] = m_new
+        o_acc[...] = o_acc[...] * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # skip k-blocks that lie entirely in this q-block's future
+        last_q_pos = (q_idx + q_offset_blocks + 1) * block_q - 1
+
+        @pl.when(last_q_pos >= kk * block_k)
+        def _run():
+            _update()
+    else:
+        _update()
+
+    @pl.when(kk == num_k_blocks - 1)
+    def _finalize():
+        o_ref[0, ...] = (o_acc[...] /
+                         jnp.maximum(l_acc[...], 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret", "q_offset"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, scale: Optional[float] = None,
+                    block_q: int = 512, block_k: int = 512,
+                    interpret: Optional[bool] = None,
+                    q_offset: int = 0) -> jax.Array:
+    """Fused attention, shapes [batch, seq, heads, head_dim].
+
+    ``q_offset`` shifts the global position of q (in elements) for causal
+    masking — how ring attention uses a kernel per KV shard. Sequence
+    lengths must be multiples of the block sizes (pad upstream; blocks
+    auto-shrink to the sequence length when shorter).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    batch, seq_q, heads, head_dim = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    if seq_q % block_q or seq_k % block_k:
+        raise ValueError(
+            f"sequence lengths ({seq_q}, {seq_k}) must be multiples of the "
+            f"block sizes ({block_q}, {block_k}); pad inputs first.")
+    if q_offset % block_q:
+        raise ValueError("q_offset must be a multiple of block_q")
+    num_k_blocks = seq_k // block_k
+
+    # [B, T, H, D] -> [B*H, T, D]: grid programs own one (batch, head)
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(
+            batch * heads, x.shape[1], head_dim)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+
+    kernel = functools.partial(
+        _attention_kernel, scale=scale, causal=causal,
+        q_offset_blocks=q_offset // block_q, num_k_blocks=num_k_blocks,
+        block_q=block_q, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(batch * heads, seq_q // block_q, num_k_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda bh, i, kk: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda bh, i, kk: (bh, kk, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda bh, i, kk: (bh, kk, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim),
+                               lambda bh, i, kk: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch * heads, seq_q, head_dim),
+                                       q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qb, kb, vb)
+    return out.reshape(batch, heads, seq_q, head_dim).transpose(0, 2, 1, 3)

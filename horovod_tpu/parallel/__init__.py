@@ -5,6 +5,11 @@ from .hierarchical import (
     hierarchical_allreduce,
     hierarchical_grad_allreduce,
 )
+from .ring_attention import (
+    dense_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from .mesh import (
     DATA_AXIS,
     DCN_AXIS,
@@ -19,4 +24,5 @@ __all__ = [
     "data_parallel_mesh", "hierarchical_mesh", "local_mesh",
     "hierarchical_allreduce", "hierarchical_allgather",
     "hierarchical_grad_allreduce",
+    "ring_attention", "ulysses_attention", "dense_attention",
 ]

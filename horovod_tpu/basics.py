@@ -69,6 +69,15 @@ def init(ranks=None, comm=None) -> None:
         _global.config = Config.from_env()
         _global.topology = discover()
         _global.initialized = True
+        if _global.topology.size > 1:
+            # Multi-process worlds start the background engine eagerly, as
+            # the reference spawns BackgroundThreadLoop inside init
+            # (operations.cc:2394): every rank must participate in control
+            # cycles from t0 or the coordinator cannot run negotiation,
+            # stall detection, or shutdown for the ranks that did arrive.
+            from .ops.engine import get_engine
+
+            get_engine()
         LOG.debug(
             "horovod_tpu initialized: rank=%d size=%d local_rank=%d "
             "local_size=%d devices=%d/%d",

@@ -20,6 +20,9 @@ HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
 HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
 HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
 HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+# Extension: the reference hardcodes 60s (STALL_WARNING_TIME,
+# operations.cc:258); configurable here, same default.
+HOROVOD_STALL_WARNING_TIME = "HOROVOD_STALL_WARNING_TIME"
 HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
 HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
 HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
@@ -112,6 +115,8 @@ class Config:
             timeline_path=os.environ.get(HOROVOD_TIMELINE, ""),
             timeline_mark_cycles=_env_bool(HOROVOD_TIMELINE_MARK_CYCLES),
             stall_check_disable=_env_bool(HOROVOD_STALL_CHECK_DISABLE),
+            stall_warning_time_s=_env_float(HOROVOD_STALL_WARNING_TIME,
+                                            STALL_WARNING_TIME_S),
             hierarchical_allreduce=_env_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
             hierarchical_allgather=_env_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
             autotune=_env_bool(HOROVOD_AUTOTUNE),

@@ -139,6 +139,19 @@ def main() -> None:
             buf = pstate.get("momentum_buffer")
             assert buf is not None and float(buf.abs().sum()) > 0
 
+    elif scenario == "stall":
+        # rank 0 submits immediately; rank 1 delays past the stall window so
+        # the coordinator must print the stall warning naming the missing
+        # rank (CheckForStalledTensors, operations.cc:1625-1672) — then the
+        # late submission still completes correctly.
+        import time
+
+        x = np.ones((4,), dtype=np.float32)
+        if rank == 1:
+            time.sleep(3.0)
+        out = hvd.allreduce(x, average=False, name="stalled_tensor")
+        np.testing.assert_array_equal(np.asarray(out), float(size))
+
     elif scenario == "object":
         obj = {"root": "payload", "rank": 0} if rank == 0 else None
         out = hvd.broadcast_object(obj, root_rank=0)

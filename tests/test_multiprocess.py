@@ -24,11 +24,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_world(scenario: str, size: int, timeout: float = 90.0):
+def _run_world(scenario: str, size: int, timeout: float = 90.0,
+               extra_env=None):
     port = _free_port()
     procs = []
     for rank in range(size):
         env = dict(os.environ)
+        if extra_env:
+            env.update(extra_env)
         env.update({
             "HOROVOD_RANK": str(rank),
             "HOROVOD_SIZE": str(size),
@@ -58,6 +61,7 @@ def _run_world(scenario: str, size: int, timeout: float = 90.0):
             f"rank {rank} failed in scenario {scenario!r} (exit {code})\n"
             f"stdout:\n{out}\nstderr:\n{err}")
         assert f"WORKER-OK {rank}" in out
+    return results
 
 
 @pytest.mark.parametrize("size", [2, 4])
@@ -83,3 +87,16 @@ def test_mp_mismatch_errors_on_all_ranks():
 
 def test_mp_broadcast_object():
     _run_world("object", 2)
+
+
+def test_mp_stall_warning():
+    """A rank submitting late must trigger the coordinator's stall warning
+    naming the missing rank (``CheckForStalledTensors``), and the collective
+    must still complete once the laggard arrives."""
+    results = _run_world(
+        "stall", 2, timeout=120.0,
+        extra_env={"HOROVOD_STALL_WARNING_TIME": "1",
+                   "HOROVOD_LOG_LEVEL": "warning"})
+    rank0_err = results[0][3]
+    assert "Stalled ops: stalled_tensor" in rank0_err
+    assert "missing ranks: 1" in rank0_err

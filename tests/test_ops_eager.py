@@ -12,17 +12,31 @@ import pytest
 
 import horovod_tpu as hvd
 
-DTYPES = [np.float32, np.float64, np.int32, np.int64, np.float16]
+DTYPES = [np.float32, np.float64, np.int32, np.int64, np.float16,
+          np.uint8, np.int8, np.uint16, np.int16]
 DIMS = [1, 2, 3]
 
 
 def test_allreduce_dtypes_dims(hvd):
+    """Reference-style dtype x dim sweep (``test_torch.py:73-108``); every
+    wire dtype of ``messages.DataType`` except bool/bf16 (covered below)."""
     rng = np.random.default_rng(1234)
     for dtype in DTYPES:
         for dim in DIMS:
-            x = rng.uniform(-100, 100, size=(17,) * dim).astype(dtype)
+            x = rng.uniform(0, 100, size=(17,) * dim).astype(dtype)
             out = hvd.allreduce(x, average=False, name=f"ar_{dtype.__name__}_{dim}")
+            assert np.asarray(out).dtype == dtype
             np.testing.assert_array_equal(np.asarray(out), x)  # size-1 sum
+
+
+def test_allgather_dtypes(hvd):
+    rng = np.random.default_rng(99)
+    for dtype in DTYPES + [np.bool_]:
+        x = (rng.uniform(0, 2, size=(3, 2)) > 1).astype(dtype) \
+            if dtype == np.bool_ else \
+            rng.uniform(0, 50, size=(3, 2)).astype(dtype)
+        out = hvd.allgather(x, name=f"ag_{np.dtype(dtype).name}")
+        np.testing.assert_array_equal(np.asarray(out), x)
 
 
 def test_allreduce_average(hvd):

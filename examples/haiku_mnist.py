@@ -69,10 +69,15 @@ def main() -> None:
 
     rng = np.random.default_rng(0)
     global_batch = args.batch_size * n_dev
+    # small fixed synthetic dataset so the loss visibly decreases
+    dataset = [
+        (jnp.asarray(rng.standard_normal(
+            (global_batch, 28, 28, 1)).astype(np.float32)),
+         jnp.asarray(rng.integers(0, 10, size=(global_batch,))))
+        for _ in range(4)
+    ]
     for i in range(args.steps):
-        x = jnp.asarray(rng.standard_normal(
-            (global_batch, 28, 28, 1)).astype(np.float32))
-        y = jnp.asarray(rng.integers(0, 10, size=(global_batch,)))
+        x, y = dataset[i % len(dataset)]
         params, opt_state, loss = step(params, opt_state, x, y)
     if hvd.rank() == 0:
         print(f"final loss: {float(loss):.4f}")

@@ -159,20 +159,19 @@ class Engine:
         self._autotuner: Optional[Autotuner] = None
         if cfg.autotune and self._rank == 0:
             self._autotuner = Autotuner(cfg)
+        self._plane = None
         if self._size == 1:
             self._negotiator = make_negotiator(1, cfg)
         else:
             if cfg.data_plane == "xla" or (
                     cfg.data_plane == "auto" and _jax_multiprocess()):
-                # Never silently funnel pod-scale tensors through the host
-                # TCP plane: on a real multi-host runtime the eager data
-                # plane must be device collectives, which are not wired up
-                # yet — fail loudly instead.
-                raise NotImplementedError(
-                    "cross-process device collectives for the eager API are "
-                    "not wired up yet; use the SPMD path (axis_name=...) on "
-                    "pods, or set HOROVOD_DATA_PLANE=host to force the "
-                    "numpy-over-TCP test plane.")
+                # The reference's NCCL/MPI split: the TCP controller below
+                # stays the control plane; bytes move as compiled XLA
+                # collectives over the global device mesh (ICI/DCN on pods,
+                # gloo on CPU test worlds).
+                from .xla_plane import XlaDataPlane
+
+                self._plane = XlaDataPlane(topo)
             secret = default_secret()
             port = int(os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "0"))
             addr = os.environ.get(_config.HOROVOD_CONTROLLER_ADDR, "127.0.0.1")
@@ -349,6 +348,8 @@ class Engine:
             # world of one: sum over a single rank. Copy so results never
             # alias the caller's input array.
             out = np.array(buf, copy=True)
+        elif self._plane is not None and self._plane.supports(dtype_of(buf)):
+            out = self._plane.allreduce(np.ascontiguousarray(buf))
         else:
             raw = self._client.payload(self._rank, idx,
                                        np.ascontiguousarray(buf).tobytes())
@@ -373,6 +374,10 @@ class Engine:
                        resp: Response) -> List[np.ndarray]:
         if self._client is None:
             return [entry.array.copy()]
+        if self._plane is not None and self._plane.supports_move(
+                dtype_of(entry.array)):
+            return [self._plane.allgather(
+                np.ascontiguousarray(entry.array), resp.tensor_sizes)]
         raw = self._client.payload(
             self._rank, idx, np.ascontiguousarray(entry.array).tobytes())
         total_first = sum(resp.tensor_sizes)
@@ -385,6 +390,10 @@ class Engine:
         root = resp.tensor_sizes[0]
         if self._client is None:
             return [entry.array.copy()]
+        if self._plane is not None and self._plane.supports_move(
+                dtype_of(entry.array)):
+            return [self._plane.broadcast(
+                np.ascontiguousarray(entry.array), root)]
         payload = np.ascontiguousarray(entry.array).tobytes() \
             if self._rank == root else b""
         raw = self._client.payload(self._rank, idx, payload)

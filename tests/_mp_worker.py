@@ -18,6 +18,17 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# With HOROVOD_TEST_JAX_COORD set, workers form a real multi-process JAX
+# world (gloo-backed CPU collectives) so the eager XLA data plane runs the
+# same cross-process compiled-collective path it uses on TPU pods.
+_coord = os.environ.get("HOROVOD_TEST_JAX_COORD")
+if _coord:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        _coord,
+        num_processes=int(os.environ["HOROVOD_SIZE"]),
+        process_id=int(os.environ["HOROVOD_RANK"]))
+
 import numpy as np  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -63,6 +74,13 @@ def main() -> None:
         x = np.full((4,), float(rank * 10 + 5), dtype=np.float32)
         out = np.asarray(hvd.broadcast(x, root_rank=root, name="mp.bcast"))
         np.testing.assert_array_equal(out, float(root * 10 + 5))
+        # non-root buffer contents are ignored — even Inf/NaN garbage
+        # (uninitialized params about to be overwritten) must not leak into
+        # the result on any data plane
+        y = (np.full((3,), 7.0, np.float32) if rank == root
+             else np.full((3,), np.inf, np.float32))
+        out2 = np.asarray(hvd.broadcast(y, root_rank=root, name="mp.bcast2"))
+        np.testing.assert_array_equal(out2, 7.0)
 
     elif scenario == "mismatch":
         # rank-dependent shapes must error on ALL ranks

@@ -30,8 +30,6 @@ def _run_world(scenario: str, size: int, timeout: float = 90.0,
     procs = []
     for rank in range(size):
         env = dict(os.environ)
-        if extra_env:
-            env.update(extra_env)
         env.update({
             "HOROVOD_RANK": str(rank),
             "HOROVOD_SIZE": str(size),
@@ -42,6 +40,10 @@ def _run_world(scenario: str, size: int, timeout: float = 90.0,
             "HOROVOD_DATA_PLANE": "host",
             "HOROVOD_CYCLE_TIME": "2",
         })
+        if extra_env:
+            # last so scenarios can override the defaults (e.g. the XLA
+            # data-plane runs replace HOROVOD_DATA_PLANE)
+            env.update(extra_env)
         env.pop("JAX_PLATFORMS", None)
         procs.append(subprocess.Popen(
             [sys.executable, _WORKER, scenario],
@@ -87,6 +89,28 @@ def test_mp_mismatch_errors_on_all_ranks():
 
 def test_mp_broadcast_object():
     _run_world("object", 2)
+
+
+def _run_world_xla(scenario: str, size: int, **kw):
+    """Same scenarios over the eager XLA data plane: workers form a real
+    multi-process JAX world (gloo CPU collectives) and bytes move as
+    compiled shard_map collectives instead of numpy-over-TCP — the CPU
+    stand-in for the TPU-pod NCCL-analog path (``ops/xla_plane.py``)."""
+    coord = f"127.0.0.1:{_free_port()}"
+    extra = {"HOROVOD_DATA_PLANE": "xla", "HOROVOD_TEST_JAX_COORD": coord}
+    extra.update(kw.pop("extra_env", {}))
+    return _run_world(scenario, size, extra_env=extra,
+                      timeout=kw.pop("timeout", 180.0), **kw)
+
+
+@pytest.mark.parametrize(
+    "scenario", ["allreduce", "fused", "allgather", "broadcast", "torch"])
+def test_mp_xla_plane(scenario):
+    _run_world_xla(scenario, 2)
+
+
+def test_mp_xla_plane_three_ranks():
+    _run_world_xla("allgather", 3)
 
 
 def test_mp_stall_warning():

@@ -191,9 +191,26 @@ class Engine:
             self._client = ControllerClient(
                 (addr, port), secret=secret, timeout_s=None)
 
+        self._host_fallback_warned = set()
+
         self._thread = threading.Thread(
             target=self._loop, name="horovod-background", daemon=True)
         self._thread.start()
+
+    def _warn_host_fallback(self, op_name: str, tensor_name: str,
+                            array: np.ndarray) -> None:
+        """The device plane is active but this dtype must ride the host TCP
+        plane — at pod scale that is orders of magnitude slower, so say so
+        once per (op, dtype) instead of silently degrading."""
+        key = (op_name, str(array.dtype))
+        if key in self._host_fallback_warned:
+            return
+        self._host_fallback_warned.add(key)
+        LOG.warning(
+            "%s of %r (dtype %s) has no device-collective wire; falling "
+            "back to the host TCP data plane, which is far slower at scale. "
+            "Cast the tensor (e.g. to float32/int32) to keep it on-device.",
+            op_name, tensor_name, array.dtype)
 
     # -- submission (API threads) --------------------------------------------
 
@@ -351,6 +368,8 @@ class Engine:
         elif self._plane is not None and self._plane.supports(dtype_of(buf)):
             out = self._plane.allreduce(np.ascontiguousarray(buf))
         else:
+            if self._plane is not None:
+                self._warn_host_fallback("allreduce", entries[0].name, buf)
             raw = self._client.payload(self._rank, idx,
                                        np.ascontiguousarray(buf).tobytes())
             out = np.frombuffer(raw, dtype=buf.dtype).copy()  # writable
@@ -378,6 +397,8 @@ class Engine:
                 dtype_of(entry.array)):
             return [self._plane.allgather(
                 np.ascontiguousarray(entry.array), resp.tensor_sizes)]
+        if self._plane is not None:
+            self._warn_host_fallback("allgather", entry.name, entry.array)
         raw = self._client.payload(
             self._rank, idx, np.ascontiguousarray(entry.array).tobytes())
         total_first = sum(resp.tensor_sizes)
@@ -394,6 +415,8 @@ class Engine:
                 dtype_of(entry.array)):
             return [self._plane.broadcast(
                 np.ascontiguousarray(entry.array), root)]
+        if self._plane is not None:
+            self._warn_host_fallback("broadcast", entry.name, entry.array)
         payload = np.ascontiguousarray(entry.array).tobytes() \
             if self._rank == root else b""
         raw = self._client.payload(self._rank, idx, payload)

@@ -185,14 +185,24 @@ class XlaDataPlane:
         padded = np.zeros((_next_bucket(n),), dtype=wire_dt)
         padded[:n] = buf
         result = self._fn("psum")(self._global_put(padded))
-        return np.asarray(result)[:n].astype(out_dt, copy=False)
+        # always copy: np.asarray of a jax Array is a read-only view of its
+        # host cache, and callers (torch front-end in-place grads) need a
+        # writable result — the host plane copies for the same reason
+        return np.array(np.asarray(result)[:n], dtype=out_dt)
 
     def allgather(self, arr: np.ndarray,
                   sizes: Sequence[int]) -> np.ndarray:
         """Concatenate per-rank arrays with ragged first dims (the
         recvcounts/displacements logic of ``operations.cc:843-927``, done as
         pad → tiled all_gather → trim)."""
-        rows = _next_bucket(max(sizes))
+        # bucket the ROW count: power-of-two for compile reuse, with the
+        # minimum scaled by row width so the floor stays ~_MIN_BUCKET
+        # *elements* — a flat 1024-row floor would blow up wide rows
+        # (e.g. (8, 65536) would pad 2 MB to 256 MB)
+        row_elems = max(1, int(np.prod(arr.shape[1:], dtype=np.int64)))
+        min_rows = max(1, -(-_MIN_BUCKET // row_elems))
+        rows = max(min_rows,
+                   1 << max(0, math.ceil(math.log2(max(max(sizes), 1)))))
         padded = np.zeros((rows,) + arr.shape[1:], dtype=arr.dtype)
         padded[:arr.shape[0]] = arr
         gathered = np.asarray(self._fn("gather")(self._global_put(padded)))
@@ -222,4 +232,4 @@ class XlaDataPlane:
         padded = np.zeros((_next_bucket(n),), dtype=buf.dtype)
         padded[:n] = buf
         result = self._fn("bcast", root)(self._global_put(padded))
-        return np.asarray(result)[:n]
+        return np.array(np.asarray(result)[:n])  # writable, see allreduce

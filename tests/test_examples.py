@@ -1,0 +1,82 @@
+"""Example smoke tests — every example must actually run (the reference's
+examples are its de-facto integration suite; SURVEY §2.8).
+
+Examples are executed in subprocesses with the platform pinned to CPU
+*after* jax import (the TPU plugin prepends itself to JAX_PLATFORMS, so an
+env var alone cannot keep subprocesses off the bench chip)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(script: str, argv, timeout: float = 300.0, env=None):
+    bootstrap = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import runpy, sys; "
+        f"sys.argv = [{script!r}] + {list(argv)!r}; "
+        f"runpy.run_path({os.path.join(_ROOT, 'examples', script)!r}, "
+        "run_name='__main__')"
+    )
+    full_env = dict(os.environ)
+    full_env.pop("JAX_PLATFORMS", None)
+    full_env.setdefault(
+        "XLA_FLAGS",
+        "--xla_force_host_platform_device_count=2")
+    if env:
+        full_env.update(env)
+    result = subprocess.run(
+        [sys.executable, "-c", bootstrap], cwd=_ROOT, env=full_env,
+        capture_output=True, text=True, timeout=timeout)
+    assert result.returncode == 0, (
+        f"{script} failed:\nstdout:\n{result.stdout}\n"
+        f"stderr:\n{result.stderr}")
+    return result
+
+
+def test_jax_mnist_eager():
+    out = _run_example("jax_mnist_eager.py",
+                       ["--steps", "12", "--batch-size", "16"])
+    assert "step 0: loss=" in out.stdout
+    assert "done" in out.stdout
+
+
+def test_flax_mnist_advanced_callbacks():
+    out = _run_example(
+        "flax_mnist_advanced.py",
+        ["--epochs", "3", "--batch-size", "8", "--warmup-epochs", "2"])
+    lines = [l for l in out.stdout.splitlines() if l.startswith("epoch")]
+    assert len(lines) == 3
+    # warmup must raise the LR from base toward base * num_devices
+    lrs = [float(l.split("lr=")[1].split()[0]) for l in lines]
+    assert lrs[-1] > lrs[0]
+
+
+def test_pytorch_synthetic_benchmark():
+    out = _run_example(
+        "pytorch_synthetic_benchmark.py",
+        ["--batch-size", "4", "--image-size", "32", "--num-iters", "2",
+         "--num-warmup-batches", "1", "--num-batches-per-iter", "1"])
+    assert "Img/sec per rank" in out.stdout
+
+
+def test_run_fn_job():
+    out = _run_example("run_fn_job.py", [],
+                       env={"EXAMPLE_PLATFORM": "cpu"})
+    assert "OK" in out.stdout
+
+
+def test_jax_mnist():
+    out = _run_example("jax_mnist.py",
+                       ["--epochs", "1", "--batch-size", "8"])
+    assert out.returncode == 0
+
+
+def test_haiku_mnist():
+    out = _run_example("haiku_mnist.py",
+                       ["--steps", "10", "--batch-size", "8"])
+    assert out.returncode == 0

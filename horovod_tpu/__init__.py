@@ -61,6 +61,16 @@ from .state_bcast import (
 
 __version__ = "0.1.0"
 
+
+def __getattr__(name):
+    # The flax front-end is optional (like the torch front-end): flax is an
+    # extra, so it must not break `import horovod_tpu` when absent.
+    if name == "flax":
+        import importlib
+
+        return importlib.import_module(".flax", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
     "__version__",
     "init", "shutdown", "is_initialized",

@@ -45,6 +45,13 @@ def test_jax_mnist_eager():
     assert "done" in out.stdout
 
 
+def test_flax_mnist_frontend():
+    out = _run_example("flax_mnist.py",
+                       ["--epochs", "1", "--batch-size", "8"])
+    assert "epoch 0: loss" in out.stdout
+    assert "restored at step" in out.stdout
+
+
 def test_flax_mnist_advanced_callbacks():
     out = _run_example(
         "flax_mnist_advanced.py",

@@ -63,12 +63,13 @@ __version__ = "0.1.0"
 
 
 def __getattr__(name):
-    # The flax front-end is optional (like the torch front-end): flax is an
-    # extra, so it must not break `import horovod_tpu` when absent.
-    if name == "flax":
+    # Framework front-ends are optional (like the torch front-end): flax and
+    # haiku are extras, so they must not break `import horovod_tpu` when
+    # absent.
+    if name in ("flax", "haiku"):
         import importlib
 
-        return importlib.import_module(".flax", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [

@@ -1,0 +1,89 @@
+"""Shared front-end implementation — the ``horovod/_keras`` of this build.
+
+The reference keeps one Keras implementation (``horovod/_keras/__init__.py``:
+``create_distributed_optimizer`` :20-70, ``load_model`` :93-109) and binds it
+to each backend through thin shims (``horovod/keras``,
+``horovod/tensorflow/keras``). The flax and haiku front-ends here follow the
+same shape: everything framework-agnostic — the optimizer wrap, the rank-0
+checkpoint round-trip, the callback surface — lives in this module; the
+shims add only the framework's native unit of training state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import optax
+
+from . import checkpoint as _checkpoint
+from .callbacks import (  # noqa: F401  (re-exported by the shims)
+    BroadcastGlobalVariablesCallback,
+    Callback,
+    CallbackList,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+)
+from .ops.compression import Compression
+from .optimizers import DistributedOptimizer, is_distributed
+
+CALLBACK_EXPORTS = [
+    "BroadcastGlobalVariablesCallback",
+    "MetricAverageCallback",
+    "LearningRateScheduleCallback",
+    "LearningRateWarmupCallback",
+    "Callback",
+    "CallbackList",
+]
+
+
+def create_distributed_optimizer(
+        optimizer: optax.GradientTransformation,
+        *,
+        axis_name=None,
+        compression=Compression.none,
+        average: bool = True,
+        backward_passes_per_step: int = 1,
+        hierarchical: Optional[bool] = None,
+) -> optax.GradientTransformation:
+    """Wrap an optax optimizer so updates come from world-averaged gradients.
+
+    The reference builds a dynamic subclass overriding ``get_gradients``
+    (``_keras/__init__.py:20-70``); in optax the seam is the gradient
+    transformation itself, so the wrap is a transformation that averages
+    before delegating to the inner optimizer.
+    """
+    return DistributedOptimizer(
+        optimizer, axis_name=axis_name, compression=compression,
+        average=average, backward_passes_per_step=backward_passes_per_step,
+        hierarchical=hierarchical)
+
+
+def wrap_unless_distributed(tx: optax.GradientTransformation,
+                            **kwargs) -> optax.GradientTransformation:
+    """Wrap ``tx`` unless it already is a DistributedOptimizer — guards the
+    front-ends' ``create(...)`` against double wrapping (two allreduces per
+    step, double compression, N*N delay counters) when a user pre-wraps and
+    then passes the result in. A pre-wrapped optimizer keeps its own knobs;
+    ``kwargs`` apply only when the wrap happens here."""
+    if is_distributed(tx):
+        return tx
+    return create_distributed_optimizer(tx, **kwargs)
+
+
+def save_model(path: str, state: Any) -> None:
+    """Checkpoint the training state's array leaves from rank 0 only (the
+    reference's rank-0 checkpoint convention, SURVEY §5.4)."""
+    _checkpoint.save(path, state)
+
+
+def load_model(path: str, template: Any, root_rank: int = 0) -> Any:
+    """Restore a training state saved by :func:`save_model`.
+
+    ``template`` supplies the static structure — including the
+    already-wrapped optimizer — which is how the Keras ``load_model``
+    guarantee "the deserialized optimizer is still distributed"
+    (``_keras/__init__.py:93-109``) carries over: the wrap never left the
+    template. The restored state is broadcast from ``root_rank`` so all
+    ranks resume identical (``keras/__init__.py:115-148``)."""
+    return _checkpoint.restore(path, template=template, root_rank=root_rank)

@@ -7,47 +7,41 @@ deserializes a model with every known optimizer class wrapped) plus thin
 ``horovod.keras`` / ``horovod.tensorflow.keras`` shims that bind it to a
 backend and re-export the callbacks (``keras/__init__.py:115-148``).
 
-Flax is the Keras of the JAX world, and its unit of "model + optimizer +
-progress" is :class:`flax.training.train_state.TrainState`. So the TPU-native
-front-end is TrainState-shaped:
+Here the shared implementation is ``horovod_tpu._frontend`` and this shim
+binds it to flax, whose unit of "model + optimizer + progress" is
+:class:`flax.training.train_state.TrainState`:
 
-* :func:`create_distributed_optimizer` — wrap any optax transformation so
-  updates come from world-averaged gradients (the ``get_gradients`` override
-  becomes a ``GradientTransformation`` wrapper; same knob surface).
-* :class:`DistributedTrainState` — ``TrainState.create`` with the wrap
-  applied, so ``state.apply_gradients(grads=...)`` injects averaging
+* :class:`DistributedTrainState` — ``TrainState.create`` with the optimizer
+  wrap applied, so ``state.apply_gradients(grads=...)`` injects averaging
   transparently, exactly how a wrapped Keras optimizer hides it inside
   ``model.fit``.
 * :func:`broadcast_train_state` — rank-0 consistency push for the whole
   state (params, opt state, step), the ``BroadcastGlobalVariablesCallback``
   contract applied to a TrainState.
-* :func:`save_model` / :func:`load_model` — rank-0 checkpoint + restore with
-  the optimizer wrap intact (carried by the template) and a post-restore
-  broadcast, the ``hvd.load_model`` round-trip of
-  ``test/test_keras.py:62-246``.
-
-Callbacks are framework-neutral in this build (``horovod_tpu.callbacks``)
-and re-exported here, playing the role of ``keras/callbacks.py``.
+* :func:`create_distributed_optimizer` / :func:`save_model` /
+  :func:`load_model` / the callbacks — re-exported from the shared impl.
 """
 
 from __future__ import annotations
 
 from typing import Any, Optional
 
-import optax
 from flax.training import train_state as _train_state
 
-from .. import checkpoint as _checkpoint
-from ..callbacks import (  # noqa: F401  (re-exports, keras/callbacks.py role)
+from .._frontend import (  # noqa: F401  (shared impl, horovod/_keras role)
+    CALLBACK_EXPORTS,
     BroadcastGlobalVariablesCallback,
     Callback,
     CallbackList,
+    Compression,
     LearningRateScheduleCallback,
     LearningRateWarmupCallback,
     MetricAverageCallback,
+    create_distributed_optimizer,
+    load_model,
+    save_model,
+    wrap_unless_distributed,
 )
-from ..ops.compression import Compression
-from ..optimizers import DistributedOptimizer
 from ..state_bcast import broadcast_parameters
 
 __all__ = [
@@ -56,44 +50,18 @@ __all__ = [
     "broadcast_train_state",
     "save_model",
     "load_model",
-    "BroadcastGlobalVariablesCallback",
-    "MetricAverageCallback",
-    "LearningRateScheduleCallback",
-    "LearningRateWarmupCallback",
-    "Callback",
-    "CallbackList",
-]
-
-
-def create_distributed_optimizer(
-        optimizer: optax.GradientTransformation,
-        *,
-        axis_name=None,
-        compression=Compression.none,
-        average: bool = True,
-        backward_passes_per_step: int = 1,
-        hierarchical: Optional[bool] = None,
-) -> optax.GradientTransformation:
-    """Keras-parity name for :func:`horovod_tpu.DistributedOptimizer`.
-
-    Reference ``horovod/_keras/__init__.py:20-70`` builds a dynamic subclass
-    overriding ``get_gradients``; in optax the seam is the gradient
-    transformation itself, so the wrap is a transformation that averages
-    before delegating to the inner optimizer.
-    """
-    return DistributedOptimizer(
-        optimizer, axis_name=axis_name, compression=compression,
-        average=average, backward_passes_per_step=backward_passes_per_step,
-        hierarchical=hierarchical)
+] + CALLBACK_EXPORTS
 
 
 class DistributedTrainState(_train_state.TrainState):
     """A ``TrainState`` whose optimizer averages gradients across the world.
 
-    ``create`` wraps ``tx`` with :func:`create_distributed_optimizer` before
-    initializing, so every subsequent ``apply_gradients`` call — eager or
-    inside a pjit/shard_map step (pass ``axis_name``) — runs the reference's
-    DistributedOptimizer semantics without the training loop knowing.
+    ``create`` wraps ``tx`` with the shared ``create_distributed_optimizer``
+    before initializing (skipped if ``tx`` is already wrapped — pre-wrapped
+    optimizers keep their own knobs), so every subsequent
+    ``apply_gradients`` call — eager or inside a pjit/shard_map step (pass
+    ``axis_name``) — runs the reference's DistributedOptimizer semantics
+    without the training loop knowing.
     """
 
     @classmethod
@@ -104,7 +72,7 @@ class DistributedTrainState(_train_state.TrainState):
                backward_passes_per_step: int = 1,
                hierarchical: Optional[bool] = None,
                **kwargs):
-        tx = create_distributed_optimizer(
+        tx = wrap_unless_distributed(
             tx, axis_name=axis_name, compression=compression,
             average=average,
             backward_passes_per_step=backward_passes_per_step,
@@ -121,22 +89,3 @@ def broadcast_train_state(state: Any, root_rank: int = 0,
     ``tx`` are static pytree fields and pass through untouched."""
     return broadcast_parameters(state, root_rank=root_rank,
                                 name_prefix=name_prefix)
-
-
-def save_model(path: str, state: Any) -> None:
-    """Checkpoint the TrainState's array leaves from rank 0 only (the
-    reference's rank-0 checkpoint convention, SURVEY §5.4)."""
-    _checkpoint.save(path, state)
-
-
-def load_model(path: str, template: Any, root_rank: int = 0) -> Any:
-    """Restore a TrainState saved by :func:`save_model`.
-
-    ``template`` supplies the static structure — ``apply_fn`` and the
-    (already-wrapped) ``tx`` — which is how the Keras ``load_model``
-    guarantee "the deserialized optimizer is still distributed"
-    (``_keras/__init__.py:93-109``) carries over: the optimizer wrap never
-    left the template. The restored state is broadcast from ``root_rank`` so
-    all ranks resume identical (``keras/__init__.py:115-148`` +
-    post-load broadcast convention)."""
-    return _checkpoint.restore(path, template=template, root_rank=root_rank)

@@ -1,0 +1,64 @@
+"""Haiku front-end — the second backend shim over the shared impl.
+
+The reference binds one shared Keras implementation to two backends via
+thin shims (``horovod/keras/__init__.py`` for standalone Keras,
+``horovod/tensorflow/keras/__init__.py`` for tf.keras, both delegating to
+``horovod/_keras``). This module plays the same role for dm-haiku over
+``horovod_tpu._frontend``: haiku has no TrainState, so training state is
+the explicit ``(params, net_state, opt_state)`` triple produced by
+``hk.transform[_with_state]`` + optax — this shim wraps that triple with
+the shared machinery (optimizer wrap, rank-0 broadcast, checkpoint
+round-trip, callbacks).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import optax
+
+from .._frontend import (  # noqa: F401  (shared impl, horovod/_keras role)
+    CALLBACK_EXPORTS,
+    BroadcastGlobalVariablesCallback,
+    Callback,
+    CallbackList,
+    Compression,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+    create_distributed_optimizer,
+    load_model,
+    save_model,
+)
+from ..state_bcast import broadcast_parameters
+
+__all__ = [
+    "create_distributed_optimizer",
+    "TrainingState",
+    "broadcast_training_state",
+    "save_model",
+    "load_model",
+] + CALLBACK_EXPORTS
+
+
+class TrainingState(NamedTuple):
+    """The (params, net_state, opt_state) triple of idiomatic haiku training
+    — ``net_state`` is the ``hk.transform_with_state`` mutable state (e.g.
+    BatchNorm statistics), ``None`` for stateless ``hk.transform``."""
+
+    params: Any
+    net_state: Any
+    opt_state: Any
+
+    @classmethod
+    def create(cls, params: Any, tx: optax.GradientTransformation,
+               net_state: Any = None) -> "TrainingState":
+        return cls(params, net_state, tx.init(params))
+
+
+def broadcast_training_state(state: TrainingState,
+                             root_rank: int = 0) -> TrainingState:
+    """Rank-0 consistency push for the whole triple
+    (``BroadcastGlobalVariablesCallback`` contract)."""
+    return broadcast_parameters(state, root_rank=root_rank,
+                                name_prefix="hk_training_state")

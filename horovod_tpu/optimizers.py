@@ -167,4 +167,13 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             (accum, state.inner, params))
         return updates, DistributedOptState(inner, accum, counter)
 
+    # Tag for is_distributed(): GradientTransformation is a plain NamedTuple
+    # (no instance attributes), so the marker rides on the update function.
+    update_fn._horovod_distributed = True
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def is_distributed(tx: optax.GradientTransformation) -> bool:
+    """True if ``tx`` was produced by :func:`DistributedOptimizer` (used by
+    the front-ends to refuse double wrapping)."""
+    return bool(getattr(tx.update, "_horovod_distributed", False))

@@ -104,5 +104,21 @@ def test_create_distributed_optimizer_alias(hvd):
     np.testing.assert_allclose(np.asarray(u["w"]), -0.1)
 
 
+def test_no_double_wrap(hvd):
+    """A pre-wrapped optimizer passed to create() must not be wrapped again
+    (double allreduce / double compression / N*N delay counters)."""
+    tx = hvd_flax.create_distributed_optimizer(optax.sgd(0.5))
+    params = {"w": jnp.ones((4, 2))}
+    state = hvd_flax.DistributedTrainState.create(
+        apply_fn=_apply_fn, params=params, tx=tx)
+    # Single wrap: opt_state is one DistributedOptState whose inner is the
+    # raw sgd state, not another DistributedOptState.
+    assert type(state.opt_state).__name__ == "DistributedOptState"
+    assert type(state.opt_state.inner).__name__ != "DistributedOptState"
+    new_state = state.apply_gradients(grads={"w": jnp.full((4, 2), 2.0)})
+    np.testing.assert_allclose(np.asarray(new_state.params["w"]),
+                               np.ones((4, 2)) - 0.5 * 2.0)
+
+
 def test_package_export():
     assert hvd_pkg.flax is hvd_flax

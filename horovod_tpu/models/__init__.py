@@ -9,5 +9,7 @@ benchmark protocol (``examples/pytorch_synthetic_benchmark.py``).
 
 from .mnist import MnistCNN
 from .resnet import ResNet, ResNet50, ResNet101
+from .transformer import TransformerLM, lm_loss
 
-__all__ = ["MnistCNN", "ResNet", "ResNet50", "ResNet101"]
+__all__ = ["MnistCNN", "ResNet", "ResNet50", "ResNet101",
+           "TransformerLM", "lm_loss"]

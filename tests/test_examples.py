@@ -45,6 +45,19 @@ def test_jax_mnist_eager():
     assert "done" in out.stdout
 
 
+@pytest.mark.parametrize("mode", ["dp", "ring", "ulysses"])
+def test_jax_transformer_lm(mode):
+    out = _run_example(
+        "jax_transformer_lm.py",
+        ["--mode", mode, "--steps", "12", "--seq-len", "64",
+         "--batch-size", "8"],
+        env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    lines = [l for l in out.stdout.splitlines() if l.startswith("step")]
+    losses = [float(l.split("loss=")[1].split()[0]) for l in lines]
+    assert losses[-1] < losses[0], (mode, losses)
+    assert "done" in out.stdout
+
+
 def test_flax_mnist_frontend():
     out = _run_example("flax_mnist.py",
                        ["--epochs", "1", "--batch-size", "8"])

@@ -59,10 +59,19 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     neg_inf = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
     batch, _, heads, head_dim = q.shape
-    # accumulators must be typed as varying over the ring axis up front
-    # (the scan carry's vma type is fixed at entry)
+    # accumulators must be typed as varying up front (the scan carry's vma
+    # type is fixed at entry) — over the ring axis AND any other mesh axis
+    # the operands vary on (e.g. a batch axis when composing ring attention
+    # with data parallelism on a 2-D mesh), since the body's outputs pick
+    # up the operands' full vma set
+    try:
+        acc_axes = tuple(jax.typeof(q).vma | jax.typeof(k).vma |
+                         jax.typeof(v).vma | {axis_name})
+    except (AttributeError, TypeError):  # legacy tracing: no vma types
+        acc_axes = (axis_name,)
+
     def _varying(x):
-        return lax.pcast(x, (axis_name,), to="varying")
+        return lax.pcast(x, acc_axes, to="varying")
 
     o0 = _varying(jnp.zeros((batch, seq_local, heads, head_dim), jnp.float32))
     m0 = _varying(jnp.full((batch, heads, seq_local), neg_inf, jnp.float32))

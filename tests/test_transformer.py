@@ -82,36 +82,43 @@ def test_sequence_parallel_matches_dense(hvd, backend):
                                rtol=2e-4, atol=2e-4)
 
 
-def test_dp_training_loss_decreases(hvd):
-    """End-to-end: DistributedOptimizer over the mesh, loss must drop."""
-    mesh = data_parallel_mesh()
-    rng = np.random.default_rng(1)
-    # learnable structure: fixed repeating pattern
-    seq = np.tile(np.arange(8), (8, T // 8 + 1))[:, :T].astype(np.int32)
-    tokens = jnp.asarray(seq + rng.integers(0, 2, (8, T)))
-
-    model = TransformerLM(**CFG)
-    variables = model.init(jax.random.PRNGKey(0), tokens[:1, :8])
-    opt = hvd_pkg.DistributedOptimizer(optax.adam(1e-2), axis_name=DATA_AXIS)
+def _train_losses(model, mesh, axis_name, tokens, data_spec, steps,
+                  positions=None):
+    """Shared DistributedOptimizer training loop over a mesh."""
+    variables = model.clone(attention="dense", seq_axis=None).init(
+        jax.random.PRNGKey(0), tokens[:1, :8])
+    opt = hvd_pkg.DistributedOptimizer(optax.adam(1e-2), axis_name=axis_name)
     opt_state = opt.init(variables)
+    args = (tokens,) if positions is None else (tokens, positions)
 
-    def step(variables, opt_state, tokens):
+    def step(variables, opt_state, *args):
         def loss_fn(v):
-            return lm_loss(model.apply(v, tokens), tokens)
+            return lm_loss(model.apply(v, *args), args[0])
 
         loss, grads = jax.value_and_grad(loss_fn)(variables)
         updates, opt_state = opt.update(grads, opt_state, variables)
         return (optax.apply_updates(variables, updates), opt_state,
-                jax.lax.pmean(loss, DATA_AXIS))
+                jax.lax.pmean(loss, axis_name))
 
     jitted = jax.jit(shard_map(
         step, mesh=mesh,
-        in_specs=(P(), P(), P(DATA_AXIS)),
+        in_specs=(P(), P()) + (data_spec,) * len(args),
         out_specs=(P(), P(), P())))
     losses = []
-    for _ in range(15):
-        variables, opt_state, loss = jitted(variables, opt_state, tokens)
+    for _ in range(steps):
+        variables, opt_state, loss = jitted(variables, opt_state, *args)
         losses.append(float(loss))
+    return losses
+
+
+def test_dp_training_loss_decreases(hvd):
+    """End-to-end: DistributedOptimizer over the mesh, loss must drop."""
+    rng = np.random.default_rng(1)
+    # learnable structure: fixed repeating pattern
+    seq = np.tile(np.arange(8), (8, T // 8 + 1))[:, :T].astype(np.int32)
+    tokens = jnp.asarray(seq + rng.integers(0, 2, (8, T)))
+    losses = _train_losses(TransformerLM(**CFG), data_parallel_mesh(),
+                           DATA_AXIS, tokens, P(DATA_AXIS), steps=15)
     assert losses[-1] < losses[0] * 0.7, losses
 
 
@@ -131,3 +138,21 @@ def test_ring_requires_seq_axis(hvd):
                                           tokens[:, :8])
     with pytest.raises(ValueError, match="requires seq_axis"):
         model.apply(variables, tokens)
+
+
+def test_dp_sp_composition(hvd):
+    """2-D mesh (docs/long-context.md): batch over 'data' (2), sequence
+    over 'seq' (4); ring attention per seq group; DistributedOptimizer
+    averages over both axes. Must train."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "seq"))
+    rng = np.random.default_rng(3)
+    seq = np.tile(np.arange(8), (4, T // 8)).astype(np.int32)
+    tokens = jnp.asarray(seq + rng.integers(0, 2, (4, T)))
+    positions = jnp.broadcast_to(jnp.arange(T), tokens.shape)
+    losses = _train_losses(
+        TransformerLM(attention="ring", seq_axis="seq", **CFG), mesh,
+        ("data", "seq"), tokens, P("data", "seq"), steps=12,
+        positions=positions)
+    assert losses[-1] < losses[0] * 0.8, losses

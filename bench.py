@@ -35,7 +35,11 @@ def main() -> None:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawTextHelpFormatter)
     parser.add_argument("--model", default="resnet50",
-                        choices=["resnet50", "resnet101"])
+                        choices=["resnet50", "resnet101", "vgg16",
+                                 "inception3"],
+                        help="resnet50 default; resnet101/vgg16/inception3 "
+                             "complete the reference's benchmark trio "
+                             "(docs/benchmarks.md:5-6)")
     parser.add_argument("--batch-size", type=int, default=32,
                         help="batch size per device (reference default 32)")
     parser.add_argument("--num-warmup-batches", type=int, default=10)
@@ -50,7 +54,7 @@ def main() -> None:
     from jax.sharding import PartitionSpec as P
 
     import horovod_tpu as hvd
-    from horovod_tpu.models import ResNet50, ResNet101
+    from horovod_tpu.models import InceptionV3, ResNet50, ResNet101, VGG16
 
     hvd.init()
     n_dev = hvd.local_device_count()
@@ -59,15 +63,20 @@ def main() -> None:
     log(f"Model: {args.model}, batch {args.batch_size}/device, "
         f"devices: {n_dev} ({jax.devices()[0].platform})")
 
-    model = (ResNet50 if args.model == "resnet50" else ResNet101)(
-        num_classes=1000)
+    model_cls = {"resnet50": ResNet50, "resnet101": ResNet101,
+                 "vgg16": VGG16, "inception3": InceptionV3}[args.model]
+    model = model_cls(num_classes=1000)
+    side = 299 if args.model == "inception3" else 224
     global_batch = args.batch_size * n_dev
     rng = jax.random.PRNGKey(0)
-    images = jax.random.normal(rng, (global_batch, 224, 224, 3), jnp.float32)
+    images = jax.random.normal(rng, (global_batch, side, side, 3),
+                               jnp.float32)
     labels = jax.random.randint(rng, (global_batch,), 0, 1000)
 
     variables = model.init(jax.random.PRNGKey(1), images[:2])
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    # vgg16 has no BatchNorm -> no batch_stats collection
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
 
     opt = hvd.DistributedOptimizer(optax.sgd(0.01), axis_name="data")
     opt_state = opt.init(params)
@@ -79,7 +88,7 @@ def main() -> None:
             mutable=["batch_stats"])
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, y).mean()
-        return loss, updated["batch_stats"]
+        return loss, updated.get("batch_stats", {})
 
     def train_step(params, opt_state, batch_stats, x, y):
         (_, new_stats), grads = jax.value_and_grad(
@@ -124,11 +133,15 @@ def main() -> None:
     log(f"Img/sec/device: {per_device:.1f} +- {conf / n_dev:.1f}")
     log(f"Total img/sec on {n_dev} device(s): {mean:.1f} +- {conf:.1f}")
 
+    # the P100 anchor is a ResNet-101 figure; a cross-model ratio would be
+    # meaningless for vgg16/inception3, so emit null there
+    vs_baseline = (round(per_device / REFERENCE_PER_DEVICE_IMG_S, 3)
+                   if args.model.startswith("resnet") else None)
     print(json.dumps({
         "metric": f"{args.model}_synthetic_train_images_per_sec_per_device",
         "value": round(per_device, 2),
         "unit": "img/s",
-        "vs_baseline": round(per_device / REFERENCE_PER_DEVICE_IMG_S, 3),
+        "vs_baseline": vs_baseline,
     }))
     hvd.shutdown()
 

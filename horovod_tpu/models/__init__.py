@@ -7,9 +7,11 @@ implemented here in flax, sized and configured to match the reference
 benchmark protocol (``examples/pytorch_synthetic_benchmark.py``).
 """
 
+from .inception import InceptionV3
 from .mnist import MnistCNN
 from .resnet import ResNet, ResNet50, ResNet101
 from .transformer import TransformerLM, lm_loss
+from .vgg import VGG16, VGG19
 
 __all__ = ["MnistCNN", "ResNet", "ResNet50", "ResNet101",
-           "TransformerLM", "lm_loss"]
+           "TransformerLM", "lm_loss", "VGG16", "VGG19", "InceptionV3"]

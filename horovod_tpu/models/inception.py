@@ -1,0 +1,168 @@
+"""Inception V3 in flax, for the reference's headline benchmark trio.
+
+The reference's published scaling chart benchmarks Inception V3 first
+(``docs/benchmarks.md:5-6``, README benchmark paragraph). Architecture
+follows Szegedy et al. 2015 (the tf_cnn_benchmarks/torchvision inception_v3
+graph): stem → 3x InceptionA (35x35) → ReductionA → 4x InceptionB (17x17)
+→ ReductionB → 2x InceptionC (8x8) → global pool → head. The auxiliary
+classifier is omitted — it exists for training regularization, not
+throughput, and the synthetic benchmark protocol never reads it.
+
+NHWC, bf16 compute with f32 params, f32 head. Input 299x299x3.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    """Conv + BatchNorm + ReLU, the Inception building block."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = 0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(64, (1, 1))(x, train)
+        b5 = cbn(48, (1, 1))(x, train)
+        b5 = cbn(64, (5, 5), padding=2)(b5, train)
+        b3 = cbn(64, (1, 1))(x, train)
+        b3 = cbn(96, (3, 3), padding=1)(b3, train)
+        b3 = cbn(96, (3, 3), padding=1)(b3, train)
+        bp = cbn(self.pool_features, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class ReductionA(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b3 = cbn(384, (3, 3), strides=(2, 2))(x, train)
+        bd = cbn(64, (1, 1))(x, train)
+        bd = cbn(96, (3, 3), padding=1)(bd, train)
+        bd = cbn(96, (3, 3), strides=(2, 2))(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """17x17 block with factorized 7x7 convolutions."""
+
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        c7 = self.channels_7x7
+        b1 = cbn(192, (1, 1))(x, train)
+        b7 = cbn(c7, (1, 1))(x, train)
+        b7 = cbn(c7, (1, 7), padding=((0, 0), (3, 3)))(b7, train)
+        b7 = cbn(192, (7, 1), padding=((3, 3), (0, 0)))(b7, train)
+        bd = cbn(c7, (1, 1))(x, train)
+        bd = cbn(c7, (7, 1), padding=((3, 3), (0, 0)))(bd, train)
+        bd = cbn(c7, (1, 7), padding=((0, 0), (3, 3)))(bd, train)
+        bd = cbn(c7, (7, 1), padding=((3, 3), (0, 0)))(bd, train)
+        bd = cbn(192, (1, 7), padding=((0, 0), (3, 3)))(bd, train)
+        bp = cbn(192, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class ReductionB(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b3 = cbn(192, (1, 1))(x, train)
+        b3 = cbn(320, (3, 3), strides=(2, 2))(b3, train)
+        b7 = cbn(192, (1, 1))(x, train)
+        b7 = cbn(192, (1, 7), padding=((0, 0), (3, 3)))(b7, train)
+        b7 = cbn(192, (7, 1), padding=((3, 3), (0, 0)))(b7, train)
+        b7 = cbn(192, (3, 3), strides=(2, 2))(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """8x8 block with split 3x3 branches."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(320, (1, 1))(x, train)
+        b3 = cbn(384, (1, 1))(x, train)
+        b3a = cbn(384, (1, 3), padding=((0, 0), (1, 1)))(b3, train)
+        b3b = cbn(384, (3, 1), padding=((1, 1), (0, 0)))(b3, train)
+        bd = cbn(448, (1, 1))(x, train)
+        bd = cbn(384, (3, 3), padding=1)(bd, train)
+        bda = cbn(384, (1, 3), padding=((0, 0), (1, 1)))(bd, train)
+        bdb = cbn(384, (3, 1), padding=((1, 1), (0, 0)))(bd, train)
+        bp = cbn(192, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b3a, b3b, bda, bdb, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # stem: 299 -> 35x35x192
+        x = cbn(32, (3, 3), strides=(2, 2))(x, train)
+        x = cbn(32, (3, 3))(x, train)
+        x = cbn(64, (3, 3), padding=1)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = cbn(80, (1, 1))(x, train)
+        x = cbn(192, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        # 35x35
+        x = InceptionA(32, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = ReductionA(dtype=self.dtype)(x, train)
+        # 17x17
+        x = InceptionB(128, dtype=self.dtype)(x, train)
+        x = InceptionB(160, dtype=self.dtype)(x, train)
+        x = InceptionB(160, dtype=self.dtype)(x, train)
+        x = InceptionB(192, dtype=self.dtype)(x, train)
+        x = ReductionB(dtype=self.dtype)(x, train)
+        # 8x8
+        x = InceptionC(dtype=self.dtype)(x, train)
+        x = InceptionC(dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x).astype(jnp.float32)

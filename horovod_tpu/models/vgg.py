@@ -1,0 +1,50 @@
+"""VGG in flax, for the reference's headline benchmark trio.
+
+The reference's published scaling chart benchmarks Inception V3, ResNet-101
+and VGG-16 (``docs/benchmarks.md:5-6``: ~90%/~90%/~68% efficiency at 512
+GPUs — VGG's huge FC layers make it the communication-bound worst case,
+which is exactly why it belongs in the benchmark set). NHWC, bf16 compute
+with f32 params, classifier head in f32.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# 'M' = 2x2 max pool; numbers = conv output channels (3x3)
+_VGG16_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M")
+_VGG19_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M")
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Union[int, str]] = _VGG16_CFG
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        conv_i = 0
+        for item in self.cfg:
+            if item == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(item, (3, 3), padding=1, dtype=self.dtype,
+                            name=f"conv_{conv_i}")(x)
+                x = nn.relu(x)
+                conv_i += 1
+        x = x.reshape((x.shape[0], -1))  # [B, 7*7*512] at 224x224
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc2")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x).astype(jnp.float32)
+
+
+VGG16 = partial(VGG, cfg=_VGG16_CFG)
+VGG19 = partial(VGG, cfg=_VGG19_CFG)

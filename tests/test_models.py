@@ -1,0 +1,72 @@
+"""Model zoo: the reference's headline benchmark trio (Inception V3,
+ResNet, VGG-16 — ``docs/benchmarks.md:5-6`` of the reference) plus MNIST.
+
+Canonical parameter counts pin the architectures: VGG-16 = 138,357,544
+(Simonyan & Zisserman), Inception V3 without the aux head = 23,834,568,
+ResNet-50 = 25,557,032 + BN stats.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu.models import InceptionV3, ResNet50, VGG16
+
+
+def _n_params(tree):
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+@pytest.mark.parametrize("cls,side,expected", [
+    (VGG16, 32, None),          # count checked at 224 below; 32 is fast
+    (InceptionV3, 299, 23_834_568),
+])
+def test_forward_shape(cls, side, expected):
+    model = cls(num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((2, side, side, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+    if expected is not None:
+        head = 10 * (2048 + 1)
+        full = expected - 1000 * (2048 + 1) + head
+        assert _n_params(variables["params"]) == full
+
+
+def test_vgg16_canonical_param_count():
+    model = VGG16(num_classes=1000, dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 224, 224, 3)))
+    assert _n_params(variables) == 138_357_544
+
+
+def test_resnet50_canonical_param_count():
+    model = ResNet50(num_classes=1000, dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 224, 224, 3)))
+    assert _n_params(variables["params"]) == 25_557_032
+
+
+def test_vgg16_train_step():
+    """One SGD step end-to-end (no BatchNorm: the no-batch_stats model path
+    the benchmark must also handle)."""
+    model = VGG16(num_classes=10, dtype=jnp.float32)
+    x = jnp.ones((2, 32, 32, 3))
+    y = jnp.zeros((2,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    assert "batch_stats" not in variables
+    opt = optax.sgd(0.01)
+    opt_state = opt.init(variables)
+
+    def loss_fn(v):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            model.apply(v, x), y).mean()
+
+    loss, grads = jax.value_and_grad(loss_fn)(variables)
+    updates, opt_state = opt.update(grads, opt_state, variables)
+    new_vars = optax.apply_updates(variables, updates)
+    assert np.isfinite(float(loss))
+    assert _n_params(new_vars) == _n_params(variables)

@@ -85,8 +85,7 @@ def test_sequence_parallel_matches_dense(hvd, backend):
 def _train_losses(model, mesh, axis_name, tokens, data_spec, steps,
                   positions=None):
     """Shared DistributedOptimizer training loop over a mesh."""
-    variables = model.clone(attention="dense", seq_axis=None).init(
-        jax.random.PRNGKey(0), tokens[:1, :8])
+    _, variables = _init(model.attention, tokens, seq_axis=model.seq_axis)
     opt = hvd_pkg.DistributedOptimizer(optax.adam(1e-2), axis_name=axis_name)
     opt_state = opt.init(variables)
     args = (tokens,) if positions is None else (tokens, positions)

@@ -30,31 +30,142 @@ from ..core import config as _config
 from .network import make_secret
 
 
-def _free_port() -> int:
+def _free_port(bind_addr: str = "127.0.0.1") -> int:
     with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
+        s.bind((bind_addr, 0))
         return s.getsockname()[1]
 
 
 def build_rank_env(rank: int, size: int, port: int, secret: str,
                    base_env: Optional[Dict[str, str]] = None,
-                   host_data_plane: bool = False) -> Dict[str, str]:
-    """Env block one rank needs — the analog of mpirun's exported world."""
+                   host_data_plane: bool = False,
+                   local_rank: Optional[int] = None,
+                   local_size: Optional[int] = None,
+                   cross_rank: int = 0, cross_size: int = 1,
+                   controller_addr: str = "127.0.0.1") -> Dict[str, str]:
+    """Env block one rank needs — the analog of mpirun's exported world.
+
+    Defaults describe a single-host world (local == global); multi-host
+    launches pass the per-host split the way mpirun derives
+    ``OMPI_COMM_WORLD_LOCAL_RANK`` from the host slot layout."""
     env = dict(base_env if base_env is not None else os.environ)
     env.update({
         _config.HOROVOD_RANK: str(rank),
         _config.HOROVOD_SIZE: str(size),
-        _config.HOROVOD_LOCAL_RANK: str(rank),
-        _config.HOROVOD_LOCAL_SIZE: str(size),
-        _config.HOROVOD_CROSS_RANK: "0",
-        _config.HOROVOD_CROSS_SIZE: "1",
-        _config.HOROVOD_CONTROLLER_ADDR: "127.0.0.1",
+        _config.HOROVOD_LOCAL_RANK: str(
+            rank if local_rank is None else local_rank),
+        _config.HOROVOD_LOCAL_SIZE: str(
+            size if local_size is None else local_size),
+        _config.HOROVOD_CROSS_RANK: str(cross_rank),
+        _config.HOROVOD_CROSS_SIZE: str(cross_size),
+        _config.HOROVOD_CONTROLLER_ADDR: controller_addr,
         _config.HOROVOD_CONTROLLER_PORT: str(port),
         _config.HOROVOD_SECRET_KEY: secret,
     })
     if host_data_plane:
         env[_config.HOROVOD_DATA_PLANE] = "host"
     return env
+
+
+def parse_hosts(spec: str) -> List[tuple]:
+    """Parse mpirun-style ``host1:slots,host2:slots`` (slots default 1)."""
+    hosts = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if ":" in item:
+            name, slots_s = item.rsplit(":", 1)
+            try:
+                slots = int(slots_s)
+            except ValueError:
+                raise ValueError(f"bad host spec {item!r}: slots must be an "
+                                 f"integer") from None
+        else:
+            name, slots = item, 1
+        if not name or slots < 1:
+            raise ValueError(f"bad host spec {item!r}")
+        hosts.append((name, slots))
+    if not hosts:
+        raise ValueError(f"empty host spec {spec!r}")
+    return hosts
+
+
+_LOCAL_HOSTS = ("localhost", "127.0.0.1")
+
+
+def _rsh_wrap(rsh_agent: Sequence[str], host: str,
+              env: Dict[str, str], command: Sequence[str]) -> List[str]:
+    """Build the remote launch line: ``<rsh...> <host> env K=V... cmd``.
+
+    The rsh agent is pluggable exactly like mpirun's ``plm_rsh_agent`` —
+    the hook the reference's Spark integration uses to route orted launches
+    through its task services (``spark/driver/mpirun_rsh.py:24-38``). Only
+    the world env vars are forwarded (the remote side keeps its own
+    inherited environment)."""
+    import shlex
+
+    world_keys = [
+        _config.HOROVOD_RANK, _config.HOROVOD_SIZE,
+        _config.HOROVOD_LOCAL_RANK, _config.HOROVOD_LOCAL_SIZE,
+        _config.HOROVOD_CROSS_RANK, _config.HOROVOD_CROSS_SIZE,
+        _config.HOROVOD_CONTROLLER_ADDR, _config.HOROVOD_CONTROLLER_PORT,
+        _config.HOROVOD_SECRET_KEY, _config.HOROVOD_DATA_PLANE,
+    ]
+    assignments = [f"{k}={env[k]}" for k in world_keys if k in env]
+    remote = " ".join(["env"] + [shlex.quote(a) for a in assignments] +
+                      [shlex.quote(c) for c in command])
+    return list(rsh_agent) + [host, remote]
+
+
+def launch_hosts(command: Sequence[str], hosts: List[tuple],
+                 rsh_agent: Optional[Sequence[str]] = None,
+                 controller_addr: Optional[str] = None,
+                 env_extra: Optional[Dict[str, str]] = None,
+                 host_data_plane: bool = False,
+                 job_timeout_s: Optional[float] = None,
+                 cancel_event: Optional["threading.Event"] = None) -> int:
+    """Multi-host launch: ``mpirun -H host1:s1,host2:s2`` semantics.
+
+    Ranks are assigned host-major (fill each host's slots before moving
+    on — mpirun's by-slot default). Each host entry becomes one
+    local-world: local_rank within the entry, cross_rank = entry index
+    (the structure ``MPI_Comm_split_type(SHARED)`` discovers in the
+    reference, ``operations.cc:1760-1797``). Remote hosts launch through
+    ``rsh_agent`` (default ``ssh``); ``localhost``/``127.0.0.1`` entries
+    exec directly, which is also how the multi-host code path is tested
+    without a cluster."""
+    size = sum(slots for _, slots in hosts)
+    remote = any(h not in _LOCAL_HOSTS for h, _ in hosts)
+    if controller_addr is None:
+        controller_addr = (socket.gethostbyname(socket.gethostname())
+                           if remote else "127.0.0.1")
+    port = _free_port("0.0.0.0" if remote else "127.0.0.1")
+    secret = make_secret()
+    rsh = list(rsh_agent) if rsh_agent else ["ssh"]
+    procs: List[subprocess.Popen] = []
+    try:
+        rank = 0
+        for cross_rank, (host, slots) in enumerate(hosts):
+            for local_rank in range(slots):
+                env = build_rank_env(
+                    rank, size, port, secret,
+                    host_data_plane=host_data_plane,
+                    local_rank=local_rank, local_size=slots,
+                    cross_rank=cross_rank, cross_size=len(hosts),
+                    controller_addr=controller_addr)
+                if env_extra:
+                    env.update(env_extra)
+                if host in _LOCAL_HOSTS and rsh_agent is None:
+                    argv = list(command)
+                else:
+                    argv = _rsh_wrap(rsh, host, env, command)
+                procs.append(subprocess.Popen(
+                    argv, env=env, start_new_session=True))
+                rank += 1
+        return _wait_all(procs, job_timeout_s, cancel_event)
+    finally:
+        _terminate_all(procs)
 
 
 class LaunchError(RuntimeError):
@@ -155,8 +266,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         description="Launch a horovod_tpu job: one process per rank on this "
                     "host (mpirun replacement; TPU pods use one process per "
                     "host via the TPU VM runtime instead).")
-    parser.add_argument("-np", "--num-proc", type=int, required=True,
-                        help="number of ranks to spawn")
+    parser.add_argument("-np", "--num-proc", type=int, default=None,
+                        help="number of ranks to spawn (single host)")
+    parser.add_argument("-H", "--hosts", default=None,
+                        help="mpirun-style host list 'host1:slots,"
+                             "host2:slots'; remote hosts launch via the rsh "
+                             "agent, localhost entries exec directly")
+    parser.add_argument("--rsh-agent", default=None,
+                        help="remote shell command for -H (default: ssh); "
+                             "the plm_rsh_agent hook of mpirun")
+    parser.add_argument("--controller-addr", default=None,
+                        help="address workers use to reach the rank-0 "
+                             "controller (default: this host's address for "
+                             "remote -H, else 127.0.0.1)")
     parser.add_argument("--host-data-plane", action="store_true",
                         help="force the numpy-over-TCP eager data plane "
                              "(CPU test worlds)")
@@ -168,7 +290,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = parser.parse_args(argv)
     if not args.command:
         parser.error("no command given")
+    if (args.num_proc is None) == (args.hosts is None):
+        parser.error("exactly one of -np or -H is required")
     try:
+        if args.hosts is not None:
+            return launch_hosts(
+                args.command, parse_hosts(args.hosts),
+                rsh_agent=(args.rsh_agent.split()
+                           if args.rsh_agent else None),
+                controller_addr=args.controller_addr,
+                host_data_plane=args.host_data_plane,
+                job_timeout_s=args.timeout)
         return launch(args.command, args.num_proc,
                       host_data_plane=args.host_data_plane,
                       job_timeout_s=args.timeout)

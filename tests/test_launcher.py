@@ -82,3 +82,102 @@ def test_horovodrun_cli():
         capture_output=True, text=True, timeout=120,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     assert rc.returncode == 0, rc.stderr
+
+
+def test_parse_hosts():
+    from horovod_tpu.runner.launcher import parse_hosts
+
+    assert parse_hosts("a:2,b:3") == [("a", 2), ("b", 3)]
+    assert parse_hosts("solo") == [("solo", 1)]
+    with pytest.raises(ValueError):
+        parse_hosts("a:x")
+    with pytest.raises(ValueError):
+        parse_hosts("a:0")
+    with pytest.raises(ValueError):
+        parse_hosts("")
+
+
+def test_launch_hosts_topology():
+    """-H localhost:2,localhost:2 = a 2x2 virtual cluster: global ranks
+    0..3, local ranks 0..1 per entry, cross ranks 0..1 (the comm-split
+    structure of ``operations.cc:1760-1797``), with a real allreduce."""
+    from horovod_tpu.runner.launcher import launch_hosts
+
+    probe = (
+        "import os, sys, json\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "out = hvd.allreduce(np.ones(2, np.float32), average=False,\n"
+        "                    name='mh.sum')\n"
+        "assert float(np.asarray(out)[0]) == 4.0, np.asarray(out)\n"
+        "expect_local = hvd.rank() % 2\n"
+        "expect_cross = hvd.rank() // 2\n"
+        "assert hvd.local_rank() == expect_local, (hvd.rank(), hvd.local_rank())\n"
+        "assert hvd.local_size() == 2\n"
+        "assert hvd.cross_rank() == expect_cross, (hvd.rank(), hvd.cross_rank())\n"
+        "assert hvd.cross_size() == 2\n"
+        "hvd.shutdown()\n"
+    )
+    rc = launch_hosts([sys.executable, "-c", probe],
+                      [("localhost", 2), ("localhost", 2)],
+                      host_data_plane=True, job_timeout_s=120.0)
+    assert rc == 0
+
+
+def test_launch_hosts_rsh_agent(tmp_path):
+    """A custom rsh agent (mpirun's plm_rsh_agent hook, the seam the
+    reference's Spark integration uses — ``spark/driver/mpirun_rsh.py``)
+    must be invoked once per rank with the host and the env-wrapped
+    command, and the job must still work end to end."""
+    from horovod_tpu.runner.launcher import launch_hosts
+
+    log = tmp_path / "rsh_calls"
+    agent = tmp_path / "fake_rsh.py"
+    agent.write_text(
+        "#!/usr/bin/env python\n"
+        "import subprocess, sys\n"
+        f"open({str(log)!r}, 'a').write(sys.argv[1] + '\\n')\n"
+        "host, remote = sys.argv[1], sys.argv[2]\n"
+        "sys.exit(subprocess.call(['bash', '-c', remote]))\n")
+    probe = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import horovod_tpu as hvd\n"
+        "hvd.init()\n"
+        "out = hvd.allreduce(np.ones(1, np.float32), average=False, name='r')\n"
+        "assert float(np.asarray(out)[0]) == 2.0\n"
+        "hvd.shutdown()\n"
+    )
+    rc = launch_hosts(
+        [sys.executable, "-c", probe], [("localhost", 1), ("localhost", 1)],
+        rsh_agent=[sys.executable, str(agent)],
+        controller_addr="127.0.0.1",
+        host_data_plane=True, job_timeout_s=120.0)
+    assert rc == 0
+    calls = log.read_text().splitlines()
+    assert calls == ["localhost", "localhost"]
+
+
+def test_horovodrun_cli_hosts():
+    import subprocess
+
+    rc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-H",
+         "localhost:2", "--host-data-plane",
+         sys.executable, _WORKER, "allreduce"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert rc.returncode == 0, rc.stderr
+
+
+def test_horovodrun_cli_np_and_hosts_conflict():
+    import subprocess
+
+    rc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2", "-H",
+         "localhost:2", sys.executable, "-c", "pass"],
+        capture_output=True, text=True, timeout=60)
+    assert rc.returncode != 0
+    assert "exactly one of" in rc.stderr

@@ -1,0 +1,53 @@
+"""Force JAX onto virtual CPU devices — the "no cluster needed" fixture.
+
+The reference's test fixture is single-process MPI (a self-initialized world
+of size 1, SURVEY §4); ours is N virtual XLA CPU devices in one process.
+Pinning matters beyond tests: in this environment the experimental TPU
+plugin can hang for minutes inside a bare ``jax.devices()`` call, so any
+code path that must never touch the real chip (tests, the driver's
+multi-chip dryrun) pins the platform first.
+
+The TPU plugin prepends itself to ``JAX_PLATFORMS``, so scrubbing the env
+var alone is not enough — the config must also be overridden after import.
+Both the env mutation and ``jax.config.update`` take effect as long as no
+backend has spun up yet; XLA_FLAGS is read lazily at backend creation.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_cpu_platform(n_devices: int = 8) -> None:
+    """Pin JAX to ``n_devices`` virtual CPU devices, verifying the result.
+
+    Must be called before any JAX backend query (``jax.devices()``,
+    ``jax.process_index()``, array creation, ...). Safe to call after
+    ``import jax`` itself. If another backend already spun up, the config
+    update is a silent no-op in JAX — so this function queries the devices
+    it just pinned and raises rather than letting the caller proceed on the
+    wrong platform with the wrong device count.
+    """
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    os.environ.pop("JAX_PLATFORMS", None)
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = " ".join(
+            flag if f.startswith("--xla_force_host_platform_device_count")
+            else f for f in flags.split())
+    else:
+        flags = f"{flags} {flag}".strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    if devices[0].platform != "cpu" or len(devices) < n_devices:
+        raise RuntimeError(
+            f"pin_cpu_platform({n_devices}) failed: JAX reports "
+            f"{len(devices)} {devices[0].platform!r} device(s). A backend "
+            f"was already initialized before the pin ran — call "
+            f"pin_cpu_platform before any jax.devices()/array operation.")

@@ -7,21 +7,14 @@ collectives, plus subprocess-based launcher tests for true multi-process
 negotiation (``test_multiprocess.py``). Env must be set before jax imports.
 """
 
-import os
-
 # Force CPU for tests even when the session env points at a real TPU: tests
-# must run on the virtual 8-device mesh and never touch the bench chip. The
-# TPU plugin prepends itself to JAX_PLATFORMS, so the env var alone is not
-# enough — override the config after import, before any backend spins up.
-os.environ.pop("JAX_PLATFORMS", None)
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+# must run on the virtual 8-device mesh and never touch the bench chip.
+# Importing the helper executes horovod_tpu/__init__.py first; that chain
+# performs no backend query today, and pin_cpu_platform verifies the pinned
+# platform and raises if any future import defeats the pin.
+from horovod_tpu.core.platform import pin_cpu_platform
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+pin_cpu_platform(8)
 
 import pytest  # noqa: E402
 

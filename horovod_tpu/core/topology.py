@@ -76,9 +76,14 @@ def discover(use_jax: bool = True) -> Topology:
         local_size = int(env.get(_config.HOROVOD_LOCAL_SIZE, 1))
         cross_rank = int(env.get(_config.HOROVOD_CROSS_RANK, rank // max(local_size, 1)))
         cross_size = int(env.get(_config.HOROVOD_CROSS_SIZE, size // max(local_size, 1)))
-        if use_jax:
-            _, _, local_devices, _ = 0, 0, _local_devices_safe(), 0
+        if use_jax and env.get(_config.HOROVOD_DATA_PLANE) != "host":
+            local_devices = _local_devices_safe()
         else:
+            # Host-plane worlds (numpy-over-TCP; the torch/TF front-ends'
+            # CPU deployment) never touch accelerators: one rank == one
+            # device, and querying JAX here would needlessly initialize —
+            # and on a machine with a wedged/slow TPU plugin, hang — a
+            # backend the job will not use.
             local_devices = 1
         return Topology(
             rank=rank,

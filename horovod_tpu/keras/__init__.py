@@ -1,0 +1,36 @@
+"""Standalone-keras front-end.
+
+The reference ships two shims — ``horovod/keras`` (keras 1/2) and
+``horovod/tensorflow/keras`` (tf.keras) — over one implementation
+(``horovod/_keras``, SURVEY §2.5). In Keras 3 ``keras`` and ``tf.keras``
+are the same package, so this module re-exports the single implementation
+under the reference's second import path.
+"""
+
+from ..tensorflow.keras import (  # noqa: F401
+    Compression,
+    DistributedOptimizer,
+    allgather,
+    allreduce,
+    broadcast,
+    broadcast_global_variables,
+    broadcast_variables,
+    callbacks,
+    init,
+    is_initialized,
+    load_model,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
+
+__all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "is_initialized", "mpi_threads_supported",
+    "DistributedOptimizer", "Compression", "broadcast_variables",
+    "broadcast_global_variables", "allreduce", "allgather", "broadcast",
+    "load_model", "callbacks",
+]

@@ -1,0 +1,394 @@
+"""TensorFlow (TF2) front-end on the TPU-native engine.
+
+Rebuild of ``horovod/tensorflow/__init__.py`` (the reference's largest user
+surface: ``allreduce`` :46-93, ``broadcast_global_variables`` :95,
+``broadcast_variables`` :105, ``BroadcastGlobalVariablesHook`` :117-148,
+``DistributedOptimizer`` :151-249, ``DistributedGradientTape`` :252-326)
+without the custom-op ``.so``: eager tensors hand off to the shared
+collective engine via numpy (zero-copy for CPU tensors), and code inside
+``tf.function`` submits through ``tf.py_function`` with names bound at
+TRACE time — the controller's named-tensor negotiation then tolerates any
+runtime execution order, exactly the property the reference's coordinator
+provides for its async custom ops.
+
+Sparse gradients (``tf.IndexedSlices``) use the reference's
+2×allgather construction (``tensorflow/__init__.py:72-83``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .. import basics
+from .. import ops as _ops
+from ..basics import (  # noqa: F401  (re-exported API surface)
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
+from .compression import Compression
+
+__all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "cross_rank", "cross_size", "is_initialized", "mpi_threads_supported",
+    "allreduce", "allgather", "broadcast",
+    "broadcast_variables", "broadcast_global_variables",
+    "BroadcastGlobalVariablesHook", "DistributedOptimizer",
+    "DistributedGradientTape", "Compression",
+]
+
+_name_lock = threading.Lock()
+_name_counter = 0
+
+
+def _auto_name(op: str) -> str:
+    """Deterministic fallback names, assigned in Python call order — the
+    analog of the reference keying on TF node names: identical programs on
+    every rank produce identical sequences (same caveat as the reference:
+    rank-divergent call order needs explicit names)."""
+    global _name_counter
+    with _name_lock:
+        n = _name_counter
+        _name_counter += 1
+    return f"tf.{op}.{n}"
+
+
+def _to_numpy(t):
+    """TF tensor → numpy. bfloat16 is widened to f32 for the wire (numpy
+    proper has no bf16); the caller narrows back."""
+    import tensorflow as tf
+
+    if t.dtype == tf.bfloat16:
+        return tf.cast(t, tf.float32).numpy(), tf.bfloat16
+    return t.numpy(), None
+
+
+def _from_numpy(arr, narrow_to):
+    import tensorflow as tf
+
+    out = tf.convert_to_tensor(np.ascontiguousarray(arr))
+    if narrow_to is not None:
+        out = tf.cast(out, narrow_to)
+    return out
+
+
+def _eager_roundtrip(submit, t, keep_shape: bool = True):
+    """submit(numpy) -> handle; waits and converts back, preserving bf16.
+
+    ``keep_shape`` restores the input shape (the multi-process host plane
+    returns 0-d scalars as shape-(1,); same defense as the torch
+    front-end's ``reshape``) — allgather passes False since its first dim
+    legitimately grows."""
+    import tensorflow as tf
+
+    arr, narrow = _to_numpy(t)
+    out = _from_numpy(_ops.synchronize(submit(arr)), narrow)
+    if keep_shape and out.shape != t.shape:
+        out = tf.reshape(out, t.shape)
+    return out
+
+
+def _graph_op(fn, t, out_dtype, out_shape):
+    """Wrap an engine roundtrip as a graph node. The python body runs at
+    step time on the host; the name was fixed at trace time by the caller."""
+    import tensorflow as tf
+
+    out = tf.py_function(fn, [t], Tout=out_dtype)
+    out.set_shape(out_shape)
+    return out
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              compression=Compression.none, device_dense: str = "",
+              device_sparse: str = ""):
+    """Allreduce a tf.Tensor/tf.Variable/tf.IndexedSlices across ranks.
+
+    ``device_dense``/``device_sparse`` are accepted for API parity and
+    ignored — placement is XLA's job on TPU (SURVEY §2.10)."""
+    import tensorflow as tf
+
+    if isinstance(tensor, tf.IndexedSlices):
+        # 2×allgather sparse path (reference :72-83)
+        values = allgather(tensor.values,
+                           name=None if name is None else f"{name}.values")
+        indices = allgather(tensor.indices,
+                            name=None if name is None else f"{name}.indices")
+        if average:
+            values = tf.divide(values, tf.cast(size(), values.dtype))
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+
+    name = name or _auto_name("allreduce")
+    compressed, ctx = compression.compress(tf.convert_to_tensor(tensor))
+    if tf.executing_eagerly():
+        out = _eager_roundtrip(
+            lambda a: _ops.allreduce_async(a, average=average, name=name),
+            compressed)
+    else:
+        def _run(t):
+            arr, narrow = _to_numpy(t)
+            h = _ops.allreduce_async(arr, average=average, name=name)
+            res = np.asarray(_ops.synchronize(h)).reshape(arr.shape)
+            return _from_numpy(res, narrow)
+
+        out = _graph_op(_run, compressed, compressed.dtype, compressed.shape)
+    return compression.decompress(out, ctx)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    """Concatenate across ranks on dim 0; first dims may differ per rank."""
+    import tensorflow as tf
+
+    name = name or _auto_name("allgather")
+    tensor = tf.convert_to_tensor(tensor)
+    if tf.executing_eagerly():
+        return _eager_roundtrip(
+            lambda a: _ops.allgather_async(a, name=name), tensor,
+            keep_shape=False)
+
+    def _run(t):
+        arr, narrow = _to_numpy(t)
+        h = _ops.allgather_async(arr, name=name)
+        return _from_numpy(_ops.synchronize(h), narrow)
+
+    out_shape = tf.TensorShape([None]).concatenate(tensor.shape[1:])
+    return _graph_op(_run, tensor, tensor.dtype, out_shape)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    import tensorflow as tf
+
+    name = name or _auto_name("broadcast")
+    tensor = tf.convert_to_tensor(tensor)
+    if tf.executing_eagerly():
+        return _eager_roundtrip(
+            lambda a: _ops.broadcast_async(a, root_rank, name=name), tensor)
+
+    def _run(t):
+        arr, narrow = _to_numpy(t)
+        h = _ops.broadcast_async(arr, root_rank, name=name)
+        res = np.asarray(_ops.synchronize(h)).reshape(arr.shape)
+        return _from_numpy(res, narrow)
+
+    return _graph_op(_run, tensor, tensor.dtype, tensor.shape)
+
+
+def broadcast_variables(variables, root_rank: int = 0):
+    """Assign rank-``root_rank``'s values to ``variables`` on every rank
+    (reference :105-114). Eager: in-place, batched through the engine so
+    fusion applies. Graph: returns a grouped assign op."""
+    import tensorflow as tf
+
+    variables = list(variables)
+    if basics.size() == 1:
+        return tf.group() if not tf.executing_eagerly() else None
+    if tf.executing_eagerly():
+        handles = []
+        for i, var in enumerate(variables):
+            arr, narrow = _to_numpy(tf.convert_to_tensor(var))
+            h = _ops.broadcast_async(
+                arr, root_rank, name=f"broadcast_variables.{i}.{var.name}")
+            handles.append((var, narrow, h))
+        for var, narrow, h in handles:
+            out = _from_numpy(_ops.synchronize(h), narrow)
+            var.assign(tf.reshape(out, var.shape))
+        return None
+    return tf.group(*[
+        var.assign(tf.reshape(
+            broadcast(tf.convert_to_tensor(var), root_rank,
+                      name=f"broadcast_variables.{i}.{var.name}"),
+            var.shape))
+        for i, var in enumerate(variables)])
+
+
+def broadcast_global_variables(root_rank: int = 0):
+    """TF1-compat surface (reference :95-102): broadcasts
+    ``tf.compat.v1.global_variables()``. In TF2 eager there are no global
+    variables — use :func:`broadcast_variables` on your model/optimizer
+    variables instead."""
+    import tensorflow as tf
+
+    if tf.executing_eagerly():
+        raise RuntimeError(
+            "broadcast_global_variables() does not support eager execution. "
+            "Please use `broadcast_variables(<model/optimizer variables>)` "
+            "instead.")
+    return broadcast_variables(tf.compat.v1.global_variables(), root_rank)
+
+
+def _make_broadcast_global_variables_hook():
+    import tensorflow as tf
+
+    class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
+        """SessionRunHook broadcasting global variables once after session
+        creation (reference :117-148)."""
+
+        def __init__(self, root_rank: int, device: str = "") -> None:
+            super().__init__()
+            self.root_rank = root_rank
+            self.bcast_op = None
+            self.device = device  # parity; placement is XLA's job
+
+        def begin(self):
+            if not self.bcast_op or \
+                    self.bcast_op.graph != tf.compat.v1.get_default_graph():
+                self.bcast_op = broadcast_global_variables(self.root_rank)
+
+        def after_create_session(self, session, coord):
+            session.run(self.bcast_op)
+
+    return BroadcastGlobalVariablesHook
+
+
+def __getattr__(attr):
+    # BroadcastGlobalVariablesHook subclasses a tf.compat.v1 class, so its
+    # definition must not force `import tensorflow` at package import.
+    if attr == "BroadcastGlobalVariablesHook":
+        cls = _make_broadcast_global_variables_hook()
+        globals()[attr] = cls
+        return cls
+    raise AttributeError(f"module {__name__!r} has no attribute {attr!r}")
+
+
+def _allreduce_grads(grads, compression, sparse_as_dense: bool,
+                     name_prefix: str):
+    """Allreduce a gradient list. Inside tf.function, all dense gradients go
+    through ONE py_function — one host hop, submitted async together so the
+    engine's fusion buffer packs them (the reference relies on its fusion
+    cycle for the same effect); eager submissions are likewise batched."""
+    import tensorflow as tf
+
+    if sparse_as_dense:
+        grads = [tf.convert_to_tensor(g)
+                 if g is not None and isinstance(g, tf.IndexedSlices) else g
+                 for g in grads]
+    names = [f"{name_prefix}.{i}" for i in range(len(grads))]
+    dense_idx = [i for i, g in enumerate(grads)
+                 if g is not None and not isinstance(g, tf.IndexedSlices)]
+    out = list(grads)
+    for i, g in enumerate(grads):
+        if g is not None and isinstance(g, tf.IndexedSlices):
+            out[i] = allreduce(g, average=True, name=names[i])
+    if not dense_idx:
+        return out
+
+    dense = [tf.convert_to_tensor(grads[i]) for i in dense_idx]
+    compressed, ctxs = zip(*[compression.compress(g) for g in dense])
+    dense_names = [names[i] for i in dense_idx]
+
+    def _run(*tensors):
+        submitted = []
+        for t, n in zip(tensors, dense_names):
+            arr, narrow = _to_numpy(t)
+            submitted.append(
+                (_ops.allreduce_async(arr, average=True, name=n), narrow,
+                 arr.shape))
+        return [_from_numpy(np.asarray(_ops.synchronize(h)).reshape(shape),
+                            narrow)
+                for h, narrow, shape in submitted]
+
+    if tf.executing_eagerly():
+        reduced = _run(*compressed)
+    else:
+        reduced = tf.py_function(
+            _run, list(compressed), Tout=[t.dtype for t in compressed])
+        if not isinstance(reduced, (list, tuple)):
+            reduced = [reduced]
+        for r, t in zip(reduced, compressed):
+            r.set_shape(t.shape)
+    for slot, r, ctx in zip(dense_idx, reduced, ctxs):
+        out[slot] = compression.decompress(r, ctx)
+    return out
+
+
+class DistributedOptimizer:
+    """Wrap a ``tf.compat.v1.train.Optimizer`` so ``compute_gradients``
+    returns world-averaged gradients (reference :151-249 — delegation, not
+    subclassing: ``apply_gradients``/slots forward to the inner optimizer).
+
+    For Keras 3 / ``tf.keras`` optimizers use
+    ``horovod_tpu.tensorflow.keras.DistributedOptimizer``."""
+
+    def __init__(self, optimizer, name: Optional[str] = None,
+                 use_locking: bool = False, device_dense: str = "",
+                 device_sparse: str = "", compression=Compression.none,
+                 sparse_as_dense: bool = False) -> None:
+        self._optimizer = optimizer
+        self._name = name or f"Distributed{type(optimizer).__name__}"
+        self._use_locking = use_locking
+        self._device_dense = device_dense
+        self._device_sparse = device_sparse
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+
+    def compute_gradients(self, *args, **kwargs):
+        grads_and_vars = self._optimizer.compute_gradients(*args, **kwargs)
+        if basics.size() == 1:
+            return grads_and_vars
+        grads, variables = zip(*grads_and_vars)
+        avg = _allreduce_grads(list(grads), self._compression,
+                               self._sparse_as_dense,
+                               name_prefix=f"{self._name}_Allreduce")
+        return list(zip(avg, variables))
+
+    def minimize(self, loss, **kwargs):
+        var_list = kwargs.pop("var_list", None)
+        global_step = kwargs.pop("global_step", None)
+        grads_and_vars = self.compute_gradients(loss, var_list=var_list)
+        return self.apply_gradients(grads_and_vars, global_step=global_step)
+
+    def apply_gradients(self, *args, **kwargs):
+        return self._optimizer.apply_gradients(*args, **kwargs)
+
+    def get_slot(self, *args, **kwargs):
+        return self._optimizer.get_slot(*args, **kwargs)
+
+    def get_slot_names(self, *args, **kwargs):
+        return self._optimizer.get_slot_names(*args, **kwargs)
+
+    def variables(self, *args, **kwargs):
+        return self._optimizer.variables(*args, **kwargs)
+
+
+def DistributedGradientTape(gradtape, device_dense: str = "",
+                            device_sparse: str = "",
+                            compression=Compression.none,
+                            sparse_as_dense: bool = False):
+    """Wrap a ``tf.GradientTape`` so ``gradient()`` returns world-averaged
+    gradients (reference :252-326: dynamic subclass of the tape's class
+    keeping the original tape's recorded state)."""
+    import tensorflow as tf
+
+    class _DistributedGradientTape(tf.GradientTape):
+        def gradient(self, target, sources, output_gradients=None):
+            grads = super(self.__class__, self).gradient(
+                target, sources, output_gradients)
+            if basics.size() == 1:
+                return grads
+            flat = tf.nest.flatten(grads)
+            avg = _allreduce_grads(flat, self._hvd_compression,
+                                   self._hvd_sparse_as_dense,
+                                   name_prefix=self._hvd_name)
+            return tf.nest.pack_sequence_as(grads, avg)
+
+    donor = {k: v for k, v in _DistributedGradientTape.__dict__.items()
+             if k not in ("__dict__", "__weakref__")}
+    cls = type(gradtape.__class__.__name__, (gradtape.__class__,), donor)
+    # Rebind the live tape: its pushed-tape state must survive the wrap, so
+    # mutate __class__ rather than re-running __init__ (the reference copies
+    # the private _tape pointer; swapping the class is the TF2-safe form).
+    gradtape.__class__ = cls
+    gradtape._hvd_compression = compression
+    gradtape._hvd_sparse_as_dense = sparse_as_dense
+    gradtape._hvd_name = "DistributedGradientTape_Allreduce"
+    return gradtape

@@ -1,0 +1,59 @@
+"""Gradient compression for the TensorFlow front-end.
+
+Rebuild of ``horovod/tensorflow/compression.py`` (the 74-line none/fp16
+pair): compression happens in TF land — cast down before the wire, cast
+back after — so the engine only ever sees the compressed payload. bf16 is
+added beyond the reference because it is the native TPU wire format.
+"""
+
+from __future__ import annotations
+
+
+class NoneCompressor:
+    """Default: no-op (``compression.py:20-33``)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor:
+    """Cast float tensors to fp16 for the wire (``compression.py:36-64``)."""
+
+    _wire_dtype = "float16"
+
+    @classmethod
+    def compress(cls, tensor):
+        import tensorflow as tf
+
+        ctx = tensor.dtype
+        if tensor.dtype.is_floating:
+            tensor = tf.cast(tensor, getattr(tf, cls._wire_dtype))
+        return tensor, ctx
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        import tensorflow as tf
+
+        if ctx is not None and ctx.is_floating and tensor.dtype != ctx:
+            tensor = tf.cast(tensor, ctx)
+        return tensor
+
+
+class BF16Compressor(FP16Compressor):
+    """bf16 wire format — same exponent range as f32, the TPU-native choice
+    (extension beyond the reference's fp16)."""
+
+    _wire_dtype = "bfloat16"
+
+
+class Compression:
+    """Namespace matching the reference surface (``compression.py:67-74``)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

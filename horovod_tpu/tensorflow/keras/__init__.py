@@ -1,0 +1,157 @@
+"""tf.keras front-end (Keras 3) on the TPU-native engine.
+
+Rebuild of ``horovod/tensorflow/keras/__init__.py`` (:40-155) +
+``horovod/_keras/__init__.py``. In Keras 3 the gradient seam moved: there
+is no ``get_gradients`` (reference ``_keras/__init__.py:34-61``); every
+path — ``model.fit``'s compiled train step and manual
+``optimizer.apply_gradients`` — funnels through ``Optimizer.apply``, so the
+dynamic subclass overrides ``apply`` to allreduce first. Inside
+``model.fit``'s ``tf.function``, all dense gradients ride ONE
+``tf.py_function`` into the engine's fusion buffer (see
+``.._allreduce_grads``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import keras
+
+from ... import basics
+from ...basics import (  # noqa: F401  (re-exported API surface)
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
+from .. import _allreduce_grads, allgather as _tf_allgather, \
+    allreduce as _tf_allreduce, broadcast as _tf_broadcast, \
+    broadcast_variables
+from ..compression import Compression
+from . import callbacks  # noqa: F401
+
+__all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "is_initialized", "mpi_threads_supported",
+    "DistributedOptimizer", "Compression", "broadcast_variables",
+    "allreduce", "allgather", "broadcast", "load_model", "callbacks",
+]
+
+
+class _DistributedOptimizer:
+    """Method donor for the dynamic subclass (reference
+    ``_keras/__init__.py:22-61`` pattern, re-seamed onto ``apply``)."""
+
+    def apply(self, grads, trainable_variables=None):
+        if basics.size() > 1:
+            grads = _allreduce_grads(
+                list(grads),
+                getattr(self, "_hvd_compression", Compression.none),
+                getattr(self, "_hvd_sparse_as_dense", False),
+                name_prefix=getattr(self, "_hvd_name",
+                                    "DistributedOptimizer_Allreduce"))
+        return super(self.__class__, self).apply(grads, trainable_variables)
+
+
+def _make_distributed_class(base_cls, name: Optional[str] = None,
+                            compression=Compression.none,
+                            sparse_as_dense: bool = False):
+    """Dynamic subclass of ``base_cls`` with the allreducing ``apply``.
+
+    Keeps the wrapped optimizer's class name so a model saved with it
+    reloads without horovod_tpu installed (the reference's stated reason
+    for the ``type(...)`` construction), and so keras's deserializer —
+    which requires a CLASS with ``from_config`` in ``custom_objects`` —
+    can construct it directly during ``load_model``."""
+    # __dict__/__weakref__ descriptors belong to the donor class and would
+    # shadow the real ones on the subclass (breaking keras's save walker)
+    donor = {k: v for k, v in _DistributedOptimizer.__dict__.items()
+             if k not in ("__dict__", "__weakref__")}
+    donor["_hvd_compression"] = compression
+    donor["_hvd_sparse_as_dense"] = sparse_as_dense
+    donor["_hvd_name"] = (name or f"Distributed{base_cls.__name__}"
+                          ) + "_Allreduce"
+    return type(base_cls.__name__, (base_cls,), donor)
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         device_dense: str = "", device_sparse: str = "",
+                         compression=Compression.none,
+                         sparse_as_dense: bool = False):
+    """Wrap a keras optimizer so gradients are world-averaged before the
+    update (reference ``tensorflow/keras/__init__.py:40-66``).
+
+    ``device_dense``/``device_sparse`` are accepted for API parity and
+    ignored — placement is XLA's job on TPU."""
+    cls = _make_distributed_class(optimizer.__class__, name=name,
+                                  compression=compression,
+                                  sparse_as_dense=sparse_as_dense)
+    return cls.from_config(optimizer.get_config())
+
+
+def broadcast_global_variables(model, root_rank: int = 0) -> None:
+    """Broadcast a model's (+ its optimizer's) variables from root_rank.
+
+    The reference signature takes no model (TF1 global-variable
+    collection, ``tensorflow/keras/__init__.py:68-76``); Keras 3 has no
+    such collection, so the model is explicit here."""
+    variables = list(model.variables)
+    if getattr(model, "optimizer", None) is not None:
+        variables += list(model.optimizer.variables)
+    broadcast_variables(variables, root_rank)
+
+
+def allreduce(value, name: Optional[str] = None, average: bool = True):
+    """Allreduce a tensor-compatible value, returned as numpy
+    (reference ``tensorflow/keras/__init__.py:78-90`` semantics)."""
+    import numpy as np
+    import tensorflow as tf
+
+    out = _tf_allreduce(tf.convert_to_tensor(value), average=average,
+                        name=name)
+    return np.asarray(out)
+
+
+def allgather(value, name: Optional[str] = None):
+    import numpy as np
+    import tensorflow as tf
+
+    return np.asarray(_tf_allgather(tf.convert_to_tensor(value), name=name))
+
+
+def broadcast(value, root_rank: int, name: Optional[str] = None):
+    import numpy as np
+    import tensorflow as tf
+
+    return np.asarray(
+        _tf_broadcast(tf.convert_to_tensor(value), root_rank, name=name))
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none):
+    """Load a saved keras model with its optimizer re-wrapped as a
+    DistributedOptimizer, preserving restored optimizer state
+    (reference ``tensorflow/keras/__init__.py:121-155``)."""
+
+    def wrap_optimizer(cls):
+        # keras 3 deserialization requires a class (constructed via
+        # from_config), not a factory function
+        return _make_distributed_class(cls, compression=compression)
+
+    horovod_objects = {
+        subclass.__name__: wrap_optimizer(subclass)
+        for subclass in vars(keras.optimizers).values()
+        if isinstance(subclass, type) and
+        issubclass(subclass, keras.optimizers.Optimizer) and
+        subclass is not keras.optimizers.Optimizer
+    }
+    if custom_optimizers is not None:
+        horovod_objects.update({
+            cls.__name__: wrap_optimizer(cls) for cls in custom_optimizers})
+    if custom_objects is not None:
+        horovod_objects.update(custom_objects)
+    return keras.models.load_model(filepath, custom_objects=horovod_objects)

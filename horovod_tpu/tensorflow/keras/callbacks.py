@@ -1,0 +1,190 @@
+"""Keras callbacks for the tf.keras front-end.
+
+Rebuild of ``horovod/_keras/callbacks.py`` + the ``tensorflow/keras``
+binding (reference: ``BroadcastGlobalVariablesCallbackImpl`` :20-30,
+``MetricAverageCallbackImpl`` :33-67, ``LearningRateScheduleCallbackImpl``
+:70-147 with momentum correction, ``LearningRateWarmupCallbackImpl``
+:149-168 — the Goyal et al. gradual warmup) for Keras 3, where there is no
+session/backend object: metric averaging goes straight through the eager
+engine and LR mutation targets ``model.optimizer.learning_rate``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import keras
+
+from ... import basics
+from ... import ops as _ops
+
+__all__ = [
+    "BroadcastGlobalVariablesCallback",
+    "MetricAverageCallback",
+    "LearningRateScheduleCallback",
+    "LearningRateWarmupCallback",
+]
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast rank-0 model + optimizer state at training start
+    (reference ``_keras/callbacks.py:20-30``).
+
+    Keras 3 creates optimizer slot variables lazily on the first
+    ``apply``, so the broadcast runs after the first batch — rank 0's
+    values overwrite whatever the divergent batch 0 computed, which is the
+    same consistency guarantee the reference's graph-mode broadcast gives
+    (every rank starts epoch-identical from rank 0's state)."""
+
+    def __init__(self, root_rank: int = 0, device: str = "") -> None:
+        super().__init__()
+        self.root_rank = root_rank
+        self.device = device  # parity; placement is XLA's job on TPU
+        self.broadcast_done = False
+
+    def _broadcast(self) -> None:
+        from .. import broadcast_variables
+
+        variables = list(self.model.variables)
+        if getattr(self.model, "optimizer", None) is not None:
+            variables += list(self.model.optimizer.variables)
+        broadcast_variables(variables, self.root_rank)
+
+    def on_train_batch_end(self, batch, logs=None) -> None:
+        if self.broadcast_done or basics.size() == 1:
+            return
+        self._broadcast()
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch-end metrics across ranks so rank 0's logs (and any
+    downstream callbacks: checkpointing, early stopping) see world metrics
+    (reference ``_keras/callbacks.py:33-67``)."""
+
+    def __init__(self, device: str = "") -> None:
+        super().__init__()
+        self.device = device
+
+    def on_epoch_end(self, epoch, logs=None) -> None:
+        if not logs or basics.size() == 1:
+            return
+        for metric in sorted(logs):
+            value = np.asarray(float(logs[metric]), dtype=np.float64)
+            avg = _ops.allreduce(value, average=True,
+                                 name=f"metric.{metric}.epoch{epoch}")
+            logs[metric] = float(np.asarray(avg))
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """LR = initial_lr * multiplier(epoch) within [start_epoch, end_epoch),
+    staircase or smoothly interpolated per batch, with momentum correction
+    (reference ``_keras/callbacks.py:70-147``)."""
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None) -> None:
+        super().__init__()
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.initial_lr = None
+        self.restore_momentum = None
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+
+    def _autodetect_steps_per_epoch(self) -> int:
+        if self.params.get("steps"):
+            return self.params["steps"]
+        raise ValueError(
+            f"Could not autodetect the number of steps per epoch. Please "
+            f"specify the steps_per_epoch parameter to the "
+            f"{self.__class__.__name__}() or upgrade to the latest version "
+            f"of Keras.")
+
+    def _get_lr(self) -> float:
+        return float(
+            keras.ops.convert_to_numpy(self.model.optimizer.learning_rate))
+
+    def _set_lr(self, lr: float) -> None:
+        self.model.optimizer.learning_rate = lr
+
+    def _adjust_learning_rate(self, epoch: float) -> None:
+        old_lr = self._get_lr()
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        self._set_lr(new_lr)
+        opt = self.model.optimizer
+        if self.momentum_correction and \
+                getattr(opt, "momentum", None) not in (None, 0):
+            # momentum correction (Goyal et al.): scale momentum by the LR
+            # ratio for the step where LR changes, restore afterwards
+            self.restore_momentum = float(opt.momentum)
+            opt.momentum = self.restore_momentum * new_lr / old_lr
+
+    def _restore_momentum_if_needed(self) -> None:
+        if self.restore_momentum:
+            self.model.optimizer.momentum = self.restore_momentum
+            self.restore_momentum = None
+
+    def on_train_begin(self, logs=None) -> None:
+        self.initial_lr = self._get_lr()
+        if not self.staircase and not self.steps_per_epoch:
+            self.steps_per_epoch = self._autodetect_steps_per_epoch()
+
+    def on_epoch_begin(self, epoch, logs=None) -> None:
+        self.current_epoch = epoch
+
+    def on_train_batch_begin(self, batch, logs=None) -> None:
+        if self.current_epoch < self.start_epoch or \
+                (self.end_epoch is not None and
+                 self.current_epoch >= self.end_epoch):
+            return
+        if self.staircase and batch == 0:
+            self._adjust_learning_rate(self.current_epoch)
+        elif not self.staircase:
+            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+            self._adjust_learning_rate(epoch)
+
+    def on_train_batch_end(self, batch, logs=None) -> None:
+        self._restore_momentum_if_needed()
+
+    def on_epoch_end(self, epoch, logs=None) -> None:
+        if logs is not None:
+            logs["lr"] = self._get_lr()
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from a 1-worker LR to the size()-scaled LR over
+    ``warmup_epochs`` (reference ``_keras/callbacks.py:149-168``)."""
+
+    def __init__(self, warmup_epochs: int = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None,
+                 verbose: int = 0) -> None:
+        def multiplier(epoch: float) -> float:
+            # shifted so epoch boundaries land on round LR values, as the
+            # reference notes for TensorBoard readability
+            epoch += 1.0 / self.steps_per_epoch
+            return 1.0 / basics.size() * (
+                epoch * (basics.size() - 1) / warmup_epochs + 1)
+
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None) -> None:
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0:
+            print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {self._get_lr():g}.")

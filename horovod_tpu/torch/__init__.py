@@ -219,8 +219,9 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     (``torch/__init__.py:163-198``: a dynamic subclass of the user's
     optimizer class, initialized from its param_groups so per-group
     hyperparameters carry over)."""
-    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
-               dict(_DistributedOptimizer.__dict__))
+    donor = {k: v for k, v in _DistributedOptimizer.__dict__.items()
+             if k not in ("__dict__", "__weakref__")}
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,), donor)
     return cls(optimizer.param_groups, named_parameters, compression,
                backward_passes_per_step)
 

@@ -96,6 +96,14 @@ def test_jax_mnist():
     assert out.returncode == 0
 
 
+def test_tensorflow_mnist():
+    out = _run_example(
+        "tensorflow_mnist.py",
+        ["--epochs", "1", "--batch-size", "32", "--samples", "64"])
+    assert "epoch 0: loss=" in out.stdout
+    assert "done" in out.stdout
+
+
 def test_haiku_mnist():
     out = _run_example("haiku_mnist.py",
                        ["--steps", "10", "--batch-size", "8"])

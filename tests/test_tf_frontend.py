@@ -1,0 +1,149 @@
+"""TensorFlow front-end (reference: ``test/test_tensorflow.py`` op tests +
+``test/test_tensorflow_keras.py`` end-to-end fit, run against the
+TPU-native engine)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = pytest.importorskip("keras")
+
+import horovod_tpu.tensorflow as hvd_tf  # noqa: E402
+import horovod_tpu.tensorflow.keras as hvd_keras  # noqa: E402
+
+
+def test_tf_allreduce_roundtrip(hvd):
+    t = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+    out = hvd_tf.allreduce(t, average=False, name="tf.ar")
+    assert isinstance(out, tf.Tensor)
+    np.testing.assert_array_equal(out.numpy(), t.numpy())
+
+
+def test_tf_bf16_roundtrip(hvd):
+    t = tf.cast(tf.ones((4,)), tf.bfloat16)
+    out = hvd_tf.allreduce(t, average=True, name="tf.bf16")
+    assert out.dtype == tf.bfloat16
+    np.testing.assert_array_equal(tf.cast(out, tf.float32).numpy(), 1.0)
+
+
+def test_tf_fp16_compression(hvd):
+    t = tf.constant([1.0, 2.0, 3.0])
+    out = hvd_tf.allreduce(t, name="tf.fp16",
+                           compression=hvd_tf.Compression.fp16)
+    assert out.dtype == tf.float32  # decompressed back
+    np.testing.assert_allclose(out.numpy(), t.numpy(), rtol=1e-3)
+
+
+def test_tf_broadcast_and_allgather(hvd):
+    t = tf.fill((3,), 5.0)
+    np.testing.assert_array_equal(
+        hvd_tf.broadcast(t, 0, name="tf.b").numpy(), 5.0)
+    np.testing.assert_array_equal(
+        hvd_tf.allgather(t, name="tf.g").numpy(), t.numpy())
+
+
+def test_tf_indexed_slices_allreduce(hvd):
+    s = tf.IndexedSlices(values=tf.ones((2, 3)),
+                         indices=tf.constant([0, 2]),
+                         dense_shape=tf.constant([4, 3]))
+    out = hvd_tf.allreduce(s, average=True, name="tf.sparse")
+    assert isinstance(out, tf.IndexedSlices)
+    np.testing.assert_array_equal(out.values.numpy(), 1.0)
+    np.testing.assert_array_equal(out.indices.numpy(), [0, 2])
+
+
+def test_tf_function_graph_mode(hvd):
+    @tf.function
+    def step(x):
+        return hvd_tf.allreduce(x, average=False, name="tf.graph.ar")
+
+    t = tf.constant([1.0, 2.0])
+    np.testing.assert_array_equal(step(t).numpy(), t.numpy())
+
+
+def test_distributed_gradient_tape(hvd):
+    v = tf.Variable([1.0, 2.0])
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(v * v)
+    tape = hvd_tf.DistributedGradientTape(tape)
+    grads = tape.gradient(loss, [v])
+    np.testing.assert_allclose(grads[0].numpy(), [2.0, 4.0])
+
+
+def test_broadcast_variables(hvd):
+    var = tf.Variable([5.0, 6.0])
+    hvd_tf.broadcast_variables([var], root_rank=0)
+    np.testing.assert_array_equal(var.numpy(), [5.0, 6.0])
+
+
+def test_broadcast_global_variables_rejects_eager(hvd):
+    with pytest.raises(RuntimeError, match="eager"):
+        hvd_tf.broadcast_global_variables(0)
+
+
+def test_keras_distributed_optimizer_fit(hvd):
+    np.random.seed(0)
+    keras.utils.set_random_seed(0)
+    X = np.random.randn(64, 4).astype(np.float32)
+    Y = (X @ np.array([1.0, -2.0, 3.0, 0.5], np.float32) + 1.0)[:, None]
+    model = keras.Sequential([keras.layers.Dense(8, activation="relu"),
+                              keras.layers.Dense(1)])
+    opt = hvd_keras.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.05, momentum=0.9))
+    model.compile(optimizer=opt, loss="mse")
+    hist = model.fit(X, Y, batch_size=16, epochs=3, verbose=0,
+                     callbacks=[
+                         hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+                         hvd_keras.callbacks.MetricAverageCallback()])
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+
+def test_keras_lr_warmup_logs_lr(hvd):
+    np.random.seed(0)
+    X = np.random.randn(32, 2).astype(np.float32)
+    Y = X.sum(axis=1, keepdims=True)
+    model = keras.Sequential([keras.layers.Dense(1)])
+    model.compile(optimizer=hvd_keras.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1)), loss="mse")
+    hist = model.fit(
+        X, Y, batch_size=16, epochs=2, verbose=0,
+        callbacks=[hvd_keras.callbacks.LearningRateWarmupCallback(
+            warmup_epochs=2, steps_per_epoch=2)])
+    assert "lr" in hist.history
+    # size-1 world: warmup multiplier is identically 1 -> lr unchanged
+    np.testing.assert_allclose(hist.history["lr"], 0.1, rtol=1e-6)
+
+
+def test_keras_save_load_roundtrip(hvd, tmp_path):
+    np.random.seed(0)
+    X = np.random.randn(32, 4).astype(np.float32)
+    Y = X.sum(axis=1, keepdims=True)
+    model = keras.Sequential([keras.layers.Dense(1)])
+    model.compile(optimizer=hvd_keras.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.05, momentum=0.9)), loss="mse")
+    model.fit(X, Y, batch_size=16, epochs=1, verbose=0)
+    path = str(tmp_path / "model.keras")
+    model.save(path)
+
+    loaded = hvd_keras.load_model(path)
+    # the deserialized optimizer must still be distributed (the reference
+    # load_model guarantee, tensorflow/keras/__init__.py:121-155) and keep
+    # its slot state
+    assert "apply" in type(loaded.optimizer).__dict__
+    assert type(loaded.optimizer).__name__ == "SGD"
+    np.testing.assert_allclose(
+        np.concatenate([w.ravel() for w in loaded.get_weights()]),
+        np.concatenate([w.ravel() for w in model.get_weights()]))
+    loaded.fit(X, Y, batch_size=16, epochs=1, verbose=0)
+
+
+def test_tf_multiprocess_world():
+    from test_multiprocess import _run_world
+
+    _run_world("tf", 2, timeout=180.0)
+
+
+def test_tf_keras_multiprocess_fit():
+    from test_multiprocess import _run_world
+
+    _run_world("tf_keras", 2, timeout=240.0)

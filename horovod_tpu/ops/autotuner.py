@@ -47,17 +47,27 @@ class Autotuner:
                             "bytes,microseconds,score_bytes_per_us\n")
             self._log.flush()
 
-    def observe_cycle(self, response_list) -> Optional[Tuple[int, float]]:
-        """Score one completed cycle (bytes of non-error responses over the
-        wall time since the previous cycle) and return
+    def observe_cycle(self, response_list,
+                      active_us: Optional[float] = None
+                      ) -> Optional[Tuple[int, float]]:
+        """Score one completed cycle and return
         (fusion_threshold_bytes, cycle_ms) when the optimizer moved the
         knobs. Exactly one component owns an Autotuner per process — the
         engine in local worlds, the controller service on rank 0 of
-        multi-process worlds — so the timestamp state lives here."""
+        multi-process worlds — so the timestamp state lives here.
+
+        ``active_us`` is the cycle's ACTIVE window: negotiation wait +
+        execution, excluding idle sleep between cycles. The reference
+        samples saturated training where wall time equals active time
+        (``parameter_manager.cc:145-171``); under sparse submission the
+        wall clock would mix user think-time into the score and the GP
+        would partly optimize noise, so callers pass the active window
+        and the wall delta is only a fallback."""
         from .messages import ResponseType
 
         now = time.monotonic()
-        microseconds = (now - self._last_cycle_ts) * 1e6
+        microseconds = active_us if active_us is not None \
+            else (now - self._last_cycle_ts) * 1e6
         self._last_cycle_ts = now
         bytes_processed = sum(
             r.payload_bytes for r in response_list.responses

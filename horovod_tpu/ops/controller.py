@@ -367,6 +367,7 @@ class ControllerService:
         self._cycle_no = 0
         self._history: Dict[int, ResponseList] = {}
         self._lock = threading.Lock()
+        self._cycle_t0: Dict[Any, float] = {}
         self._autotuner = autotuner
         self._tuned_cycle_ms: Optional[float] = None
         self._service = BasicService(
@@ -378,9 +379,14 @@ class ControllerService:
         kind = req[0]
         if kind == "cycle":
             _, rank, request_list = req
-            return self._cycles.submit(
-                ("cycle", self._current_cycle(rank)), rank, request_list,
-                self._run_cycle)
+            key = ("cycle", self._current_cycle(rank))
+            with self._lock:
+                # active-window start: first rank's arrival for this cycle
+                # (straggler wait + construct count toward the autotune
+                # score; inter-cycle client think time does not)
+                self._cycle_t0.setdefault(key, time.monotonic())
+            return self._cycles.submit(key, rank, request_list,
+                                       lambda slot: self._run_cycle(slot, key))
         if kind == "payload":
             _, rank, cycle_no, idx, data = req
             resp = self._history[cycle_no].responses[idx]
@@ -401,11 +407,15 @@ class ControllerService:
             counters[rank] = n + 1
             return n
 
-    def _run_cycle(self, slot: Dict[int, RequestList]) -> ResponseList:
+    def _run_cycle(self, slot: Dict[int, RequestList],
+                   key: Any = None) -> ResponseList:
         for rank in sorted(slot):
             self._negotiator.add_request_list(slot[rank])
         response_list = self._negotiator.construct_response_list()
-        self._maybe_autotune(response_list)
+        with self._lock:
+            t0 = self._cycle_t0.pop(key, None)
+        active_us = (time.monotonic() - t0) * 1e6 if t0 is not None else None
+        self._maybe_autotune(response_list, active_us)
         with self._lock:
             self._history[self._cycle_no] = response_list
             # History only needs to survive until the payload exchanges of
@@ -416,13 +426,15 @@ class ControllerService:
             self._cycle_no += 1
         return response_list
 
-    def _maybe_autotune(self, response_list: ResponseList) -> None:
+    def _maybe_autotune(self, response_list: ResponseList,
+                        active_us: Optional[float] = None) -> None:
         """Apply retuned knobs: fusion threshold directly on the negotiator,
         cycle time piggybacked to every rank on the response (the Params
         broadcast of ``parameter_manager.cc:213``)."""
         if self._autotuner is None:
             return
-        tuned = self._autotuner.observe_cycle(response_list)
+        tuned = self._autotuner.observe_cycle(response_list,
+                                              active_us=active_us)
         if tuned is not None:
             threshold, cycle_ms = tuned
             self._negotiator.set_fusion_threshold(threshold)
@@ -457,7 +469,7 @@ def _combine(resp: Response, slot: Dict[int, bytes]) -> bytes:
 class ControllerClient:
     """Worker-side handle on the controller (one per process)."""
 
-    def __init__(self, addr: Tuple[str, int],
+    def __init__(self, addr,  # (host, port) or {intf: (host, port)}
                  secret: Optional[bytes] = None,
                  timeout_s: Optional[float] = None,
                  connect_attempts: int = 100) -> None:

@@ -188,8 +188,17 @@ class Engine:
                     self._size, negotiator, secret=secret, port=port,
                     bind_host=bind_host, autotuner=self._autotuner)
                 port = self._service.port
+            # The launcher may advertise several controller addresses
+            # (comma-separated: every NIC of the controller host); the
+            # client probes them and uses the first routable one.
+            addr_list = [a.strip() for a in addr.split(",") if a.strip()]
+            if not addr_list:
+                raise RuntimeError(
+                    f"HOROVOD_CONTROLLER_ADDR is set but empty ({addr!r}); "
+                    f"the launcher must export the controller address.")
             self._client = ControllerClient(
-                (addr, port), secret=secret, timeout_s=None)
+                {a: (a, port) for a in addr_list}, secret=secret,
+                timeout_s=None)
 
         self._host_fallback_warned = set()
 
@@ -249,6 +258,7 @@ class Engine:
                 self._wake.wait(timeout=cycle_s)
                 self._wake.clear()
                 self.timeline.mark_cycle_start()
+                cycle_t0 = time.monotonic()
                 stop = self._stop_requested
                 with self._lock:
                     new_entries, self._submissions = self._submissions, []
@@ -268,7 +278,9 @@ class Engine:
                 # autotune: local worlds score here; multi-process worlds
                 # score on the coordinator and ship cycle time back
                 if self._negotiator is not None and self._autotuner is not None:
-                    tuned = self._autotuner.observe_cycle(response_list)
+                    active_us = (time.monotonic() - cycle_t0) * 1e6
+                    tuned = self._autotuner.observe_cycle(
+                        response_list, active_us=active_us)
                     if tuned is not None:
                         threshold, cycle_ms = tuned
                         self._negotiator.set_fusion_threshold(threshold)

@@ -27,7 +27,7 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from ..core import config as _config
-from .network import make_secret
+from .network import local_addresses, make_secret
 
 
 def _free_port(bind_addr: str = "127.0.0.1") -> int:
@@ -95,14 +95,16 @@ _LOCAL_HOSTS = ("localhost", "127.0.0.1")
 
 
 def _rsh_wrap(rsh_agent: Sequence[str], host: str,
-              env: Dict[str, str], command: Sequence[str]) -> List[str]:
+              env: Dict[str, str], command: Sequence[str],
+              extra_keys: Sequence[str] = ()) -> List[str]:
     """Build the remote launch line: ``<rsh...> <host> env K=V... cmd``.
 
     The rsh agent is pluggable exactly like mpirun's ``plm_rsh_agent`` —
     the hook the reference's Spark integration uses to route orted launches
-    through its task services (``spark/driver/mpirun_rsh.py:24-38``). Only
-    the world env vars are forwarded (the remote side keeps its own
-    inherited environment)."""
+    through its task services (``spark/driver/mpirun_rsh.py:24-38``). The
+    world env vars plus any caller-supplied ``extra_keys`` (programmatic
+    ``launch_hosts(env_extra=...)``) are forwarded; the remote side keeps
+    the rest of its own inherited environment."""
     import shlex
 
     world_keys = [
@@ -111,8 +113,10 @@ def _rsh_wrap(rsh_agent: Sequence[str], host: str,
         _config.HOROVOD_CROSS_RANK, _config.HOROVOD_CROSS_SIZE,
         _config.HOROVOD_CONTROLLER_ADDR, _config.HOROVOD_CONTROLLER_PORT,
         _config.HOROVOD_SECRET_KEY, _config.HOROVOD_DATA_PLANE,
+        "HOROVOD_CONTROLLER_BIND",
     ]
-    assignments = [f"{k}={env[k]}" for k in world_keys if k in env]
+    keys = world_keys + [k for k in extra_keys if k not in world_keys]
+    assignments = [f"{k}={env[k]}" for k in keys if k in env]
     remote = " ".join(["env"] + [shlex.quote(a) for a in assignments] +
                       [shlex.quote(c) for c in command])
     return list(rsh_agent) + [host, remote]
@@ -138,11 +142,30 @@ def launch_hosts(command: Sequence[str], hosts: List[tuple],
     size = sum(slots for _, slots in hosts)
     remote = any(h not in _LOCAL_HOSTS for h, _ in hosts)
     if controller_addr is None:
-        controller_addr = (socket.gethostbyname(socket.gethostname())
-                           if remote else "127.0.0.1")
+        # Rank 0 — and with it the ControllerService — runs on hosts[0],
+        # which need not be this machine: workers must dial THAT host. A
+        # local hosts[0] advertises every NIC (comma list; workers probe
+        # for a routable one, the reference's interface-matching).
+        if hosts[0][0] in _LOCAL_HOSTS:
+            if remote:
+                # loopback is never routable from another host — and could
+                # even match an unrelated local service on the worker side —
+                # so advertise only real NICs to remote workers
+                nics = [a for a in dict.fromkeys(local_addresses().values())
+                        if not a.startswith("127.")]
+                controller_addr = ",".join(nics) if nics else "127.0.0.1"
+            else:
+                controller_addr = "127.0.0.1"
+        else:
+            controller_addr = hosts[0][0]
+    # NOTE: with a remote hosts[0] the port is probed free on THIS machine
+    # but bound on hosts[0]; a collision there surfaces as rank 0 exiting
+    # with "Address already in use", which _wait_all turns into a prompt
+    # LaunchError that tears the world down (no silent spin).
     port = _free_port("0.0.0.0" if remote else "127.0.0.1")
     secret = make_secret()
     rsh = list(rsh_agent) if rsh_agent else ["ssh"]
+    extra_keys = sorted(env_extra) if env_extra else []
     procs: List[subprocess.Popen] = []
     try:
         rank = 0
@@ -156,10 +179,15 @@ def launch_hosts(command: Sequence[str], hosts: List[tuple],
                     controller_addr=controller_addr)
                 if env_extra:
                     env.update(env_extra)
+                if rank == 0 and remote:
+                    # remote workers dial in over a real NIC; the per-job
+                    # secret satisfies the non-loopback bind guard
+                    env["HOROVOD_CONTROLLER_BIND"] = "0.0.0.0"
                 if host in _LOCAL_HOSTS and rsh_agent is None:
                     argv = list(command)
                 else:
-                    argv = _rsh_wrap(rsh, host, env, command)
+                    argv = _rsh_wrap(rsh, host, env, command,
+                                     extra_keys=extra_keys)
                 procs.append(subprocess.Popen(
                     argv, env=env, start_new_session=True))
                 rank += 1

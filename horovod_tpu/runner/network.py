@@ -85,16 +85,64 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def local_addresses() -> Dict[str, Tuple[str, int]]:
-    """Hostname-keyed address map; the reference advertises every NIC
-    (``network.py:117-141``). We advertise hostname + loopback."""
-    host = socket.gethostname()
-    addrs = {"lo": "127.0.0.1"}
+def local_addresses() -> Dict[str, str]:
+    """IPv4 address of every NIC, keyed by interface name — the reference
+    advertises every interface so peers can find a routable one
+    (``network.py:117-141`` uses psutil; here the Linux SIOCGIFCONF ioctl
+    with a hostname+loopback fallback for other platforms)."""
+    addrs: Dict[str, str] = {}
     try:
-        addrs["host"] = socket.gethostbyname(host)
-    except OSError:
+        import array
+        import fcntl
+
+        SIOCGIFCONF = 0x8912
+        IFREQ = 40  # sizeof(struct ifreq) on LP64
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            buf = array.array("B", b"\0" * (64 * IFREQ))
+            out_len = struct.unpack(
+                "iL", fcntl.ioctl(
+                    s.fileno(), SIOCGIFCONF,
+                    struct.pack("iL", len(buf), buf.buffer_info()[0])))[0]
+            raw = buf.tobytes()
+            for off in range(0, out_len, IFREQ):
+                name = raw[off:off + 16].split(b"\0", 1)[0].decode()
+                addrs[name] = socket.inet_ntoa(raw[off + 20:off + 24])
+    except Exception:  # noqa: BLE001 - non-Linux / restricted environments
         pass
+    if not addrs:
+        addrs["lo"] = "127.0.0.1"
+        try:
+            addrs["host"] = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            pass
     return addrs
+
+
+def probe_addresses(candidates: Dict[str, Tuple[str, int]],
+                    timeout_s: float = 2.0) -> Dict[str, Tuple[str, int]]:
+    """Probe every candidate ``(addr, port)`` with a parallel TCP connect
+    and return the reachable subset — the reference's interface-matching
+    probe (``BasicClient._probe``, ``network.py:144-236``; the ring probe
+    of ``spark/__init__.py:35-52`` runs this against the next task)."""
+    reachable: Dict[str, Tuple[str, int]] = {}
+    lock = threading.Lock()
+
+    def _try(intf: str, addr: Tuple[str, int]) -> None:
+        try:
+            with socket.create_connection(addr, timeout=timeout_s):
+                pass
+        except OSError:
+            return
+        with lock:
+            reachable[intf] = addr
+
+    threads = [threading.Thread(target=_try, args=item, daemon=True)
+               for item in candidates.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s + 1.0)
+    return reachable
 
 
 class BasicService:
@@ -160,27 +208,50 @@ class BasicService:
 
 class BasicClient:
     """Persistent client connection with connect retries
-    (reference ``BasicClient``, ``network.py:144-236``)."""
+    (reference ``BasicClient``, ``network.py:144-236``).
 
-    def __init__(self, addr: Tuple[str, int],
+    ``addr`` may be a single ``(host, port)`` or a dict of candidates
+    ``{intf: (host, port)}`` — multiple candidates are probed in parallel
+    each attempt and the first reachable one wins, which is how a worker
+    finds a routable path to a service that advertised every NIC."""
+
+    def __init__(self, addr,
                  secret: Optional[bytes] = None,
                  attempts: int = 10,
                  retry_delay_s: float = 0.3,
                  timeout_s: Optional[float] = None) -> None:
         self._wire = Wire(secret)
         self._lock = threading.Lock()
+        candidates: Dict[str, Tuple[str, int]] = (
+            dict(addr) if isinstance(addr, dict) else {"addr": tuple(addr)})
+        self.connected_intf: Optional[str] = None
         last_err: Optional[Exception] = None
+        if not candidates:
+            raise WireError("no service addresses given (empty candidate "
+                            "list — check HOROVOD_CONTROLLER_ADDR)")
         for _ in range(attempts):
-            try:
-                self._sock = socket.create_connection(addr, timeout=timeout_s)
-                self._sock.settimeout(timeout_s)
-                break
-            except OSError as exc:
-                last_err = exc
-                time.sleep(retry_delay_s)
-        else:
-            raise WireError(
-                f"unable to connect to service at {addr}: {last_err}")
+            if len(candidates) > 1:
+                reachable = probe_addresses(
+                    candidates, timeout_s=min(timeout_s or 2.0, 2.0))
+                if not reachable:
+                    last_err = OSError(
+                        f"no candidate reachable within probe timeout "
+                        f"(tried {sorted(candidates.values())})")
+            else:
+                reachable = candidates
+            for intf, target in reachable.items():
+                try:
+                    self._sock = socket.create_connection(
+                        target, timeout=timeout_s)
+                    self._sock.settimeout(timeout_s)
+                    self.connected_intf = intf
+                    return
+                except OSError as exc:
+                    last_err = exc
+            time.sleep(retry_delay_s)
+        raise WireError(
+            f"unable to connect to service at any of "
+            f"{sorted(candidates.values())}: {last_err}")
 
     def request(self, obj: Any) -> Any:
         with self._lock:

@@ -160,6 +160,54 @@ def test_launch_hosts_rsh_agent(tmp_path):
     assert calls == ["localhost", "localhost"]
 
 
+def test_launch_hosts_remote_simulation(tmp_path):
+    """A simulated REMOTE 2x2 world: hosts named by hostname (not
+    localhost), so the launcher must derive the controller address from
+    hosts[0], export a non-loopback controller bind for rank 0, and
+    forward world env + env_extra through the rsh line — the fake rsh
+    scrubs its inherited environment the way a real ssh session would
+    start clean (ADVICE round-1 items + reference
+    ``spark/util/network.py:117-141`` NIC advertisement)."""
+    import socket
+
+    from horovod_tpu.runner.launcher import launch_hosts
+
+    hostname = socket.gethostname()
+    try:
+        socket.gethostbyname(hostname)
+    except OSError:
+        pytest.skip("hostname does not resolve locally")
+
+    agent = tmp_path / "fake_rsh.py"
+    agent.write_text(
+        "#!/usr/bin/env python\n"
+        "import os, subprocess, sys\n"
+        "# simulate a clean remote login shell: only the env assignments\n"
+        "# embedded in the remote command line may carry the world\n"
+        "env = {k: v for k, v in os.environ.items()\n"
+        "       if not k.startswith(('HOROVOD_', 'HVD_TEST_'))}\n"
+        "sys.exit(subprocess.call(['bash', '-c', sys.argv[2]], env=env))\n")
+    probe = (
+        "import os\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import horovod_tpu as hvd\n"
+        "assert os.environ.get('HVD_TEST_EXTRA') == '42', 'env_extra lost'\n"
+        "hvd.init()\n"
+        "out = hvd.allreduce(np.ones(2, np.float32), average=False,\n"
+        "                    name='remote.sum')\n"
+        "assert float(np.asarray(out)[0]) == 4.0, np.asarray(out)\n"
+        "hvd.shutdown()\n"
+    )
+    rc = launch_hosts(
+        [sys.executable, "-c", probe],
+        [(hostname, 2), (hostname, 2)],
+        rsh_agent=[sys.executable, str(agent)],
+        env_extra={"HVD_TEST_EXTRA": "42"},
+        host_data_plane=True, job_timeout_s=180.0)
+    assert rc == 0
+
+
 def test_horovodrun_cli_hosts():
     import subprocess
 

@@ -23,12 +23,86 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 REFERENCE_PER_DEVICE_IMG_S = 1656.82 / 16  # docs/benchmarks.md:19-38
+
+
+def _preflight_backend(attempts: int = 4, probe_timeout_s: float = 120.0):
+    """Verify the accelerator backend initializes before touching it here.
+
+    Round-1 postmortem: ``hvd.init()`` was the first JAX backend query in
+    this process and it died with "Unable to initialize backend 'axon':
+    UNAVAILABLE" — no diagnostics, no retry, rc=1, and no number was ever
+    recorded. The plugin can also *hang* (not fail) when the chip is held
+    by a stale process, which would turn rc=1 into rc=124. So: probe in a
+    subprocess (a hang costs one timeout, not the whole bench), retry with
+    backoff (a chip being released frees within seconds), and on exhaustion
+    print every actionable fact we can gather before exiting nonzero.
+    """
+    probe = ("import jax; d = jax.devices(); "
+             "print(d[0].platform, len(d), flush=True)")
+    log = lambda *a: print(*a, file=sys.stderr, flush=True)  # noqa: E731
+    for attempt in range(1, attempts + 1):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", probe], capture_output=True,
+                text=True, timeout=probe_timeout_s)
+        except subprocess.TimeoutExpired:
+            log(f"[preflight {attempt}/{attempts}] backend probe HUNG "
+                f"(> {probe_timeout_s:.0f}s) — the accelerator plugin is "
+                f"wedged, likely a stale process holding the chip.")
+            _print_chip_diagnostics(log)
+            continue
+        if out.returncode == 0 and out.stdout.strip():
+            # The probe's own print is a 2-token line; scan from the end so
+            # plugin banners on stdout cannot break the parse.
+            for line in reversed(out.stdout.strip().splitlines()):
+                tokens = line.split()
+                if len(tokens) == 2 and tokens[1].isdigit():
+                    platform, ndev = tokens
+                    log(f"[preflight {attempt}/{attempts}] backend OK: "
+                        f"{ndev} {platform} device(s)")
+                    return platform
+            log(f"[preflight {attempt}/{attempts}] probe exited 0 but "
+                f"printed no recognizable result: {out.stdout!r}")
+        log(f"[preflight {attempt}/{attempts}] backend probe failed "
+            f"(rc={out.returncode}):")
+        for line in out.stderr.strip().splitlines()[-8:]:
+            log(f"    {line}")
+        _print_chip_diagnostics(log)
+        if attempt < attempts:
+            time.sleep(5.0 * attempt)
+    log("[preflight] giving up: the accelerator backend never initialized. "
+        "Fix the environment (kill the chip holder / unset JAX_PLATFORMS) "
+        "and re-run.")
+    sys.exit(1)
+
+
+def _print_chip_diagnostics(log) -> None:
+    """Everything a human (or the next round's builder) needs to unwedge."""
+    log(f"    JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '<unset>')!r} "
+        f"XLA_FLAGS={os.environ.get('XLA_FLAGS', '<unset>')!r}")
+    me = os.getpid()
+    try:
+        for pid in sorted(int(p) for p in os.listdir("/proc") if p.isdigit()):
+            if pid == me:
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmd = f.read().replace(b"\0", b" ").decode().strip()
+            except OSError:
+                continue
+            if "python" in cmd and any(
+                    k in cmd for k in ("jax", "bench", "graft", "tpu")):
+                log(f"    possible chip holder: pid {pid}: {cmd[:120]}")
+    except OSError:
+        pass
 
 
 def main() -> None:
@@ -46,6 +120,8 @@ def main() -> None:
     parser.add_argument("--num-batches-per-iter", type=int, default=10)
     parser.add_argument("--num-iters", type=int, default=10)
     args = parser.parse_args()
+
+    _preflight_backend()
 
     import jax
     import jax.numpy as jnp

@@ -27,7 +27,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.logging import LOG
-from ..runner.network import BasicClient, BasicService
+from ..runner.network import BasicClient, BasicService, Preserialized
 from .messages import (
     DataType,
     Request,
@@ -317,10 +317,13 @@ class _Rendezvous:
         self._slots: Dict[Any, Dict[int, Any]] = {}
         self._results: Dict[Any, Any] = {}
         self._delivered: Dict[Any, int] = {}
+        self._aborted: Optional[BaseException] = None
 
     def submit(self, key: Any, rank: int, item: Any,
                compute: Callable[[Dict[int, Any]], Any]) -> Any:
         with self._cond:
+            if self._aborted is not None:
+                raise RuntimeError(str(self._aborted)) from self._aborted
             slot = self._slots.setdefault(key, {})
             slot[rank] = item
             if len(slot) == self._size:
@@ -334,7 +337,10 @@ class _Rendezvous:
                 self._delivered[key] = 0
                 self._cond.notify_all()
             else:
-                self._cond.wait_for(lambda: key in self._results)
+                self._cond.wait_for(
+                    lambda: key in self._results or self._aborted is not None)
+            if key not in self._results:
+                raise RuntimeError(str(self._aborted)) from self._aborted
             kind, result = self._results[key]
             self._delivered[key] += 1
             if self._delivered[key] == self._size:
@@ -344,6 +350,17 @@ class _Rendezvous:
                     f"coordinator-side collective failure: {result}") \
                     from result
             return result
+
+    def abort(self, exc: BaseException) -> None:
+        """Wake every waiter with ``exc`` and fail all future submits —
+        the rendezvous can never complete once a participant is dead.
+        The first abort wins: survivors tearing down after it cascade more
+        disconnects, and their exceptions must not overwrite the actual
+        culprit in what every rank reports."""
+        with self._cond:
+            if self._aborted is None:
+                self._aborted = exc
+            self._cond.notify_all()
 
 
 class ControllerService:
@@ -370,15 +387,60 @@ class ControllerService:
         self._cycle_t0: Dict[Any, float] = {}
         self._autotuner = autotuner
         self._tuned_cycle_ms: Optional[float] = None
+        # Failure detection: map each connection to the rank it serves; a
+        # connection that drops before the world reached a clean shutdown
+        # means that rank died, and every peer blocked in a rendezvous with
+        # it must be unblocked with SHUT_DOWN_ERROR (the reference's
+        # "exception on one of the ranks" semantics, operations.cc:1942-1957).
+        self._conn_ranks: Dict[int, int] = {}
+        self._world_shutdown = False
+        self._abort_fired = False
         self._service = BasicService(
             "horovod-controller", self._handle, secret=secret, port=port,
-            bind_host=bind_host)
+            bind_host=bind_host, on_disconnect=self._on_disconnect)
         self.port = self._service.port
+
+    def _on_disconnect(self, sock: Any) -> None:
+        with self._lock:
+            rank = self._conn_ranks.pop(id(sock), None)
+            if rank is None or self._world_shutdown:
+                return
+            first = not self._abort_fired
+            self._abort_fired = True
+        from ..core.status import SHUT_DOWN_ERROR
+
+        if first:
+            LOG.warning("rank %d disconnected before shutdown; aborting "
+                        "in-flight collectives on all ranks", rank)
+        else:
+            # Cascade: survivors tear down after the first abort; their
+            # disconnects are a consequence, not the cause.
+            LOG.debug("rank %d disconnected during abort teardown", rank)
+        exc = RuntimeError(f"rank {rank} exited mid-job. {SHUT_DOWN_ERROR}")
+        self._cycles.abort(exc)  # first abort wins inside the rendezvous
+        self._payloads.abort(exc)
 
     def _handle(self, req: Any, _sock: Any) -> Any:
         kind = req[0]
+        if kind == "bye":
+            # Clean detach for clients that leave without a negotiated
+            # world shutdown (tests, tooling): de-register so the
+            # subsequent connection close is not mistaken for a rank death.
+            with self._lock:
+                self._conn_ranks.pop(id(_sock), None)
+            return ("ok",)
+        # Every other message carries the sender's rank at req[1]: bind the
+        # connection to it for failure detection. "hello" exists so ranks
+        # identify at connect time (a rank can die before its first cycle),
+        # while anonymous connections (NIC reachability probes open and
+        # close without sending) are never mistaken for dead ranks.
+        rank = req[1]
+        with self._lock:
+            self._conn_ranks[id(_sock)] = rank
+        if kind == "hello":
+            return ("ok",)
         if kind == "cycle":
-            _, rank, request_list = req
+            _, _, request_list = req
             key = ("cycle", self._current_cycle(rank))
             with self._lock:
                 # active-window start: first rank's arrival for this cycle
@@ -388,11 +450,15 @@ class ControllerService:
             return self._cycles.submit(key, rank, request_list,
                                        lambda slot: self._run_cycle(slot, key))
         if kind == "payload":
-            _, rank, cycle_no, idx, data = req
+            _, _, cycle_no, idx, data = req
             resp = self._history[cycle_no].responses[idx]
+            # Frame once: the combine result is identical for every rank,
+            # and HMAC+pickle over a fused buffer per rank would make the
+            # coordinator's serial work O(size x bytes) per cycle.
             return self._payloads.submit(
                 ("payload", cycle_no, idx), rank, data,
-                lambda slot: _combine(resp, slot))
+                lambda slot: Preserialized(
+                    self._service.wire.frame(_combine(resp, slot))))
         raise ValueError(f"unknown controller request {kind!r}")
 
     def _current_cycle(self, rank: int) -> int:
@@ -408,10 +474,15 @@ class ControllerService:
             return n
 
     def _run_cycle(self, slot: Dict[int, RequestList],
-                   key: Any = None) -> ResponseList:
+                   key: Any = None) -> Preserialized:
         for rank in sorted(slot):
             self._negotiator.add_request_list(slot[rank])
         response_list = self._negotiator.construct_response_list()
+        if response_list.shutdown:
+            # Clean coordinated shutdown: connection drops after this cycle
+            # are expected teardown, not rank deaths.
+            with self._lock:
+                self._world_shutdown = True
         with self._lock:
             t0 = self._cycle_t0.pop(key, None)
         active_us = (time.monotonic() - t0) * 1e6 if t0 is not None else None
@@ -424,7 +495,9 @@ class ControllerService:
             if stale in self._history:
                 del self._history[stale]
             self._cycle_no += 1
-        return response_list
+        # One frame serves every rank (identical ResponseList by
+        # construction — the property that makes lockstep execution legal).
+        return Preserialized(self._service.wire.frame(response_list))
 
     def _maybe_autotune(self, response_list: ResponseList,
                         active_us: Optional[float] = None) -> None:
@@ -472,15 +545,27 @@ class ControllerClient:
     def __init__(self, addr,  # (host, port) or {intf: (host, port)}
                  secret: Optional[bytes] = None,
                  timeout_s: Optional[float] = None,
-                 connect_attempts: int = 100) -> None:
+                 connect_attempts: int = 100,
+                 rank: Optional[int] = None) -> None:
         # Generous connect window: ranks race the coordinator's service
         # startup (JAX import time dominates), like orted waiting on the
         # reference's driver registration (``util/timeout.py``).
         self._client = BasicClient(addr, secret=secret, timeout_s=timeout_s,
                                    attempts=connect_attempts)
         self._cycle_no = 0
+        self._rank = rank
+        if rank is not None:
+            # Identify immediately so the controller can attribute a
+            # connection drop to this rank even if the process dies before
+            # its first cycle.
+            self._client.request(("hello", rank))
 
     def cycle(self, rank: int, request_list: RequestList) -> ResponseList:
+        # The controller registers this connection under ``rank`` for
+        # failure detection; remember it so close() can detach cleanly even
+        # when the caller did not pass rank= at construction.
+        if self._rank is None:
+            self._rank = rank
         out = self._client.request(("cycle", rank, request_list))
         self._last_cycle = self._cycle_no
         self._cycle_no += 1
@@ -490,5 +575,15 @@ class ControllerClient:
         return self._client.request(
             ("payload", rank, self._last_cycle, response_idx, data))
 
-    def close(self) -> None:
+    def close(self, detach: bool = True) -> None:
+        """``detach=True`` (tooling/tests): clean goodbye, the departure is
+        not a rank death. ``detach=False`` (the engine): no goodbye — if the
+        world has not negotiated shutdown yet, this close IS a rank death
+        and the controller must abort the peers. An engine that sent "bye"
+        on its crash path would mask its own death and deadlock the world."""
+        if detach and self._rank is not None:
+            try:
+                self._client.request(("bye", self._rank))
+            except Exception:  # noqa: BLE001 - controller may already be gone
+                pass
         self._client.close()

@@ -198,7 +198,7 @@ class Engine:
                     f"the launcher must export the controller address.")
             self._client = ControllerClient(
                 {a: (a, port) for a in addr_list}, secret=secret,
-                timeout_s=None)
+                timeout_s=None, rank=self._rank)
 
         self._host_fallback_warned = set()
 
@@ -291,11 +291,24 @@ class Engine:
                     break
         except Exception as exc:  # noqa: BLE001 - propagate to handles
             LOG.error("background loop failed: %s", exc)
-            self._flush_outstanding(Status.unknown_error(str(exc)))
+            # A dead control plane (coordinator gone, peer died and the
+            # abort raced teardown) IS a world shutdown: surface the
+            # reference's SHUT_DOWN_ERROR semantics, keeping the transport
+            # detail as the cause (``operations.cc:1942-1957``).
+            reason = str(exc)
+            if "shut down" not in reason:
+                reason = f"{SHUT_DOWN_ERROR} (cause: {reason})"
+            self._stop_requested = True  # before the flush: an enqueue
+            # racing it must be rejected, not parked on a dead loop
+            self._flush_outstanding(Status.unknown_error(reason))
         finally:
+            self._stop_requested = True
             self._flush_outstanding(Status.unknown_error(SHUT_DOWN_ERROR))
             if self._client is not None:
-                self._client.close()
+                # Never a clean detach: after a negotiated shutdown the
+                # controller ignores the drop anyway, and on the crash path
+                # the drop is precisely what tells it this rank died.
+                self._client.close(detach=False)
             if self._service is not None:
                 self._service.shutdown()
             if self._autotuner is not None:
@@ -354,10 +367,19 @@ class Engine:
                 tl.end(entry.name, shape=result.shape)
                 self.handles.mark_done(entry.handle, Status.ok(), result)
         except Exception as exc:  # noqa: BLE001
+            from ..runner.network import WireError
+
+            reason = str(exc)
+            if isinstance(exc, (WireError, OSError)) and \
+                    "shut down" not in reason:
+                # Control-plane loss mid-exchange == world shutdown (see
+                # the equivalent mapping in _loop); genuine op errors keep
+                # their own message.
+                reason = f"{SHUT_DOWN_ERROR} (cause: {reason})"
             for entry in entries:
                 tl.end(entry.name)
                 self.handles.mark_done(
-                    entry.handle, Status.unknown_error(str(exc)), None)
+                    entry.handle, Status.unknown_error(reason), None)
 
     def _run_allreduce(self, idx: int,
                        entries: List[TensorTableEntry]) -> List[np.ndarray]:

@@ -53,6 +53,19 @@ def make_secret() -> str:
     return os.urandom(32).hex()
 
 
+class Preserialized:
+    """A response already framed for the wire. A service whose handler
+    returns the *same* object to every connected rank (the controller's
+    per-cycle ResponseList, the host-plane combine result) frames it once
+    instead of paying pickle+HMAC per rank — at 32+ ranks that serial work
+    on the coordinator dominates cycle latency."""
+
+    __slots__ = ("payload",)
+
+    def __init__(self, payload: bytes) -> None:
+        self.payload = payload
+
+
 class Wire:
     """HMAC digest + 8-byte big-endian length + pickled body
     (reference ``Wire``, ``network.py:44-78``)."""
@@ -60,10 +73,16 @@ class Wire:
     def __init__(self, secret: Optional[bytes] = None) -> None:
         self._secret = secret if secret is not None else default_secret()
 
-    def write(self, obj: Any, sock: socket.socket) -> None:
+    def frame(self, obj: Any) -> bytes:
         body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
         digest = hmac.new(self._secret, body, hashlib.sha256).digest()
-        sock.sendall(digest + _LEN.pack(len(body)) + body)
+        return digest + _LEN.pack(len(body)) + body
+
+    def write(self, obj: Any, sock: socket.socket) -> None:
+        if isinstance(obj, Preserialized):
+            sock.sendall(obj.payload)
+            return
+        sock.sendall(self.frame(obj))
 
     def read(self, sock: socket.socket) -> Any:
         header = _read_exact(sock, _DIGEST_BYTES + _LEN.size)
@@ -157,7 +176,9 @@ class BasicService:
                  handler: Callable[[Any, socket.socket], Any],
                  secret: Optional[bytes] = None,
                  port: int = 0,
-                 bind_host: str = "127.0.0.1") -> None:
+                 bind_host: str = "127.0.0.1",
+                 on_disconnect: Optional[Callable[[socket.socket], None]]
+                 = None) -> None:
         self.name = name
         # The wire deserializes pickle: loopback-only by default, and a
         # non-loopback bind demands a real per-job secret — the hardcoded
@@ -170,11 +191,20 @@ class BasicService:
                 f"(the launcher does this automatically).")
         self._wire = Wire(secret)
         self._handler = handler
+        self._on_disconnect = on_disconnect
+        self._conns_lock = threading.Lock()
+        self._conns: set = set()
+        self._monitor_stop = threading.Event()
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:  # one connection, many requests
                 sock = self.request
+                # Cycle messages are small request/response pairs; Nagle +
+                # delayed-ACK would add tens of ms per cycle.
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                with outer._conns_lock:
+                    outer._conns.add(sock)
                 try:
                     while True:
                         req = outer._wire.read(sock)
@@ -186,10 +216,18 @@ class BasicService:
                             outer._wire.write(resp, sock)
                 except (WireError, OSError):
                     return
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(sock)
+                    outer._notify_disconnect(sock)
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
+            # Every rank connects at t0; the default backlog of 5 overflows
+            # at ~16+ ranks and the kernel drops SYNs, adding 1s retransmit
+            # stalls to world start and the first cycle.
+            request_queue_size = 128
 
         self._server = _Server((bind_host, port), _Handler)
         self.port = self._server.server_address[1]
@@ -197,11 +235,72 @@ class BasicService:
             target=self._server.serve_forever, name=f"{name}-service",
             daemon=True)
         self._thread.start()
+        if on_disconnect is not None:
+            # Liveness monitor: a handler thread blocked inside the handler
+            # (e.g. a collective rendezvous waiting on OTHER ranks) is not
+            # reading its socket, so a peer that dies mid-rendezvous would
+            # go unnoticed and deadlock the world. Peek every connection for
+            # EOF out-of-band — MSG_PEEK never consumes a pipelined request.
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name=f"{name}-liveness",
+                daemon=True)
+            self._monitor.start()
+
+    def _notify_disconnect(self, sock: socket.socket) -> None:
+        """Idempotence is the callback's job (disconnects are observed both
+        by the handler thread and the liveness monitor)."""
+        if self._on_disconnect is None:
+            return
+        try:
+            self._on_disconnect(sock)
+        except Exception:  # noqa: BLE001 - teardown path must not raise
+            pass
+
+    # MSG_DONTWAIT makes the peek non-blocking per call without touching the
+    # socket's blocking mode (which the handler thread relies on). It is
+    # POSIX-only. Without it there is no race-free out-of-band peek (a
+    # select-then-peek can block if the handler thread consumes the bytes
+    # in between), so non-POSIX platforms degrade to in-band detection by
+    # the handler threads — degraded, never wedged.
+    _MSG_DONTWAIT = getattr(socket, "MSG_DONTWAIT", None)
+
+    def _monitor_loop(self) -> None:
+        if self._MSG_DONTWAIT is None:  # pragma: no cover - non-POSIX
+            import logging
+
+            logging.getLogger("horovod_tpu").warning(
+                "socket.MSG_DONTWAIT unavailable on this platform; "
+                "out-of-band peer-death detection is disabled (dead ranks "
+                "are still detected when their handler thread next reads).")
+            return
+        while not self._monitor_stop.wait(0.2):
+            with self._conns_lock:
+                conns = list(self._conns)
+            for sock in conns:
+                # A non-blocking MSG_PEEK never consumes a pipelined request
+                # and never blocks even if the handler thread raced us to
+                # the bytes; EOF shows as an empty read.
+                try:
+                    data = sock.recv(1, socket.MSG_PEEK | self._MSG_DONTWAIT)
+                except (BlockingIOError, InterruptedError):
+                    continue  # alive, no pending bytes
+                except (OSError, ValueError):
+                    self._notify_disconnect(sock)  # reset / already closed
+                    continue
+                if data == b"":  # orderly EOF: the peer process is gone
+                    self._notify_disconnect(sock)
+
+    @property
+    def wire(self) -> Wire:
+        """The service's framing wire — lets a handler pre-frame responses
+        it will hand to many connections (see ``Preserialized``)."""
+        return self._wire
 
     def addresses(self) -> Dict[str, Tuple[str, int]]:
         return {k: (v, self.port) for k, v in local_addresses().items()}
 
     def shutdown(self) -> None:
+        self._monitor_stop.set()
         self._server.shutdown()
         self._server.server_close()
 
@@ -244,6 +343,8 @@ class BasicClient:
                     self._sock = socket.create_connection(
                         target, timeout=timeout_s)
                     self._sock.settimeout(timeout_s)
+                    self._sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                     self.connected_intf = intf
                     return
                 except OSError as exc:

@@ -246,6 +246,82 @@ def main() -> None:
                 np.testing.assert_array_equal(
                     out, float(sum(r + i for r in range(size))))
 
+    elif scenario == "peer_death":
+        # Failure detection under load (reference semantics: an exception or
+        # exit on one rank shuts the whole world down,
+        # ``operations.cc:1942-1957``): the last rank dies abruptly with
+        # tensors in flight; every survivor must unblock with
+        # SHUT_DOWN_ERROR well inside the stall window instead of hanging.
+        import time
+
+        victim = size - 1
+        # Barrier: the kill must hit a fully-formed world mid-stream, not a
+        # rank still inside init (that is a different failure, surfaced as
+        # an init error).
+        hvd.allreduce(np.ones((4,), np.float32), average=False,
+                      name="pd.barrier")
+        if rank == victim:
+            for i in range(3):
+                hvd.allreduce_async(np.ones((64,), np.float32),
+                                    average=False, name=f"pd.{i}")
+            os._exit(3)  # no shutdown message, no atexit — a real crash
+        handles = [hvd.allreduce_async(np.full((256,), float(rank),
+                                               np.float32),
+                                       average=False, name=f"pd.{i}")
+                   for i in range(8)]
+        t0 = time.monotonic()
+        try:
+            for h in handles:
+                hvd.synchronize(h)
+        except hvd.HorovodInternalError as exc:
+            assert "shut down" in str(exc), exc
+        else:
+            raise AssertionError("expected SHUT_DOWN_ERROR after peer death")
+        elapsed = time.monotonic() - t0
+        assert elapsed < 30.0, f"unblocked only after {elapsed:.1f}s"
+
+    elif scenario == "local_crash":
+        # A rank whose ENGINE dies from a local fault while its process
+        # stays alive must still be treated as a rank death: its crash-path
+        # close carries no clean-detach, so the controller aborts the peers
+        # instead of leaving them parked in the cycle rendezvous forever.
+        import time
+
+        from horovod_tpu.ops.engine import get_engine
+
+        victim = size - 1
+        hvd.allreduce(np.ones((4,), np.float32), average=False,
+                      name="lc.barrier")
+        if rank == victim:
+            engine = get_engine()
+
+            def _boom(entry):
+                raise RuntimeError("injected local engine fault")
+
+            engine._request_of = _boom
+            h = hvd.allreduce_async(np.ones((8,), np.float32),
+                                    name="lc.trigger")
+            try:
+                hvd.synchronize(h)
+            except hvd.HorovodInternalError:
+                pass  # own handle flushed by the dying loop
+            time.sleep(5.0)  # stay alive: only the engine is dead
+            return  # skip the hvd.shutdown() handshake below via early exit
+        handles = [hvd.allreduce_async(np.full((64,), float(rank),
+                                               np.float32),
+                                       average=False, name=f"lc.{i}")
+                   for i in range(4)]
+        t0 = time.monotonic()
+        try:
+            for h in handles:
+                hvd.synchronize(h)
+        except hvd.HorovodInternalError as exc:
+            assert "shut down" in str(exc), exc
+        else:
+            raise AssertionError("expected SHUT_DOWN_ERROR after engine "
+                                 "death on a peer")
+        assert time.monotonic() - t0 < 30.0
+
     elif scenario == "object":
         obj = {"root": "payload", "rank": 0} if rank == 0 else None
         out = hvd.broadcast_object(obj, root_rank=0)

@@ -1,0 +1,166 @@
+"""Controller scalability: cycle latency must stay bounded at 32 ranks.
+
+The reference runs 5 ms negotiation cycles at 512 MPI ranks
+(``operations.cc:2030``); this environment cannot host 512 processes, so the
+stand-in is 32 threaded ranks driving one ``ControllerService`` — which
+exercises exactly the coordinator-side serial work that would collapse first
+(accept backlog, per-rank response serialization, rendezvous wakeups).
+
+Regression history: before round 2 the service inherited socketserver's
+backlog of 5 (SYN drops → 1 s retransmit stalls at 16+ simultaneous
+connects) and pickled+HMAC'd the identical ResponseList once per rank; a
+32-rank world saw >1 s worst-case cycles. With the fixes the same world
+measures ~15 ms median / ~40 ms max on this hardware; the bounds below are
+several-fold looser to absorb CI noise while still catching a collapse.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.core.config import Config
+from horovod_tpu.ops.controller import (
+    ControllerClient,
+    ControllerService,
+    make_negotiator,
+)
+from horovod_tpu.ops.messages import (
+    DataType,
+    Request,
+    RequestList,
+    RequestType,
+)
+
+SECRET = b"s" * 32
+
+
+def _request(rank: int, name: str, shape=(64,)) -> Request:
+    return Request(request_rank=rank, request_type=RequestType.ALLREDUCE,
+                   tensor_name=name, tensor_type=DataType.FLOAT32,
+                   tensor_shape=shape, root_rank=-1)
+
+
+def _drive_world(size: int, n_cycles: int, tensors_per_cycle: int):
+    """Run a threaded world; return rank 0's per-cycle latencies (seconds)
+    and every rank's final ResponseList for cross-rank identity checks."""
+    cfg = Config.from_env()
+    service = ControllerService(size, make_negotiator(size, cfg),
+                                secret=SECRET, port=0)
+    latencies: list[float] = []
+    finals: dict[int, object] = {}
+    errors: list[BaseException] = []
+
+    def worker(rank: int) -> None:
+        try:
+            client = ControllerClient(("127.0.0.1", service.port),
+                                      secret=SECRET)
+            for c in range(n_cycles):
+                requests = [_request(rank, f"t{c}_{i}")
+                            for i in range(tensors_per_cycle)]
+                t0 = time.perf_counter()
+                out = client.cycle(rank, RequestList(rank=rank,
+                                                     requests=requests))
+                if rank == 0:
+                    latencies.append(time.perf_counter() - t0)
+                finals[rank] = out
+            client.close()
+        except BaseException as exc:  # noqa: BLE001 - surfaced by the test
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    service.shutdown()
+    assert not errors, errors
+    assert len(finals) == size
+    return latencies, finals
+
+
+def test_cycle_latency_bounded_at_32_ranks():
+    latencies, finals = _drive_world(size=32, n_cycles=30,
+                                     tensors_per_cycle=8)
+    median = statistics.median(latencies)
+    worst = max(latencies)
+    assert median < 0.25, f"median cycle {median * 1e3:.1f} ms at 32 ranks"
+    # The pre-fix failure mode was kernel SYN retransmits: ~1 s spikes.
+    assert worst < 1.0, f"worst cycle {worst * 1e3:.0f} ms at 32 ranks"
+    # Every rank decoded the identical (pre-framed) response list.
+    names = [tuple(n for r in f.responses for n in r.tensor_names)
+             for f in finals.values()]
+    assert len(set(names)) == 1
+
+
+def test_clean_client_close_is_not_a_rank_death():
+    """A rank-identified client that detaches cleanly (close() without a
+    negotiated world shutdown) must not poison the controller: later
+    clients for the same ranks still complete cycles."""
+    cfg = Config.from_env()
+    service = ControllerService(2, make_negotiator(2, cfg),
+                                secret=SECRET, port=0)
+
+    def one_round():
+        outs = {}
+        def worker(rank):
+            client = ControllerClient(("127.0.0.1", service.port),
+                                      secret=SECRET, rank=rank)
+            outs[rank] = client.cycle(
+                rank, RequestList(rank=rank,
+                                  requests=[_request(rank, "w")]))
+            client.close()
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        return outs
+
+    first = one_round()
+    time.sleep(0.5)  # give the liveness monitor a chance to misfire
+    second = one_round()  # raises if the close aborted the rendezvous
+    service.shutdown()
+    assert len(first) == 2 and len(second) == 2
+
+
+@pytest.mark.parametrize("size", [16])
+def test_payload_exchange_correct_at_scale(size):
+    """The once-per-cycle framed combine result must still deliver correct
+    allreduce bytes to every rank."""
+    cfg = Config.from_env()
+    service = ControllerService(size, make_negotiator(size, cfg),
+                                secret=SECRET, port=0)
+    results: dict[int, np.ndarray] = {}
+    errors: list[BaseException] = []
+
+    def worker(rank: int) -> None:
+        try:
+            client = ControllerClient(("127.0.0.1", service.port),
+                                      secret=SECRET)
+            rl = RequestList(rank=rank, requests=[_request(rank, "grad")])
+            client.cycle(rank, rl)
+            payload = np.full(64, float(rank), np.float32)
+            raw = client.payload(rank, 0, payload.tobytes())
+            results[rank] = np.frombuffer(raw, np.float32)
+            client.close()
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(r,))
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    service.shutdown()
+    assert not errors, errors
+    expected = np.full(64, sum(range(size)), np.float32)
+    for rank in range(size):
+        np.testing.assert_array_equal(results[rank], expected)

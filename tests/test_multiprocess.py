@@ -25,7 +25,11 @@ def _free_port() -> int:
 
 
 def _run_world(scenario: str, size: int, timeout: float = 90.0,
-               extra_env=None):
+               extra_env=None, expected_codes=None):
+    """Spawn a world; assert per-rank exit codes (default: everyone exits 0
+    and prints WORKER-OK; ``expected_codes={rank: code}`` overrides
+    individual ranks, e.g. a deliberately crashing victim)."""
+    expected_codes = expected_codes or {}
     port = _free_port()
     procs = []
     for rank in range(size):
@@ -59,10 +63,12 @@ def _run_world(scenario: str, size: int, timeout: float = 90.0,
             pytest.fail(f"rank {rank} timed out in scenario {scenario!r}")
         results.append((rank, proc.returncode, out, err))
     for rank, code, out, err in results:
-        assert code == 0, (
-            f"rank {rank} failed in scenario {scenario!r} (exit {code})\n"
-            f"stdout:\n{out}\nstderr:\n{err}")
-        assert f"WORKER-OK {rank}" in out
+        want = expected_codes.get(rank, 0)
+        assert code == want, (
+            f"rank {rank} exited {code}, expected {want} in scenario "
+            f"{scenario!r}\nstdout:\n{out}\nstderr:\n{err}")
+        if want == 0:
+            assert f"WORKER-OK {rank}" in out
     return results
 
 
@@ -133,6 +139,22 @@ def test_mp_autotune_end_to_end(tmp_path):
     for line in lines:
         us = float(line.split(",")[4])
         assert us < 60e6, f"implausible active window in sample: {line}"
+
+
+def test_mp_peer_death_unblocks_survivors():
+    """Kill a rank mid-cycle with fused tensors in flight: every survivor
+    must fail its outstanding handles with SHUT_DOWN_ERROR promptly
+    (reference ``operations.cc:1942-1957``), not hang until the test
+    timeout. The victim exits 3 via os._exit — no shutdown handshake."""
+    _run_world("peer_death", 3, expected_codes={2: 3})
+
+
+def test_mp_local_engine_crash_unblocks_survivors():
+    """A local fault that kills only a rank's background engine (process
+    still alive, TCP link healthy until the crash-path close) must abort
+    the peers like a process death — the crash-path close sends no clean
+    detach, so the controller attributes the drop to the rank."""
+    _run_world("local_crash", 3, timeout=120.0)
 
 
 def test_mp_stall_warning():

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Tensor-fusion micro-benchmark: many small eager allreduces, fused vs not.
+
+The reference's core eager-path performance claim is that batching small
+tensors into one fusion buffer amortizes per-op overhead
+(``docs/tensor-fusion.md``; the 64 MB ``HOROVOD_FUSION_THRESHOLD`` default).
+This benchmark measures that claim for this framework's two eager data
+planes on a 2-process world:
+
+* ``host``  — numpy-over-TCP exchange through the controller: per-op cost is
+  a TCP payload round-trip, so fusion collapses M round-trips into one.
+* ``xla``   — compiled XLA collectives (gloo on CPU, ICI on pods): per-op
+  cost is a dispatch + compile-cache lookup per buffer; fusion collapses M
+  dispatches into one and pads into the bucketed compile cache.
+
+Usage:  python benchmarks/fusion_bench.py [--tensors 64] [--elems 25000]
+                                          [--rounds 12]
+
+Prints one table row per (plane, threshold) with tensors/s and speedup.
+The driver for each world is this same file re-executed with
+``HOROVOD_RANK`` set (the launcher-env protocol of ``core/topology.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+
+def _worker() -> None:
+    """Rank body: submit --tensors async allreduces per round, synchronize
+    all, repeat; report wall seconds for the timed rounds on rank 0."""
+    os.environ.pop("JAX_PLATFORMS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("FUSION_BENCH_JAX_COORD"):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.distributed.initialize(
+            os.environ["FUSION_BENCH_JAX_COORD"],
+            num_processes=int(os.environ["HOROVOD_SIZE"]),
+            process_id=int(os.environ["HOROVOD_RANK"]))
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import horovod_tpu as hvd
+
+    n_tensors = int(os.environ["FUSION_BENCH_TENSORS"])
+    n_elems = int(os.environ["FUSION_BENCH_ELEMS"])
+    rounds = int(os.environ["FUSION_BENCH_ROUNDS"])
+    hvd.init()
+    tensors = [np.full((n_elems,), float(i), np.float32)
+               for i in range(n_tensors)]
+
+    def one_round(tag: str) -> None:
+        handles = [hvd.allreduce_async(t, average=False,
+                                       name=f"fb.{tag}.{i}")
+                   for i, t in enumerate(tensors)]
+        for h in handles:
+            hvd.synchronize(h)
+
+    one_round("warm0")  # warm the compile cache / connections
+    one_round("warm1")
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        one_round(str(r))
+    dt = time.perf_counter() - t0
+    if hvd.rank() == 0:
+        print(json.dumps({"seconds": dt,
+                          "tensors_per_s": rounds * n_tensors / dt}))
+    hvd.shutdown()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_world(plane: str, threshold: int, args) -> dict:
+    port = _free_port()
+    coord = f"127.0.0.1:{_free_port()}" if plane == "xla" else ""
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": "2",
+            "HOROVOD_LOCAL_RANK": str(rank), "HOROVOD_LOCAL_SIZE": "2",
+            "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
+            "HOROVOD_CONTROLLER_PORT": str(port),
+            "HOROVOD_DATA_PLANE": plane,
+            "HOROVOD_FUSION_THRESHOLD": str(threshold),
+            "HOROVOD_CYCLE_TIME": "1",
+            "FUSION_BENCH_WORKER": "1",
+            "FUSION_BENCH_TENSORS": str(args.tensors),
+            "FUSION_BENCH_ELEMS": str(args.elems),
+            "FUSION_BENCH_ROUNDS": str(args.rounds),
+            "FUSION_BENCH_JAX_COORD": coord,
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = [p.communicate(timeout=600) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        if p.returncode != 0:
+            raise RuntimeError(f"worker failed:\n{err}")
+    return json.loads(outs[0][0].strip().splitlines()[-1])
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--tensors", type=int, default=64,
+                        help="small tensors per round (grad-sized count)")
+    parser.add_argument("--elems", type=int, default=25_000,
+                        help="float32 elements per tensor (~100 KB)")
+    parser.add_argument("--rounds", type=int, default=12)
+    args = parser.parse_args()
+
+    mb = args.tensors * args.elems * 4 / 1e6
+    print(f"# fusion micro-benchmark: 2 ranks, {args.tensors} x "
+          f"{args.elems * 4 / 1e3:.0f} KB tensors/round ({mb:.1f} MB), "
+          f"{args.rounds} rounds")
+    print(f"{'plane':<6} {'threshold':>10} {'tensors/s':>10} {'speedup':>8}")
+    for plane in ("host", "xla"):
+        base = None
+        for threshold in (0, 64 * 1024 * 1024):
+            r = _run_world(plane, threshold, args)
+            if base is None:
+                base = r["tensors_per_s"]
+            label = "0" if threshold == 0 else "64MiB"
+            print(f"{plane:<6} {label:>10} {r['tensors_per_s']:>10.0f} "
+                  f"{r['tensors_per_s'] / base:>7.1f}x", flush=True)
+
+
+if __name__ == "__main__":
+    if os.environ.get("FUSION_BENCH_WORKER"):
+        _worker()
+    else:
+        main()

@@ -54,22 +54,37 @@ def _state() -> _GlobalState:
 def init(ranks=None, comm=None) -> None:
     """Initialize the world. Idempotent, like ``InitializeHorovodOnce``
     (``operations.cc:2384-2399``): a second call while initialized is a
-    no-op; after ``shutdown()`` re-initialization is allowed."""
+    no-op; after ``shutdown()`` re-initialization is allowed.
+
+    ``ranks`` (or ``comm`` given as a rank list — the reference accepts
+    both spellings, ``common/__init__.py:58-84``) forms a subset world:
+    the listed launcher ranks become the active communicator in list
+    order; every launcher process must call init with the same list.
+    Processes outside the list get a self-world of size 1. An mpi4py
+    communicator object is rejected — there is no MPI in this build."""
     with _global.lock:
         if _global.initialized:
             return
-        if ranks:
+        if comm is not None and isinstance(comm, (list, tuple)):
+            if ranks:
+                raise ValueError("pass ranks either as ranks= or comm=, "
+                                 "not both")
+            ranks = list(comm)
+            comm = None
+        if ranks is not None and len(list(ranks)) == 0:
             raise ValueError(
-                "horovod_tpu.init(ranks=...) subset worlds are not supported: "
-                "the world is defined by the TPU pod topology / launcher.")
+                "init(ranks=[]) is an empty communicator; pass None (or "
+                "omit) for the full world.")
         if comm is not None:
             raise ValueError(
-                "horovod_tpu.init(comm=...) requires MPI, which this build "
-                "intentionally does not use.")
+                "horovod_tpu.init(comm=<mpi communicator>) requires MPI, "
+                "which this build intentionally does not use; pass "
+                "ranks=[...] for a subset world.")
         _global.config = Config.from_env()
-        _global.topology = discover()
+        _global.topology = discover(subset=list(ranks) if ranks else None)
         _global.initialized = True
-        if _global.topology.size > 1:
+        topo = _global.topology
+        if topo.size > 1:
             # Multi-process worlds start the background engine eagerly, as
             # the reference spawns BackgroundThreadLoop inside init
             # (operations.cc:2394): every rank must participate in control
@@ -78,6 +93,16 @@ def init(ranks=None, comm=None) -> None:
             from .ops.engine import get_engine
 
             get_engine()
+        elif ranks and len(ranks) > 1 and not topo.is_member \
+                and topo.world_rank == 0:
+            # Launcher world-rank 0 hosts the controller service even when
+            # outside the subset: the launcher advertised ITS address to
+            # every process, so the subset's cycles must rendezvous here.
+            # (A single-member subset negotiates locally — no service, and
+            # no shutdown cycle to wait for.)
+            from .ops.engine import start_subset_service
+
+            start_subset_service(len(ranks))
         LOG.debug(
             "horovod_tpu initialized: rank=%d size=%d local_rank=%d "
             "local_size=%d devices=%d/%d",

@@ -42,6 +42,31 @@ class Topology:
     local_device_count: int
     global_device_count: int
     hostname: str
+    # Launcher-world coordinates. For a full world these equal rank/size;
+    # for a subset world (``hvd.init(ranks=[...])``, reference
+    # ``operations.cc:1728-1742`` MPI_Group_incl) rank/size describe the
+    # subset communicator while world_rank/world_size keep the launcher
+    # coordinates — world_rank 0 always hosts the controller service, since
+    # that is the address the launcher advertised to every process.
+    world_rank: int = -1
+    world_size: int = -1
+    # False for a process outside the subset: it gets a self-world of size
+    # 1 (collectives work locally, nothing deadlocks) instead of the
+    # reference's ill-defined MPI_COMM_WORLD fallback.
+    is_member: bool = True
+
+    def __post_init__(self):
+        if self.world_rank < 0:
+            object.__setattr__(self, "world_rank", self.rank)
+            object.__setattr__(self, "world_size", self.size)
+
+    @property
+    def in_subset_world(self) -> bool:
+        # A permuted full-size list (ranks=[1,0]) is also a subset world:
+        # subset ranks no longer align with JAX process indices, so the
+        # device plane (which assumes that alignment) must not be used.
+        return (self.world_size != self.size or not self.is_member
+                or self.rank != self.world_rank)
 
     @property
     def is_homogeneous(self) -> bool:
@@ -65,8 +90,46 @@ def _jax_counts():
     )
 
 
-def discover(use_jax: bool = True) -> Topology:
-    """Resolve the world, preferring launcher env over the JAX runtime."""
+def discover(use_jax: bool = True, subset=None) -> Topology:
+    """Resolve the world, preferring launcher env over the JAX runtime.
+
+    ``subset`` is the rank list of ``hvd.init(ranks=[...])``: the subset
+    forms the active communicator in list order (the reference's
+    MPI_Group_incl semantics, ``operations.cc:1728-1742``); every launcher
+    process must call init with the same list. Processes outside the list
+    become self-worlds of size 1. Host-local splits (local_rank/size) keep
+    their launcher values — the subset does not move processes between
+    hosts (documented delta: the reference re-splits the subset comm by
+    shared memory)."""
+    full = _discover_full(use_jax=use_jax)
+    if subset is None:
+        return full
+    subset = list(subset)
+    if sorted(set(subset)) != sorted(subset) or not subset or \
+            not all(isinstance(r, int) and 0 <= r < full.world_size
+                    for r in subset):
+        raise ValueError(
+            f"init(ranks=...) must be a list of distinct ranks within "
+            f"[0, {full.world_size}), got {subset!r}")
+    if full.rank not in subset:
+        return Topology(
+            rank=0, size=1, local_rank=0, local_size=1, cross_rank=0,
+            cross_size=1, local_device_count=full.local_device_count,
+            global_device_count=full.local_device_count,
+            hostname=full.hostname, world_rank=full.rank,
+            world_size=full.size, is_member=False)
+    index = subset.index(full.rank)
+    return Topology(
+        rank=index, size=len(subset), local_rank=full.local_rank,
+        local_size=full.local_size, cross_rank=full.cross_rank,
+        cross_size=full.cross_size,
+        local_device_count=full.local_device_count,
+        global_device_count=full.local_device_count * len(subset),
+        hostname=full.hostname, world_rank=full.rank,
+        world_size=full.size, is_member=True)
+
+
+def _discover_full(use_jax: bool = True) -> Topology:
     env = os.environ
     hostname = socket.gethostname()
     if _config.HOROVOD_RANK in env and _config.HOROVOD_SIZE in env:

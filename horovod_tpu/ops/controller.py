@@ -517,6 +517,20 @@ class ControllerService:
     def shutdown(self) -> None:
         self._service.shutdown()
 
+    def wait_world_shutdown(self, timeout_s: float) -> bool:
+        """Poll until the world negotiated its shutdown cycle (or timeout).
+        Used by a non-member subset-service host so its own exit does not
+        tear the controller out from under a still-running subset."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                # An aborted world is equally final — no point waiting.
+                if self._world_shutdown or self._abort_fired:
+                    return True
+            time.sleep(0.05)
+        with self._lock:
+            return self._world_shutdown or self._abort_fired
+
 
 def _combine(resp: Response, slot: Dict[int, bytes]) -> bytes:
     """Host-mode data plane: the numpy reduction the coordinator applies to

@@ -157,13 +157,26 @@ class Engine:
         self._client: Optional[ControllerClient] = None
         self._negotiator = None
         self._autotuner: Optional[Autotuner] = None
-        if cfg.autotune and self._rank == 0:
+        # The autotuner lives with the controller service — launcher
+        # world-rank 0 (when a member; a non-member service host builds its
+        # own in start_subset_service, and this engine's size-1 self-world
+        # must not grow an orphan tuner beside it).
+        if cfg.autotune and topo.world_rank == 0 and topo.is_member:
             self._autotuner = Autotuner(cfg)
         self._plane = None
         if self._size == 1:
             self._negotiator = make_negotiator(1, cfg)
         else:
-            if cfg.data_plane == "xla" or (
+            if topo.in_subset_world:
+                # The device plane spans the FULL jax process world; a
+                # subset communicator must not issue collectives over it
+                # (non-members would never participate). Host exchange only.
+                if cfg.data_plane == "xla":
+                    LOG.warning(
+                        "subset world (init(ranks=...)): forcing the host "
+                        "data plane — XLA collectives span the full device "
+                        "mesh, not a rank subset.")
+            elif cfg.data_plane == "xla" or (
                     cfg.data_plane == "auto" and _jax_multiprocess()):
                 # The reference's NCCL/MPI split: the TCP controller below
                 # stays the control plane; bytes move as compiled XLA
@@ -175,12 +188,14 @@ class Engine:
             secret = default_secret()
             port = int(os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "0"))
             addr = os.environ.get(_config.HOROVOD_CONTROLLER_ADDR, "127.0.0.1")
-            if port == 0 and self._rank != 0:
+            if port == 0 and topo.world_rank != 0:
                 raise RuntimeError(
                     "multi-process world but HOROVOD_CONTROLLER_PORT is not "
                     "set; the launcher (horovodrun / horovod_tpu.runner) "
                     "must export the coordinator address to every rank.")
-            if self._rank == 0:
+            if topo.world_rank == 0:
+                # Controller duty follows the launcher's advertised address
+                # (world rank 0), not the subset rank numbering.
                 negotiator = make_negotiator(self._size, cfg)
                 bind_host = os.environ.get(
                     "HOROVOD_CONTROLLER_BIND", "127.0.0.1")
@@ -466,6 +481,34 @@ class Engine:
         self._stop_requested = True
         self._wake.set()
         self._stopped.wait(timeout)
+
+
+def start_subset_service(subset_size: int) -> None:
+    """Host the controller service for a subset world this process is NOT
+    a member of (launcher world-rank 0 outside ``init(ranks=...)``): the
+    launcher advertised this host's address, so the subset's control
+    cycles and host-plane exchanges must rendezvous here. No engine, no
+    client — pure service duty, torn down by ``hvd.shutdown``."""
+    cfg = basics.config()
+    port = int(os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "0"))
+    bind_host = os.environ.get("HOROVOD_CONTROLLER_BIND", "127.0.0.1")
+    autotuner = Autotuner(cfg) if cfg.autotune else None
+    service = ControllerService(
+        subset_size, make_negotiator(subset_size, cfg),
+        secret=default_secret(), port=port, bind_host=bind_host,
+        autotuner=autotuner)
+
+    def _teardown() -> None:
+        # Grace period: the host's own shutdown (often atexit) must not
+        # yank the controller from a subset that is still mid-job.
+        if not service.wait_world_shutdown(30.0):
+            LOG.warning("subset-service host exiting before the subset "
+                        "negotiated shutdown; tearing the controller down")
+        service.shutdown()
+        if autotuner is not None:
+            autotuner.close()
+
+    basics._state().engine_shutdown_hooks.append(_teardown)
 
 
 _engine_lock = threading.Lock()

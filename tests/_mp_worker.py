@@ -38,6 +38,8 @@ import horovod_tpu as hvd  # noqa: E402
 
 def main() -> None:
     scenario = sys.argv[1]
+    if scenario.startswith("subset"):
+        return _subset_scenario(scenario)
     hvd.init()
     rank, size = hvd.rank(), hvd.size()
     assert size == int(os.environ["HOROVOD_SIZE"])
@@ -330,6 +332,47 @@ def main() -> None:
     else:
         raise ValueError(f"unknown scenario {scenario}")
 
+    hvd.shutdown()
+
+
+def _subset_scenario(scenario: str) -> None:
+    """Subset worlds (``hvd.init(ranks=[...])``): members form a communicator
+    in list order; non-members get a self-world; launcher world-rank 0
+    hosts the controller service even as a non-member
+    (reference ``operations.cc:1728-1742`` / ``common/__init__.py:58-84``).
+
+    subset_02: 3-process world, ranks=[0, 2]  (member coordinator host)
+    subset_12: 3-process world, ranks=[1, 2]  (NON-member coordinator host)
+    """
+    world_rank = int(os.environ["HOROVOD_RANK"])
+    subset = {"subset_02": [0, 2], "subset_12": [1, 2]}[scenario]
+    hvd.init(ranks=subset)
+    if world_rank in subset:
+        my = subset.index(world_rank)
+        assert hvd.rank() == my, (hvd.rank(), my)
+        assert hvd.size() == len(subset)
+        # members allreduce their WORLD rank: the sum proves exactly the
+        # subset participated
+        out = hvd.allreduce(np.full((4,), float(world_rank), np.float32),
+                            average=False, name="sub.sum")
+        np.testing.assert_array_equal(np.asarray(out), float(sum(subset)))
+        # broadcast from the last subset member
+        root = len(subset) - 1
+        b = hvd.broadcast(np.full((2,), float(world_rank), np.float32),
+                          root_rank=root, name="sub.bcast")
+        np.testing.assert_array_equal(np.asarray(b), float(subset[-1]))
+    else:
+        # non-member: self-world; collectives act locally and cannot hang
+        assert hvd.rank() == 0 and hvd.size() == 1
+        out = hvd.allreduce(np.full((4,), 7.0, np.float32),
+                            average=False, name="sub.self")
+        np.testing.assert_array_equal(np.asarray(out), 7.0)
+        if world_rank == 0:
+            # service host: stay alive while the members finish (shutdown's
+            # grace period would cover this, but do not rely on timing)
+            import time
+
+            time.sleep(3.0)
     hvd.shutdown()
 
 

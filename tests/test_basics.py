@@ -46,9 +46,27 @@ def test_mpi_threads_supported(hvd):
     assert hvd.mpi_threads_supported() is False
 
 
-def test_init_rejects_subset_worlds():
+def test_init_subset_validation():
+    """Subset worlds (reference ``common/__init__.py:58-84``): rank lists
+    are validated against the launcher world; an mpi4py communicator object
+    is rejected (no MPI here); a rank list may also be spelled ``comm=``
+    as the reference allows. Multi-member subsets are exercised in
+    tests/test_multiprocess.py::test_mp_subset_world."""
     hvd.shutdown()
     with pytest.raises(ValueError):
-        hvd.init(ranks=[0, 1])
+        hvd.init(ranks=[0, 1])  # world of 1: rank 1 does not exist
     with pytest.raises(ValueError):
-        hvd.init(comm=object())
+        hvd.init(ranks=[0, 0])  # duplicates
+    with pytest.raises(ValueError):
+        hvd.init(ranks=[])  # empty communicator is a typo, not full world
+    with pytest.raises(ValueError):
+        hvd.init(comm=object())  # an actual MPI communicator: unsupported
+
+    # the self-subset of a single-process world is legal, via either
+    # spelling
+    hvd.init(ranks=[0])
+    assert hvd.rank() == 0 and hvd.size() == 1
+    hvd.shutdown()
+    hvd.init(comm=[0])
+    assert hvd.rank() == 0 and hvd.size() == 1
+    hvd.shutdown()

@@ -108,3 +108,17 @@ def test_haiku_mnist():
     out = _run_example("haiku_mnist.py",
                        ["--steps", "10", "--batch-size", "8"])
     assert out.returncode == 0
+
+
+def test_fusion_bench_smoke():
+    """The fusion micro-benchmark (docs/benchmarks.md) must run end to end
+    on tiny sizes; its workers spawn their own 2-process worlds."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    result = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "benchmarks",
+                                      "fusion_bench.py"),
+         "--tensors", "4", "--elems", "256", "--rounds", "2"],
+        cwd=_ROOT, env=env, capture_output=True, text=True, timeout=300)
+    assert result.returncode == 0, result.stderr
+    assert "xla" in result.stdout and "host" in result.stdout

@@ -149,6 +149,14 @@ def test_mp_peer_death_unblocks_survivors():
     _run_world("peer_death", 3, expected_codes={2: 3})
 
 
+@pytest.mark.parametrize("scenario", ["subset_02", "subset_12"])
+def test_mp_subset_world(scenario):
+    """hvd.init(ranks=[...]) on a 3-process world: members communicate in
+    list order, non-members get self-worlds, and the controller stays on
+    launcher world-rank 0 even when it is not a member (subset_12)."""
+    _run_world(scenario, 3, timeout=120.0)
+
+
 def test_mp_local_engine_crash_unblocks_survivors():
     """A local fault that kills only a rank's background engine (process
     still alive, TCP link healthy until the crash-path close) must abort

@@ -1,0 +1,50 @@
+"""Shared data-parallel train-step construction for the benchmark scripts.
+
+One definition of the measured program (model apply + loss + grad +
+DistributedOptimizer update + cross-replica BatchNorm averaging, jitted as
+a shard_map over the data axis) so `bench.py` and
+`benchmarks/scaling_bench.py` cannot drift apart — the reference keeps its
+protocol in one script per framework for the same reason
+(``examples/pytorch_synthetic_benchmark.py:37-110``).
+"""
+
+from __future__ import annotations
+
+
+def make_dp_train_step(model, opt, mesh, axis_name: str = "data",
+                       donate: bool = True):
+    """Build the jitted DP train step over ``mesh``'s ``axis_name``.
+
+    Returns ``step(params, opt_state, batch_stats, x, y) -> (params,
+    opt_state, batch_stats)`` with x/y sharded on the data axis and
+    everything else replicated. Models without BatchNorm pass
+    ``batch_stats={}`` through unchanged.
+    """
+    import jax
+    import optax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def loss_fn(params, batch_stats, x, y):
+        logits, updated = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=True,
+            mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+        return loss, updated.get("batch_stats", {})
+
+    def train_step(params, opt_state, batch_stats, x, y):
+        (_, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, x, y)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        # cross-replica BN statistics averaging (per-replica stats would be
+        # rank-varying; the reference averages metrics the same way)
+        new_stats = jax.tree_util.tree_map(
+            lambda s: jax.lax.pmean(s, axis_name), new_stats)
+        return optax.apply_updates(params, updates), opt_state, new_stats
+
+    return jax.jit(
+        shard_map(train_step, mesh=mesh,
+                  in_specs=(P(), P(), P(), P(axis_name), P(axis_name)),
+                  out_specs=(P(), P(), P())),
+        donate_argnums=(0, 1, 2) if donate else ())

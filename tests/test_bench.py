@@ -1,0 +1,43 @@
+"""bench.py is the driver's perf artifact — it must always run end to end.
+
+Round-1 postmortem: the bench had never executed before the driver ran it,
+and it died inside ``hvd.init()`` with zero measured numbers. This test
+executes the REAL bench script (tiny sizes, platform pinned to CPU, the
+preflight skipped via its documented knob) and asserts the machine-readable
+result line, so any refactor that breaks the artifact fails CI instead of
+the round.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_end_to_end_cpu():
+    bootstrap = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import sys, runpy; "
+        "sys.argv = ['bench.py', '--batch-size', '2', "
+        "'--num-warmup-batches', '1', '--num-batches-per-iter', '1', "
+        "'--num-iters', '1']; "
+        f"runpy.run_path({os.path.join(_ROOT, 'bench.py')!r}, "
+        "run_name='__main__')"
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["HOROVOD_BENCH_PREFLIGHT"] = "0"
+    result = subprocess.run(
+        [sys.executable, "-c", bootstrap], cwd=_ROOT, env=env,
+        capture_output=True, text=True, timeout=560)
+    assert result.returncode == 0, (
+        f"bench.py failed\nstdout:\n{result.stdout}\n"
+        f"stderr:\n{result.stderr}")
+    line = json.loads(result.stdout.strip().splitlines()[-1])
+    assert line["metric"] == \
+        "resnet50_synthetic_train_images_per_sec_per_device"
+    assert line["value"] > 0
+    assert line["unit"] == "img/s"
+    assert isinstance(line["vs_baseline"], float)

@@ -110,6 +110,22 @@ def test_haiku_mnist():
     assert out.returncode == 0
 
 
+def test_scaling_bench_smoke():
+    """The scaling-curve harness (BASELINE.md north star) must produce a
+    point per device count and the efficiency table."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    result = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "benchmarks",
+                                      "scaling_bench.py"),
+         "--devices", "1,2", "--batch-size", "4", "--iters", "1",
+         "--batches-per-iter", "1"],
+        cwd=_ROOT, env=env, capture_output=True, text=True, timeout=300)
+    assert result.returncode == 0, result.stderr
+    assert '"devices": 2' in result.stdout
+    assert "efficiency" in result.stdout
+
+
 def test_fusion_bench_smoke():
     """The fusion micro-benchmark (docs/benchmarks.md) must run end to end
     on tiny sizes; its workers spawn their own 2-process worlds."""

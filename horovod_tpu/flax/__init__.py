@@ -42,9 +42,24 @@ from .._frontend import (  # noqa: F401  (shared impl, horovod/_keras role)
     save_model,
     wrap_unless_distributed,
 )
+from ..basics import (  # noqa: F401  (re-exported like horovod.keras's
+    # init/rank/... surface, keras/__init__.py there)
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
 from ..state_bcast import broadcast_parameters
 
 __all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "cross_rank", "cross_size", "is_initialized", "mpi_threads_supported",
     "create_distributed_optimizer",
     "DistributedTrainState",
     "broadcast_train_state",

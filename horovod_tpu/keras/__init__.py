@@ -16,6 +16,8 @@ from ..tensorflow.keras import (  # noqa: F401
     broadcast_global_variables,
     broadcast_variables,
     callbacks,
+    cross_rank,
+    cross_size,
     init,
     is_initialized,
     load_model,
@@ -29,6 +31,7 @@ from ..tensorflow.keras import (  # noqa: F401
 
 __all__ = [
     "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "cross_rank", "cross_size",
     "is_initialized", "mpi_threads_supported",
     "DistributedOptimizer", "Compression", "broadcast_variables",
     "broadcast_global_variables", "allreduce", "allgather", "broadcast",

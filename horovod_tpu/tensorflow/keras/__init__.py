@@ -19,6 +19,8 @@ import keras
 
 from ... import basics
 from ...basics import (  # noqa: F401  (re-exported API surface)
+    cross_rank,
+    cross_size,
     init,
     is_initialized,
     local_rank,

@@ -25,13 +25,34 @@ import torch
 
 from .. import basics
 from .. import ops as _ops
+# Process-control surface re-exported like the reference's
+# ``horovod.torch`` namespace (``torch/mpi_ops.py:42-51``): users do
+# ``import horovod_tpu.torch as hvd; hvd.init(); hvd.rank()``.
+from ..basics import (  # noqa: F401
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
 from ..ops.compression import Compression
 
 __all__ = [
+    "init", "shutdown", "is_initialized",
+    "rank", "size", "local_rank", "local_size",
+    "cross_rank", "cross_size", "mpi_threads_supported",
+    "Compression",
     "DistributedOptimizer",
     "broadcast_parameters",
     "broadcast_optimizer_state",
-    "allreduce", "allreduce_async", "allgather", "broadcast",
+    "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
+    "allgather", "allgather_async",
+    "broadcast", "broadcast_async", "broadcast_", "broadcast_async_",
     "synchronize", "poll",
 ]
 
@@ -70,22 +91,76 @@ def allreduce(tensor: torch.Tensor, average: bool = True,
     return synchronize(allreduce_async(tensor, average, name, compression))
 
 
-def allgather(tensor: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
+def allreduce_async_(tensor: torch.Tensor, average: bool = True,
+                     name: Optional[str] = None,
+                     compression=Compression.none) -> int:
+    """In-place async allreduce (reference ``mpi_ops.py:156-178``): the
+    result is written back into ``tensor`` when synchronized."""
+    handle = allreduce_async(tensor, average=average, name=name,
+                             compression=compression)
+    _track_inplace(handle, tensor)
+    return handle
+
+
+def allreduce_(tensor: torch.Tensor, average: bool = True,
+               name: Optional[str] = None,
+               compression=Compression.none) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, average=average, name=name,
+                                        compression=compression))
+
+
+def allgather_async(tensor: torch.Tensor,
+                    name: Optional[str] = None) -> int:
     arr, narrow = _to_numpy(tensor)
     handle = _ops.allgather_async(arr, name=name)
     _narrow_map[handle] = narrow
-    return synchronize(handle)
+    return handle
+
+
+def allgather(tensor: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(allgather_async(tensor, name=name))
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None) -> int:
+    arr, narrow = _to_numpy(tensor)
+    handle = _ops.broadcast_async(arr, root_rank, name=name)
+    _narrow_map[handle] = narrow
+    return handle
 
 
 def broadcast(tensor: torch.Tensor, root_rank: int,
               name: Optional[str] = None) -> torch.Tensor:
-    arr, narrow = _to_numpy(tensor)
-    handle = _ops.broadcast_async(arr, root_rank, name=name)
-    _narrow_map[handle] = narrow
-    return synchronize(handle)
+    return synchronize(broadcast_async(tensor, root_rank, name=name))
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None) -> int:
+    """In-place async broadcast (reference ``mpi_ops.py:361-382``)."""
+    handle = broadcast_async(tensor, root_rank, name=name)
+    _track_inplace(handle, tensor)
+    return handle
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name=name))
 
 
 _narrow_map: dict = {}
+_inplace_map: dict = {}
+# Abandoned handles (async op issued, synchronize never called — e.g. an
+# exception between the two) must not pin gradient-sized tensors forever;
+# mirror the engine HandleManager's bounded retention.
+_MAX_TRACKED = 1 << 16
+
+
+def _track_inplace(handle: int, tensor: torch.Tensor) -> None:
+    _inplace_map[handle] = tensor
+    while len(_inplace_map) > _MAX_TRACKED:
+        _inplace_map.pop(next(iter(_inplace_map)))
+    while len(_narrow_map) > _MAX_TRACKED:
+        _narrow_map.pop(next(iter(_narrow_map)))
 
 
 def poll(handle: int) -> bool:
@@ -94,8 +169,18 @@ def poll(handle: int) -> bool:
 
 def synchronize(handle: int) -> torch.Tensor:
     narrow = _narrow_map.pop(handle, None)
+    target = _inplace_map.pop(handle, None)
     result = _ops.synchronize(handle)
-    return _from_numpy(np.asarray(result), narrow)
+    out = _from_numpy(np.asarray(result), narrow)
+    if target is not None:
+        # In-place semantics: the caller's tensor receives the result (the
+        # reference's op writes into the input buffer directly). Leaf
+        # parameters with requires_grad are the canonical use — the write
+        # is data movement, not an autograd-tracked operation.
+        with torch.no_grad():
+            target.copy_(out.reshape(target.shape))
+        return target
+    return out
 
 
 # -- DistributedOptimizer ------------------------------------------------------
@@ -237,19 +322,11 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
         items = list(params)
     if basics.size() == 1:
         return
-    handles = []
-    for name, p in items:
-        if not isinstance(p, torch.Tensor):
-            continue
-        arr, narrow = _to_numpy(p)
-        h = _ops.broadcast_async(arr, root_rank,
-                                 name=f"broadcast_parameters.{name}")
-        _narrow_map[h] = narrow
-        handles.append((p, h))
-    for p, h in handles:
-        out = synchronize(h)
-        with torch.no_grad():
-            p.copy_(out.reshape(p.shape))
+    handles = [broadcast_async_(p, root_rank,
+                                name=f"broadcast_parameters.{name}")
+               for name, p in items if isinstance(p, torch.Tensor)]
+    for h in handles:
+        synchronize(h)  # in-place: writes straight into each parameter
 
 
 def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
@@ -306,20 +383,15 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
         new_state[pid] = entry
 
     # 3) identical tensor collectives on every rank, in deterministic order
-    handles = []
-    for pid in sorted(new_state):
+    handles = [
+        broadcast_async_(new_state[pid][key], root_rank,
+                         name=f"broadcast_optimizer_state.{pid}.{key}")
+        for pid in sorted(new_state)
         for key in sorted(k for k, s in meta["state"][pid].items()
-                          if s[0] == "tensor"):
-            t = new_state[pid][key]
-            arr, narrow = _to_numpy(t)
-            h = _ops.broadcast_async(
-                arr, root_rank, name=f"broadcast_optimizer_state.{pid}.{key}")
-            _narrow_map[h] = narrow
-            handles.append((t, h))
-    for t, h in handles:
-        out = synchronize(h)
-        with torch.no_grad():
-            t.copy_(out.reshape(t.shape))
+                          if s[0] == "tensor")
+    ]
+    for h in handles:
+        synchronize(h)  # in-place: fills the conformed state tensors
 
     state_dict["state"] = new_state
     for group, group_meta in zip(state_dict["param_groups"],

@@ -1,0 +1,44 @@
+"""Every framework namespace must carry the full process-control surface.
+
+The reference re-exports ``init/shutdown/rank/size/...`` inside each
+front-end module so users write ``import horovod.torch as hvd`` and never
+touch another namespace (``torch/mpi_ops.py:42-51``,
+``keras/__init__.py``); drop-in parity requires the same here.
+"""
+
+import importlib
+
+import pytest
+
+PROCESS_SURFACE = [
+    "init", "shutdown", "is_initialized", "rank", "size",
+    "local_rank", "local_size", "cross_rank", "cross_size",
+    "mpi_threads_supported",
+]
+
+
+@pytest.mark.parametrize("module", [
+    "horovod_tpu",
+    "horovod_tpu.torch",
+    "horovod_tpu.tensorflow",
+    "horovod_tpu.tensorflow.keras",
+    "horovod_tpu.keras",
+    "horovod_tpu.flax",
+    "horovod_tpu.haiku",
+])
+def test_process_surface(module):
+    mod = importlib.import_module(module)
+    missing = [s for s in PROCESS_SURFACE if not hasattr(mod, s)]
+    assert not missing, f"{module} lacks {missing}"
+
+
+def test_torch_op_surface():
+    """The reference's full op set incl. in-place and async variants
+    (``torch/mpi_ops.py:86-438``)."""
+    mod = importlib.import_module("horovod_tpu.torch")
+    ops = ["allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
+           "allgather", "allgather_async",
+           "broadcast", "broadcast_async", "broadcast_", "broadcast_async_",
+           "poll", "synchronize", "Compression"]
+    missing = [s for s in ops if not hasattr(mod, s)]
+    assert not missing, f"horovod_tpu.torch lacks {missing}"

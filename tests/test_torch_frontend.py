@@ -31,6 +31,41 @@ def test_torch_broadcast_and_allgather(hvd):
         hvd_torch.allgather(t, name="t.g").numpy(), t.numpy())
 
 
+def test_torch_inplace_and_async_variants(hvd):
+    """In-place variants write the result back into the caller's tensor and
+    return it (reference ``mpi_ops.py:156-178, 361-404``); async variants
+    return handles usable with poll/synchronize."""
+    t = torch.full((4,), 3.0)
+    out = hvd_torch.allreduce_(t, average=False, name="t.ar_")
+    assert out is t
+    np.testing.assert_array_equal(t.numpy(), 3.0)  # world of 1: identity
+
+    # leaf parameters with requires_grad are the canonical in-place target
+    # (syncing model weights); the write must not trip autograd
+    p = torch.nn.Parameter(torch.full((3,), 2.0))
+    assert hvd_torch.broadcast_(p, 0, name="t.p_") is p
+    assert hvd_torch.allreduce_(p, average=True, name="t.par_") is p
+
+    t2 = torch.full((2, 2), 7.0)
+    h = hvd_torch.allreduce_async_(t2, average=True, name="t.ara_")
+    out2 = hvd_torch.synchronize(h)
+    assert out2 is t2
+
+    b = torch.full((3,), 9.0)
+    out3 = hvd_torch.broadcast_(b, 0, name="t.b_")
+    assert out3 is b
+
+    h2 = hvd_torch.broadcast_async_(b, 0, name="t.ba_")
+    assert hvd_torch.synchronize(h2) is b
+
+    h3 = hvd_torch.allgather_async(torch.ones(2), name="t.ga")
+    np.testing.assert_array_equal(
+        hvd_torch.synchronize(h3).numpy(), 1.0)
+    h4 = hvd_torch.broadcast_async(torch.ones(2), 0, name="t.ba")
+    np.testing.assert_array_equal(
+        hvd_torch.synchronize(h4).numpy(), 1.0)
+
+
 def test_distributed_optimizer_size1_matches_sgd(hvd):
     torch.manual_seed(0)
     model = torch.nn.Linear(4, 2)

@@ -25,7 +25,9 @@ from typing import List, Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "build", "libhtpu_core.so")
-_SOURCES = ("negotiator.cc", "autotune.cc", "timeline_writer.cc", "Makefile")
+_SOURCES = ("negotiator.cc", "autotune.cc", "timeline_writer.cc",
+            "controller_service.cc", "negotiator_core.h", "sha256.h",
+            "Makefile")
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -108,6 +110,16 @@ def _declare(lib) -> None:
     lib.htpu_timeline_open.argtypes = [c.c_char_p]
     lib.htpu_timeline_write.argtypes = [c.c_void_p, c.c_char_p]
     lib.htpu_timeline_close.argtypes = [c.c_void_p]
+
+    lib.htpu_controller_start.restype = c.c_void_p
+    lib.htpu_controller_start.argtypes = [
+        c.c_int, c.c_char_p, c.c_int, c.c_char_p, c.c_int, c.c_longlong,
+        c.c_double, c.c_int, c.c_char_p, c.c_char_p, c.c_int]
+    lib.htpu_controller_port.restype = c.c_int
+    lib.htpu_controller_port.argtypes = [c.c_void_p]
+    lib.htpu_controller_world_shutdown.restype = c.c_int
+    lib.htpu_controller_world_shutdown.argtypes = [c.c_void_p]
+    lib.htpu_controller_stop.argtypes = [c.c_void_p]
 
 
 def available() -> bool:

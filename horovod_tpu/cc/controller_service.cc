@@ -1,0 +1,724 @@
+// Native controller service: the rank-0 hot path of the eager control plane
+// in C++ — sockets, HMAC framing, cycle rendezvous, negotiation (via the
+// shared negotiator core), host-plane payload combine, and failure
+// detection. TPU-native rebuild of the coordinator role of
+// horovod/common/operations.cc:2030-2380 (there: MPI_Gather/Bcast each
+// cycle inside the C++ background thread; here: an authenticated TCP star,
+// one service thread per rank plus a liveness monitor).
+//
+// Behavior contract: identical to the Python ControllerService
+// (horovod_tpu/ops/controller.py) — same negotiated responses, same error
+// strings, same rank-death abort semantics — so the multi-process test
+// battery runs against both via HOROVOD_NATIVE_CONTROLLER. Not supported
+// here (the engine falls back to the Python service): autotune.
+//
+// Wire: HMAC-SHA256 digest + u64 big-endian length + body (the exact
+// framing of runner/network.py Wire), with a little-endian binary body
+// (encoded/decoded by horovod_tpu/ops/native_controller.py) instead of
+// pickle — a C++ service must not execute pickled payloads, and parsing
+// cost on the coordinator is what bounds cycle latency at scale.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "negotiator_core.h"
+#include "sha256.h"
+
+namespace htpu {
+namespace {
+
+// ---- binary body codec ------------------------------------------------------
+
+struct Reader {
+  const uint8_t* p;
+  size_t n;
+  bool ok = true;
+
+  template <typename T>
+  T Get() {
+    T v{};
+    if (n < sizeof(T)) { ok = false; return v; }
+    std::memcpy(&v, p, sizeof(T));
+    p += sizeof(T);
+    n -= sizeof(T);
+    return v;
+  }
+
+  std::string GetBytes(size_t len) {
+    if (n < len) { ok = false; return ""; }
+    std::string s(reinterpret_cast<const char*>(p), len);
+    p += len;
+    n -= len;
+    return s;
+  }
+};
+
+struct Writer {
+  std::string out;
+
+  template <typename T>
+  void Put(T v) {
+    out.append(reinterpret_cast<const char*>(&v), sizeof(T));
+  }
+
+  void PutBytes(const std::string& s) { out.append(s); }
+};
+
+enum MsgKind : uint8_t { kHello = 1, kBye = 2, kCycle = 3, kPayload = 4 };
+
+// ---- half / bfloat16 arithmetic for the payload combine ---------------------
+
+inline float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ff;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;
+    } else {  // subnormal: normalize
+      int shift = 0;
+      while (!(mant & 0x400)) { mant <<= 1; ++shift; }
+      mant &= 0x3ff;
+      bits = sign | ((127 - 15 - shift + 1) << 23) | (mant << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7f800000u | (mant << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t FloatToHalf(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint16_t sign = static_cast<uint16_t>((bits >> 16) & 0x8000u);
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = bits & 0x7fffffu;
+  if (((bits >> 23) & 0xff) == 0xff)  // inf / nan
+    return static_cast<uint16_t>(sign | 0x7c00u | (mant ? 0x200u : 0));
+  if (exp >= 31) return static_cast<uint16_t>(sign | 0x7c00u);  // overflow
+  if (exp <= 0) {  // subnormal or zero, round-to-nearest-even
+    if (exp < -10) return sign;
+    mant |= 0x800000u;
+    int shift = 14 - exp;
+    uint32_t q = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t half = 1u << (shift - 1);
+    if (rem > half || (rem == half && (q & 1))) ++q;
+    return static_cast<uint16_t>(sign | q);
+  }
+  uint32_t q = mant >> 13;
+  uint32_t rem = mant & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (q & 1))) {
+    if (++q == 0x400u) { q = 0; ++exp; if (exp >= 31) return static_cast<uint16_t>(sign | 0x7c00u); }
+  }
+  return static_cast<uint16_t>(sign | (exp << 10) | q);
+}
+
+inline float Bf16ToFloat(uint16_t b) {
+  uint32_t bits = static_cast<uint32_t>(b) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t FloatToBf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  if ((bits & 0x7f800000u) == 0x7f800000u && (bits & 0x7fffffu))
+    return static_cast<uint16_t>((bits >> 16) | 0x40);  // quiet nan
+  uint32_t lsb = (bits >> 16) & 1;
+  bits += 0x7fffu + lsb;  // round-to-nearest-even
+  return static_cast<uint16_t>(bits >> 16);
+}
+
+template <typename T>
+void SumTyped(std::string* acc, const std::string& add) {
+  T* a = reinterpret_cast<T*>(&(*acc)[0]);
+  const T* b = reinterpret_cast<const T*>(add.data());
+  size_t count = acc->size() / sizeof(T);
+  for (size_t i = 0; i < count; ++i) a[i] += b[i];
+}
+
+void SumInto(std::string* acc, const std::string& add, int dtype) {
+  switch (dtype) {
+    case 0: SumTyped<uint8_t>(acc, add); break;
+    case 1: SumTyped<int8_t>(acc, add); break;
+    case 2: SumTyped<uint16_t>(acc, add); break;
+    case 3: SumTyped<int16_t>(acc, add); break;
+    case 4: SumTyped<int32_t>(acc, add); break;
+    case 5: SumTyped<int64_t>(acc, add); break;
+    case 6: {  // float16: numpy computes in f32 and rounds back per element
+      uint16_t* a = reinterpret_cast<uint16_t*>(&(*acc)[0]);
+      const uint16_t* b = reinterpret_cast<const uint16_t*>(add.data());
+      for (size_t i = 0; i < acc->size() / 2; ++i)
+        a[i] = FloatToHalf(HalfToFloat(a[i]) + HalfToFloat(b[i]));
+      break;
+    }
+    case 7: SumTyped<float>(acc, add); break;
+    case 8: SumTyped<double>(acc, add); break;
+    case 9: {  // bool: + is logical or in numpy
+      uint8_t* a = reinterpret_cast<uint8_t*>(&(*acc)[0]);
+      const uint8_t* b = reinterpret_cast<const uint8_t*>(add.data());
+      for (size_t i = 0; i < acc->size(); ++i) a[i] = (a[i] || b[i]) ? 1 : 0;
+      break;
+    }
+    case 10: {  // bfloat16
+      uint16_t* a = reinterpret_cast<uint16_t*>(&(*acc)[0]);
+      const uint16_t* b = reinterpret_cast<const uint16_t*>(add.data());
+      for (size_t i = 0; i < acc->size() / 2; ++i)
+        a[i] = FloatToBf16(Bf16ToFloat(a[i]) + Bf16ToFloat(b[i]));
+      break;
+    }
+  }
+}
+
+// ---- service ---------------------------------------------------------------
+
+struct CycleSlot {
+  std::map<int, std::pair<std::vector<Request>, bool>> lists;  // rank ->
+  bool done = false;
+  std::string framed;  // one frame serves every rank
+};
+
+struct PayloadSlot {
+  std::map<int, std::string> data;
+  bool done = false;
+  std::string framed;
+};
+
+class ControllerServer {
+ public:
+  ControllerServer(int size, std::string secret, int64_t fusion_threshold,
+                   double stall_warning_s, bool stall_check_disable,
+                   std::string shutdown_error)
+      : size_(size),
+        secret_(std::move(secret)),
+        shutdown_error_(std::move(shutdown_error)),
+        negotiator_(size, fusion_threshold, stall_warning_s,
+                    stall_check_disable) {}
+
+  bool Start(const char* bind_host, int port, std::string* err) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) { *err = "socket() failed"; return false; }
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, bind_host, &addr.sin_addr) != 1) {
+      *err = "bad bind host";
+      return false;
+    }
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0) {
+      *err = "bind() failed";
+      return false;
+    }
+    // Every rank connects at t0 (see the Python service's backlog note).
+    if (::listen(listen_fd_, 512) != 0) { *err = "listen() failed"; return false; }
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    monitor_thread_ = std::thread([this] { MonitorLoop(); });
+    return true;
+  }
+
+  int port() const { return port_; }
+
+  bool world_shutdown() {
+    std::lock_guard<std::mutex> guard(mutex_);
+    return world_shutdown_ || !abort_reason_.empty();
+  }
+
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> guard(mutex_);
+      if (stopping_) return;
+      stopping_ = true;
+    }
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    {
+      std::lock_guard<std::mutex> guard(mutex_);
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    cv_.notify_all();
+    if (accept_thread_.joinable()) accept_thread_.join();
+    if (monitor_thread_.joinable()) monitor_thread_.join();
+    for (auto& t : conn_threads_) t.join();
+  }
+
+  ~ControllerServer() { Stop(); }
+
+ private:
+  // -- framing ---------------------------------------------------------------
+
+  bool ReadExact(int fd, uint8_t* buf, size_t n) {
+    while (n > 0) {
+      ssize_t got = ::recv(fd, buf, n, 0);
+      if (got <= 0) return false;
+      buf += got;
+      n -= static_cast<size_t>(got);
+    }
+    return true;
+  }
+
+  bool ReadFrame(int fd, std::string* body) {
+    uint8_t header[40];
+    if (!ReadExact(fd, header, sizeof(header))) return false;
+    uint64_t len = 0;
+    for (int i = 0; i < 8; ++i) len = (len << 8) | header[32 + i];
+    if (len > (1ull << 33)) return false;  // 8 GiB sanity bound
+    body->resize(len);
+    if (len && !ReadExact(fd, reinterpret_cast<uint8_t*>(&(*body)[0]), len))
+      return false;
+    uint8_t digest[32];
+    HmacSha256(secret_, reinterpret_cast<const uint8_t*>(body->data()),
+               body->size(), digest);
+    return ConstTimeEqual(digest, header, 32);
+  }
+
+  std::string FrameBody(const std::string& body) {
+    std::string frame;
+    frame.resize(40 + body.size());
+    HmacSha256(secret_, reinterpret_cast<const uint8_t*>(body.data()),
+               body.size(), reinterpret_cast<uint8_t*>(&frame[0]));
+    uint64_t len = body.size();
+    for (int i = 0; i < 8; ++i)
+      frame[32 + i] = static_cast<char>(len >> (56 - 8 * i));
+    std::memcpy(&frame[40], body.data(), body.size());
+    return frame;
+  }
+
+  bool WriteAll(int fd, const std::string& data) {
+    size_t off = 0;
+    while (off < data.size()) {
+      ssize_t sent = ::send(fd, data.data() + off, data.size() - off,
+                            MSG_NOSIGNAL);
+      if (sent <= 0) return false;
+      off += static_cast<size_t>(sent);
+    }
+    return true;
+  }
+
+  // -- connection handling ---------------------------------------------------
+
+  void AcceptLoop() {
+    while (true) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return;  // listener closed by Stop()
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> guard(mutex_);
+      if (stopping_) { ::close(fd); return; }
+      conn_fds_.push_back(fd);
+      conn_threads_.emplace_back([this, fd] { ConnLoop(fd); });
+    }
+  }
+
+  void ConnLoop(int fd) {
+    std::string body;
+    while (ReadFrame(fd, &body)) {
+      std::string resp;
+      try {
+        resp = Dispatch(fd, body);
+      } catch (const std::exception& e) {
+        // Behavior contract with the Python service: a handler failure is
+        // a per-request remote error, never a coordinator crash.
+        resp = ErrorResp(std::string("native controller error: ") + e.what());
+      }
+      if (!WriteAll(fd, resp)) break;
+    }
+    OnDisconnect(fd);
+    ::close(fd);
+  }
+
+  // Out-of-band EOF detection: a connection thread parked in a rendezvous
+  // is not reading its socket, so a peer dying mid-rendezvous would go
+  // unnoticed (the Python service has the same monitor for the same hole).
+  void MonitorLoop() {
+    while (true) {
+      {
+        std::unique_lock<std::mutex> lock(mutex_);
+        if (cv_.wait_for(lock, std::chrono::milliseconds(200),
+                         [this] { return stopping_; }))
+          return;
+      }
+      std::vector<int> fds;
+      {
+        std::lock_guard<std::mutex> guard(mutex_);
+        fds = conn_fds_;
+      }
+      for (int fd : fds) {
+        char c;
+        ssize_t got = ::recv(fd, &c, 1, MSG_PEEK | MSG_DONTWAIT);
+        if (got == 0) OnDisconnect(fd);  // orderly EOF
+        // got<0 with EAGAIN: alive; other errors surface in the conn thread
+      }
+    }
+  }
+
+  void OnDisconnect(int fd) {
+    std::string reason;
+    {
+      std::lock_guard<std::mutex> guard(mutex_);
+      // Always stop monitoring the fd (anonymous probe connections close
+      // without ever identifying a rank; their number may be reused).
+      for (auto fit = conn_fds_.begin(); fit != conn_fds_.end(); ++fit)
+        if (*fit == fd) { conn_fds_.erase(fit); break; }
+      auto it = conn_ranks_.find(fd);
+      if (it == conn_ranks_.end()) return;
+      int rank = it->second;
+      conn_ranks_.erase(it);
+      if (world_shutdown_ || stopping_) return;
+      if (abort_reason_.empty())
+        abort_reason_ = "rank " + std::to_string(rank) + " exited mid-job. " +
+                        shutdown_error_;
+      reason = abort_reason_;
+    }
+    std::fprintf(stderr,
+                 "[horovod_tpu native controller] %s — aborting in-flight "
+                 "collectives on all ranks\n",
+                 reason.c_str());
+    cv_.notify_all();
+  }
+
+  // -- dispatch --------------------------------------------------------------
+
+  std::string ErrorResp(const std::string& msg) {
+    Writer w;
+    w.Put<uint8_t>(1);
+    w.Put<uint32_t>(static_cast<uint32_t>(msg.size()));
+    w.PutBytes(msg);
+    return FrameBody(w.out);
+  }
+
+  std::string Dispatch(int fd, const std::string& body) {
+    Reader r{reinterpret_cast<const uint8_t*>(body.data()), body.size()};
+    uint8_t kind = r.Get<uint8_t>();
+    if (!r.ok) return ErrorResp("malformed request");
+    if (kind == 0x80) {
+      // A pickle protocol marker: this rank fell back to the Python
+      // controller client (native core unavailable there?) while the
+      // coordinator runs the native service. It cannot parse our error
+      // frame either — log the diagnosis where the operator will look.
+      std::fprintf(stderr,
+                   "[horovod_tpu native controller] received a PICKLE "
+                   "request: a rank is running the Python controller "
+                   "client against the native service. "
+                   "HOROVOD_NATIVE_CONTROLLER must resolve identically on "
+                   "every rank (is the native core built on every host?). "
+                   "Set HOROVOD_NATIVE_CONTROLLER=0 to force the Python "
+                   "service everywhere.\n");
+      return ErrorResp("protocol mismatch: coordinator speaks the native "
+                       "binary protocol");
+    }
+    switch (kind) {
+      case kHello: {
+        int32_t rank = r.Get<int32_t>();
+        std::lock_guard<std::mutex> guard(mutex_);
+        conn_ranks_[fd] = rank;
+        Writer w;
+        w.Put<uint8_t>(0);
+        return FrameBody(w.out);
+      }
+      case kBye: {
+        std::lock_guard<std::mutex> guard(mutex_);
+        conn_ranks_.erase(fd);
+        Writer w;
+        w.Put<uint8_t>(0);
+        return FrameBody(w.out);
+      }
+      case kCycle:
+        return HandleCycle(fd, &r);
+      case kPayload:
+        return HandlePayload(fd, &r);
+      default:
+        return ErrorResp("unknown request kind");
+    }
+  }
+
+  std::string HandleCycle(int fd, Reader* r) {
+    int32_t rank = r->Get<int32_t>();
+    uint8_t shutdown = r->Get<uint8_t>();
+    uint32_t nreq = r->Get<uint32_t>();
+    std::vector<Request> reqs;
+    reqs.reserve(nreq);
+    for (uint32_t i = 0; i < nreq && r->ok; ++i) {
+      Request req;
+      req.rank = rank;
+      req.op = static_cast<Op>(r->Get<uint8_t>());
+      req.dtype = r->Get<uint8_t>();
+      req.root_rank = r->Get<int32_t>();
+      uint8_t ndim = r->Get<uint8_t>();
+      for (uint8_t d = 0; d < ndim; ++d)
+        req.shape.push_back(r->Get<int64_t>());
+      uint16_t name_len = r->Get<uint16_t>();
+      req.name = r->GetBytes(name_len);
+      reqs.push_back(std::move(req));
+    }
+    if (!r->ok) return ErrorResp("malformed cycle request");
+
+    std::unique_lock<std::mutex> lock(mutex_);
+    conn_ranks_[fd] = rank;
+    if (!abort_reason_.empty()) return ErrorResp(abort_reason_);
+    int64_t key = rank_cycles_[rank]++;
+    CycleSlot& slot = cycles_[key];
+    slot.lists[rank] = {std::move(reqs), shutdown != 0};
+    if (static_cast<int>(slot.lists.size()) == size_) {
+      // rank order, matching the Python service's deterministic feed
+      bool any_shutdown = false;
+      for (auto& kv : slot.lists) {
+        for (Request& req : kv.second.first)
+          negotiator_.AddRequest(std::move(req), false);
+        any_shutdown |= kv.second.second;
+      }
+      if (any_shutdown) negotiator_.SetShutdown();
+      std::vector<std::string> stalls;
+      bool world_shutdown = false;
+      std::vector<Response> responses =
+          negotiator_.ConstructList(&stalls, &world_shutdown);
+      if (world_shutdown) world_shutdown_ = true;
+      history_[cycle_no_] = responses;
+      history_.erase(cycle_no_ - 16);
+      ++cycle_no_;
+      slot.framed = FrameBody(EncodeCycleResponse(
+          responses, stalls, world_shutdown));
+      slot.done = true;
+      cv_.notify_all();
+    } else {
+      cv_.wait(lock, [&] {
+        return slot.done || !abort_reason_.empty() || stopping_;
+      });
+      if (!slot.done)
+        return ErrorResp(abort_reason_.empty() ? "controller stopping"
+                                               : abort_reason_);
+    }
+    std::string framed = slot.framed;
+    if (++delivered_[key] == size_) {
+      cycles_.erase(key);
+      delivered_.erase(key);
+    }
+    return framed;
+  }
+
+  std::string EncodeCycleResponse(const std::vector<Response>& responses,
+                                  const std::vector<std::string>& stalls,
+                                  bool shutdown) {
+    Writer w;
+    w.Put<uint8_t>(0);
+    w.Put<uint8_t>(shutdown ? 1 : 0);
+    w.Put<uint32_t>(static_cast<uint32_t>(responses.size()));
+    for (const Response& resp : responses) {
+      w.Put<uint8_t>(static_cast<uint8_t>(resp.type));
+      w.Put<uint8_t>(static_cast<uint8_t>(resp.dtype));
+      w.Put<uint64_t>(static_cast<uint64_t>(resp.payload_bytes));
+      w.Put<uint16_t>(static_cast<uint16_t>(resp.names.size()));
+      for (const std::string& name : resp.names) {
+        w.Put<uint16_t>(static_cast<uint16_t>(name.size()));
+        w.PutBytes(name);
+      }
+      w.Put<uint32_t>(static_cast<uint32_t>(resp.error.size()));
+      w.PutBytes(resp.error);
+      w.Put<uint32_t>(static_cast<uint32_t>(resp.sizes.size()));
+      for (int64_t s : resp.sizes) w.Put<int64_t>(s);
+    }
+    w.Put<uint32_t>(static_cast<uint32_t>(stalls.size()));
+    for (const std::string& s : stalls) {
+      w.Put<uint32_t>(static_cast<uint32_t>(s.size()));
+      w.PutBytes(s);
+    }
+    return w.out;
+  }
+
+  std::string HandlePayload(int fd, Reader* r) {
+    int32_t rank = r->Get<int32_t>();
+    uint64_t cycle_no = r->Get<uint64_t>();
+    uint32_t idx = r->Get<uint32_t>();
+    uint64_t data_len = r->Get<uint64_t>();
+    if (!r->ok || r->n < data_len) return ErrorResp("malformed payload");
+    std::string data = r->GetBytes(data_len);
+
+    std::unique_lock<std::mutex> lock(mutex_);
+    conn_ranks_[fd] = rank;
+    if (!abort_reason_.empty()) return ErrorResp(abort_reason_);
+    auto hist_it = history_.find(static_cast<int64_t>(cycle_no));
+    if (hist_it == history_.end() ||
+        idx >= hist_it->second.size())
+      return ErrorResp("payload references an unknown cycle/response");
+    const Response resp = hist_it->second[idx];  // copy: history may be
+                                                 // pruned once unlocked
+    if (resp.type == RespType::ERROR)
+      return ErrorResp("payload submitted for an error response: " +
+                       resp.error);
+    auto key = std::make_pair(static_cast<int64_t>(cycle_no),
+                              static_cast<int64_t>(idx));
+    PayloadSlot& slot = payloads_[key];
+    slot.data[rank] = std::move(data);
+    if (static_cast<int>(slot.data.size()) == size_) {
+      // Combine + frame outside the service mutex: summing a fused
+      // multi-MB buffer across N ranks (plus the HMAC over the result)
+      // must not block every other connection's cycle handling.
+      std::map<int, std::string> gathered = std::move(slot.data);
+      lock.unlock();
+      std::string framed;
+      std::string error;
+      try {
+        std::string combined = Combine(resp, gathered);
+        Writer w;
+        w.Put<uint8_t>(0);
+        w.Put<uint64_t>(combined.size());
+        w.PutBytes(combined);
+        framed = FrameBody(w.out);
+      } catch (const std::exception& e) {
+        error = e.what();
+      }
+      lock.lock();
+      if (!error.empty()) {
+        // Poison the slot for every waiting rank, like the Python
+        // rendezvous does on a compute failure.
+        Writer w;
+        w.Put<uint8_t>(1);
+        w.Put<uint32_t>(static_cast<uint32_t>(error.size()));
+        w.PutBytes(error);
+        framed = FrameBody(w.out);
+      }
+      slot.framed = std::move(framed);
+      slot.done = true;
+      cv_.notify_all();
+    } else {
+      cv_.wait(lock, [&] {
+        return slot.done || !abort_reason_.empty() || stopping_;
+      });
+      if (!slot.done)
+        return ErrorResp(abort_reason_.empty() ? "controller stopping"
+                                               : abort_reason_);
+    }
+    std::string framed = slot.framed;
+    if (++payload_delivered_[key] == size_) {
+      payloads_.erase(key);
+      payload_delivered_.erase(key);
+    }
+    return framed;
+  }
+
+  std::string Combine(const Response& resp,
+                      const std::map<int, std::string>& data) {
+    if (resp.type == RespType::ALLREDUCE) {
+      std::string acc = data.begin()->second;
+      for (auto it = std::next(data.begin()); it != data.end(); ++it) {
+        // The Python twin's numpy add raises on ragged buffers; an
+        // unchecked sum here would read past the shorter one.
+        if (it->second.size() != acc.size())
+          throw std::runtime_error(
+              "allreduce payload size mismatch across ranks (" +
+              std::to_string(acc.size()) + " vs " +
+              std::to_string(it->second.size()) + " bytes)");
+        SumInto(&acc, it->second, resp.dtype);
+      }
+      return acc;
+    }
+    if (resp.type == RespType::ALLGATHER) {
+      std::string out;
+      for (const auto& kv : data) out += kv.second;
+      return out;
+    }
+    // BROADCAST: sizes[0] is the root rank
+    if (resp.sizes.empty())
+      throw std::runtime_error("broadcast response carries no root rank");
+    auto it = data.find(static_cast<int>(resp.sizes[0]));
+    if (it == data.end())
+      throw std::runtime_error("broadcast root sent no payload");
+    return it->second;
+  }
+
+  const int size_;
+  const std::string secret_;
+  const std::string shutdown_error_;
+  Negotiator negotiator_;
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::thread accept_thread_;
+  std::thread monitor_thread_;
+  std::vector<std::thread> conn_threads_;
+
+  std::mutex mutex_;
+  std::condition_variable cv_;
+  bool stopping_ = false;
+  bool world_shutdown_ = false;
+  std::string abort_reason_;
+  std::vector<int> conn_fds_;
+  std::unordered_map<int, int> conn_ranks_;  // fd -> rank
+  std::unordered_map<int, int64_t> rank_cycles_;
+  std::map<int64_t, CycleSlot> cycles_;
+  std::map<int64_t, int> delivered_;
+  int64_t cycle_no_ = 0;
+  std::map<int64_t, std::vector<Response>> history_;
+  std::map<std::pair<int64_t, int64_t>, PayloadSlot> payloads_;
+  std::map<std::pair<int64_t, int64_t>, int> payload_delivered_;
+};
+
+}  // namespace
+}  // namespace htpu
+
+extern "C" {
+
+void* htpu_controller_start(int size, const char* bind_host, int port,
+                            const uint8_t* secret, int secret_len,
+                            long long fusion_threshold,
+                            double stall_warning_s, int stall_check_disable,
+                            const char* shutdown_error, char* err_out,
+                            int err_cap) {
+  auto* server = new htpu::ControllerServer(
+      size, std::string(reinterpret_cast<const char*>(secret),
+                        static_cast<size_t>(secret_len)),
+      fusion_threshold, stall_warning_s, stall_check_disable != 0,
+      shutdown_error);
+  std::string err;
+  if (!server->Start(bind_host, port, &err)) {
+    std::snprintf(err_out, static_cast<size_t>(err_cap), "%s", err.c_str());
+    delete server;
+    return nullptr;
+  }
+  return server;
+}
+
+int htpu_controller_port(void* handle) {
+  return static_cast<htpu::ControllerServer*>(handle)->port();
+}
+
+int htpu_controller_world_shutdown(void* handle) {
+  return static_cast<htpu::ControllerServer*>(handle)->world_shutdown() ? 1
+                                                                        : 0;
+}
+
+void htpu_controller_stop(void* handle) {
+  auto* server = static_cast<htpu::ControllerServer*>(handle);
+  server->Stop();
+  delete server;
+}
+
+}  // extern "C"

@@ -1,0 +1,399 @@
+// Negotiation core shared by the ctypes negotiator shim and the native
+// controller service (single definition; see negotiator.cc for provenance
+// and reference citations).
+#ifndef HTPU_NEGOTIATOR_CORE_H_
+#define HTPU_NEGOTIATOR_CORE_H_
+
+#include <algorithm>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace htpu {
+
+enum class Op : int { ALLREDUCE = 0, ALLGATHER = 1, BROADCAST = 2 };
+enum class RespType : int { ALLREDUCE = 0, ALLGATHER = 1, BROADCAST = 2, ERROR = 3 };
+
+inline const char* const kOpNames[] = {"ALLREDUCE", "ALLGATHER", "BROADCAST"};
+inline const char* const kDtypeNames[] = {"UINT8",   "INT8",    "UINT16",  "INT16",
+                             "INT32",   "INT64",   "FLOAT16", "FLOAT32",
+                             "FLOAT64", "BOOL",    "BFLOAT16"};
+inline const int64_t kDtypeBytes[] = {1, 1, 2, 2, 4, 8, 2, 4, 8, 1, 2};
+
+struct Request {
+  int rank = -1;
+  Op op = Op::ALLREDUCE;
+  int dtype = 0;
+  std::string name;
+  int root_rank = -1;
+  std::vector<int64_t> shape;
+
+  int64_t nbytes() const {
+    int64_t n = kDtypeBytes[dtype];
+    for (int64_t d : shape) n *= d;
+    return n;
+  }
+};
+
+struct Response {
+  RespType type = RespType::ALLREDUCE;
+  std::vector<std::string> names;
+  std::string error;
+  std::vector<int64_t> sizes;
+  int dtype = 0;
+  int64_t payload_bytes = 0;
+};
+
+struct TableEntry {
+  std::map<int, Request> requests;  // rank -> request (sorted by rank)
+  std::chrono::steady_clock::time_point first_seen =
+      std::chrono::steady_clock::now();
+  int64_t arrival = 0;
+};
+
+inline std::string ShapeStr(const std::vector<int64_t>& shape) {
+  std::ostringstream os;
+  os << "[";
+  for (size_t i = 0; i < shape.size(); ++i) {
+    if (i) os << ", ";
+    os << shape[i];
+  }
+  os << "]";
+  return os.str();
+}
+
+inline std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+class Negotiator {
+ public:
+  Negotiator(int size, int64_t fusion_threshold, double stall_warning_s,
+             bool stall_check_disable)
+      : size_(size),
+        fusion_threshold_(fusion_threshold),
+        stall_warning_s_(stall_warning_s),
+        stall_check_disable_(stall_check_disable),
+        last_stall_check_(std::chrono::steady_clock::now()) {}
+
+  void AddRequest(Request req, bool shutdown) {
+    std::lock_guard<std::mutex> guard(mutex_);
+    if (shutdown) shutdown_ = true;
+    TableEntry& entry = table_[req.name];
+    std::string name = req.name;
+    entry.requests[req.rank] = std::move(req);
+    if (static_cast<int>(entry.requests.size()) == size_) {
+      entry.arrival = ++arrivals_;
+      ready_.emplace_back(entry.arrival, name);
+    }
+  }
+
+  void SetShutdown() {
+    std::lock_guard<std::mutex> guard(mutex_);
+    shutdown_ = true;
+  }
+
+  // Autotuner hook: the coordinator retunes the fusion window between
+  // cycles (parameter_manager.cc Tune/SyncParams).
+  void SetFusionThreshold(int64_t bytes) {
+    std::lock_guard<std::mutex> guard(mutex_);
+    fusion_threshold_ = bytes;
+  }
+
+  // Drain ready tensors into the cycle's fused ResponseList (struct form,
+  // used directly by the native controller service). Outputs the stall
+  // warnings and whether the world has negotiated shutdown.
+  std::vector<Response> ConstructList(std::vector<std::string>* stalls,
+                                      bool* shutdown) {
+    std::lock_guard<std::mutex> guard(mutex_);
+    std::sort(ready_.begin(), ready_.end());
+    std::vector<Response> responses;
+    for (const auto& item : ready_) {
+      const std::string& name = item.second;
+      auto it = table_.find(name);
+      if (it == table_.end()) continue;
+      Response resp = ConstructResponse(name, it->second);
+      const Request& first = it->second.requests.begin()->second;
+      resp.dtype = first.dtype;
+      resp.payload_bytes = first.nbytes();
+      responses.push_back(std::move(resp));
+      table_.erase(it);
+    }
+    ready_.clear();
+    *stalls = MaybeCheckStalls();
+    *shutdown = shutdown_;
+    return Fuse(responses);
+  }
+
+  // Drain ready tensors into the cycle's ResponseList JSON (the ctypes
+  // negotiator shim's wire).
+  std::string Construct() {
+    std::vector<std::string> stalls;
+    bool shutdown = false;
+    std::vector<Response> fused = ConstructList(&stalls, &shutdown);
+    return ToJson(fused, stalls, shutdown);
+  }
+
+ private:
+  Response ConstructResponse(const std::string& name, const TableEntry& entry) {
+    std::vector<const Request*> reqs;
+    for (const auto& kv : entry.requests) reqs.push_back(&kv.second);
+    const Request& first = *reqs[0];
+
+    auto error = [&](const std::string& msg) {
+      Response r;
+      r.type = RespType::ERROR;
+      r.names = {name};
+      r.error = msg;
+      return r;
+    };
+
+    for (size_t i = 1; i < reqs.size(); ++i) {
+      const Request& req = *reqs[i];
+      if (req.op != first.op) {
+        std::ostringstream os;
+        os << "Mismatched collective operations: rank " << first.rank
+           << " requested " << kOpNames[static_cast<int>(first.op)]
+           << ", but rank " << req.rank << " requested "
+           << kOpNames[static_cast<int>(req.op)] << " for tensor " << name
+           << ".";
+        return error(os.str());
+      }
+      if (req.dtype != first.dtype) {
+        std::ostringstream os;
+        os << "Mismatched data types: rank " << first.rank << " sent "
+           << kDtypeNames[first.dtype] << ", but rank " << req.rank
+           << " sent " << kDtypeNames[req.dtype] << " for tensor " << name
+           << ".";
+        return error(os.str());
+      }
+    }
+
+    if (first.op == Op::ALLREDUCE) {
+      for (size_t i = 1; i < reqs.size(); ++i) {
+        if (reqs[i]->shape != first.shape) {
+          std::ostringstream os;
+          os << "Mismatched allreduce tensor shapes: rank " << first.rank
+             << " sent shape " << ShapeStr(first.shape) << ", but rank "
+             << reqs[i]->rank << " sent shape " << ShapeStr(reqs[i]->shape)
+             << " for tensor " << name << ".";
+          return error(os.str());
+        }
+      }
+      Response r;
+      r.type = RespType::ALLREDUCE;
+      r.names = {name};
+      return r;
+    }
+
+    if (first.op == Op::BROADCAST) {
+      for (size_t i = 1; i < reqs.size(); ++i) {
+        if (reqs[i]->root_rank != first.root_rank) {
+          std::ostringstream os;
+          os << "Mismatched broadcast root ranks: rank " << first.rank
+             << " specified root " << first.root_rank << ", but rank "
+             << reqs[i]->rank << " specified root " << reqs[i]->root_rank
+             << " for tensor " << name << ".";
+          return error(os.str());
+        }
+      }
+      if (first.root_rank < 0 || first.root_rank >= size_) {
+        std::ostringstream os;
+        os << "Invalid broadcast root rank " << first.root_rank
+           << " for a world of size " << size_ << " (tensor " << name << ").";
+        return error(os.str());
+      }
+      auto root_it = entry.requests.find(first.root_rank);
+      const std::vector<int64_t>& root_shape =
+          root_it != entry.requests.end() ? root_it->second.shape : first.shape;
+      for (const Request* req : reqs) {
+        if (req->shape != root_shape) {
+          std::ostringstream os;
+          os << "Mismatched broadcast tensor shapes: root sent shape "
+             << ShapeStr(root_shape) << ", but rank " << req->rank
+             << " has shape " << ShapeStr(req->shape) << " for tensor "
+             << name << ".";
+          return error(os.str());
+        }
+      }
+      Response r;
+      r.type = RespType::BROADCAST;
+      r.names = {name};
+      r.sizes = {first.root_rank};
+      return r;
+    }
+
+    // ALLGATHER: ragged first dim allowed, trailing dims must match
+    // (operations.cc:382-430); sizes = rank-ordered recvcounts.
+    for (size_t i = 1; i < reqs.size(); ++i) {
+      const Request& req = *reqs[i];
+      bool trailing_match =
+          req.shape.size() == first.shape.size() &&
+          std::equal(req.shape.begin() + 1, req.shape.end(),
+                     first.shape.begin() + 1);
+      if (!trailing_match) {
+        std::ostringstream os;
+        os << "Mismatched allgather tensor shapes: every dimension except "
+              "the first must match; rank "
+           << first.rank << " sent " << ShapeStr(first.shape) << ", rank "
+           << req.rank << " sent " << ShapeStr(req.shape) << " for tensor "
+           << name << ".";
+        return error(os.str());
+      }
+    }
+    if (first.shape.empty()) {
+      std::ostringstream os;
+      os << "Rank zero tried to allgather a rank-zero tensor (" << name
+         << "); allgather requires at least one dimension.";
+      return error(os.str());
+    }
+    Response r;
+    r.type = RespType::ALLGATHER;
+    r.names = {name};
+    for (const Request* req : reqs) r.sizes.push_back(req->shape[0]);
+    return r;
+  }
+
+  std::vector<Response> Fuse(const std::vector<Response>& responses) {
+    std::vector<Response> fused;
+    size_t i = 0;
+    while (i < responses.size()) {
+      const Response& resp = responses[i];
+      if (resp.type != RespType::ALLREDUCE) {
+        fused.push_back(resp);
+        ++i;
+        continue;
+      }
+      Response batch = resp;
+      int64_t total = resp.payload_bytes;
+      size_t j = i + 1;
+      while (j < responses.size()) {
+        const Response& nxt = responses[j];
+        if (nxt.type != RespType::ALLREDUCE || nxt.dtype != batch.dtype) break;
+        if (total + nxt.payload_bytes > fusion_threshold_) break;
+        batch.names.insert(batch.names.end(), nxt.names.begin(),
+                           nxt.names.end());
+        total += nxt.payload_bytes;
+        ++j;
+      }
+      batch.payload_bytes = total;
+      fused.push_back(std::move(batch));
+      i = j;
+    }
+    return fused;
+  }
+
+  std::vector<std::string> MaybeCheckStalls() {
+    std::vector<std::string> warnings;
+    if (stall_check_disable_) return warnings;
+    auto now = std::chrono::steady_clock::now();
+    double since = std::chrono::duration<double>(now - last_stall_check_).count();
+    if (since < stall_warning_s_) return warnings;
+    last_stall_check_ = now;
+    for (const auto& kv : table_) {
+      double age =
+          std::chrono::duration<double>(now - kv.second.first_seen).count();
+      if (age <= stall_warning_s_) continue;
+      std::ostringstream missing, ready;
+      bool mfirst = true, rfirst = true;
+      std::set<int> have;
+      for (const auto& rkv : kv.second.requests) have.insert(rkv.first);
+      for (int r = 0; r < size_; ++r) {
+        if (have.count(r)) {
+          if (!rfirst) ready << ", ";
+          ready << r;
+          rfirst = false;
+        } else {
+          if (!mfirst) missing << ", ";
+          missing << r;
+          mfirst = false;
+        }
+      }
+      std::ostringstream os;
+      os << "One or more tensors were submitted to be reduced, gathered or "
+            "broadcasted by subset of ranks and are waiting for remainder of "
+            "ranks for more than "
+         << static_cast<int>(stall_warning_s_)
+         << " seconds. This may indicate that different ranks are trying to "
+            "submit different tensors or that only subset of ranks is "
+            "submitting tensors, which will cause deadlock. Stalled ops: "
+         << kv.first << " [missing ranks: " << missing.str()
+         << "] [ready ranks: " << ready.str() << "]";
+      warnings.push_back(os.str());
+    }
+    return warnings;
+  }
+
+  std::string ToJson(const std::vector<Response>& responses,
+                     const std::vector<std::string>& stalls,
+                     bool shutdown) {
+    std::ostringstream os;
+    os << "{\"shutdown\":" << (shutdown ? 1 : 0) << ",\"responses\":[";
+    for (size_t i = 0; i < responses.size(); ++i) {
+      const Response& r = responses[i];
+      if (i) os << ",";
+      os << "{\"type\":" << static_cast<int>(r.type) << ",\"names\":[";
+      for (size_t k = 0; k < r.names.size(); ++k) {
+        if (k) os << ",";
+        os << "\"" << JsonEscape(r.names[k]) << "\"";
+      }
+      os << "],\"error\":\"" << JsonEscape(r.error) << "\",\"sizes\":[";
+      for (size_t k = 0; k < r.sizes.size(); ++k) {
+        if (k) os << ",";
+        os << r.sizes[k];
+      }
+      os << "],\"dtype\":" << r.dtype
+         << ",\"bytes\":" << r.payload_bytes << "}";
+    }
+    os << "],\"stall_warnings\":[";
+    for (size_t i = 0; i < stalls.size(); ++i) {
+      if (i) os << ",";
+      os << "\"" << JsonEscape(stalls[i]) << "\"";
+    }
+    os << "]}";
+    return os.str();
+  }
+
+  const int size_;
+  int64_t fusion_threshold_;
+  const double stall_warning_s_;
+  const bool stall_check_disable_;
+  std::mutex mutex_;
+  std::unordered_map<std::string, TableEntry> table_;
+  std::vector<std::pair<int64_t, std::string>> ready_;
+  int64_t arrivals_ = 0;
+  bool shutdown_ = false;
+  std::chrono::steady_clock::time_point last_stall_check_;
+};
+
+}  // namespace htpu
+
+#endif  // HTPU_NEGOTIATOR_CORE_H_

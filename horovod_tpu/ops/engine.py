@@ -193,15 +193,30 @@ class Engine:
                     "multi-process world but HOROVOD_CONTROLLER_PORT is not "
                     "set; the launcher (horovodrun / horovod_tpu.runner) "
                     "must export the coordinator address to every rank.")
+            from .native_controller import (
+                NativeControllerClient,
+                NativeControllerService,
+                native_controller_enabled,
+            )
+
+            # Native (C++) vs Python controller: one decision from config +
+            # library availability, identical on every rank (the two speak
+            # different wires).
+            use_native = native_controller_enabled(cfg)
             if topo.world_rank == 0:
                 # Controller duty follows the launcher's advertised address
                 # (world rank 0), not the subset rank numbering.
-                negotiator = make_negotiator(self._size, cfg)
                 bind_host = os.environ.get(
                     "HOROVOD_CONTROLLER_BIND", "127.0.0.1")
-                self._service = ControllerService(
-                    self._size, negotiator, secret=secret, port=port,
-                    bind_host=bind_host, autotuner=self._autotuner)
+                if use_native:
+                    self._service = NativeControllerService(
+                        self._size, cfg, secret=secret, port=port,
+                        bind_host=bind_host)
+                else:
+                    negotiator = make_negotiator(self._size, cfg)
+                    self._service = ControllerService(
+                        self._size, negotiator, secret=secret, port=port,
+                        bind_host=bind_host, autotuner=self._autotuner)
                 port = self._service.port
             # The launcher may advertise several controller addresses
             # (comma-separated: every NIC of the controller host); the
@@ -211,9 +226,12 @@ class Engine:
                 raise RuntimeError(
                     f"HOROVOD_CONTROLLER_ADDR is set but empty ({addr!r}); "
                     f"the launcher must export the controller address.")
-            self._client = ControllerClient(
+            client_cls = (NativeControllerClient if use_native
+                          else ControllerClient)
+            self._client = client_cls(
                 {a: (a, port) for a in addr_list}, secret=secret,
-                timeout_s=None, rank=self._rank)
+                timeout_s=None, rank=self._rank,
+                **({"log_stalls": self._rank == 0} if use_native else {}))
 
         self._host_fallback_warned = set()
 
@@ -489,14 +507,25 @@ def start_subset_service(subset_size: int) -> None:
     launcher advertised this host's address, so the subset's control
     cycles and host-plane exchanges must rendezvous here. No engine, no
     client — pure service duty, torn down by ``hvd.shutdown``."""
+    from .native_controller import (
+        NativeControllerService,
+        native_controller_enabled,
+    )
+
     cfg = basics.config()
     port = int(os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "0"))
     bind_host = os.environ.get("HOROVOD_CONTROLLER_BIND", "127.0.0.1")
-    autotuner = Autotuner(cfg) if cfg.autotune else None
-    service = ControllerService(
-        subset_size, make_negotiator(subset_size, cfg),
-        secret=default_secret(), port=port, bind_host=bind_host,
-        autotuner=autotuner)
+    autotuner = None
+    if native_controller_enabled(cfg):  # same decision the members make
+        service = NativeControllerService(
+            subset_size, cfg, secret=default_secret(), port=port,
+            bind_host=bind_host)
+    else:
+        autotuner = Autotuner(cfg) if cfg.autotune else None
+        service = ControllerService(
+            subset_size, make_negotiator(subset_size, cfg),
+            secret=default_secret(), port=port, bind_host=bind_host,
+            autotuner=autotuner)
 
     def _teardown() -> None:
         # Grace period: the host's own shutdown (often atexit) must not

@@ -1,0 +1,329 @@
+"""Python half of the native (C++) controller service.
+
+The service itself lives in ``cc/controller_service.cc`` — the rank-0 hot
+path (sockets, HMAC framing, cycle rendezvous, negotiation, host-plane
+combine, failure detection) in C++, the reference's architectural choice
+for its coordinator (``operations.cc`` is C++ precisely because negotiation
+runs every ~5 ms at up to 512 ranks). This module provides:
+
+* the little-endian binary body codec (pickle is neither parseable nor safe
+  to execute from C++);
+* :class:`NativeControllerClient` — same interface as
+  ``controller.ControllerClient`` (hello at connect, cycle, payload, clean
+  or attributed close), speaking the binary wire over the same
+  HMAC + u64-length framing as ``runner.network.Wire``;
+* :class:`NativeControllerService` — ctypes wrapper owning the C++ server.
+
+The engine selects native vs Python per ``HOROVOD_NATIVE_CONTROLLER``
+(auto/1/0); the decision must be identical on every rank, so it derives
+only from config + library availability, never per-rank state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..core.logging import LOG
+from ..core.status import SHUT_DOWN_ERROR
+from ..runner.network import WireError, probe_addresses
+from .messages import (
+    DataType,
+    RequestList,
+    Response,
+    ResponseList,
+    ResponseType,
+)
+
+_LEN = struct.Struct(">Q")
+_DIGEST = hashlib.sha256().digest_size
+
+_HELLO, _BYE, _CYCLE, _PAYLOAD = 1, 2, 3, 4
+
+
+# -- body codec ---------------------------------------------------------------
+
+def encode_hello(rank: int) -> bytes:
+    return struct.pack("<Bi", _HELLO, rank)
+
+
+def encode_bye(rank: int) -> bytes:
+    return struct.pack("<Bi", _BYE, rank)
+
+
+def encode_cycle(rank: int, request_list: RequestList) -> bytes:
+    parts = [struct.pack("<BiBI", _CYCLE, rank,
+                         1 if request_list.shutdown else 0,
+                         len(request_list.requests))]
+    for req in request_list.requests:
+        name = req.tensor_name.encode("utf-8")
+        parts.append(struct.pack(
+            "<BBiB", int(req.request_type), int(req.tensor_type),
+            req.root_rank, len(req.tensor_shape)))
+        for dim in req.tensor_shape:
+            parts.append(struct.pack("<q", dim))
+        parts.append(struct.pack("<H", len(name)))
+        parts.append(name)
+    return b"".join(parts)
+
+
+def encode_payload(rank: int, cycle_no: int, idx: int, data: bytes) -> bytes:
+    return struct.pack("<BiQIQ", _PAYLOAD, rank, cycle_no, idx,
+                       len(data)) + data
+
+
+class _BodyReader:
+    def __init__(self, body: bytes) -> None:
+        self._body = body
+        self._off = 0
+
+    def unpack(self, fmt: str):
+        s = struct.Struct(fmt)
+        vals = s.unpack_from(self._body, self._off)
+        self._off += s.size
+        return vals if len(vals) > 1 else vals[0]
+
+    def take(self, n: int) -> bytes:
+        out = self._body[self._off:self._off + n]
+        if len(out) != n:
+            raise WireError("truncated native controller response")
+        self._off += n
+        return out
+
+
+def _decode_status(body: bytes) -> _BodyReader:
+    if body[:1] == b"\x80":
+        # A pickle protocol marker: the coordinator is running the PYTHON
+        # controller service while this rank speaks the native binary
+        # protocol — the HOROVOD_NATIVE_CONTROLLER decision diverged
+        # across ranks (native core built here but not on the coordinator?).
+        raise WireError(
+            "protocol mismatch: the coordinator runs the Python controller "
+            "service but this rank connected with the native client. "
+            "HOROVOD_NATIVE_CONTROLLER must resolve identically on every "
+            "rank; set HOROVOD_NATIVE_CONTROLLER=0 everywhere to force the "
+            "Python service.")
+    r = _BodyReader(body)
+    status = r.unpack("<B")
+    if status != 0:
+        msg_len = r.unpack("<I")
+        msg = r.take(msg_len).decode("utf-8", "replace")
+        # parity with the Python service's RemoteError path
+        raise WireError(f"service-side failure: {msg}")
+    return r
+
+
+def decode_cycle_response(body: bytes,
+                          log_stalls: bool) -> ResponseList:
+    r = _decode_status(body)
+    shutdown = bool(r.unpack("<B"))
+    nresp = r.unpack("<I")
+    responses = []
+    for _ in range(nresp):
+        rtype, dtype, payload_bytes = r.unpack("<BBQ")
+        nnames = r.unpack("<H")
+        names = [r.take(r.unpack("<H")).decode("utf-8")
+                 for _ in range(nnames)]
+        err = r.take(r.unpack("<I")).decode("utf-8")
+        nsizes = r.unpack("<I")
+        sizes = [r.unpack("<q") for _ in range(nsizes)]
+        responses.append(Response(
+            response_type=ResponseType(rtype), tensor_names=names,
+            error_message=err, tensor_sizes=sizes,
+            tensor_dtype=DataType(dtype), payload_bytes=payload_bytes))
+    nstalls = r.unpack("<I")
+    for _ in range(nstalls):
+        warning = r.take(r.unpack("<I")).decode("utf-8", "replace")
+        if log_stalls:
+            LOG.warning("%s", warning)
+    return ResponseList(responses=responses, shutdown=shutdown)
+
+
+def decode_payload_response(body: bytes) -> bytes:
+    r = _decode_status(body)
+    data_len = r.unpack("<Q")
+    return r.take(data_len)
+
+
+# -- client -------------------------------------------------------------------
+
+class NativeControllerClient:
+    """Drop-in for ``ControllerClient`` against the C++ service."""
+
+    def __init__(self, addr, secret: Optional[bytes] = None,
+                 timeout_s: Optional[float] = None,
+                 connect_attempts: int = 100,
+                 rank: Optional[int] = None,
+                 log_stalls: bool = False) -> None:
+        from ..runner.network import default_secret
+
+        self._secret = secret if secret is not None else default_secret()
+        self._lock = threading.Lock()
+        self._rank = rank
+        self._log_stalls = log_stalls
+        self._cycle_no = 0
+        self._last_cycle = 0
+        candidates: Dict[str, Tuple[str, int]] = (
+            dict(addr) if isinstance(addr, dict) else {"addr": tuple(addr)})
+        last_err: Optional[Exception] = None
+        self._sock: Optional[socket.socket] = None
+        for _ in range(connect_attempts):
+            if len(candidates) > 1:
+                reachable = probe_addresses(
+                    candidates, timeout_s=min(timeout_s or 2.0, 2.0))
+            else:
+                reachable = candidates
+            for target in reachable.values():
+                try:
+                    self._sock = socket.create_connection(
+                        target, timeout=timeout_s)
+                    self._sock.settimeout(timeout_s)
+                    self._sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    break
+                except OSError as exc:
+                    last_err = exc
+            if self._sock is not None:
+                break
+            import time
+
+            time.sleep(0.3)
+        if self._sock is None:
+            raise WireError(
+                f"unable to connect to native controller at any of "
+                f"{sorted(candidates.values())}: {last_err}")
+        if rank is not None:
+            _decode_status(self._request(encode_hello(rank)))
+
+    def _request(self, body: bytes) -> bytes:
+        digest = hmac.new(self._secret, body, hashlib.sha256).digest()
+        with self._lock:
+            self._sock.sendall(digest + _LEN.pack(len(body)) + body)
+            header = self._read_exact(_DIGEST + _LEN.size)
+            (length,) = _LEN.unpack(header[_DIGEST:])
+            resp = self._read_exact(length)
+        expected = hmac.new(self._secret, resp, hashlib.sha256).digest()
+        if not hmac.compare_digest(header[:_DIGEST], expected):
+            raise WireError("message HMAC mismatch (wrong or missing secret)")
+        return resp
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise WireError("connection closed mid-message")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def cycle(self, rank: int, request_list: RequestList) -> ResponseList:
+        if self._rank is None:
+            self._rank = rank
+        out = decode_cycle_response(
+            self._request(encode_cycle(rank, request_list)),
+            log_stalls=self._log_stalls)
+        self._last_cycle = self._cycle_no
+        self._cycle_no += 1
+        return out
+
+    def payload(self, rank: int, response_idx: int, data: bytes) -> bytes:
+        return decode_payload_response(self._request(
+            encode_payload(rank, self._last_cycle, response_idx, data)))
+
+    def close(self, detach: bool = True) -> None:
+        if detach and self._rank is not None:
+            try:
+                self._request(encode_bye(self._rank))
+            except Exception:  # noqa: BLE001 - controller may be gone
+                pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# -- service ------------------------------------------------------------------
+
+class NativeControllerService:
+    """Owns the C++ controller server (ctypes)."""
+
+    def __init__(self, size: int, cfg, secret: Optional[bytes] = None,
+                 port: int = 0, bind_host: str = "127.0.0.1") -> None:
+        import ctypes
+
+        from .. import cc
+        from ..runner.network import default_secret
+
+        lib = cc._load()
+        if lib is None:
+            raise RuntimeError(
+                f"native controller unavailable: {cc.load_error()}")
+        secret = secret if secret is not None else default_secret()
+        err = ctypes.create_string_buffer(256)
+        self._lib = lib
+        self._handle = lib.htpu_controller_start(
+            size, bind_host.encode(), port, secret, len(secret),
+            cfg.fusion_threshold_bytes, cfg.stall_warning_time_s,
+            1 if cfg.stall_check_disable else 0,
+            SHUT_DOWN_ERROR.encode("utf-8"), err, len(err))
+        if not self._handle:
+            raise RuntimeError(
+                f"native controller failed to start: {err.value.decode()}")
+        self.port = lib.htpu_controller_port(self._handle)
+
+    def wait_world_shutdown(self, timeout_s: float) -> bool:
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._lib.htpu_controller_world_shutdown(self._handle):
+                return True
+            time.sleep(0.05)
+        return bool(self._lib.htpu_controller_world_shutdown(self._handle))
+
+    def shutdown(self) -> None:
+        handle, self._handle = self._handle, None
+        if handle:
+            self._lib.htpu_controller_stop(handle)
+
+    def __del__(self) -> None:
+        try:
+            self.shutdown()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+
+def native_controller_enabled(cfg) -> bool:
+    """One decision per rank from config + local library availability.
+
+    Auto mode uses the native service except when autotune is on (the
+    GP/EI tuner feeds off the Python service's cycle observations). The
+    decision MUST resolve identically on every rank — library availability
+    is per-host, so a heterogeneous deployment (native core builds on some
+    hosts only) must pin HOROVOD_NATIVE_CONTROLLER=0/1 explicitly. A
+    divergence fails loudly at the first request with a protocol-mismatch
+    diagnostic on both sides, never a silent hang.
+    """
+    import os
+
+    from .. import cc
+
+    knob = os.environ.get("HOROVOD_NATIVE_CONTROLLER", "auto").lower()
+    if knob in ("0", "false", "off"):
+        return False
+    if cfg.autotune:
+        if knob in ("1", "true", "on"):
+            LOG.warning("HOROVOD_NATIVE_CONTROLLER=1 ignored: autotune "
+                        "requires the Python controller service.")
+        return False
+    if not cc.available():
+        if knob in ("1", "true", "on"):
+            raise RuntimeError(
+                f"HOROVOD_NATIVE_CONTROLLER=1 but the native core did not "
+                f"load: {cc.load_error()}")
+        return False
+    return True

@@ -91,7 +91,21 @@ class Wire:
         expected = hmac.new(self._secret, body, hashlib.sha256).digest()
         if not hmac.compare_digest(digest, expected):
             raise WireError("message HMAC mismatch (wrong or missing secret)")
-        return pickle.loads(body)
+        try:
+            return pickle.loads(body)
+        except Exception as exc:  # noqa: BLE001 - diagnose, then fail
+            import logging
+
+            # An authenticated but unpicklable body is almost always the
+            # native binary-protocol controller client talking to a Python
+            # service: the HOROVOD_NATIVE_CONTROLLER decision diverged
+            # across ranks. Say so — the peer only sees a closed connection.
+            logging.getLogger("horovod_tpu").warning(
+                "authenticated message with unpicklable body (%s); if the "
+                "peer runs the native controller client, "
+                "HOROVOD_NATIVE_CONTROLLER diverged across ranks — set it "
+                "to 0 or 1 explicitly on every rank.", exc)
+            raise WireError(f"unpicklable message body: {exc}") from exc
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:

@@ -71,6 +71,16 @@ def probe_cxx_flags(cxx: str) -> list:
         f"compiler or HOROVOD_TPU_WITHOUT_NATIVE=1 to skip the native core.")
 
 
+def _native_sources():
+    """The Makefile's SRCS line is the single source of truth — a second
+    hardcoded list here once shipped a library missing a translation unit."""
+    with open(os.path.join(_CC_DIR, "Makefile"), encoding="utf-8") as fh:
+        for line in fh:
+            if line.startswith("SRCS"):
+                return line.split(":=", 1)[1].split()
+    raise RuntimeError("cc/Makefile has no SRCS line")
+
+
 def build_native_core(out_dir: str) -> str:
     """Compile the native controller core into ``out_dir`` and return the
     library path."""
@@ -78,8 +88,7 @@ def build_native_core(out_dir: str) -> str:
     flags = probe_cxx_flags(cxx)
     os.makedirs(out_dir, exist_ok=True)
     lib = os.path.join(out_dir, "libhtpu_core.so")
-    sources = [os.path.join(_CC_DIR, s)
-               for s in ("negotiator.cc", "autotune.cc", "timeline_writer.cc")]
+    sources = [os.path.join(_CC_DIR, s) for s in _native_sources()]
     cmd = [cxx, *flags, "-Wall", "-Wextra", "-shared", "-o", lib, *sources]
     result = subprocess.run(cmd, capture_output=True, text=True)
     if result.returncode != 0:

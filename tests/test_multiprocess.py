@@ -72,25 +72,41 @@ def _run_world(scenario: str, size: int, timeout: float = 90.0,
     return results
 
 
+# The eager control plane has two interchangeable implementations — the
+# Python ControllerService and the native C++ controller_service.cc — with
+# one behavior contract; the core scenario battery runs against both.
+CONTROLLERS = pytest.mark.parametrize("controller", ["native", "python"])
+
+
+def _ctrl_env(controller):
+    return {"HOROVOD_NATIVE_CONTROLLER":
+            "1" if controller == "native" else "0"}
+
+
+@CONTROLLERS
 @pytest.mark.parametrize("size", [2, 4])
-def test_mp_allreduce(size):
-    _run_world("allreduce", size)
+def test_mp_allreduce(size, controller):
+    _run_world("allreduce", size, extra_env=_ctrl_env(controller))
 
 
-def test_mp_fused():
-    _run_world("fused", 2)
+@CONTROLLERS
+def test_mp_fused(controller):
+    _run_world("fused", 2, extra_env=_ctrl_env(controller))
 
 
-def test_mp_allgather_ragged():
-    _run_world("allgather", 3)
+@CONTROLLERS
+def test_mp_allgather_ragged(controller):
+    _run_world("allgather", 3, extra_env=_ctrl_env(controller))
 
 
-def test_mp_broadcast():
-    _run_world("broadcast", 2)
+@CONTROLLERS
+def test_mp_broadcast(controller):
+    _run_world("broadcast", 2, extra_env=_ctrl_env(controller))
 
 
-def test_mp_mismatch_errors_on_all_ranks():
-    _run_world("mismatch", 2)
+@CONTROLLERS
+def test_mp_mismatch_errors_on_all_ranks(controller):
+    _run_world("mismatch", 2, extra_env=_ctrl_env(controller))
 
 
 def test_mp_broadcast_object():
@@ -141,38 +157,45 @@ def test_mp_autotune_end_to_end(tmp_path):
         assert us < 60e6, f"implausible active window in sample: {line}"
 
 
-def test_mp_peer_death_unblocks_survivors():
+@CONTROLLERS
+def test_mp_peer_death_unblocks_survivors(controller):
     """Kill a rank mid-cycle with fused tensors in flight: every survivor
     must fail its outstanding handles with SHUT_DOWN_ERROR promptly
     (reference ``operations.cc:1942-1957``), not hang until the test
     timeout. The victim exits 3 via os._exit — no shutdown handshake."""
-    _run_world("peer_death", 3, expected_codes={2: 3})
+    _run_world("peer_death", 3, expected_codes={2: 3},
+               extra_env=_ctrl_env(controller))
 
 
+@CONTROLLERS
 @pytest.mark.parametrize("scenario", ["subset_02", "subset_12"])
-def test_mp_subset_world(scenario):
+def test_mp_subset_world(scenario, controller):
     """hvd.init(ranks=[...]) on a 3-process world: members communicate in
     list order, non-members get self-worlds, and the controller stays on
     launcher world-rank 0 even when it is not a member (subset_12)."""
-    _run_world(scenario, 3, timeout=120.0)
+    _run_world(scenario, 3, timeout=120.0, extra_env=_ctrl_env(controller))
 
 
-def test_mp_local_engine_crash_unblocks_survivors():
+@CONTROLLERS
+def test_mp_local_engine_crash_unblocks_survivors(controller):
     """A local fault that kills only a rank's background engine (process
     still alive, TCP link healthy until the crash-path close) must abort
     the peers like a process death — the crash-path close sends no clean
     detach, so the controller attributes the drop to the rank."""
-    _run_world("local_crash", 3, timeout=120.0)
+    _run_world("local_crash", 3, timeout=120.0,
+               extra_env=_ctrl_env(controller))
 
 
-def test_mp_stall_warning():
+@CONTROLLERS
+def test_mp_stall_warning(controller):
     """A rank submitting late must trigger the coordinator's stall warning
     naming the missing rank (``CheckForStalledTensors``), and the collective
     must still complete once the laggard arrives."""
     results = _run_world(
         "stall", 2, timeout=120.0,
         extra_env={"HOROVOD_STALL_WARNING_TIME": "1",
-                   "HOROVOD_LOG_LEVEL": "warning"})
+                   "HOROVOD_LOG_LEVEL": "warning",
+                   **_ctrl_env(controller)})
     rank0_err = results[0][3]
     assert "Stalled ops: stalled_tensor" in rank0_err
     assert "missing ranks: 1" in rank0_err

@@ -290,8 +290,15 @@ class ControllerServer {
     if (!ReadExact(fd, header, sizeof(header))) return false;
     uint64_t len = 0;
     for (int i = 0; i < 8; ++i) len = (len << 8) | header[32 + i];
-    if (len > (1ull << 33)) return false;  // 8 GiB sanity bound
-    body->resize(len);
+    // The length field arrives before the body it is HMAC'd with, so it is
+    // attacker-controlled on a non-loopback bind: bound it well below
+    // anything that could throw bad_alloc (fused buffers are ~64 MB).
+    if (len > (1ull << 31)) return false;
+    try {
+      body->resize(len);
+    } catch (const std::bad_alloc&) {
+      return false;  // drop the connection, never the coordinator
+    }
     if (len && !ReadExact(fd, reinterpret_cast<uint8_t*>(&(*body)[0]), len))
       return false;
     uint8_t digest[32];
@@ -469,8 +476,14 @@ class ControllerServer {
     for (uint32_t i = 0; i < nreq && r->ok; ++i) {
       Request req;
       req.rank = rank;
-      req.op = static_cast<Op>(r->Get<uint8_t>());
-      req.dtype = r->Get<uint8_t>();
+      uint8_t op = r->Get<uint8_t>();
+      uint8_t dtype = r->Get<uint8_t>();
+      // Range-check wire enums before they index kDtypeBytes/kOpNames —
+      // the Python twin gets this for free from DataType()/RequestType().
+      if (op > 2 || dtype > 10)
+        return ErrorResp("malformed cycle request (bad op or dtype)");
+      req.op = static_cast<Op>(op);
+      req.dtype = dtype;
       req.root_rank = r->Get<int32_t>();
       uint8_t ndim = r->Get<uint8_t>();
       for (uint8_t d = 0; d < ndim; ++d)

@@ -21,16 +21,12 @@ only from config + library availability, never per-rank state.
 
 from __future__ import annotations
 
-import hashlib
-import hmac
-import socket
 import struct
-import threading
-from typing import Dict, Optional, Tuple
+from typing import Optional
 
 from ..core.logging import LOG
 from ..core.status import SHUT_DOWN_ERROR
-from ..runner.network import WireError, probe_addresses
+from ..runner.network import WireError
 from .messages import (
     DataType,
     RequestList,
@@ -38,9 +34,6 @@ from .messages import (
     ResponseList,
     ResponseType,
 )
-
-_LEN = struct.Struct(">Q")
-_DIGEST = hashlib.sha256().digest_size
 
 _HELLO, _BYE, _CYCLE, _PAYLOAD = 1, 2, 3, 4
 
@@ -152,98 +145,50 @@ def decode_payload_response(body: bytes) -> bytes:
 # -- client -------------------------------------------------------------------
 
 class NativeControllerClient:
-    """Drop-in for ``ControllerClient`` against the C++ service."""
+    """Drop-in for ``ControllerClient`` against the C++ service.
+
+    Connection management and framing come from ``BasicClient`` (candidate
+    probing, retries, TCP_NODELAY, HMAC + u64-length frames via
+    ``request_raw``); only the body codec differs from the pickle wire."""
 
     def __init__(self, addr, secret: Optional[bytes] = None,
                  timeout_s: Optional[float] = None,
                  connect_attempts: int = 100,
                  rank: Optional[int] = None,
                  log_stalls: bool = False) -> None:
-        from ..runner.network import default_secret
+        from ..runner.network import BasicClient
 
-        self._secret = secret if secret is not None else default_secret()
-        self._lock = threading.Lock()
+        self._client = BasicClient(addr, secret=secret,
+                                   attempts=connect_attempts,
+                                   timeout_s=timeout_s)
         self._rank = rank
         self._log_stalls = log_stalls
         self._cycle_no = 0
         self._last_cycle = 0
-        candidates: Dict[str, Tuple[str, int]] = (
-            dict(addr) if isinstance(addr, dict) else {"addr": tuple(addr)})
-        last_err: Optional[Exception] = None
-        self._sock: Optional[socket.socket] = None
-        for _ in range(connect_attempts):
-            if len(candidates) > 1:
-                reachable = probe_addresses(
-                    candidates, timeout_s=min(timeout_s or 2.0, 2.0))
-            else:
-                reachable = candidates
-            for target in reachable.values():
-                try:
-                    self._sock = socket.create_connection(
-                        target, timeout=timeout_s)
-                    self._sock.settimeout(timeout_s)
-                    self._sock.setsockopt(
-                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    break
-                except OSError as exc:
-                    last_err = exc
-            if self._sock is not None:
-                break
-            import time
-
-            time.sleep(0.3)
-        if self._sock is None:
-            raise WireError(
-                f"unable to connect to native controller at any of "
-                f"{sorted(candidates.values())}: {last_err}")
         if rank is not None:
-            _decode_status(self._request(encode_hello(rank)))
-
-    def _request(self, body: bytes) -> bytes:
-        digest = hmac.new(self._secret, body, hashlib.sha256).digest()
-        with self._lock:
-            self._sock.sendall(digest + _LEN.pack(len(body)) + body)
-            header = self._read_exact(_DIGEST + _LEN.size)
-            (length,) = _LEN.unpack(header[_DIGEST:])
-            resp = self._read_exact(length)
-        expected = hmac.new(self._secret, resp, hashlib.sha256).digest()
-        if not hmac.compare_digest(header[:_DIGEST], expected):
-            raise WireError("message HMAC mismatch (wrong or missing secret)")
-        return resp
-
-    def _read_exact(self, n: int) -> bytes:
-        buf = bytearray()
-        while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
-            if not chunk:
-                raise WireError("connection closed mid-message")
-            buf.extend(chunk)
-        return bytes(buf)
+            _decode_status(self._client.request_raw(encode_hello(rank)))
 
     def cycle(self, rank: int, request_list: RequestList) -> ResponseList:
         if self._rank is None:
             self._rank = rank
         out = decode_cycle_response(
-            self._request(encode_cycle(rank, request_list)),
+            self._client.request_raw(encode_cycle(rank, request_list)),
             log_stalls=self._log_stalls)
         self._last_cycle = self._cycle_no
         self._cycle_no += 1
         return out
 
     def payload(self, rank: int, response_idx: int, data: bytes) -> bytes:
-        return decode_payload_response(self._request(
+        return decode_payload_response(self._client.request_raw(
             encode_payload(rank, self._last_cycle, response_idx, data)))
 
     def close(self, detach: bool = True) -> None:
         if detach and self._rank is not None:
             try:
-                self._request(encode_bye(self._rank))
+                self._client.request_raw(encode_bye(self._rank))
             except Exception:  # noqa: BLE001 - controller may be gone
                 pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._client.close()
 
 
 # -- service ------------------------------------------------------------------

@@ -74,9 +74,26 @@ class Wire:
         self._secret = secret if secret is not None else default_secret()
 
     def frame(self, obj: Any) -> bytes:
-        body = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        return self.frame_raw(
+            pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+
+    def frame_raw(self, body: bytes) -> bytes:
+        """Frame pre-encoded bytes (the native controller's binary bodies
+        ride the identical HMAC + u64-length framing, minus pickle)."""
         digest = hmac.new(self._secret, body, hashlib.sha256).digest()
         return digest + _LEN.pack(len(body)) + body
+
+    def read_raw(self, sock: socket.socket) -> bytes:
+        """Read one authenticated frame, returning the body bytes verbatim
+        (no unpickling)."""
+        header = _read_exact(sock, _DIGEST_BYTES + _LEN.size)
+        digest = header[:_DIGEST_BYTES]
+        (length,) = _LEN.unpack(header[_DIGEST_BYTES:])
+        body = _read_exact(sock, length)
+        expected = hmac.new(self._secret, body, hashlib.sha256).digest()
+        if not hmac.compare_digest(digest, expected):
+            raise WireError("message HMAC mismatch (wrong or missing secret)")
+        return body
 
     def write(self, obj: Any, sock: socket.socket) -> None:
         if isinstance(obj, Preserialized):
@@ -375,6 +392,13 @@ class BasicClient:
         if isinstance(resp, RemoteError):
             raise WireError(f"service-side failure: {resp.message}")
         return resp
+
+    def request_raw(self, body: bytes) -> bytes:
+        """One round-trip of pre-encoded bytes over the same framing (the
+        native controller client's path)."""
+        with self._lock:
+            self._sock.sendall(self._wire.frame_raw(body))
+            return self._wire.read_raw(self._sock)
 
     def send(self, obj: Any) -> None:
         with self._lock:

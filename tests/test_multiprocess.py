@@ -74,8 +74,15 @@ def _run_world(scenario: str, size: int, timeout: float = 90.0,
 
 # The eager control plane has two interchangeable implementations — the
 # Python ControllerService and the native C++ controller_service.cc — with
-# one behavior contract; the core scenario battery runs against both.
-CONTROLLERS = pytest.mark.parametrize("controller", ["native", "python"])
+# one behavior contract; the core scenario battery runs against both
+# (native skips where the core cannot build, like test_native_controller).
+from horovod_tpu import cc as _cc  # noqa: E402
+
+CONTROLLERS = pytest.mark.parametrize("controller", [
+    pytest.param("native", marks=pytest.mark.skipif(
+        not _cc.available(), reason=f"native core: {_cc.load_error()}")),
+    "python",
+])
 
 
 def _ctrl_env(controller):

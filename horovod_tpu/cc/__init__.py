@@ -114,11 +114,16 @@ def _declare(lib) -> None:
     lib.htpu_controller_start.restype = c.c_void_p
     lib.htpu_controller_start.argtypes = [
         c.c_int, c.c_char_p, c.c_int, c.c_char_p, c.c_int, c.c_longlong,
-        c.c_double, c.c_int, c.c_char_p, c.c_char_p, c.c_int]
+        c.c_double, c.c_int, c.c_char_p, c.c_int, c.c_char_p, c.c_int]
     lib.htpu_controller_port.restype = c.c_int
     lib.htpu_controller_port.argtypes = [c.c_void_p]
     lib.htpu_controller_world_shutdown.restype = c.c_int
     lib.htpu_controller_world_shutdown.argtypes = [c.c_void_p]
+    lib.htpu_controller_drain_stats.restype = c.c_int
+    lib.htpu_controller_drain_stats.argtypes = [
+        c.c_void_p, c.POINTER(c.c_double), c.POINTER(c.c_double), c.c_int]
+    lib.htpu_controller_set_tuning.argtypes = [c.c_void_p, c.c_longlong,
+                                               c.c_double]
     lib.htpu_controller_stop.argtypes = [c.c_void_p]
 
 

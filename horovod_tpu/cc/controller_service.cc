@@ -9,8 +9,9 @@
 // Behavior contract: identical to the Python ControllerService
 // (horovod_tpu/ops/controller.py) — same negotiated responses, same error
 // strings, same rank-death abort semantics — so the multi-process test
-// battery runs against both via HOROVOD_NATIVE_CONTROLLER. Not supported
-// here (the engine falls back to the Python service): autotune.
+// battery runs against both via HOROVOD_NATIVE_CONTROLLER. Autotune works
+// on both: this service streams per-cycle (bytes, active-µs) observations
+// to the Python GP tuner, which pushes retuned knobs back.
 //
 // Wire: HMAC-SHA256 digest + u64 big-endian length + body (the exact
 // framing of runner/network.py Wire), with a little-endian binary body
@@ -199,6 +200,10 @@ struct CycleSlot {
   std::map<int, std::pair<std::vector<Request>, bool>> lists;  // rank ->
   bool done = false;
   std::string framed;  // one frame serves every rank
+  // active-window start: first rank's arrival (straggler wait + negotiate
+  // count toward the autotune score; inter-cycle client idle does not)
+  std::chrono::steady_clock::time_point t0 =
+      std::chrono::steady_clock::now();
 };
 
 struct PayloadSlot {
@@ -211,10 +216,11 @@ class ControllerServer {
  public:
   ControllerServer(int size, std::string secret, int64_t fusion_threshold,
                    double stall_warning_s, bool stall_check_disable,
-                   std::string shutdown_error)
+                   std::string shutdown_error, bool collect_stats)
       : size_(size),
         secret_(std::move(secret)),
         shutdown_error_(std::move(shutdown_error)),
+        collect_stats_(collect_stats),
         negotiator_(size, fusion_threshold, stall_warning_s,
                     stall_check_disable) {}
 
@@ -250,6 +256,23 @@ class ControllerServer {
   bool world_shutdown() {
     std::lock_guard<std::mutex> guard(mutex_);
     return world_shutdown_ || !abort_reason_.empty();
+  }
+
+  int DrainStats(double* bytes_out, double* us_out, int cap) {
+    std::lock_guard<std::mutex> guard(mutex_);
+    int n = 0;
+    for (; n < cap && n < static_cast<int>(stats_.size()); ++n) {
+      bytes_out[n] = stats_[static_cast<size_t>(n)].first;
+      us_out[n] = stats_[static_cast<size_t>(n)].second;
+    }
+    stats_.erase(stats_.begin(), stats_.begin() + n);
+    return n;
+  }
+
+  void SetTuning(int64_t fusion_bytes, double cycle_ms) {
+    negotiator_.SetFusionThreshold(fusion_bytes);
+    std::lock_guard<std::mutex> guard(mutex_);
+    tuned_cycle_ms_ = cycle_ms;
   }
 
   void Stop() {
@@ -517,6 +540,18 @@ class ControllerServer {
       history_[cycle_no_] = responses;
       history_.erase(cycle_no_ - 16);
       ++cycle_no_;
+      // Autotune observation: (payload bytes, active µs) per cycle,
+      // drained by the Python tuner thread (parameter_manager.cc scoring).
+      int64_t bytes = 0;
+      if (collect_stats_)
+        for (const Response& resp : responses)
+          if (resp.type != RespType::ERROR) bytes += resp.payload_bytes;
+      if (bytes > 0 && stats_.size() < 4096) {
+        double us = std::chrono::duration<double, std::micro>(
+                        std::chrono::steady_clock::now() - slot.t0)
+                        .count();
+        stats_.emplace_back(static_cast<double>(bytes), us);
+      }
       slot.framed = FrameBody(EncodeCycleResponse(
           responses, stalls, world_shutdown));
       slot.done = true;
@@ -543,6 +578,10 @@ class ControllerServer {
     Writer w;
     w.Put<uint8_t>(0);
     w.Put<uint8_t>(shutdown ? 1 : 0);
+    // Tuned cycle time piggybacks to every rank, the role of the
+    // reference's Params broadcast (parameter_manager.cc:213 SyncParams).
+    w.Put<uint8_t>(tuned_cycle_ms_ > 0 ? 1 : 0);
+    w.Put<double>(tuned_cycle_ms_);
     w.Put<uint32_t>(static_cast<uint32_t>(responses.size()));
     for (const Response& resp : responses) {
       w.Put<uint8_t>(static_cast<uint8_t>(resp.type));
@@ -670,6 +709,7 @@ class ControllerServer {
   const int size_;
   const std::string secret_;
   const std::string shutdown_error_;
+  const bool collect_stats_;
   Negotiator negotiator_;
 
   int listen_fd_ = -1;
@@ -689,6 +729,8 @@ class ControllerServer {
   std::map<int64_t, CycleSlot> cycles_;
   std::map<int64_t, int> delivered_;
   int64_t cycle_no_ = 0;
+  double tuned_cycle_ms_ = 0;  // 0 = untuned; guarded by mutex_
+  std::vector<std::pair<double, double>> stats_;  // (bytes, active_us)
   std::map<int64_t, std::vector<Response>> history_;
   std::map<std::pair<int64_t, int64_t>, PayloadSlot> payloads_;
   std::map<std::pair<int64_t, int64_t>, int> payload_delivered_;
@@ -703,13 +745,13 @@ void* htpu_controller_start(int size, const char* bind_host, int port,
                             const uint8_t* secret, int secret_len,
                             long long fusion_threshold,
                             double stall_warning_s, int stall_check_disable,
-                            const char* shutdown_error, char* err_out,
-                            int err_cap) {
+                            const char* shutdown_error, int collect_stats,
+                            char* err_out, int err_cap) {
   auto* server = new htpu::ControllerServer(
       size, std::string(reinterpret_cast<const char*>(secret),
                         static_cast<size_t>(secret_len)),
       fusion_threshold, stall_warning_s, stall_check_disable != 0,
-      shutdown_error);
+      shutdown_error, collect_stats != 0);
   std::string err;
   if (!server->Start(bind_host, port, &err)) {
     std::snprintf(err_out, static_cast<size_t>(err_cap), "%s", err.c_str());
@@ -726,6 +768,18 @@ int htpu_controller_port(void* handle) {
 int htpu_controller_world_shutdown(void* handle) {
   return static_cast<htpu::ControllerServer*>(handle)->world_shutdown() ? 1
                                                                         : 0;
+}
+
+int htpu_controller_drain_stats(void* handle, double* bytes_out,
+                                double* us_out, int cap) {
+  return static_cast<htpu::ControllerServer*>(handle)->DrainStats(
+      bytes_out, us_out, cap);
+}
+
+void htpu_controller_set_tuning(void* handle, long long fusion_bytes,
+                                double cycle_ms) {
+  static_cast<htpu::ControllerServer*>(handle)->SetTuning(fusion_bytes,
+                                                          cycle_ms);
 }
 
 void htpu_controller_stop(void* handle) {

@@ -72,6 +72,13 @@ class Autotuner:
         bytes_processed = sum(
             r.payload_bytes for r in response_list.responses
             if r.response_type != ResponseType.ERROR)
+        return self.observe(bytes_processed, microseconds)
+
+    def observe(self, bytes_processed: float,
+                microseconds: float) -> Optional[Tuple[int, float]]:
+        """Score one (bytes, active µs) sample — the raw form the native
+        controller service drains from C++ (no ResponseList exists on the
+        Python side there)."""
         if bytes_processed <= 0 or microseconds <= 0:
             return None
         if self._log is not None:

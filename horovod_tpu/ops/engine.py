@@ -211,7 +211,7 @@ class Engine:
                 if use_native:
                     self._service = NativeControllerService(
                         self._size, cfg, secret=secret, port=port,
-                        bind_host=bind_host)
+                        bind_host=bind_host, autotuner=self._autotuner)
                 else:
                     negotiator = make_negotiator(self._size, cfg)
                     self._service = ControllerService(
@@ -515,13 +515,12 @@ def start_subset_service(subset_size: int) -> None:
     cfg = basics.config()
     port = int(os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "0"))
     bind_host = os.environ.get("HOROVOD_CONTROLLER_BIND", "127.0.0.1")
-    autotuner = None
+    autotuner = Autotuner(cfg) if cfg.autotune else None
     if native_controller_enabled(cfg):  # same decision the members make
         service = NativeControllerService(
             subset_size, cfg, secret=default_secret(), port=port,
-            bind_host=bind_host)
+            bind_host=bind_host, autotuner=autotuner)
     else:
-        autotuner = Autotuner(cfg) if cfg.autotune else None
         service = ControllerService(
             subset_size, make_negotiator(subset_size, cfg),
             secret=default_secret(), port=port, bind_host=bind_host,

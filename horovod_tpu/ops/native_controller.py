@@ -114,6 +114,7 @@ def decode_cycle_response(body: bytes,
                           log_stalls: bool) -> ResponseList:
     r = _decode_status(body)
     shutdown = bool(r.unpack("<B"))
+    has_tuned, tuned_ms = r.unpack("<Bd")
     nresp = r.unpack("<I")
     responses = []
     for _ in range(nresp):
@@ -133,7 +134,8 @@ def decode_cycle_response(body: bytes,
         warning = r.take(r.unpack("<I")).decode("utf-8", "replace")
         if log_stalls:
             LOG.warning("%s", warning)
-    return ResponseList(responses=responses, shutdown=shutdown)
+    return ResponseList(responses=responses, shutdown=shutdown,
+                        tuned_cycle_ms=tuned_ms if has_tuned else None)
 
 
 def decode_payload_response(body: bytes) -> bytes:
@@ -194,10 +196,15 @@ class NativeControllerClient:
 # -- service ------------------------------------------------------------------
 
 class NativeControllerService:
-    """Owns the C++ controller server (ctypes)."""
+    """Owns the C++ controller server (ctypes). With an ``autotuner``, a
+    background thread drains the server's per-cycle (bytes, active µs)
+    observations into the GP optimizer and pushes retuned knobs back —
+    the fusion threshold to the negotiator, the cycle time piggybacked to
+    every rank on the next cycle response."""
 
     def __init__(self, size: int, cfg, secret: Optional[bytes] = None,
-                 port: int = 0, bind_host: str = "127.0.0.1") -> None:
+                 port: int = 0, bind_host: str = "127.0.0.1",
+                 autotuner=None) -> None:
         import ctypes
 
         from .. import cc
@@ -214,11 +221,46 @@ class NativeControllerService:
             size, bind_host.encode(), port, secret, len(secret),
             cfg.fusion_threshold_bytes, cfg.stall_warning_time_s,
             1 if cfg.stall_check_disable else 0,
-            SHUT_DOWN_ERROR.encode("utf-8"), err, len(err))
+            SHUT_DOWN_ERROR.encode("utf-8"),
+            1 if autotuner is not None else 0, err, len(err))
         if not self._handle:
             raise RuntimeError(
                 f"native controller failed to start: {err.value.decode()}")
         self.port = lib.htpu_controller_port(self._handle)
+        self._tuner_stop = None
+        if autotuner is not None:
+            import threading
+
+            self._tuner_stop = threading.Event()
+            self._tuner_thread = threading.Thread(
+                target=self._tuner_loop, args=(autotuner,),
+                name="horovod-native-autotune", daemon=True)
+            self._tuner_thread.start()
+
+    def _tuner_loop(self, autotuner) -> None:
+        import ctypes
+
+        cap = 256
+        bytes_buf = (ctypes.c_double * cap)()
+        us_buf = (ctypes.c_double * cap)()
+        while not self._tuner_stop.wait(0.02):
+            handle = self._handle
+            if not handle:
+                return
+            try:
+                n = self._lib.htpu_controller_drain_stats(
+                    handle, bytes_buf, us_buf, cap)
+                for i in range(n):
+                    tuned = autotuner.observe(bytes_buf[i], us_buf[i])
+                    if tuned is not None:
+                        threshold, cycle_ms = tuned
+                        self._lib.htpu_controller_set_tuning(
+                            handle, threshold, cycle_ms)
+            except Exception as exc:  # noqa: BLE001 - keep tuning alive
+                # Match the Python service's failure loudness: a tuner
+                # error (log disk full, GP failure) must not silently
+                # freeze the knobs without a trace.
+                LOG.error("native autotune observation failed: %s", exc)
 
     def wait_world_shutdown(self, timeout_s: float) -> bool:
         import time
@@ -231,6 +273,17 @@ class NativeControllerService:
         return bool(self._lib.htpu_controller_world_shutdown(self._handle))
 
     def shutdown(self) -> None:
+        if self._tuner_stop is not None:
+            self._tuner_stop.set()
+            self._tuner_thread.join(timeout=5.0)
+            if self._tuner_thread.is_alive():
+                # A wedged tuner thread (hung log disk?) still holds the
+                # raw handle; freeing it now would be a use-after-free.
+                # Leak the server instead — teardown-only, bounded.
+                LOG.warning("native autotune thread did not stop; leaking "
+                            "the controller handle to avoid use-after-free")
+                self._handle = None
+                return
         handle, self._handle = self._handle, None
         if handle:
             self._lib.htpu_controller_stop(handle)
@@ -245,25 +298,19 @@ class NativeControllerService:
 def native_controller_enabled(cfg) -> bool:
     """One decision per rank from config + local library availability.
 
-    Auto mode uses the native service except when autotune is on (the
-    GP/EI tuner feeds off the Python service's cycle observations). The
-    decision MUST resolve identically on every rank — library availability
-    is per-host, so a heterogeneous deployment (native core builds on some
-    hosts only) must pin HOROVOD_NATIVE_CONTROLLER=0/1 explicitly. A
-    divergence fails loudly at the first request with a protocol-mismatch
-    diagnostic on both sides, never a silent hang.
+    The decision MUST resolve identically on every rank — library
+    availability is per-host, so a heterogeneous deployment (native core
+    builds on some hosts only) must pin HOROVOD_NATIVE_CONTROLLER=0/1
+    explicitly. A divergence fails loudly at the first request with a
+    protocol-mismatch diagnostic on both sides, never a silent hang.
     """
     import os
 
     from .. import cc
 
+    del cfg  # knob + library only: autotune runs on both services
     knob = os.environ.get("HOROVOD_NATIVE_CONTROLLER", "auto").lower()
     if knob in ("0", "false", "off"):
-        return False
-    if cfg.autotune:
-        if knob in ("1", "true", "on"):
-            LOG.warning("HOROVOD_NATIVE_CONTROLLER=1 ignored: autotune "
-                        "requires the Python controller service.")
         return False
     if not cc.available():
         if knob in ("1", "true", "on"):

@@ -142,16 +142,19 @@ def test_mp_xla_plane_three_ranks():
     _run_world_xla("allgather", 3)
 
 
-def test_mp_autotune_end_to_end(tmp_path):
+@CONTROLLERS
+def test_mp_autotune_end_to_end(tmp_path, controller):
     """HOROVOD_AUTOTUNE=1 on a real 2-process world: the coordinator's
     tuner must log active-window samples and actually move the knobs
     (reference ``parameter_manager.cc:145-213``), with collectives staying
-    correct throughout."""
+    correct throughout — on both controller implementations (the native
+    service drains its cycle stats to the same GP tuner)."""
     log_path = str(tmp_path / "autotune.csv")
     _run_world("autotune", 2, timeout=180.0,
                extra_env={"HOROVOD_AUTOTUNE": "1",
                           "HOROVOD_AUTOTUNE_LOG": log_path,
-                          "HOROVOD_CYCLE_TIME": "1"})
+                          "HOROVOD_CYCLE_TIME": "1",
+                          **_ctrl_env(controller)})
     with open(log_path, encoding="utf-8") as fh:
         lines = [l for l in fh.read().strip().splitlines()
                  if not l.startswith("timestamp")]

@@ -117,7 +117,7 @@ def _print_chip_diagnostics(log) -> None:
         pass
 
 
-def main() -> None:
+def _parse_args(argv=None):
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawTextHelpFormatter)
     parser.add_argument("--model", default="resnet50",
@@ -131,11 +131,98 @@ def main() -> None:
     parser.add_argument("--num-warmup-batches", type=int, default=10)
     parser.add_argument("--num-batches-per-iter", type=int, default=10)
     parser.add_argument("--num-iters", type=int, default=10)
-    args = parser.parse_args()
+    parser.add_argument("--_measure", action="store_true",
+                        help=argparse.SUPPRESS)  # internal: child mode
+    return parser.parse_args(argv)
 
-    _preflight_backend()
+
+def _supervise(args) -> None:
+    """Run the measurement in a killable child, retrying on wedge/failure.
+
+    Round-2 postmortem: preflight passed, ``hvd.init()`` saw the chip, and
+    then the FIRST compile RPC hung for ~35 minutes before erroring
+    UNAVAILABLE — the shared-pool tunnel can wedge after a clean startup,
+    not just during it. A hang inside this process would eat the driver's
+    whole job budget, so the measurement runs in a subprocess whose life is
+    bounded by HOROVOD_BENCH_MEASURE_TIMEOUT (default 20 min) and retried
+    (HOROVOD_BENCH_MEASURE_ATTEMPTS, default 2); the child is killed with
+    its whole process group because a wedged TPU client ignores SIGTERM.
+    Child stderr is inherited so progress streams into the driver log; the
+    JSON result line is relayed from child stdout.
+    """
+    log = lambda *a: print(*a, file=sys.stderr, flush=True)  # noqa: E731
+    timeout_s = float(os.environ.get("HOROVOD_BENCH_MEASURE_TIMEOUT",
+                                     "1200"))
+    attempts = int(os.environ.get("HOROVOD_BENCH_MEASURE_ATTEMPTS", "2"))
+    child_argv = [sys.executable, os.path.abspath(__file__), "--_measure",
+                  "--model", args.model,
+                  "--batch-size", str(args.batch_size),
+                  "--num-warmup-batches", str(args.num_warmup_batches),
+                  "--num-batches-per-iter", str(args.num_batches_per_iter),
+                  "--num-iters", str(args.num_iters)]
+    import signal
+    import subprocess as sp
+
+    for attempt in range(1, attempts + 1):
+        log(f"[supervise {attempt}/{attempts}] measuring "
+            f"(timeout {timeout_s:.0f}s)")
+        child = sp.Popen(child_argv, stdout=sp.PIPE, text=True,
+                         start_new_session=True)
+        timed_out = False
+        try:
+            stdout, _ = child.communicate(timeout=timeout_s)
+        except sp.TimeoutExpired:
+            timed_out = True
+            log(f"[supervise {attempt}/{attempts}] measurement HUNG "
+                f"> {timeout_s:.0f}s — killing the child process group")
+            try:
+                os.killpg(child.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            # re-communicate to salvage the pipe: a child that finished
+            # measuring and printed its result before wedging in TPU
+            # client *teardown* still produced a good number
+            stdout, _ = child.communicate()
+        if child.returncode == 0 or timed_out:
+            # relay the one JSON result line (last stdout line)
+            for line in reversed((stdout or "").strip().splitlines()):
+                if line.startswith("{"):
+                    print(line, flush=True)
+                    return
+            log(f"[supervise {attempt}/{attempts}] no JSON result line "
+                f"{'salvaged from the killed child' if timed_out else 'in child stdout'}: "
+                f"{(stdout or '')[-200:]!r}")
+        else:
+            log(f"[supervise {attempt}/{attempts}] measurement failed "
+                f"(rc={child.returncode})")
+        if attempt < attempts:
+            time.sleep(10.0)
+    log("[supervise] giving up: no measurement completed. The accelerator "
+        "pool stayed wedged; re-run when the chip frees up.")
+    sys.exit(1)
+
+
+def main() -> None:
+    args = _parse_args()
+
+    if not args._measure:
+        preflight_on = os.environ.get("HOROVOD_BENCH_PREFLIGHT", "1") != "0"
+        if preflight_on:
+            _preflight_backend()
+        # Supervision defaults to following preflight (CI/CPU runs that
+        # pin the platform in-process skip both); HOROVOD_BENCH_SUPERVISE
+        # overrides either way, and the CPU regression test uses it with
+        # HOROVOD_BENCH_PLATFORM=cpu to exercise this exact driver path.
+        if os.environ.get("HOROVOD_BENCH_SUPERVISE",
+                          "1" if preflight_on else "0") != "0":
+            _supervise(args)
+            return
 
     import jax
+
+    platform_pin = os.environ.get("HOROVOD_BENCH_PLATFORM")
+    if platform_pin:
+        jax.config.update("jax_platforms", platform_pin)
     import jax.numpy as jnp
     import optax
 

@@ -1,0 +1,243 @@
+"""PyTorch ImageNet ResNet-50 example — analog of the reference's
+``examples/pytorch_imagenet_resnet50.py`` on the TPU-native engine,
+demonstrating the full production training loop:
+
+- checkpoint-resume with the resume epoch *broadcast* from rank 0 so all
+  ranks agree (reference :71-80),
+- ``--batches-per-allreduce`` local gradient accumulation via the
+  optimizer's ``backward_passes_per_step`` (reference :30-35),
+- ``--fp16-allreduce`` gradient compression on the wire,
+- ``DistributedSampler``-partitioned data, one shard per rank,
+- Goyal et al. LR schedule: warmup from the single-device LR to the
+  world-scaled LR over the first epochs, then stepped decay,
+- cross-rank metric averaging and rank-0-only checkpointing.
+
+torchvision isn't available in this image, so the ResNet-50 definition is
+inline (standard bottleneck residual network) and the dataset is synthetic
+ImageNet-shaped noise; every distributed mechanic matches the reference.
+
+Run: python -m horovod_tpu.runner -np 2 --host-data-plane \
+         python examples/pytorch_imagenet_resnet50.py --epochs 1 \
+         --image-size 64 --train-batches 4
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import torch
+import torch.nn.functional as F
+import torch.utils.data.distributed
+
+import horovod_tpu as hvd
+import horovod_tpu.torch as hvd_torch
+
+
+class Bottleneck(torch.nn.Module):
+    expansion = 4
+
+    def __init__(self, in_ch, width, stride=1):
+        super().__init__()
+        out_ch = width * self.expansion
+        self.conv1 = torch.nn.Conv2d(in_ch, width, 1, bias=False)
+        self.bn1 = torch.nn.BatchNorm2d(width)
+        self.conv2 = torch.nn.Conv2d(width, width, 3, stride=stride,
+                                     padding=1, bias=False)
+        self.bn2 = torch.nn.BatchNorm2d(width)
+        self.conv3 = torch.nn.Conv2d(width, out_ch, 1, bias=False)
+        self.bn3 = torch.nn.BatchNorm2d(out_ch)
+        self.down = None
+        if stride != 1 or in_ch != out_ch:
+            self.down = torch.nn.Sequential(
+                torch.nn.Conv2d(in_ch, out_ch, 1, stride=stride, bias=False),
+                torch.nn.BatchNorm2d(out_ch))
+
+    def forward(self, x):
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = F.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        shortcut = x if self.down is None else self.down(x)
+        return F.relu(out + shortcut)
+
+
+class ResNet50(torch.nn.Module):
+    """Standard ResNet-50 (He et al.): stages [3, 4, 6, 3] of bottlenecks."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(3, 64, 7, stride=2, padding=3,
+                                     bias=False)
+        self.bn1 = torch.nn.BatchNorm2d(64)
+        layers = []
+        in_ch = 64
+        for width, blocks, stride in ((64, 3, 1), (128, 4, 2),
+                                      (256, 6, 2), (512, 3, 2)):
+            for i in range(blocks):
+                layers.append(Bottleneck(in_ch, width,
+                                         stride if i == 0 else 1))
+                in_ch = width * Bottleneck.expansion
+        self.layers = torch.nn.Sequential(*layers)
+        self.fc = torch.nn.Linear(in_ch, num_classes)
+
+    def forward(self, x):
+        x = F.max_pool2d(F.relu(self.bn1(self.conv1(x))), 3, stride=2,
+                         padding=1)
+        x = self.layers(x)
+        x = torch.flatten(F.adaptive_avg_pool2d(x, 1), 1)
+        return self.fc(x)
+
+
+class Metric:
+    """Cross-rank running average (reference's Metric helper, :230-246)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.sum = torch.zeros(1)
+        self.n = 0
+
+    def update(self, val):
+        self.sum += hvd_torch.allreduce(val.detach(), average=True,
+                                        name=self.name)
+        self.n += 1
+
+    @property
+    def avg(self):
+        return self.sum / max(self.n, 1)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--checkpoint-format",
+                        default="/tmp/imagenet-checkpoint-{epoch}.pth.tar")
+    parser.add_argument("--fp16-allreduce", action="store_true",
+                        help="fp16 gradient compression on the wire")
+    parser.add_argument("--batches-per-allreduce", type=int, default=1,
+                        help="local accumulation before the allreduce; "
+                             "multiplies the effective batch size")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--train-batches", type=int, default=8,
+                        help="synthetic batches per rank per epoch")
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--base-lr", type=float, default=0.0125,
+                        help="single-device learning rate")
+    parser.add_argument("--warmup-epochs", type=float, default=5)
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--wd", type=float, default=0.00005)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--seed", type=int, default=42)
+    args = parser.parse_args()
+
+    allreduce_batch_size = args.batch_size * args.batches_per_allreduce
+
+    hvd.init()
+    torch.manual_seed(args.seed)
+    verbose = hvd.rank() == 0
+
+    # Resume from the newest checkpoint rank 0 can see; broadcast the
+    # decision so every rank starts the same epoch (reference :71-80).
+    resume_from_epoch = 0
+    for try_epoch in range(args.epochs, 0, -1):
+        if os.path.exists(args.checkpoint_format.format(epoch=try_epoch)):
+            resume_from_epoch = try_epoch
+            break
+    resume_from_epoch = int(hvd_torch.broadcast(
+        torch.tensor(resume_from_epoch), root_rank=0,
+        name="resume_from_epoch"))
+
+    # Synthetic ImageNet-shaped shard, partitioned by DistributedSampler
+    # exactly as the reference partitions the real dataset.
+    n = args.train_batches * allreduce_batch_size
+    g = torch.Generator().manual_seed(args.seed)
+    train_dataset = torch.utils.data.TensorDataset(
+        torch.randn(n, 3, args.image_size, args.image_size, generator=g),
+        torch.randint(0, args.num_classes, (n,), generator=g))
+    train_sampler = torch.utils.data.distributed.DistributedSampler(
+        train_dataset, num_replicas=hvd.size(), rank=hvd.rank())
+    train_loader = torch.utils.data.DataLoader(
+        train_dataset, batch_size=allreduce_batch_size,
+        sampler=train_sampler)
+
+    model = ResNet50(num_classes=args.num_classes)
+    compression = (hvd_torch.Compression.fp16 if args.fp16_allreduce
+                   else hvd_torch.Compression.none)
+    optimizer = hvd_torch.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(),
+                        # LR scaled by total batch multiplier (ref :150).
+                        lr=args.base_lr * hvd.size() *
+                        args.batches_per_allreduce,
+                        momentum=args.momentum, weight_decay=args.wd),
+        named_parameters=model.named_parameters(),
+        compression=compression,
+        backward_passes_per_step=args.batches_per_allreduce)
+
+    if resume_from_epoch > 0 and hvd.rank() == 0:
+        ckpt = torch.load(
+            args.checkpoint_format.format(epoch=resume_from_epoch))
+        model.load_state_dict(ckpt["model"])
+        optimizer.load_state_dict(ckpt["optimizer"])
+
+    # Rank-0-consistent start, fresh or restored (reference :158-160).
+    hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd_torch.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    def adjust_learning_rate(epoch, batch_idx, batches_per_epoch):
+        """Goyal et al. warmup then 30/60/80-epoch decay (ref :168-184)."""
+        if epoch < args.warmup_epochs:
+            ep = epoch + float(batch_idx + 1) / batches_per_epoch
+            lr_adj = 1.0 / hvd.size() * (
+                ep * (hvd.size() - 1) / args.warmup_epochs + 1)
+        elif epoch < 30:
+            lr_adj = 1.0
+        elif epoch < 60:
+            lr_adj = 1e-1
+        elif epoch < 80:
+            lr_adj = 1e-2
+        else:
+            lr_adj = 1e-3
+        for pg in optimizer.param_groups:
+            pg["lr"] = (args.base_lr * hvd.size() *
+                        args.batches_per_allreduce * lr_adj)
+
+    def accuracy(output, target):
+        pred = output.max(1, keepdim=True)[1]
+        return pred.eq(target.view_as(pred)).float().mean()
+
+    for epoch in range(resume_from_epoch, args.epochs):
+        model.train()
+        train_sampler.set_epoch(epoch)
+        train_loss, train_acc = Metric("train_loss"), Metric("train_acc")
+        for batch_idx, (data, target) in enumerate(train_loader):
+            adjust_learning_rate(epoch, batch_idx, len(train_loader))
+            optimizer.zero_grad()
+            # Split an allreduce batch into sub-batches; grads accumulate
+            # locally and the allreduce fires once per full batch
+            # (reference :196-208).
+            for i in range(0, len(data), args.batch_size):
+                data_b = data[i:i + args.batch_size]
+                target_b = target[i:i + args.batch_size]
+                output = model(data_b)
+                train_acc.update(accuracy(output, target_b))
+                loss = F.cross_entropy(output, target_b)
+                train_loss.update(loss)
+                # scale so the accumulated gradient is the batch average
+                loss = loss * (len(data_b) / len(data))
+                loss.backward()
+            optimizer.step()
+        if verbose:
+            print(f"epoch {epoch}: loss={float(train_loss.avg):.4f} "
+                  f"acc={float(train_acc.avg):.4f}")
+        # Checkpoint on rank 0 only (reference :249-255).
+        if hvd.rank() == 0:
+            torch.save({"model": model.state_dict(),
+                        "optimizer": optimizer.state_dict()},
+                       args.checkpoint_format.format(epoch=epoch + 1))
+    print("done")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

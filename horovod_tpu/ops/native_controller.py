@@ -243,24 +243,37 @@ class NativeControllerService:
         cap = 256
         bytes_buf = (ctypes.c_double * cap)()
         us_buf = (ctypes.c_double * cap)()
-        while not self._tuner_stop.wait(0.02):
+        while True:
+            # check AFTER one more drain when stopping: observations queued
+            # in the C++ stats buffer between the last tick and shutdown()
+            # would otherwise be dropped (the Python service scores every
+            # completed cycle; the native path must too)
+            stopping = self._tuner_stop.wait(0.02)
             handle = self._handle
             if not handle:
                 return
             try:
-                n = self._lib.htpu_controller_drain_stats(
-                    handle, bytes_buf, us_buf, cap)
-                for i in range(n):
-                    tuned = autotuner.observe(bytes_buf[i], us_buf[i])
-                    if tuned is not None:
-                        threshold, cycle_ms = tuned
-                        self._lib.htpu_controller_set_tuning(
-                            handle, threshold, cycle_ms)
+                while True:
+                    n = self._lib.htpu_controller_drain_stats(
+                        handle, bytes_buf, us_buf, cap)
+                    for i in range(n):
+                        tuned = autotuner.observe(bytes_buf[i], us_buf[i])
+                        if tuned is not None:
+                            threshold, cycle_ms = tuned
+                            self._lib.htpu_controller_set_tuning(
+                                handle, threshold, cycle_ms)
+                    # the C++ buffer holds up to 4096 samples; one
+                    # cap-sized batch per tick keeps the steady state
+                    # cheap, but the final pass must drain to empty
+                    if n < cap or not stopping:
+                        break
             except Exception as exc:  # noqa: BLE001 - keep tuning alive
                 # Match the Python service's failure loudness: a tuner
                 # error (log disk full, GP failure) must not silently
                 # freeze the knobs without a trace.
                 LOG.error("native autotune observation failed: %s", exc)
+            if stopping:
+                return
 
     def wait_world_shutdown(self, timeout_s: float) -> bool:
         import time

@@ -41,3 +41,30 @@ def test_bench_end_to_end_cpu():
     assert line["value"] > 0
     assert line["unit"] == "img/s"
     assert isinstance(line["vs_baseline"], float)
+
+
+def test_bench_supervised_path_cpu():
+    """The driver-facing path: supervisor parent + measurement child.
+
+    Round-2 postmortem: the tunnel wedged AFTER a clean preflight, inside
+    the first compile — so the measurement itself must run in a killable,
+    retryable child. This exercises that exact topology on CPU (preflight
+    skipped, supervision forced on, child pinned via
+    HOROVOD_BENCH_PLATFORM) and asserts the JSON line is relayed through
+    the parent."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update({"HOROVOD_BENCH_PREFLIGHT": "0",
+                "HOROVOD_BENCH_SUPERVISE": "1",
+                "HOROVOD_BENCH_PLATFORM": "cpu"})
+    result = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"),
+         "--batch-size", "2", "--num-warmup-batches", "1",
+         "--num-batches-per-iter", "1", "--num-iters", "1"],
+        cwd=_ROOT, env=env, capture_output=True, text=True, timeout=560)
+    assert result.returncode == 0, (
+        f"bench.py supervised failed\nstdout:\n{result.stdout}\n"
+        f"stderr:\n{result.stderr}")
+    assert "[supervise 1/" in result.stderr
+    line = json.loads(result.stdout.strip().splitlines()[-1])
+    assert line["value"] > 0

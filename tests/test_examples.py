@@ -104,6 +104,32 @@ def test_tensorflow_mnist():
     assert "done" in out.stdout
 
 
+def test_tensorflow_mnist_eager():
+    out = _run_example(
+        "tensorflow_mnist_eager.py",
+        ["--batches", "12", "--batch-size", "16"])
+    assert "Step #0\tLoss:" in out.stdout
+    assert "done" in out.stdout
+
+
+def test_pytorch_imagenet_resnet50(tmp_path):
+    """The production-loop example: gradient accumulation, fp16 wire
+    compression, checkpoint save — then a second run that must resume from
+    the broadcast epoch instead of retraining."""
+    fmt = str(tmp_path / "ckpt-{epoch}.pth.tar")
+    argv = ["--epochs", "1", "--image-size", "64", "--train-batches", "2",
+            "--batch-size", "8", "--batches-per-allreduce", "2",
+            "--num-classes", "10", "--fp16-allreduce",
+            "--checkpoint-format", fmt]
+    out = _run_example("pytorch_imagenet_resnet50.py", argv, timeout=600.0)
+    assert "epoch 0: loss=" in out.stdout
+    assert os.path.exists(fmt.format(epoch=1))
+    # resume: epoch 1 checkpoint exists -> nothing left to train
+    out2 = _run_example("pytorch_imagenet_resnet50.py", argv, timeout=600.0)
+    assert "epoch 0" not in out2.stdout
+    assert "done" in out2.stdout
+
+
 def test_haiku_mnist():
     out = _run_example("haiku_mnist.py",
                        ["--steps", "10", "--batch-size", "8"])

@@ -7,10 +7,12 @@ Broadcast`` (``operations.cc:2472-2591``), the background cycle loop
 (``:768-1621``), and the torch-style handle manager
 (``torch/handle_manager.{h,cc}``). Differences by design:
 
-* Tensors are held as host numpy arrays. The eager API exists for Horovod
-  parity and cross-process use; the performance path on TPU is the SPMD
-  ``DistributedOptimizer``/jit route where XLA owns the collectives and none
-  of this machinery runs (SURVEY §7 design stance).
+* Tensors are host numpy arrays OR device-resident ``jax.Array``s; device
+  submissions fuse and reduce through on-chip programs (zero host
+  transfers) and convert lazily only when a host wire needs bytes. The
+  bulk-performance path on TPU remains the SPMD ``DistributedOptimizer``/
+  jit route where XLA owns the collectives and none of this machinery runs
+  (SURVEY §7 design stance).
 * The multi-process data plane is the controller's host exchange (numpy over
   the authenticated TCP wire) — the CPU-world stand-in for MPI. On-device
   eager collectives across processes ride the same negotiated order; the
@@ -55,13 +57,28 @@ from .messages import (
 
 @dataclass
 class TensorTableEntry:
-    """In-flight named tensor (``common.h:77-98`` TensorTableEntry)."""
+    """In-flight named tensor (``common.h:77-98`` TensorTableEntry).
+
+    ``array`` is a host numpy array OR a device-resident ``jax.Array`` —
+    the TPU-native analog of the reference's device tensors staying on-GPU
+    through the NCCL plane: jax submissions are fused/reduced by on-chip
+    programs and only hit the host when a host wire needs the bytes."""
 
     name: str
     op: RequestType
-    array: np.ndarray
+    array: "np.ndarray"
     handle: int
     root_rank: int = -1
+
+
+def _is_jax_array(a) -> bool:
+    if isinstance(a, np.ndarray):
+        return False
+    try:
+        import jax
+    except Exception:  # noqa: BLE001 - no jax in this process
+        return False
+    return isinstance(a, jax.Array)
 
 
 def _jax_multiprocess() -> bool:
@@ -418,14 +435,40 @@ class Engine:
                        entries: List[TensorTableEntry]) -> List[np.ndarray]:
         fused = len(entries) > 1
         tl = self.timeline
+        device_in = all(_is_jax_array(e.array) for e in entries)
+        if device_in and self._client is None:
+            # World of one, device tensors: sum over a single rank without
+            # leaving the device. entry.array is already a private
+            # on-device snapshot (see ops._submit), so returning it cannot
+            # alias — or be invalidated by — any caller buffer.
+            results = []
+            for e in entries:
+                tl.activity_start(e.name, "EXECUTE")
+                results.append(e.array)
+                tl.activity_end(e.name)
+            return results
+        if device_in and self._plane is not None and \
+                self._plane.supports(dtype_of(entries[0].array)):
+            # All-device batch on the XLA plane: pack → psum → unpack with
+            # zero host transfers (the analog of the reference's tensors
+            # staying on-GPU through the NCCL fusion buffer).
+            for e in entries:
+                tl.activity_start(e.name, "EXECUTE")
+            results = self._plane.allreduce_onchip([e.array for e in entries])
+            for e in entries:
+                tl.activity_end(e.name)
+            return results
         if fused:
             for e in entries:
                 tl.activity_start(e.name, "MEMCPY_IN_FUSION_BUFFER")
-            buf = np.concatenate([e.array.ravel() for e in entries])
+            # np.asarray is the lazy D2H for any jax entries mixed into a
+            # host-path batch
+            buf = np.concatenate([np.asarray(e.array).ravel()
+                                  for e in entries])
             for e in entries:
                 tl.activity_end(e.name)
         else:
-            buf = entries[0].array.ravel()
+            buf = np.asarray(entries[0].array).ravel()
         for e in entries:
             tl.activity_start(e.name, "EXECUTE")
         if self._client is None:
@@ -458,37 +501,39 @@ class Engine:
 
     def _run_allgather(self, idx: int, entry: TensorTableEntry,
                        resp: Response) -> List[np.ndarray]:
+        arr = np.asarray(entry.array)  # lazy D2H for device submissions
         if self._client is None:
-            return [entry.array.copy()]
+            return [arr.copy()]
         if self._plane is not None and self._plane.supports_move(
-                dtype_of(entry.array)):
+                dtype_of(arr)):
             return [self._plane.allgather(
-                np.ascontiguousarray(entry.array), resp.tensor_sizes)]
+                np.ascontiguousarray(arr), resp.tensor_sizes)]
         if self._plane is not None:
-            self._warn_host_fallback("allgather", entry.name, entry.array)
+            self._warn_host_fallback("allgather", entry.name, arr)
         raw = self._client.payload(
-            self._rank, idx, np.ascontiguousarray(entry.array).tobytes())
+            self._rank, idx, np.ascontiguousarray(arr).tobytes())
         total_first = sum(resp.tensor_sizes)
-        shape = (total_first,) + tuple(entry.array.shape[1:])
-        return [np.frombuffer(raw, dtype=entry.array.dtype)
+        shape = (total_first,) + tuple(arr.shape[1:])
+        return [np.frombuffer(raw, dtype=arr.dtype)
                 .reshape(shape).copy()]
 
     def _run_broadcast(self, idx: int, entry: TensorTableEntry,
                        resp: Response) -> List[np.ndarray]:
         root = resp.tensor_sizes[0]
+        arr = np.asarray(entry.array)  # lazy D2H for device submissions
         if self._client is None:
-            return [entry.array.copy()]
+            return [arr.copy()]
         if self._plane is not None and self._plane.supports_move(
-                dtype_of(entry.array)):
+                dtype_of(arr)):
             return [self._plane.broadcast(
-                np.ascontiguousarray(entry.array), root)]
+                np.ascontiguousarray(arr), root)]
         if self._plane is not None:
-            self._warn_host_fallback("broadcast", entry.name, entry.array)
-        payload = np.ascontiguousarray(entry.array).tobytes() \
+            self._warn_host_fallback("broadcast", entry.name, arr)
+        payload = np.ascontiguousarray(arr).tobytes() \
             if self._rank == root else b""
         raw = self._client.payload(self._rank, idx, payload)
-        return [np.frombuffer(raw, dtype=entry.array.dtype)
-                .reshape(entry.array.shape).copy()]
+        return [np.frombuffer(raw, dtype=arr.dtype)
+                .reshape(arr.shape).copy()]
 
     # -- shutdown -------------------------------------------------------------
 

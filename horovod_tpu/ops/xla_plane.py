@@ -129,54 +129,137 @@ class XlaDataPlane:
     # -- compiled programs ----------------------------------------------------
 
     def _fn(self, kind: str, *key):
-        with self._lock:
-            fn = self._fns.get((kind,) + key)
-        if fn is not None:
-            return fn
+        def _build():
+            import jax
+            from jax import lax
 
-        import jax
-        from jax import lax
+            P = self._P
+            if kind == "psum":
+                body = lambda x: lax.psum(x, "hvd")  # noqa: E731
+            elif kind == "gather":
+                body = lambda x: lax.all_gather(  # noqa: E731
+                    x, "hvd", axis=0, tiled=True)
+            else:  # bcast, key = (root,)
+                root = key[0]
 
-        P = self._P
-        if kind == "psum":
-            body = lambda x: lax.psum(x, "hvd")  # noqa: E731
-            in_specs = P("hvd")
-        elif kind == "gather":
-            body = lambda x: lax.all_gather(  # noqa: E731
-                x, "hvd", axis=0, tiled=True)
-            in_specs = P("hvd")
-        else:  # bcast, key = (root,)
-            root = key[0]
+                def body(x):  # noqa: E306
+                    import jax.numpy as jnp
 
-            def body(x):  # noqa: E306
-                import jax.numpy as jnp
+                    # where, not multiply: non-root buffer contents are
+                    # ignored by Horovod broadcast semantics, and Inf/NaN
+                    # garbage there would survive a *0 mask as NaN
+                    sel = lax.axis_index("hvd") == root
+                    return lax.psum(
+                        jnp.where(sel, x, jnp.zeros_like(x)), "hvd")
 
-                # where, not multiply: non-root buffer contents are
-                # ignored by Horovod broadcast semantics, and Inf/NaN
-                # garbage there would survive a *0 mask as NaN
-                sel = lax.axis_index("hvd") == root
-                return lax.psum(jnp.where(sel, x, jnp.zeros_like(x)), "hvd")
+            # check_vma=False: the vma checker cannot statically infer that
+            # a tiled all_gather output is replicated (psum it can); all
+            # three bodies end in a collective whose output is identical on
+            # every device, so declaring P() replication is sound.
+            return jax.jit(jax.shard_map(
+                body, mesh=self._mesh, in_specs=P("hvd"), out_specs=P(),
+                check_vma=False))
 
-            in_specs = P("hvd")
-        # check_vma=False: the vma checker cannot statically infer that a
-        # tiled all_gather output is replicated (psum it can); all three
-        # bodies end in a collective whose output is identical on every
-        # device, so declaring P() replication is sound.
-        fn = jax.jit(jax.shard_map(body, mesh=self._mesh, in_specs=in_specs,
-                                   out_specs=P(), check_vma=False))
-        with self._lock:
-            self._fns[(kind,) + key] = fn
-        return fn
+        return self._local_fn((kind,) + key, _build)
 
-    def _global_put(self, local: np.ndarray):
-        """Local shard → global array sharded one-block-per-process."""
+    def _global_put(self, local):
+        """Local shard (numpy or on-device array) → global array sharded
+        one-block-per-process. device_put is the H2D for numpy and a no-op
+        for arrays already on the lead device."""
         jax = self._jax
         arr = jax.device_put(local, self._local_device)
         shape = (self._size * local.shape[0],) + local.shape[1:]
         return jax.make_array_from_single_device_arrays(
             shape, self._shard, [arr])
 
+    def _local_fn(self, key: Tuple, builder):
+        """Double-checked compile cache: collective programs (via ``_fn``)
+        and the local collective-free pack/unpack programs around the
+        shared psum. Pack/unpack keys carry the fused batch's shape/dtype
+        signature — stable across training steps, so steady state is all
+        cache hits."""
+        with self._lock:
+            fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        fn = builder()
+        with self._lock:
+            self._fns[key] = fn
+        return fn
+
     # -- collectives ----------------------------------------------------------
+
+    def allreduce_onchip(self, arrays: Sequence) -> List:
+        """Fused allreduce of device-resident ``jax.Array``s with ZERO host
+        transfers: pack (local jit: cast+concat+pad to the bucket) → the
+        SAME bucketed psum program the host-fed path issues → unpack
+        (local jit: slice+reshape+cast back).
+
+        Launch-order legality: the collective step reuses ``_fn("psum")``
+        verbatim with the same bucket size the host path would compute for
+        this batch, so a rank whose local tensors happened to be numpy and
+        a rank holding jax arrays still execute byte-identical collective
+        programs — only the collective-free pack/unpack differs per rank.
+        This is the TPU analog of the reference's device tensors staying
+        on-GPU through the NCCL fusion buffer (``operations.cc:1115-1208``)
+        instead of staging through host memory.
+        """
+        jax = self._jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        in_dt = np.dtype(arrays[0].dtype)
+        wire_dt, out_dt = self._wire_parts(in_dt)
+        shapes = [tuple(int(s) for s in a.shape) for a in arrays]
+        sizes = [int(np.prod(s, dtype=np.int64)) if s else 1 for s in shapes]
+        total = int(sum(sizes))
+        bucket = _next_bucket(total)
+
+        # Pack/unpack are PER-ENTRY programs keyed by the entry's shape
+        # (offsets ride as dynamic scalars), NOT one program keyed by the
+        # whole batch composition: fusion batches split at cycle
+        # boundaries, so their composition shifts from cycle to cycle and
+        # a composition-keyed program would recompile every cycle (a
+        # measured 100x collapse), while per-entry programs are all cache
+        # hits after the first step.
+        def _build_zeros():
+            return jax.jit(lambda: jnp.zeros((bucket,), wire_dt))
+
+        def _build_write(shape):
+            def _write(buf, x, off):
+                return lax.dynamic_update_slice(
+                    buf, x.astype(wire_dt).reshape(-1), (off,))
+            # donating the bucket keeps the chain of writes in-place on
+            # backends that support donation; CPU ignores it with a
+            # one-time note
+            return jax.jit(_write, donate_argnums=(0,))
+
+        def _build_read(shape, n):
+            def _read(buf, off):
+                return lax.dynamic_slice(
+                    buf, (off,), (n,)).astype(out_dt).reshape(shape)
+            return jax.jit(_read)
+
+        buf = self._local_fn(("zeros", bucket, str(wire_dt)), _build_zeros)()
+        off = 0
+        for a, shape, n in zip(arrays, shapes, sizes):
+            write = self._local_fn(
+                ("pack1", shape, str(in_dt), str(wire_dt), bucket),
+                lambda shape=shape: _build_write(shape))
+            buf = write(buf, a, off)
+            off += n
+        result = self._fn("psum")(self._global_put(buf))
+        # out_specs=P(): replicated, so this process's single shard holds
+        # the full reduced value, already on the lead device
+        local = result.addressable_shards[0].data
+        outs, off = [], 0
+        for shape, n in zip(shapes, sizes):
+            read = self._local_fn(
+                ("unpack1", shape, n, str(wire_dt), str(out_dt), bucket),
+                lambda shape=shape, n=n: _build_read(shape, n))
+            outs.append(read(local, off))
+            off += n
+        return outs
 
     def allreduce(self, buf: np.ndarray) -> np.ndarray:
         """Sum a flat (possibly fused) buffer across all ranks."""

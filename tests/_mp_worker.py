@@ -66,6 +66,45 @@ def main() -> None:
             expected = sum(r + i for r in range(size))
             np.testing.assert_array_equal(np.asarray(out), expected)
 
+    elif scenario == "jax_fused":
+        # Device-resident submissions: jax.Arrays fuse and reduce via the
+        # on-chip pack→psum→unpack path on the XLA plane (zero host
+        # transfers), or convert lazily on the host plane — values and
+        # round-trip types must match on both.
+        import jax.numpy as jnp
+
+        tensors = [jnp.full((40,), float(rank + i), jnp.float32)
+                   for i in range(8)]
+        handles = [hvd.allreduce_async(t, average=False, name=f"mp.jaxf.{i}")
+                   for i, t in enumerate(tensors)]
+        for i, h in enumerate(handles):
+            out = hvd.synchronize(h)
+            assert isinstance(out, jax.Array), type(out)
+            expected = sum(r + i for r in range(size))
+            np.testing.assert_array_equal(np.asarray(out), expected)
+        # averaging of a device result happens on device
+        avg = hvd.allreduce(jnp.full((8,), float(rank + 1)), average=True,
+                            name="mp.jax.avg")
+        np.testing.assert_allclose(np.asarray(avg),
+                                   sum(range(1, size + 1)) / size)
+        # a mixed numpy+jax cycle falls back to one host-packed buffer;
+        # both callers still get their framework type back
+        hj = hvd.allreduce_async(jnp.arange(6, dtype=jnp.float32),
+                                 average=False, name="mp.jax.mix.j")
+        hn = hvd.allreduce_async(np.arange(6, dtype=np.float32),
+                                 average=False, name="mp.jax.mix.n")
+        outj, outn = hvd.synchronize(hj), hvd.synchronize(hn)
+        assert isinstance(outj, jax.Array) and isinstance(outn, np.ndarray)
+        np.testing.assert_array_equal(np.asarray(outj),
+                                      np.arange(6, dtype=np.float32) * size)
+        np.testing.assert_array_equal(outn,
+                                      np.arange(6, dtype=np.float32) * size)
+        # bf16 — the MXU-native wire — must survive the trip
+        hb = hvd.allreduce(jnp.ones((16,), jnp.bfloat16), average=False,
+                           name="mp.jax.bf16")
+        np.testing.assert_array_equal(
+            np.asarray(hb, dtype=np.float32), float(size))
+
     elif scenario == "allgather":
         # ragged first dims: rank r contributes r+1 rows of value r
         x = np.full((rank + 1, 3), float(rank), dtype=np.float32)

@@ -133,9 +133,17 @@ def _run_world_xla(scenario: str, size: int, **kw):
 
 
 @pytest.mark.parametrize(
-    "scenario", ["allreduce", "fused", "allgather", "broadcast", "torch"])
+    "scenario", ["allreduce", "fused", "jax_fused", "allgather", "broadcast",
+                 "torch"])
 def test_mp_xla_plane(scenario):
     _run_world_xla(scenario, 2)
+
+
+@CONTROLLERS
+def test_mp_jax_inputs_host_plane(controller):
+    """Device-array submissions on the host data plane: lazy D2H, same
+    values, jax type round-trip."""
+    _run_world("jax_fused", 2, extra_env=_ctrl_env(controller))
 
 
 def test_mp_xla_plane_three_ranks():

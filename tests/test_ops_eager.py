@@ -52,6 +52,51 @@ def test_allreduce_jax_array_roundtrip(hvd):
     np.testing.assert_allclose(np.asarray(out), np.asarray(x))
 
 
+def test_allreduce_jax_device_resident_no_alias(hvd):
+    """World-of-one device path: jax in → jax out with no host staging,
+    and the result must be a copy — a caller later donating its input
+    buffer to a jit must not invalidate the allreduce result."""
+    import jax
+
+    x = jnp.arange(16, dtype=jnp.float32)
+    out = hvd.allreduce(x, average=False, name="dev_res")
+    assert isinstance(out, jax.Array)
+    assert out is not x
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_allreduce_async_survives_input_deletion(hvd):
+    """The submission must be an on-device snapshot: a caller deleting (or
+    jit-donating) its buffer between allreduce_async and the fusion cycle
+    must not fail the collective — nor poison other tensors fused into the
+    same batch."""
+    import jax
+
+    x = jnp.arange(1024, dtype=jnp.float32)
+    y = jnp.ones(1024, dtype=jnp.float32)
+    hx = hvd.allreduce_async(x, average=False, name="donated")
+    hy = hvd.allreduce_async(y, average=False, name="survivor")
+    x.delete()  # what jit(donate_argnums=...) does to the buffer
+    out = hvd.synchronize(hx)
+    assert isinstance(out, jax.Array)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.arange(1024, dtype=np.float32))
+    np.testing.assert_array_equal(np.asarray(hvd.synchronize(hy)), 1.0)
+
+
+def test_allreduce_async_fused_jax(hvd):
+    """A burst of device-array submissions rides one fusion cycle and every
+    result comes back as a device array (the on-chip fused path)."""
+    import jax
+
+    handles = [hvd.allreduce_async(jnp.full((32,), float(i)), average=False,
+                                   name=f"jaxfused.{i}") for i in range(6)]
+    for i, h in enumerate(handles):
+        out = hvd.synchronize(h)
+        assert isinstance(out, jax.Array)
+        np.testing.assert_array_equal(np.asarray(out), float(i))
+
+
 def test_allreduce_bfloat16(hvd):
     x = jnp.ones((4, 4), dtype=jnp.bfloat16)
     out = hvd.allreduce(x, average=False)

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Controller cycle-latency vs world size, for both controller backends.
+
+The reference's coordinator holds 5 ms negotiation cycles at 512 MPI ranks
+(``operations.cc:2030``). This environment cannot host 512 processes, so
+the harness drives N GIL-bound client threads against one service in this
+process — a pessimistic stand-in that still exercises the coordinator-side
+serial work that collapses first (accept backlog, rendezvous wakeups,
+response serialization). Real distributed clients see lower numbers than
+this harness reports.
+
+Produces the table in docs/benchmarks.md:
+
+    python benchmarks/controller_bench.py                 # both backends
+    python benchmarks/controller_bench.py --sizes 8,64,256 --impl native
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.core.config import Config
+from horovod_tpu.ops.controller import (
+    ControllerClient,
+    ControllerService,
+    make_negotiator,
+)
+from horovod_tpu.ops.messages import (
+    DataType,
+    Request,
+    RequestList,
+    RequestType,
+)
+
+SECRET = b"s" * 32
+
+
+def _request(rank: int, name: str) -> Request:
+    return Request(request_rank=rank, request_type=RequestType.ALLREDUCE,
+                   tensor_name=name, tensor_type=DataType.FLOAT32,
+                   tensor_shape=(64,), root_rank=-1)
+
+
+def _measure(impl: str, size: int, n_cycles: int,
+             tensors_per_cycle: int) -> tuple[float, float]:
+    """Median and worst rank-0 cycle latency (seconds)."""
+    cfg = Config.from_env()
+    if impl == "native":
+        from horovod_tpu.ops.native_controller import (
+            NativeControllerClient,
+            NativeControllerService,
+        )
+
+        service = NativeControllerService(size, cfg, secret=SECRET, port=0)
+        client_cls = NativeControllerClient
+    else:
+        service = ControllerService(size, make_negotiator(size, cfg),
+                                    secret=SECRET, port=0)
+        client_cls = ControllerClient
+    latencies: list[float] = []
+    errors: list[BaseException] = []
+    # all ranks enter each cycle together so the measured latency is the
+    # full gather+construct+broadcast rendezvous, not thread-start skew
+    barrier = threading.Barrier(size)
+
+    def worker(rank: int) -> None:
+        try:
+            client = client_cls(("127.0.0.1", service.port), secret=SECRET,
+                                rank=rank)
+            for c in range(n_cycles):
+                requests = [_request(rank, f"t{c}_{i}")
+                            for i in range(tensors_per_cycle)]
+                barrier.wait(timeout=120)
+                t0 = time.perf_counter()
+                client.cycle(rank, RequestList(rank=rank, requests=requests))
+                if rank == 0:
+                    latencies.append(time.perf_counter() - t0)
+            client.close()
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+            # release peers blocked on the barrier — one failed rank must
+            # fail the run, not hang it (threads are daemon anyway, but the
+            # abort turns a silent 600 s join timeout into the real error)
+            barrier.abort()
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    service.shutdown()
+    if errors:
+        raise RuntimeError(f"{impl} @ {size} ranks failed: {errors[:3]}")
+    # first cycle carries connect+auth for every rank; drop it
+    timed = latencies[1:] or latencies
+    return statistics.median(timed), max(timed)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--sizes", default="8,16,32,64,128",
+                        help="comma-separated world sizes")
+    parser.add_argument("--impl", default="both",
+                        choices=["python", "native", "both"])
+    parser.add_argument("--cycles", type=int, default=20)
+    parser.add_argument("--tensors-per-cycle", type=int, default=8)
+    args = parser.parse_args()
+
+    impls = ["python", "native"] if args.impl == "both" else [args.impl]
+    sizes = [int(s) for s in args.sizes.split(",")]
+    print(f"# controller cycle latency, {args.tensors_per_cycle} tensors/"
+          f"cycle, {args.cycles} cycles, GIL-bound threaded clients")
+    print(f"{'impl':<8} {'ranks':>6} {'median ms':>10} {'worst ms':>10}")
+    for impl in impls:
+        if impl == "native":
+            from horovod_tpu import cc
+
+            if not cc.available():
+                print(f"native   skipped: {cc.load_error()}")
+                continue
+        for size in sizes:
+            median, worst = _measure(impl, size, args.cycles,
+                                     args.tensors_per_cycle)
+            print(f"{impl:<8} {size:>6} {median * 1e3:>10.1f} "
+                  f"{worst * 1e3:>10.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

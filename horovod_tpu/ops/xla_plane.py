@@ -225,13 +225,14 @@ class XlaDataPlane:
         def _build_zeros():
             return jax.jit(lambda: jnp.zeros((bucket,), wire_dt))
 
-        def _build_write(shape):
+        def _build_write():
             def _write(buf, x, off):
                 return lax.dynamic_update_slice(
                     buf, x.astype(wire_dt).reshape(-1), (off,))
             # donating the bucket keeps the chain of writes in-place on
             # backends that support donation; CPU ignores it with a
-            # one-time note
+            # one-time note. One program per dtype pair — jit specializes
+            # per input shape internally, so no shape in the cache key.
             return jax.jit(_write, donate_argnums=(0,))
 
         def _build_read(shape, n):
@@ -241,11 +242,10 @@ class XlaDataPlane:
             return jax.jit(_read)
 
         buf = self._local_fn(("zeros", bucket, str(wire_dt)), _build_zeros)()
+        write = self._local_fn(("pack1", str(in_dt), str(wire_dt)),
+                               _build_write)
         off = 0
-        for a, shape, n in zip(arrays, shapes, sizes):
-            write = self._local_fn(
-                ("pack1", shape, str(in_dt), str(wire_dt), bucket),
-                lambda shape=shape: _build_write(shape))
+        for a, n in zip(arrays, sizes):
             buf = write(buf, a, off)
             off += n
         result = self._fn("psum")(self._global_put(buf))

@@ -96,9 +96,17 @@ def _measure(impl: str, size: int, n_cycles: int,
         t.start()
     for t in threads:
         t.join(timeout=600)
+    hung = sum(1 for t in threads if t.is_alive())
     service.shutdown()
     if errors:
         raise RuntimeError(f"{impl} @ {size} ranks failed: {errors[:3]}")
+    if hung:
+        # a rank blocked inside cycle() IS the collapse this harness
+        # exists to catch — never report partial latencies as a healthy
+        # measurement
+        raise RuntimeError(
+            f"{impl} @ {size} ranks: {hung} rank(s) hung past the join "
+            f"timeout; no valid measurement")
     # first cycle carries connect+auth for every rank; drop it
     timed = latencies[1:] or latencies
     return statistics.median(timed), max(timed)

@@ -501,6 +501,14 @@ class Engine:
 
     def _run_allgather(self, idx: int, entry: TensorTableEntry,
                        resp: Response) -> List[np.ndarray]:
+        if _is_jax_array(entry.array):
+            if self._client is None:
+                # size-1 concat == the (private, snapshot) array itself
+                return [entry.array]
+            if self._plane is not None and self._plane.supports_move(
+                    dtype_of(entry.array)):
+                return [self._plane.allgather_onchip(
+                    entry.array, resp.tensor_sizes)]
         arr = np.asarray(entry.array)  # lazy D2H for device submissions
         if self._client is None:
             return [arr.copy()]
@@ -520,6 +528,13 @@ class Engine:
     def _run_broadcast(self, idx: int, entry: TensorTableEntry,
                        resp: Response) -> List[np.ndarray]:
         root = resp.tensor_sizes[0]
+        if _is_jax_array(entry.array):
+            if self._client is None:
+                # size-1 broadcast == the (private, snapshot) array itself
+                return [entry.array]
+            if self._plane is not None and self._plane.supports_move(
+                    dtype_of(entry.array)):
+                return [self._plane.broadcast_onchip(entry.array, root)]
         arr = np.asarray(entry.array)  # lazy D2H for device submissions
         if self._client is None:
             return [arr.copy()]

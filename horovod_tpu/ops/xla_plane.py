@@ -222,28 +222,8 @@ class XlaDataPlane:
         # a composition-keyed program would recompile every cycle (a
         # measured 100x collapse), while per-entry programs are all cache
         # hits after the first step.
-        def _build_zeros():
-            return jax.jit(lambda: jnp.zeros((bucket,), wire_dt))
-
-        def _build_write():
-            def _write(buf, x, off):
-                return lax.dynamic_update_slice(
-                    buf, x.astype(wire_dt).reshape(-1), (off,))
-            # donating the bucket keeps the chain of writes in-place on
-            # backends that support donation; CPU ignores it with a
-            # one-time note. One program per dtype pair — jit specializes
-            # per input shape internally, so no shape in the cache key.
-            return jax.jit(_write, donate_argnums=(0,))
-
-        def _build_read(shape, n):
-            def _read(buf, off):
-                return lax.dynamic_slice(
-                    buf, (off,), (n,)).astype(out_dt).reshape(shape)
-            return jax.jit(_read)
-
-        buf = self._local_fn(("zeros", bucket, str(wire_dt)), _build_zeros)()
-        write = self._local_fn(("pack1", str(in_dt), str(wire_dt)),
-                               _build_write)
+        buf = self._zeros_fn(bucket, wire_dt)()
+        write = self._write_fn(in_dt, wire_dt)
         off = 0
         for a, n in zip(arrays, sizes):
             buf = write(buf, a, off)
@@ -254,12 +234,129 @@ class XlaDataPlane:
         local = result.addressable_shards[0].data
         outs, off = [], 0
         for shape, n in zip(shapes, sizes):
-            read = self._local_fn(
-                ("unpack1", shape, n, str(wire_dt), str(out_dt), bucket),
-                lambda shape=shape, n=n: _build_read(shape, n))
-            outs.append(read(local, off))
+            outs.append(
+                self._read_fn(shape, n, wire_dt, out_dt, bucket)(local, off))
             off += n
         return outs
+
+    # -- shared on-chip pack/unpack programs ----------------------------------
+    # ONE definition each: the host-fed and device-resident paths must stay
+    # byte-equivalent in bucket math and wire casts for cross-rank
+    # launch-order legality, so the building blocks live here and nowhere
+    # else.
+
+    def _zeros_fn(self, bucket: int, wire_dt):
+        def _build():
+            import jax
+            import jax.numpy as jnp
+
+            return jax.jit(lambda: jnp.zeros((bucket,), wire_dt))
+        return self._local_fn(("zeros", bucket, str(wire_dt)), _build)
+
+    def _write_fn(self, in_dt, wire_dt):
+        def _build():
+            import jax
+            from jax import lax
+
+            def _write(buf, x, off):
+                return lax.dynamic_update_slice(
+                    buf, x.astype(wire_dt).reshape(-1), (off,))
+            # donating the bucket keeps the chain of writes in-place on
+            # backends that support donation; CPU ignores it with a
+            # one-time note. One program per dtype pair — jit specializes
+            # per input shape internally, so no shape in the cache key.
+            return jax.jit(_write, donate_argnums=(0,))
+        return self._local_fn(("pack1", str(in_dt), str(wire_dt)), _build)
+
+    def _read_fn(self, shape, n: int, wire_dt, out_dt, bucket: int):
+        def _build():
+            import jax
+            from jax import lax
+
+            def _read(buf, off):
+                return lax.dynamic_slice(
+                    buf, (off,), (n,)).astype(out_dt).reshape(shape)
+            return jax.jit(_read)
+        return self._local_fn(
+            ("unpack1", tuple(shape), n, str(wire_dt), str(out_dt), bucket),
+            _build)
+
+    @staticmethod
+    def _bcast_wire_src(dtype) -> np.dtype:
+        """Pre-wire widening for broadcast: the psum wire needs a dtype
+        with a stable XLA reduction, so bool and sub-32-bit ints widen to
+        int32 (lossless, cast back exact). Shared by the host-fed and
+        on-chip paths — they must agree or mixed-input ranks diverge."""
+        dtype = np.dtype(dtype)
+        if dtype == np.bool_ or dtype in (
+                np.dtype(np.uint8), np.dtype(np.int8),
+                np.dtype(np.uint16), np.dtype(np.int16)):
+            return np.dtype(np.int32)
+        return dtype
+
+    @staticmethod
+    def _gather_rows(tail_shape, sizes: Sequence[int]) -> int:
+        """Row bucket for ragged allgather: power-of-two over the largest
+        contribution, with the floor scaled by row width so it stays
+        ~_MIN_BUCKET *elements* (a flat 1024-row floor would blow up wide
+        rows: (8, 65536) would pad 2 MB to 256 MB). Shared by the
+        host-fed and on-chip paths — they must agree or mixed-input ranks
+        issue different gather programs."""
+        row_elems = max(1, int(np.prod(tail_shape, dtype=np.int64)))
+        min_rows = max(1, -(-_MIN_BUCKET // row_elems))
+        return max(min_rows,
+                   1 << max(0, math.ceil(math.log2(max(max(sizes), 1)))))
+
+    def broadcast_onchip(self, arr, root: int):
+        """Device-resident broadcast of one ``jax.Array``: cast/pad on
+        device, then the SAME root-keyed masked-psum program the host-fed
+        ``broadcast`` issues (same widening policy, same bucket), then
+        cast back — launch-compatible with ranks feeding numpy."""
+        out_np = np.dtype(arr.dtype)
+        wire_dt, _ = self._wire_parts(self._bcast_wire_src(out_np))
+        shape = tuple(int(s) for s in arr.shape)
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        bucket = _next_bucket(n)
+        buf = self._write_fn(out_np, wire_dt)(
+            self._zeros_fn(bucket, wire_dt)(), arr, 0)
+        result = self._fn("bcast", root)(self._global_put(buf))
+        local = result.addressable_shards[0].data
+        return self._read_fn(shape, n, wire_dt, out_np, bucket)(local, 0)
+
+    def allgather_onchip(self, arr, sizes: Sequence[int]):
+        """Device-resident ragged allgather of one ``jax.Array``: pad rows
+        on device, run the SAME tiled all_gather program the host-fed path
+        issues (same row bucket), then slice+concat the valid blocks on
+        device. The trim program is keyed by the negotiated ``sizes``
+        tuple — stable across the steps of a training loop."""
+        jax = self._jax
+        import jax.numpy as jnp
+
+        shape = tuple(int(s) for s in arr.shape)
+        dt = np.dtype(arr.dtype)
+        rows = self._gather_rows(shape[1:], sizes)
+        sizes = tuple(int(s) for s in sizes)
+
+        def _build_pad():
+            def _pad(x):
+                return jnp.zeros((rows,) + shape[1:], dt).at[
+                    :x.shape[0]].set(x)
+            return jax.jit(_pad)
+
+        def _build_trim():
+            def _trim(g):
+                blocks = [g[r * rows:r * rows + valid]
+                          for r, valid in enumerate(sizes)]
+                return blocks[0] if len(blocks) == 1 else \
+                    jnp.concatenate(blocks, axis=0)
+            return jax.jit(_trim)
+
+        pad = self._local_fn(("padrows", shape, str(dt), rows), _build_pad)
+        gathered = self._fn("gather")(self._global_put(pad(arr)))
+        local = gathered.addressable_shards[0].data
+        trim = self._local_fn(
+            ("trimrows", shape[1:], str(dt), rows, sizes), _build_trim)
+        return trim(local)
 
     def allreduce(self, buf: np.ndarray) -> np.ndarray:
         """Sum a flat (possibly fused) buffer across all ranks."""
@@ -278,14 +375,7 @@ class XlaDataPlane:
         """Concatenate per-rank arrays with ragged first dims (the
         recvcounts/displacements logic of ``operations.cc:843-927``, done as
         pad → tiled all_gather → trim)."""
-        # bucket the ROW count: power-of-two for compile reuse, with the
-        # minimum scaled by row width so the floor stays ~_MIN_BUCKET
-        # *elements* — a flat 1024-row floor would blow up wide rows
-        # (e.g. (8, 65536) would pad 2 MB to 256 MB)
-        row_elems = max(1, int(np.prod(arr.shape[1:], dtype=np.int64)))
-        min_rows = max(1, -(-_MIN_BUCKET // row_elems))
-        rows = max(min_rows,
-                   1 << max(0, math.ceil(math.log2(max(max(sizes), 1)))))
+        rows = self._gather_rows(arr.shape[1:], sizes)
         padded = np.zeros((rows,) + arr.shape[1:], dtype=arr.dtype)
         padded[:arr.shape[0]] = arr
         gathered = np.asarray(self._fn("gather")(self._global_put(padded)))
@@ -297,14 +387,12 @@ class XlaDataPlane:
     def broadcast(self, arr: np.ndarray, root: int) -> np.ndarray:
         """Masked psum from root: only root's slot is selected, so the sum
         IS the root's bytes — one compiled program per root instead of a
-        ppermute chain. The psum wire must be a dtype with a stable XLA
-        reduction, so bool and sub-32-bit ints widen to int32 (lossless,
-        cast back exact); f16/bf16 widen on CPU via ``_wire_parts``."""
+        ppermute chain. Pre-wire widening per ``_bcast_wire_src``; f16/bf16
+        widen on CPU via ``_wire_parts``."""
         out_dt = arr.dtype
-        if arr.dtype == np.bool_ or arr.dtype in (
-                np.dtype(np.uint8), np.dtype(np.int8),
-                np.dtype(np.uint16), np.dtype(np.int16)):
-            arr = arr.astype(np.int32)
+        wire_src = self._bcast_wire_src(arr.dtype)
+        if wire_src != arr.dtype:
+            arr = arr.astype(wire_src)
         wire_dt, _ = self._wire_parts(arr.dtype)
         flat = np.ascontiguousarray(arr, dtype=wire_dt).reshape(-1)
         out = self.allreduce_masked(flat, root)

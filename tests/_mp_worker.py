@@ -104,6 +104,27 @@ def main() -> None:
                            name="mp.jax.bf16")
         np.testing.assert_array_equal(
             np.asarray(hb, dtype=np.float32), float(size))
+        # device-resident ragged allgather
+        g = hvd.allgather(jnp.full((rank + 1, 3), float(rank)),
+                          name="mp.jax.gather")
+        assert isinstance(g, jax.Array), type(g)
+        np.testing.assert_array_equal(
+            np.asarray(g),
+            np.concatenate([np.full((r + 1, 3), float(r), np.float32)
+                            for r in range(size)]))
+        # device-resident broadcast: non-root Inf garbage must not leak,
+        # narrow int dtypes must widen losslessly and cast back
+        root = size - 1
+        y = (jnp.full((5,), 7.0) if rank == root
+             else jnp.full((5,), jnp.inf))
+        b = hvd.broadcast(y, root_rank=root, name="mp.jax.bcast")
+        assert isinstance(b, jax.Array), type(b)
+        np.testing.assert_array_equal(np.asarray(b), 7.0)
+        bi = hvd.broadcast(jnp.arange(4, dtype=jnp.int8) + rank,
+                           root_rank=0, name="mp.jax.bcast.i8")
+        assert np.asarray(bi).dtype == np.int8
+        np.testing.assert_array_equal(np.asarray(bi),
+                                      np.arange(4, dtype=np.int8))
 
     elif scenario == "allgather":
         # ragged first dims: rank r contributes r+1 rows of value r

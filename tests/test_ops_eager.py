@@ -97,6 +97,23 @@ def test_allreduce_async_fused_jax(hvd):
         np.testing.assert_array_equal(np.asarray(out), float(i))
 
 
+def test_allgather_broadcast_jax_device_resident(hvd):
+    """Size-1 device path for the movement ops: jax in → jax out, values
+    intact, dtypes preserved."""
+    import jax
+
+    g = hvd.allgather(jnp.arange(6, dtype=jnp.int32).reshape(3, 2),
+                      name="dev_gather")
+    assert isinstance(g, jax.Array)
+    np.testing.assert_array_equal(np.asarray(g),
+                                  np.arange(6, dtype=np.int32).reshape(3, 2))
+    b = hvd.broadcast(jnp.arange(4, dtype=jnp.int8), root_rank=0,
+                      name="dev_bcast")
+    assert isinstance(b, jax.Array)
+    assert np.asarray(b).dtype == np.int8
+    np.testing.assert_array_equal(np.asarray(b), np.arange(4, dtype=np.int8))
+
+
 def test_allreduce_bfloat16(hvd):
     x = jnp.ones((4, 4), dtype=jnp.bfloat16)
     out = hvd.allreduce(x, average=False)

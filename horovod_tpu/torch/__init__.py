@@ -85,10 +85,28 @@ def allreduce_async(tensor: torch.Tensor, average: bool = True,
     return handle
 
 
+class _HorovodAllreduce(torch.autograd.Function):
+    """Differentiable allreduce (reference ``mpi_ops.py:110-121``):
+    the gradient of a sum-over-ranks is the same sum of the upstream
+    gradients, with matching ``average`` semantics."""
+
+    @staticmethod
+    def forward(ctx, tensor, average, name, compression):
+        ctx.average = average
+        return synchronize(
+            allreduce_async(tensor, average=average, name=name,
+                            compression=compression))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        return (allreduce(grad_output.contiguous(), average=ctx.average),
+                None, None, None)
+
+
 def allreduce(tensor: torch.Tensor, average: bool = True,
               name: Optional[str] = None,
               compression=Compression.none) -> torch.Tensor:
-    return synchronize(allreduce_async(tensor, average, name, compression))
+    return _HorovodAllreduce.apply(tensor, average, name, compression)
 
 
 def allreduce_async_(tensor: torch.Tensor, average: bool = True,
@@ -117,8 +135,30 @@ def allgather_async(tensor: torch.Tensor,
     return handle
 
 
+class _HorovodAllgather(torch.autograd.Function):
+    """Differentiable allgather (reference ``mpi_ops.py:236-254``): the
+    upstream gradient of the concatenated output is summed across ranks,
+    and each rank keeps the slice matching its own contribution."""
+
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim = tensor.shape[0]
+        return synchronize(allgather_async(tensor, name=name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad_reduced = allreduce(grad_output.contiguous(), average=False)
+        # int32, as the reference's IntTensor: int64 would force this
+        # exchange off the XLA device plane whenever x64 is disabled
+        dims = allgather(
+            torch.tensor([ctx.dim], dtype=torch.int32)).view(basics.size())
+        r = basics.rank()
+        offset = int(dims.narrow(0, 0, r).sum()) if r != 0 else 0
+        return grad_reduced.narrow(0, offset, ctx.dim), None
+
+
 def allgather(tensor: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
-    return synchronize(allgather_async(tensor, name=name))
+    return _HorovodAllgather.apply(tensor, name)
 
 
 def broadcast_async(tensor: torch.Tensor, root_rank: int,
@@ -129,9 +169,27 @@ def broadcast_async(tensor: torch.Tensor, root_rank: int,
     return handle
 
 
+class _HorovodBroadcast(torch.autograd.Function):
+    """Differentiable broadcast (reference ``mpi_ops.py:318-332``): all
+    gradients flow back to the root; non-root inputs never influenced the
+    output, so their gradient is zero."""
+
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return synchronize(broadcast_async(tensor, root_rank, name=name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad_reduced = allreduce(grad_output.contiguous(), average=False)
+        if basics.rank() != ctx.root_rank:
+            grad_reduced = grad_reduced * 0
+        return grad_reduced, None, None
+
+
 def broadcast(tensor: torch.Tensor, root_rank: int,
               name: Optional[str] = None) -> torch.Tensor:
-    return synchronize(broadcast_async(tensor, root_rank, name=name))
+    return _HorovodBroadcast.apply(tensor, root_rank, name)
 
 
 def broadcast_async_(tensor: torch.Tensor, root_rank: int,

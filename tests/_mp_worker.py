@@ -158,6 +158,40 @@ def main() -> None:
         else:
             raise AssertionError("expected coordinator error on all ranks")
 
+    elif scenario == "torch_grad":
+        # Autograd rules for the collectives across real ranks (reference
+        # ``test_torch.py:377-428``): backward of allreduce is allreduce,
+        # allgather backward slices the summed gradient, broadcast sends
+        # all gradient to the root.
+        import torch
+
+        import horovod_tpu.torch as hvd_torch
+
+        x = torch.arange(4, dtype=torch.float32, requires_grad=True)
+        w = torch.full((4,), float(rank + 1))
+        y = hvd_torch.allreduce(x, average=False, name="g.ar")
+        (y * w).sum().backward()
+        # grad_output = w; backward allreduce sums w over ranks
+        np.testing.assert_array_equal(
+            x.grad.numpy(), np.full(4, float(sum(range(1, size + 1)))))
+
+        g = torch.ones(rank + 1, 2, requires_grad=True)  # ragged rows
+        out = hvd_torch.allgather(g, name="g.gather")
+        (out * float(rank + 1)).sum().backward()
+        # grad_output = (rank+1)*ones per rank; summed over ranks then this
+        # rank keeps its own row block
+        np.testing.assert_array_equal(
+            g.grad.numpy(),
+            np.full((rank + 1, 2), float(sum(range(1, size + 1)))))
+
+        b = torch.ones(3, requires_grad=True)
+        root = size - 1
+        bout = hvd_torch.broadcast(b, root_rank=root, name="g.bcast")
+        (bout * float(rank + 1)).sum().backward()
+        expected = (float(sum(range(1, size + 1)))
+                    if rank == root else 0.0)
+        np.testing.assert_array_equal(b.grad.numpy(), np.full(3, expected))
+
     elif scenario == "torch":
         import torch
 

@@ -140,6 +140,13 @@ def test_mp_xla_plane(scenario):
 
 
 @CONTROLLERS
+def test_mp_torch_autograd(controller):
+    """Collective backward rules across real ranks (reference
+    ``test_torch.py:377-428``)."""
+    _run_world("torch_grad", 2, extra_env=_ctrl_env(controller))
+
+
+@CONTROLLERS
 def test_mp_jax_inputs_host_plane(controller):
     """Device-array submissions on the host data plane: lazy D2H, same
     values, jax type round-trip."""

@@ -66,6 +66,31 @@ def test_torch_inplace_and_async_variants(hvd):
         hvd_torch.synchronize(h4).numpy(), 1.0)
 
 
+def test_torch_autograd_allreduce(hvd):
+    """Collectives are differentiable torch ops (reference
+    ``test_torch.py:377-428``, ``mpi_ops.py:110-121``): at size 1 the
+    allreduce is identity, so d(sum(allreduce(x) * w))/dx == w."""
+    x = torch.arange(4, dtype=torch.float32, requires_grad=True)
+    w = torch.tensor([1.0, 2.0, 3.0, 4.0])
+    y = hvd_torch.allreduce(x, average=False, name="ag.ar")
+    (y * w).sum().backward()
+    np.testing.assert_array_equal(x.grad.numpy(), w.numpy())
+
+
+def test_torch_autograd_allgather_and_broadcast(hvd):
+    x = torch.ones(3, 2, requires_grad=True)
+    y = hvd_torch.allgather(x, name="ag.g")
+    y.sum().backward()
+    # size-1: the gathered output IS the input; grad of sum is ones
+    np.testing.assert_array_equal(x.grad.numpy(), np.ones((3, 2)))
+
+    z = torch.ones(4, requires_grad=True)
+    out = hvd_torch.broadcast(z, root_rank=0, name="ag.b")
+    (out * 2).sum().backward()
+    # rank 0 IS the root at size 1: all gradient flows back
+    np.testing.assert_array_equal(z.grad.numpy(), np.full(4, 2.0))
+
+
 def test_distributed_optimizer_size1_matches_sgd(hvd):
     torch.manual_seed(0)
     model = torch.nn.Linear(4, 2)

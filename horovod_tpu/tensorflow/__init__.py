@@ -128,6 +128,28 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
         return tf.IndexedSlices(values, indices,
                                 dense_shape=tensor.dense_shape)
 
+    @tf.custom_gradient
+    def _op(x):
+        y = _allreduce_dense(x, average, name, compression)
+
+        def grad(dy):
+            # reference mpi_ops.py:94-105: the gradient of a sum-over-ranks
+            # is the same sum of the upstream gradients (the reference's
+            # post-sum divide node supplies the /size; here ``average``
+            # composes it directly). Via the public differentiable wrapper
+            # so second-order tapes chain, as the reference's registered
+            # ops do.
+            return allreduce(dy, average=average)
+
+        return y, grad
+
+    return _op(tf.convert_to_tensor(tensor))
+
+
+def _allreduce_dense(tensor, average: bool, name: Optional[str],
+                     compression):
+    import tensorflow as tf
+
     name = name or _auto_name("allreduce")
     compressed, ctx = compression.compress(tf.convert_to_tensor(tensor))
     if tf.executing_eagerly():
@@ -146,7 +168,29 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
 
 
 def allgather(tensor, name: Optional[str] = None):
-    """Concatenate across ranks on dim 0; first dims may differ per rank."""
+    """Concatenate across ranks on dim 0; first dims may differ per rank.
+    Differentiable: the upstream gradient is summed across ranks and each
+    rank keeps its own block (reference ``mpi_ops.py:127-165``)."""
+    import tensorflow as tf
+
+    @tf.custom_gradient
+    def _op(x):
+        y = _allgather_impl(x, name)
+
+        def grad(dy):
+            # public wrappers so second-order tapes chain
+            gsum = allreduce(dy, average=False)
+            dim = tf.shape(x)[0]
+            dims = _allgather_impl(tf.reshape(dim, [1]), None)
+            offset = tf.reduce_sum(dims[:basics.rank()])
+            return gsum[offset:offset + dim]
+
+        return y, grad
+
+    return _op(tf.convert_to_tensor(tensor))
+
+
+def _allgather_impl(tensor, name: Optional[str]):
     import tensorflow as tf
 
     name = name or _auto_name("allgather")
@@ -166,6 +210,27 @@ def allgather(tensor, name: Optional[str] = None):
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    """Differentiable: all gradient flows to the root, non-root inputs get
+    zero (reference ``mpi_ops.py:168-183``)."""
+    import tensorflow as tf
+
+    @tf.custom_gradient
+    def _op(x):
+        y = _broadcast_impl(x, root_rank, name)
+
+        def grad(dy):
+            # public wrapper so second-order tapes chain
+            gsum = allreduce(dy, average=False)
+            if basics.rank() != root_rank:  # static per process
+                gsum = gsum * 0
+            return gsum
+
+        return y, grad
+
+    return _op(tf.convert_to_tensor(tensor))
+
+
+def _broadcast_impl(tensor, root_rank: int, name: Optional[str]):
     import tensorflow as tf
 
     name = name or _auto_name("broadcast")

@@ -288,6 +288,39 @@ def main() -> None:
         rs = hvd_tf.allreduce(s, average=False, name="mp.tf.sparse")
         assert rs.values.shape[0] == size
 
+    elif scenario == "tf_grad":
+        # TF collective backward rules across real ranks — the tf twin of
+        # torch_grad (reference gradient registrations mpi_ops.py:94-183).
+        import tensorflow as tf
+
+        import horovod_tpu.tensorflow as hvd_tf
+
+        x = tf.Variable(np.arange(4, dtype=np.float32))
+        w = tf.constant(float(rank + 1))
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(
+                hvd_tf.allreduce(x, average=False, name="g.ar") * w)
+        total = float(sum(range(1, size + 1)))
+        np.testing.assert_array_equal(tape.gradient(loss, x).numpy(),
+                                      np.full(4, total))
+
+        g = tf.Variable(np.ones((rank + 1, 2), np.float32))  # ragged rows
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(
+                hvd_tf.allgather(g, name="g.gather") * float(rank + 1))
+        np.testing.assert_array_equal(tape.gradient(loss, g).numpy(),
+                                      np.full((rank + 1, 2), total))
+
+        b = tf.Variable(np.ones(3, np.float32))
+        root = size - 1
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(
+                hvd_tf.broadcast(b, root_rank=root,
+                                 name="g.bcast") * float(rank + 1))
+        expected = total if rank == root else 0.0
+        np.testing.assert_array_equal(tape.gradient(loss, b).numpy(),
+                                      np.full(3, expected))
+
     elif scenario == "tf_keras":
         import keras
         import tensorflow as tf  # noqa: F401

@@ -70,6 +70,33 @@ def test_distributed_gradient_tape(hvd):
     np.testing.assert_allclose(grads[0].numpy(), [2.0, 4.0])
 
 
+def test_tf_collectives_differentiable(hvd):
+    """The collectives carry gradients (reference ``mpi_ops.py:94-183``
+    registrations): at size 1 each op is identity, so the tape gradient of
+    sum(op(x) * w) is w."""
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd_tf
+
+    x = tf.Variable([1.0, 2.0, 3.0])
+    w = tf.constant([2.0, 3.0, 4.0])
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(hvd_tf.allreduce(x, average=False,
+                                              name="g.ar") * w)
+    np.testing.assert_array_equal(tape.gradient(loss, x).numpy(), w.numpy())
+
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(hvd_tf.allgather(x, name="g.gather"))
+    np.testing.assert_array_equal(tape.gradient(loss, x).numpy(),
+                                  np.ones(3, np.float32))
+
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(hvd_tf.broadcast(x, root_rank=0,
+                                              name="g.bcast") * 2.0)
+    np.testing.assert_array_equal(tape.gradient(loss, x).numpy(),
+                                  np.full(3, 2.0, np.float32))
+
+
 def test_broadcast_variables(hvd):
     var = tf.Variable([5.0, 6.0])
     hvd_tf.broadcast_variables([var], root_rank=0)
@@ -141,6 +168,12 @@ def test_tf_multiprocess_world():
     from test_multiprocess import _run_world
 
     _run_world("tf", 2, timeout=180.0)
+
+
+def test_tf_multiprocess_autograd():
+    from test_multiprocess import _run_world
+
+    _run_world("tf_grad", 2, timeout=180.0)
 
 
 def test_tf_keras_multiprocess_fit():

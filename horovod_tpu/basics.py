@@ -84,6 +84,26 @@ def init(ranks=None, comm=None) -> None:
         _global.topology = discover(subset=list(ranks) if ranks else None)
         _global.initialized = True
         topo = _global.topology
+        if _global.config.jax_profile_dir and topo.rank == 0 \
+                and topo.is_member:
+            # is_member: subset-world NON-members also carry rank 0 (their
+            # self-world), and several of them tracing into one directory
+            # would collide on the hostname-keyed artifact
+            # On-device twin of HOROVOD_TIMELINE (SURVEY §5.1): the host
+            # timeline shows enqueue/negotiate/execute; XLA kernel time
+            # lives in the profiler trace. Rank 0 only, like the timeline.
+            try:
+                import jax
+
+                jax.profiler.start_trace(_global.config.jax_profile_dir)
+
+                def _stop_trace() -> None:
+                    jax.profiler.stop_trace()
+
+                _global.engine_shutdown_hooks.append(_stop_trace)
+            except Exception as exc:  # noqa: BLE001 - tracing is optional
+                LOG.warning("HOROVOD_JAX_PROFILE: could not start the JAX "
+                            "profiler trace: %s", exc)
         if topo.size > 1:
             # Multi-process worlds start the background engine eagerly, as
             # the reference spawns BackgroundThreadLoop inside init
@@ -119,6 +139,10 @@ def shutdown() -> None:
         if not _global.initialized:
             return
         hooks, _global.engine_shutdown_hooks = _global.engine_shutdown_hooks, []
+        # LIFO, like atexit: later-registered hooks depend on earlier state
+        # (the engine registers after init's profiler hook; the engine must
+        # drain and negotiate shutdown while the profiler is still tracing)
+        hooks.reverse()
         for hook in hooks:
             try:
                 hook()

@@ -19,6 +19,11 @@ HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
 HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
 HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
 HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+# TPU-side twin of the timeline (SURVEY §5.1 mapping): the host timeline
+# records enqueue/negotiate/execute; on-device time lives in the XLA
+# profiler. This knob brackets init→shutdown with a jax.profiler trace on
+# rank 0, so both artifacts land side by side.
+HOROVOD_JAX_PROFILE = "HOROVOD_JAX_PROFILE"
 HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
 # Extension: the reference hardcodes 60s (STALL_WARNING_TIME,
 # operations.cc:258); configurable here, same default.
@@ -90,6 +95,7 @@ class Config:
     cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
     timeline_path: str = ""
     timeline_mark_cycles: bool = False
+    jax_profile_dir: str = ""
     stall_check_disable: bool = False
     stall_warning_time_s: float = STALL_WARNING_TIME_S
     hierarchical_allreduce: bool = False
@@ -114,6 +120,7 @@ class Config:
             cycle_time_ms=_env_float(HOROVOD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS),
             timeline_path=os.environ.get(HOROVOD_TIMELINE, ""),
             timeline_mark_cycles=_env_bool(HOROVOD_TIMELINE_MARK_CYCLES),
+            jax_profile_dir=os.environ.get(HOROVOD_JAX_PROFILE, ""),
             stall_check_disable=_env_bool(HOROVOD_STALL_CHECK_DISABLE),
             stall_warning_time_s=_env_float(HOROVOD_STALL_WARNING_TIME,
                                             STALL_WARNING_TIME_S),

@@ -9,9 +9,10 @@ Differences, by design (SURVEY §2.10):
   communication thread for the synchronous API — SPMD jit programs need no
   negotiation. The background controller for the *eager/async* named-tensor
   API is started lazily on first use (``ops.engine``).
-* ``init(comm=...)`` — the reference accepts a ranks subset or an mpi4py
-  communicator; neither concept exists here. A ``ranks``/``comm`` argument is
-  accepted and must be None/empty for compatibility with call sites.
+* ``init(ranks=[...])`` (or ``comm=`` given as a rank list) forms a subset
+  communicator over the launcher world in list order, matching the
+  reference's ``MPI_Group_incl`` semantics; an mpi4py communicator object
+  is rejected — there is no MPI in this build.
 * ``mpi_threads_supported()`` exists for API parity and always returns False
   (there is no MPI to share with user code).
 """

@@ -167,7 +167,10 @@ class Engine:
         self._stopped = threading.Event()
         self._wake = threading.Event()
 
-        timeline_path = cfg.timeline_path if topo.rank == 0 else ""
+        # member rank 0 only: subset-world NON-members also carry rank 0
+        # (their self-world) and would clobber the same timeline file
+        timeline_path = cfg.timeline_path \
+            if topo.rank == 0 and topo.is_member else ""
         self.timeline = Timeline(timeline_path, cfg.timeline_mark_cycles)
 
         self._service: Optional[ControllerService] = None

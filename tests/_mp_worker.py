@@ -192,6 +192,35 @@ def main() -> None:
                     if rank == root else 0.0)
         np.testing.assert_array_equal(b.grad.numpy(), np.full(3, expected))
 
+    elif scenario == "torch_unused":
+        # Rank-dependent unused parameters (reference
+        # ``test_force_allreduce``): a rank whose backward never touched a
+        # param must still join that param's allreduce with zeros —
+        # skipping a collective the peers wait on would deadlock — and all
+        # ranks must end the step with identical weights.
+        import torch
+
+        import horovod_tpu.torch as hvd_torch
+
+        torch.manual_seed(5)
+        l1, l2 = torch.nn.Linear(4, 4), torch.nn.Linear(4, 2)
+        named = ([("l1." + k, v) for k, v in l1.named_parameters()] +
+                 [("l2." + k, v) for k, v in l2.named_parameters()])
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD([p for _, p in named], lr=0.1),
+            named_parameters=named)
+        hvd_torch.broadcast_parameters(dict(named), root_rank=0)
+        x = torch.full((3, 4), float(rank + 1))
+        loss = (l2(l1(x)).sum() if rank == 0 else l1(x).sum())
+        loss.backward()
+        opt.step()  # must not hang; rank>0 joins l2's allreduce with zeros
+        w = torch.cat([p.detach().reshape(-1) for _, p in named])
+        gathered = hvd_torch.allgather(w.reshape(1, -1),
+                                       name="unused.check")
+        for r in range(1, size):
+            np.testing.assert_allclose(gathered[r].numpy(),
+                                       gathered[0].numpy(), rtol=1e-6)
+
     elif scenario == "torch":
         import torch
 

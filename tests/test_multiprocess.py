@@ -140,6 +140,13 @@ def test_mp_xla_plane(scenario):
 
 
 @CONTROLLERS
+def test_mp_torch_unused_params(controller):
+    """Force-allreduce of untouched grads (reference
+    ``test_force_allreduce``): no deadlock, identical weights after step."""
+    _run_world("torch_unused", 2, extra_env=_ctrl_env(controller))
+
+
+@CONTROLLERS
 def test_mp_torch_autograd(controller):
     """Collective backward rules across real ranks (reference
     ``test_torch.py:377-428``)."""

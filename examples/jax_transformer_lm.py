@@ -48,6 +48,10 @@ def main() -> None:
                         help="global batch (dp shards it; sp replicates it)")
     parser.add_argument("--seq-len", type=int, default=256)
     parser.add_argument("--lr", type=float, default=1e-2)
+    parser.add_argument("--remat", action="store_true",
+                        help="jax.checkpoint each block: recompute "
+                             "activations in backward (memory for FLOPs — "
+                             "the lever for longer sequences per chip)")
     args = parser.parse_args()
 
     hvd.init()
@@ -65,7 +69,7 @@ def main() -> None:
         max_seq_len=args.seq_len, dtype=jnp.float32,
         attention={"dp": "dense", "ring": "ring",
                    "ulysses": "ulysses"}[args.mode],
-        seq_axis=axis if seq_parallel else None)
+        seq_axis=axis if seq_parallel else None, remat=args.remat)
     # dense twin for init: same structure/params, no axis requirement
     init_model = model.clone(attention="dense", seq_axis=None)
     tokens = synthetic_text(args.batch_size, args.seq_len,

@@ -96,6 +96,16 @@ def test_mp_allreduce(size, controller):
     _run_world("allreduce", size, extra_env=_ctrl_env(controller))
 
 
+@pytest.mark.skipif(not _cc.available(),
+                    reason="native core not built")
+def test_mp_allreduce_eight_ranks_native():
+    """Full-stack (engine + controller + host plane) at 8 real processes —
+    the controller-scale tests drive 256 threaded clients, but this is the
+    largest real-process world the suite runs."""
+    _run_world("allreduce", 8, timeout=180.0,
+               extra_env=_ctrl_env("native"))
+
+
 @CONTROLLERS
 def test_mp_fused(controller):
     _run_world("fused", 2, extra_env=_ctrl_env(controller))

@@ -155,3 +155,32 @@ def test_dp_sp_composition(hvd):
         ("data", "seq"), tokens, P("data", "seq"), steps=12,
         positions=positions)
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_remat_matches_plain():
+    """remat=True must be a pure memory/FLOP trade: identical logits and
+    gradients, activations recomputed in backward instead of stored."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models.transformer import TransformerLM, lm_loss
+
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, 64)
+    kw = dict(vocab_size=64, num_layers=2, num_heads=2, d_model=32,
+              d_ff=64, max_seq_len=64, dtype=jnp.float32)
+    plain = TransformerLM(**kw)
+    remat = TransformerLM(remat=True, **kw)
+    params = plain.init(jax.random.PRNGKey(1), tokens)
+
+    def loss_of(model):
+        return lambda p: lm_loss(model.apply(p, tokens), tokens)
+
+    lp, gp = jax.value_and_grad(loss_of(plain))(params)
+    lr, gr = jax.value_and_grad(loss_of(remat))(params)
+    np.testing.assert_allclose(float(lp), float(lr), rtol=1e-6)
+    flat_p = jax.tree_util.tree_leaves(gp)
+    flat_r = jax.tree_util.tree_leaves(gr)
+    for a, b in zip(flat_p, flat_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)

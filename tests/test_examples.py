@@ -130,6 +130,29 @@ def test_pytorch_imagenet_resnet50(tmp_path):
     assert "done" in out2.stdout
 
 
+def test_pytorch_mnist():
+    out = _run_example("pytorch_mnist.py",
+                       ["--epochs", "1", "--batch-size", "8"])
+    assert "epoch 0: loss=" in out.stdout
+
+
+def test_jax_imagenet_resnet50():
+    out = _run_example(
+        "jax_imagenet_resnet50.py",
+        ["--epochs", "1", "--steps-per-epoch", "2", "--batch-size", "4",
+         "--image-size", "64", "--warmup-epochs", "1"],
+        timeout=600.0)
+    assert "epoch 0: loss=" in out.stdout
+
+
+def test_jax_word2vec():
+    out = _run_example(
+        "jax_word2vec.py",
+        ["--vocab-size", "200", "--embedding-dim", "16",
+         "--batch-size", "32", "--steps", "12"])
+    assert "loss=" in out.stdout
+
+
 def test_haiku_mnist():
     out = _run_example("haiku_mnist.py",
                        ["--steps", "10", "--batch-size", "8"])

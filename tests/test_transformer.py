@@ -176,6 +176,15 @@ def test_remat_matches_plain():
     def loss_of(model):
         return lambda p: lm_loss(model.apply(p, tokens), tokens)
 
+    # the flag must be observable, not just numerically equivalent: the
+    # grad jaxpr of the remat model carries checkpoint (remat) equations,
+    # the plain one does not — otherwise silently dropping nn.remat would
+    # keep this test green while losing the memory trade it exists for
+    jaxpr_r = str(jax.make_jaxpr(jax.grad(loss_of(remat)))(params))
+    jaxpr_p = str(jax.make_jaxpr(jax.grad(loss_of(plain)))(params))
+    assert "remat" in jaxpr_r, "remat=True produced no checkpoint eqns"
+    assert "remat" not in jaxpr_p
+
     lp, gp = jax.value_and_grad(loss_of(plain))(params)
     lr, gr = jax.value_and_grad(loss_of(remat))(params)
     np.testing.assert_allclose(float(lp), float(lr), rtol=1e-6)

@@ -170,8 +170,11 @@ def test_mp_jax_inputs_host_plane(controller):
     _run_world("jax_fused", 2, extra_env=_ctrl_env(controller))
 
 
-def test_mp_xla_plane_three_ranks():
-    _run_world_xla("allgather", 3)
+@pytest.mark.parametrize("scenario", ["allgather", "jax_fused"])
+def test_mp_xla_plane_three_ranks(scenario):
+    """Odd-sized world over the device plane: ragged gathers and the
+    on-chip fused path must not assume power-of-two rank counts."""
+    _run_world_xla(scenario, 3)
 
 
 @CONTROLLERS

@@ -107,10 +107,15 @@ def _print_chip_diagnostics(log) -> None:
                 continue
             try:
                 with open(f"/proc/{pid}/cmdline", "rb") as f:
-                    cmd = f.read().replace(b"\0", b" ").decode().strip()
+                    cmd = f.read().replace(b"\0", b" ").decode(
+                        errors="replace").strip()
             except OSError:
                 continue
-            if "python" in cmd and any(
+            # only processes actually RUNNING python (first token), not
+            # shells/tools whose argument text merely mentions a keyword
+            parts = cmd.split()
+            first = os.path.basename(parts[0]) if parts else ""
+            if first.startswith("python") and any(
                     k in cmd for k in ("jax", "bench", "graft", "tpu")):
                 log(f"    possible chip holder: pid {pid}: {cmd[:120]}")
     except OSError:

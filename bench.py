@@ -33,6 +33,27 @@ import numpy as np
 
 REFERENCE_PER_DEVICE_IMG_S = 1656.82 / 16  # docs/benchmarks.md:19-38
 
+# Per-chip bf16 peak TFLOP/s by TPU generation, for the MFU line. The
+# measured step runs bf16 on the MXU (models/_common dtype policy), so the
+# bf16 number is the right denominator. Override with
+# HOROVOD_BENCH_PEAK_TFLOPS when the device kind isn't recognized.
+_PEAK_TFLOPS_BY_KIND = {
+    "v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
+}
+
+
+def _peak_tflops(device) -> Optional[float]:
+    env = os.environ.get("HOROVOD_BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = getattr(device, "device_kind", "") or ""
+    for tag, peak in _PEAK_TFLOPS_BY_KIND.items():
+        if tag in kind.lower().replace(" ", ""):
+            return peak
+    if device.platform in ("tpu", "axon"):
+        return 197.0  # pool chips are v5e unless the kind says otherwise
+    return None  # CPU runs: MFU is meaningless, skip the field
+
 
 def _preflight_backend(attempts: Optional[int] = None,
                        probe_timeout_s: float = 120.0):
@@ -189,9 +210,15 @@ def _supervise(args) -> None:
             # client *teardown* still produced a good number
             stdout, _ = child.communicate()
         if child.returncode == 0 or timed_out:
-            # relay the one JSON result line (last stdout line)
+            # relay the one JSON result line (last stdout line). Validate it
+            # parses: a line truncated mid-write by the SIGKILL must fall
+            # through to the retry path, not reach the driver as corrupt JSON.
             for line in reversed((stdout or "").strip().splitlines()):
                 if line.startswith("{"):
+                    try:
+                        json.loads(line)
+                    except ValueError:
+                        continue
                     print(line, flush=True)
                     return
             log(f"[supervise {attempt}/{attempts}] no JSON result line "
@@ -263,9 +290,25 @@ def main() -> None:
 
     step = make_dp_train_step(model, opt, mesh, axis_name="data")
 
+    # AOT-compile once: the compiled executable exposes cost_analysis()
+    # (XLA's own FLOP count for the whole fwd+bwd+update program), which is
+    # what MFU should be computed from — an analytic 2*MACs estimate would
+    # miss rematerialization and the optimizer/BN work XLA actually runs.
+    log("Compiling train step (AOT)...")
+    compiled = step.lower(params, opt_state, batch_stats, images,
+                          labels).compile()
+    step_flops = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        step_flops = float(ca.get("flops", 0.0)) or None
+    except Exception as e:  # noqa: BLE001 - cost model is best-effort
+        log(f"cost_analysis unavailable: {e!r}")
+
     def run_batch():
         nonlocal params, opt_state, batch_stats
-        params, opt_state, batch_stats = step(
+        params, opt_state, batch_stats = compiled(
             params, opt_state, batch_stats, images, labels)
 
     log(f"Running {args.num_warmup_batches} warmup batches...")
@@ -294,12 +337,24 @@ def main() -> None:
     # meaningless for vgg16/inception3, so emit null there
     vs_baseline = (round(per_device / REFERENCE_PER_DEVICE_IMG_S, 3)
                    if args.model.startswith("resnet") else None)
-    print(json.dumps({
+    result = {
         "metric": f"{args.model}_synthetic_train_images_per_sec_per_device",
         "value": round(per_device, 2),
         "unit": "img/s",
         "vs_baseline": vs_baseline,
-    }))
+    }
+    if step_flops:
+        # cost_analysis() reports the per-device SPMD program, so achieved
+        # FLOP/s at steps/s executed is already a per-device figure
+        steps_per_s = mean / global_batch
+        achieved = step_flops * steps_per_s
+        result["tflops_per_device"] = round(achieved / 1e12, 2)
+        peak_tf = _peak_tflops(jax.devices()[0])
+        if peak_tf:
+            result["mfu_pct"] = round(100.0 * achieved / (peak_tf * 1e12), 1)
+            log(f"MFU: {result['mfu_pct']}% "
+                f"({result['tflops_per_device']} of {peak_tf} TFLOP/s peak)")
+    print(json.dumps(result))
     hvd.shutdown()
 
 

@@ -3,8 +3,21 @@
 // shared negotiator core), host-plane payload combine, and failure
 // detection. TPU-native rebuild of the coordinator role of
 // horovod/common/operations.cc:2030-2380 (there: MPI_Gather/Bcast each
-// cycle inside the C++ background thread; here: an authenticated TCP star,
-// one service thread per rank plus a liveness monitor).
+// cycle inside the C++ background thread; here: an authenticated TCP star
+// serviced by ONE epoll event loop).
+//
+// Scaling design: a single event-loop thread owns every connection. A rank
+// whose rendezvous is incomplete is *parked* — its fd simply has no queued
+// response yet — instead of blocking an OS thread, so coordinator memory
+// and scheduler load are O(1) in world size where the previous
+// thread-per-connection design (and a 512-rank MPI coordinator) are O(N).
+// Completing a cycle queues the one shared framed response onto every
+// parked fd. EOF on a parked fd is seen directly by epoll, which replaces
+// the out-of-band liveness monitor thread. The payload combine runs inline
+// on the loop (the reference combines on its single background thread the
+// same way); cycle negotiation, the latency-critical path at scale, never
+// waits behind a peer's combine in practice because host-plane payloads
+// and control cycles are phase-separated per world.
 //
 // Behavior contract: identical to the Python ControllerService
 // (horovod_tpu/ops/controller.py) — same negotiated responses, same error
@@ -20,14 +33,17 @@
 // cost on the coordinator is what bounds cycle latency at scale.
 
 #include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
-#include <poll.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
 #include <atomic>
-#include <condition_variable>
+#include <chrono>
 #include <cstdint>
 #include <cstring>
 #include <map>
@@ -81,7 +97,15 @@ struct Writer {
   void PutBytes(const std::string& s) { out.append(s); }
 };
 
-enum MsgKind : uint8_t { kHello = 1, kBye = 2, kCycle = 3, kPayload = 4 };
+enum MsgKind : uint8_t {
+  kHello = 1, kBye = 2, kCycle = 3, kPayload = 4,
+  // Abort push channel: the response is deferred until the world aborts
+  // (rank death) or the service stops — the signal for ranks blocked
+  // inside a compiled device collective, which no poisoned rendezvous
+  // response can reach. Watch connections stay anonymous (rank -1), so
+  // their own teardown is never mistaken for a rank death.
+  kWatch = 5,
+};
 
 // ---- half / bfloat16 arithmetic for the payload combine ---------------------
 
@@ -198,8 +222,9 @@ void SumInto(std::string* acc, const std::string& add, int dtype) {
 
 struct CycleSlot {
   std::map<int, std::pair<std::vector<Request>, bool>> lists;  // rank ->
-  bool done = false;
-  std::string framed;  // one frame serves every rank
+  // fds parked on this rendezvous — no thread blocks; the completing
+  // request queues the one shared framed response onto each of these
+  std::vector<int> waiters;
   // active-window start: first rank's arrival (straggler wait + negotiate
   // count toward the autotune score; inter-cycle client idle does not)
   std::chrono::steady_clock::time_point t0 =
@@ -208,8 +233,7 @@ struct CycleSlot {
 
 struct PayloadSlot {
   std::map<int, std::string> data;
-  bool done = false;
-  std::string framed;
+  std::vector<int> waiters;
 };
 
 class ControllerServer {
@@ -242,12 +266,21 @@ class ControllerServer {
       return false;
     }
     // Every rank connects at t0 (see the Python service's backlog note).
-    if (::listen(listen_fd_, 512) != 0) { *err = "listen() failed"; return false; }
+    if (::listen(listen_fd_, 1024) != 0) { *err = "listen() failed"; return false; }
     socklen_t len = sizeof(addr);
     ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
     port_ = ntohs(addr.sin_port);
-    accept_thread_ = std::thread([this] { AcceptLoop(); });
-    monitor_thread_ = std::thread([this] { MonitorLoop(); });
+    ::fcntl(listen_fd_, F_SETFL, O_NONBLOCK);
+    epoll_fd_ = ::epoll_create1(0);
+    wake_fd_ = ::eventfd(0, EFD_NONBLOCK);
+    if (epoll_fd_ < 0 || wake_fd_ < 0) { *err = "epoll/eventfd failed"; return false; }
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = listen_fd_;
+    ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
+    ev.data.fd = wake_fd_;
+    ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+    loop_thread_ = std::thread([this] { EventLoop(); });
     return true;
   }
 
@@ -281,54 +314,15 @@ class ControllerServer {
       if (stopping_) return;
       stopping_ = true;
     }
-    ::shutdown(listen_fd_, SHUT_RDWR);
-    ::close(listen_fd_);
-    {
-      std::lock_guard<std::mutex> guard(mutex_);
-      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
-    }
-    cv_.notify_all();
-    if (accept_thread_.joinable()) accept_thread_.join();
-    if (monitor_thread_.joinable()) monitor_thread_.join();
-    for (auto& t : conn_threads_) t.join();
+    uint64_t one = 1;
+    (void)!::write(wake_fd_, &one, sizeof(one));
+    if (loop_thread_.joinable()) loop_thread_.join();
   }
 
   ~ControllerServer() { Stop(); }
 
  private:
   // -- framing ---------------------------------------------------------------
-
-  bool ReadExact(int fd, uint8_t* buf, size_t n) {
-    while (n > 0) {
-      ssize_t got = ::recv(fd, buf, n, 0);
-      if (got <= 0) return false;
-      buf += got;
-      n -= static_cast<size_t>(got);
-    }
-    return true;
-  }
-
-  bool ReadFrame(int fd, std::string* body) {
-    uint8_t header[40];
-    if (!ReadExact(fd, header, sizeof(header))) return false;
-    uint64_t len = 0;
-    for (int i = 0; i < 8; ++i) len = (len << 8) | header[32 + i];
-    // The length field arrives before the body it is HMAC'd with, so it is
-    // attacker-controlled on a non-loopback bind: bound it well below
-    // anything that could throw bad_alloc (fused buffers are ~64 MB).
-    if (len > (1ull << 31)) return false;
-    try {
-      body->resize(len);
-    } catch (const std::bad_alloc&) {
-      return false;  // drop the connection, never the coordinator
-    }
-    if (len && !ReadExact(fd, reinterpret_cast<uint8_t*>(&(*body)[0]), len))
-      return false;
-    uint8_t digest[32];
-    HmacSha256(secret_, reinterpret_cast<const uint8_t*>(body->data()),
-               body->size(), digest);
-    return ConstTimeEqual(digest, header, 32);
-  }
 
   std::string FrameBody(const std::string& body) {
     std::string frame;
@@ -342,86 +336,254 @@ class ControllerServer {
     return frame;
   }
 
-  bool WriteAll(int fd, const std::string& data) {
-    size_t off = 0;
-    while (off < data.size()) {
-      ssize_t sent = ::send(fd, data.data() + off, data.size() - off,
-                            MSG_NOSIGNAL);
-      if (sent <= 0) return false;
-      off += static_cast<size_t>(sent);
-    }
-    return true;
-  }
+  // -- event loop ------------------------------------------------------------
+  // Everything below runs on the single loop thread; conns_ / cycles_ /
+  // payloads_ / history_ / rank_cycles_ are loop-thread-owned and need no
+  // lock. mutex_ guards only the state shared with external API threads
+  // (stopping_, world_shutdown_, abort_reason_, stats_, tuned_cycle_ms_).
 
-  // -- connection handling ---------------------------------------------------
+  struct Conn {
+    std::string rbuf;   // inbound bytes, possibly a partial frame
+    std::string wbuf;   // outbound framed responses not yet written
+    size_t woff = 0;
+    int rank = -1;      // set by hello/cycle/payload; -1 = anonymous probe
+    bool out_armed = false;
+  };
 
-  void AcceptLoop() {
-    while (true) {
-      int fd = ::accept(listen_fd_, nullptr, nullptr);
-      if (fd < 0) return;  // listener closed by Stop()
-      int one = 1;
-      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      std::lock_guard<std::mutex> guard(mutex_);
-      if (stopping_) { ::close(fd); return; }
-      conn_fds_.push_back(fd);
-      conn_threads_.emplace_back([this, fd] { ConnLoop(fd); });
-    }
-  }
-
-  void ConnLoop(int fd) {
-    std::string body;
-    while (ReadFrame(fd, &body)) {
-      std::string resp;
-      try {
-        resp = Dispatch(fd, body);
-      } catch (const std::exception& e) {
-        // Behavior contract with the Python service: a handler failure is
-        // a per-request remote error, never a coordinator crash.
-        resp = ErrorResp(std::string("native controller error: ") + e.what());
+  void EventLoop() {
+    std::vector<epoll_event> events(256);
+    for (;;) {
+      int n = ::epoll_wait(epoll_fd_, events.data(),
+                           static_cast<int>(events.size()), -1);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        break;
       }
-      if (!WriteAll(fd, resp)) break;
-    }
-    OnDisconnect(fd);
-    ::close(fd);
-  }
-
-  // Out-of-band EOF detection: a connection thread parked in a rendezvous
-  // is not reading its socket, so a peer dying mid-rendezvous would go
-  // unnoticed (the Python service has the same monitor for the same hole).
-  void MonitorLoop() {
-    while (true) {
-      {
-        std::unique_lock<std::mutex> lock(mutex_);
-        if (cv_.wait_for(lock, std::chrono::milliseconds(200),
-                         [this] { return stopping_; }))
-          return;
+      for (int i = 0; i < n; ++i) {
+        int fd = events[i].data.fd;
+        uint32_t ev = events[i].events;
+        if (fd == wake_fd_) {
+          uint64_t v;
+          (void)!::read(wake_fd_, &v, sizeof(v));
+          continue;
+        }
+        if (fd == listen_fd_) {
+          AcceptAll();
+          continue;
+        }
+        auto it = conns_.find(fd);
+        if (it == conns_.end()) continue;  // closed earlier this batch
+        if (ev & (EPOLLHUP | EPOLLERR)) {
+          CloseConn(fd);
+          continue;
+        }
+        if (ev & EPOLLIN) {
+          if (!ReadAvailable(fd)) continue;  // conn closed
+        }
+        if (ev & EPOLLOUT) {
+          auto it2 = conns_.find(fd);
+          if (it2 != conns_.end()) FlushWrites(fd, &it2->second);
+        }
       }
-      std::vector<int> fds;
+      bool stop;
       {
         std::lock_guard<std::mutex> guard(mutex_);
-        fds = conn_fds_;
+        stop = stopping_;
       }
-      for (int fd : fds) {
-        char c;
-        ssize_t got = ::recv(fd, &c, 1, MSG_PEEK | MSG_DONTWAIT);
-        if (got == 0) OnDisconnect(fd);  // orderly EOF
-        // got<0 with EAGAIN: alive; other errors surface in the conn thread
-      }
+      if (stop) break;
     }
-  }
-
-  void OnDisconnect(int fd) {
+    // Contract parity with the blocking design: ranks parked in a
+    // rendezvous get an explicit "controller stopping" error (or the
+    // abort reason) before their sockets close, not a bare EOF.
     std::string reason;
     {
       std::lock_guard<std::mutex> guard(mutex_);
-      // Always stop monitoring the fd (anonymous probe connections close
-      // without ever identifying a rank; their number may be reused).
-      for (auto fit = conn_fds_.begin(); fit != conn_fds_.end(); ++fit)
-        if (*fit == fd) { conn_fds_.erase(fit); break; }
-      auto it = conn_ranks_.find(fd);
-      if (it == conn_ranks_.end()) return;
-      int rank = it->second;
-      conn_ranks_.erase(it);
+      reason = abort_reason_.empty() ? "controller stopping" : abort_reason_;
+    }
+    const std::string resp = ErrorResp(reason);
+    for (int fd : DrainWaiters()) QueueWrite(fd, resp);
+    for (int fd : DrainWatchers()) QueueWrite(fd, resp);
+    for (auto& kv : conns_) ::close(kv.first);
+    conns_.clear();
+    ::close(listen_fd_);
+    ::close(epoll_fd_);
+    ::close(wake_fd_);
+  }
+
+  // Collect every parked fd and clear the slots FIRST: QueueWrite can fail
+  // into CloseConn, which walks the waiter lists and can re-enter
+  // AbortWorld — the maps must already be empty by then.
+  std::vector<int> DrainWaiters() {
+    std::vector<int> waiters;
+    for (auto& kv : cycles_)
+      waiters.insert(waiters.end(), kv.second.waiters.begin(),
+                     kv.second.waiters.end());
+    for (auto& kv : payloads_)
+      waiters.insert(waiters.end(), kv.second.waiters.begin(),
+                     kv.second.waiters.end());
+    cycles_.clear();
+    payloads_.clear();
+    return waiters;
+  }
+
+  std::vector<int> DrainWatchers() {
+    std::vector<int> watchers = std::move(watch_fds_);
+    watch_fds_.clear();
+    return watchers;
+  }
+
+  void AcceptAll() {
+    for (;;) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return;  // EAGAIN: drained
+      ::fcntl(fd, F_SETFL, O_NONBLOCK);
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      // Keepalive: watch-channel connections idle for the whole job; this
+      // keeps NAT/conntrack mappings alive and surfaces silent drops.
+      ::setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+      int idle = 60, intvl = 20, cnt = 3;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &idle, sizeof(idle));
+      ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof(intvl));
+      ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof(cnt));
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.fd = fd;
+      ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+      conns_[fd];
+    }
+  }
+
+  // false = the connection was closed (caller must not touch it again)
+  bool ReadAvailable(int fd) {
+    Conn& c = conns_[fd];
+    char buf[65536];
+    for (;;) {
+      ssize_t got = ::recv(fd, buf, sizeof(buf), 0);
+      if (got > 0) {
+        c.rbuf.append(buf, static_cast<size_t>(got));
+        if (got < static_cast<ssize_t>(sizeof(buf))) break;
+        continue;
+      }
+      if (got < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+      CloseConn(fd);  // EOF or hard error — possibly a dead rank
+      return false;
+    }
+    return ProcessFrames(fd);
+  }
+
+  bool ProcessFrames(int fd) {
+    for (;;) {
+      Conn& c = conns_[fd];
+      if (c.rbuf.size() < 40) return true;
+      uint64_t len = 0;
+      for (int i = 0; i < 8; ++i)
+        len = (len << 8) | static_cast<uint8_t>(c.rbuf[32 + i]);
+      // The length field arrives before the body it is HMAC'd with, so it
+      // is attacker-controlled on a non-loopback bind: bound it well below
+      // anything that could throw bad_alloc (fused buffers are ~64 MB).
+      if (len > (1ull << 31)) {
+        CloseConn(fd);
+        return false;
+      }
+      std::string body;
+      try {
+        if (c.rbuf.size() < 40 + len) {
+          c.rbuf.reserve(40 + len);  // one allocation for the rest
+          return true;
+        }
+        uint8_t digest[32];
+        HmacSha256(secret_,
+                   reinterpret_cast<const uint8_t*>(c.rbuf.data()) + 40,
+                   len, digest);
+        if (!ConstTimeEqual(digest,
+                            reinterpret_cast<const uint8_t*>(c.rbuf.data()),
+                            32)) {
+          CloseConn(fd);  // unauthenticated frame: drop, as ReadFrame did
+          return false;
+        }
+        body = c.rbuf.substr(40, len);
+      } catch (const std::bad_alloc&) {
+        // The claimed length precedes its HMAC check, so it is
+        // attacker-controlled: drop the connection, never the coordinator.
+        CloseConn(fd);
+        return false;
+      }
+      c.rbuf.erase(0, 40 + len);
+      try {
+        Dispatch(fd, body);
+      } catch (const std::exception& e) {
+        // Behavior contract with the Python service: a handler failure is
+        // a per-request remote error, never a coordinator crash.
+        QueueWrite(fd, ErrorResp(std::string("native controller error: ") +
+                                 e.what()));
+      }
+      if (conns_.find(fd) == conns_.end()) return false;
+    }
+  }
+
+  void QueueWrite(int fd, const std::string& framed) {
+    auto it = conns_.find(fd);
+    if (it == conns_.end()) return;  // waiter died before completion
+    it->second.wbuf.append(framed);
+    FlushWrites(fd, &it->second);
+  }
+
+  void FlushWrites(int fd, Conn* c) {
+    while (c->woff < c->wbuf.size()) {
+      ssize_t sent = ::send(fd, c->wbuf.data() + c->woff,
+                            c->wbuf.size() - c->woff, MSG_NOSIGNAL);
+      if (sent > 0) {
+        c->woff += static_cast<size_t>(sent);
+        continue;
+      }
+      if (sent < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+      CloseConn(fd);
+      return;
+    }
+    bool need_out = c->woff < c->wbuf.size();
+    if (!need_out && c->woff) {
+      c->wbuf.clear();
+      c->woff = 0;
+    }
+    if (need_out != c->out_armed) {
+      epoll_event ev{};
+      ev.events = EPOLLIN | (need_out ? EPOLLOUT : 0u);
+      ev.data.fd = fd;
+      ::epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, fd, &ev);
+      c->out_armed = need_out;
+    }
+  }
+
+  void CloseConn(int fd) {
+    auto it = conns_.find(fd);
+    if (it == conns_.end()) return;
+    int rank = it->second.rank;
+    ::epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, fd, nullptr);
+    ::close(fd);
+    conns_.erase(it);
+    // A parked fd can no longer receive its rendezvous response.
+    for (auto& kv : cycles_) EraseWaiter(&kv.second.waiters, fd);
+    for (auto& kv : payloads_) EraseWaiter(&kv.second.waiters, fd);
+    EraseWaiter(&watch_fds_, fd);
+    if (rank >= 0) AbortWorld(rank);
+  }
+
+  static void EraseWaiter(std::vector<int>* waiters, int fd) {
+    for (auto it = waiters->begin(); it != waiters->end(); ++it)
+      if (*it == fd) {
+        waiters->erase(it);
+        return;
+      }
+  }
+
+  // An identified rank's connection died mid-job: attribute, record the
+  // abort reason, and poison every parked rendezvous so survivors unblock
+  // with SHUT_DOWN_ERROR (reference semantics, operations.cc:1942-1957).
+  void AbortWorld(int rank) {
+    std::string reason;
+    {
+      std::lock_guard<std::mutex> guard(mutex_);
       if (world_shutdown_ || stopping_) return;
       if (abort_reason_.empty())
         abort_reason_ = "rank " + std::to_string(rank) + " exited mid-job. " +
@@ -432,7 +594,9 @@ class ControllerServer {
                  "[horovod_tpu native controller] %s — aborting in-flight "
                  "collectives on all ranks\n",
                  reason.c_str());
-    cv_.notify_all();
+    const std::string resp = ErrorResp(reason);
+    for (int fd : DrainWaiters()) QueueWrite(fd, resp);
+    for (int fd : DrainWatchers()) QueueWrite(fd, resp);
   }
 
   // -- dispatch --------------------------------------------------------------
@@ -445,10 +609,10 @@ class ControllerServer {
     return FrameBody(w.out);
   }
 
-  std::string Dispatch(int fd, const std::string& body) {
+  void Dispatch(int fd, const std::string& body) {
     Reader r{reinterpret_cast<const uint8_t*>(body.data()), body.size()};
     uint8_t kind = r.Get<uint8_t>();
-    if (!r.ok) return ErrorResp("malformed request");
+    if (!r.ok) return QueueWrite(fd, ErrorResp("malformed request"));
     if (kind == 0x80) {
       // A pickle protocol marker: this rank fell back to the Python
       // controller client (native core unavailable there?) while the
@@ -462,35 +626,45 @@ class ControllerServer {
                    "every rank (is the native core built on every host?). "
                    "Set HOROVOD_NATIVE_CONTROLLER=0 to force the Python "
                    "service everywhere.\n");
-      return ErrorResp("protocol mismatch: coordinator speaks the native "
-                       "binary protocol");
+      return QueueWrite(fd,
+                        ErrorResp("protocol mismatch: coordinator speaks "
+                                  "the native binary protocol"));
     }
     switch (kind) {
       case kHello: {
         int32_t rank = r.Get<int32_t>();
-        std::lock_guard<std::mutex> guard(mutex_);
-        conn_ranks_[fd] = rank;
+        conns_[fd].rank = rank;
         Writer w;
         w.Put<uint8_t>(0);
-        return FrameBody(w.out);
+        return QueueWrite(fd, FrameBody(w.out));
       }
       case kBye: {
-        std::lock_guard<std::mutex> guard(mutex_);
-        conn_ranks_.erase(fd);
+        // De-identify: the close that follows a farewell is orderly, not a
+        // rank death (the threaded design erased conn_ranks_ the same way).
+        conns_[fd].rank = -1;
         Writer w;
         w.Put<uint8_t>(0);
-        return FrameBody(w.out);
+        return QueueWrite(fd, FrameBody(w.out));
       }
       case kCycle:
         return HandleCycle(fd, &r);
       case kPayload:
         return HandlePayload(fd, &r);
+      case kWatch: {
+        {
+          std::lock_guard<std::mutex> guard(mutex_);
+          if (!abort_reason_.empty())
+            return QueueWrite(fd, ErrorResp(abort_reason_));
+        }
+        watch_fds_.push_back(fd);  // parked until abort or stop
+        return;
+      }
       default:
-        return ErrorResp("unknown request kind");
+        return QueueWrite(fd, ErrorResp("unknown request kind"));
     }
   }
 
-  std::string HandleCycle(int fd, Reader* r) {
+  void HandleCycle(int fd, Reader* r) {
     int32_t rank = r->Get<int32_t>();
     uint8_t shutdown = r->Get<uint8_t>();
     uint32_t nreq = r->Get<uint32_t>();
@@ -504,7 +678,8 @@ class ControllerServer {
       // Range-check wire enums before they index kDtypeBytes/kOpNames —
       // the Python twin gets this for free from DataType()/RequestType().
       if (op > 2 || dtype > 10)
-        return ErrorResp("malformed cycle request (bad op or dtype)");
+        return QueueWrite(
+            fd, ErrorResp("malformed cycle request (bad op or dtype)"));
       req.op = static_cast<Op>(op);
       req.dtype = dtype;
       req.root_rank = r->Get<int32_t>();
@@ -515,31 +690,40 @@ class ControllerServer {
       req.name = r->GetBytes(name_len);
       reqs.push_back(std::move(req));
     }
-    if (!r->ok) return ErrorResp("malformed cycle request");
+    if (!r->ok) return QueueWrite(fd, ErrorResp("malformed cycle request"));
 
-    std::unique_lock<std::mutex> lock(mutex_);
-    conn_ranks_[fd] = rank;
-    if (!abort_reason_.empty()) return ErrorResp(abort_reason_);
+    conns_[fd].rank = rank;
+    {
+      std::lock_guard<std::mutex> guard(mutex_);
+      if (!abort_reason_.empty())
+        return QueueWrite(fd, ErrorResp(abort_reason_));
+    }
     int64_t key = rank_cycles_[rank]++;
     CycleSlot& slot = cycles_[key];
     slot.lists[rank] = {std::move(reqs), shutdown != 0};
-    if (static_cast<int>(slot.lists.size()) == size_) {
-      // rank order, matching the Python service's deterministic feed
-      bool any_shutdown = false;
-      for (auto& kv : slot.lists) {
-        for (Request& req : kv.second.first)
-          negotiator_.AddRequest(std::move(req), false);
-        any_shutdown |= kv.second.second;
-      }
-      if (any_shutdown) negotiator_.SetShutdown();
-      std::vector<std::string> stalls;
-      bool world_shutdown = false;
-      std::vector<Response> responses =
-          negotiator_.ConstructList(&stalls, &world_shutdown);
+    if (static_cast<int>(slot.lists.size()) < size_) {
+      slot.waiters.push_back(fd);  // parked: no thread, no response yet
+      return;
+    }
+    // Last rank in: negotiate once, answer everyone.
+    // rank order, matching the Python service's deterministic feed
+    bool any_shutdown = false;
+    for (auto& kv : slot.lists) {
+      for (Request& req : kv.second.first)
+        negotiator_.AddRequest(std::move(req), false);
+      any_shutdown |= kv.second.second;
+    }
+    if (any_shutdown) negotiator_.SetShutdown();
+    std::vector<std::string> stalls;
+    bool world_shutdown = false;
+    std::vector<Response> responses =
+        negotiator_.ConstructList(&stalls, &world_shutdown);
+    history_[cycle_no_] = responses;
+    history_.erase(cycle_no_ - 16);
+    ++cycle_no_;
+    {
+      std::lock_guard<std::mutex> guard(mutex_);
       if (world_shutdown) world_shutdown_ = true;
-      history_[cycle_no_] = responses;
-      history_.erase(cycle_no_ - 16);
-      ++cycle_no_;
       // Autotune observation: (payload bytes, active µs) per cycle,
       // drained by the Python tuner thread (parameter_manager.cc scoring).
       int64_t bytes = 0;
@@ -552,36 +736,30 @@ class ControllerServer {
                         .count();
         stats_.emplace_back(static_cast<double>(bytes), us);
       }
-      slot.framed = FrameBody(EncodeCycleResponse(
-          responses, stalls, world_shutdown));
-      slot.done = true;
-      cv_.notify_all();
-    } else {
-      cv_.wait(lock, [&] {
-        return slot.done || !abort_reason_.empty() || stopping_;
-      });
-      if (!slot.done)
-        return ErrorResp(abort_reason_.empty() ? "controller stopping"
-                                               : abort_reason_);
     }
-    std::string framed = slot.framed;
-    if (++delivered_[key] == size_) {
-      cycles_.erase(key);
-      delivered_.erase(key);
-    }
-    return framed;
+    const std::string framed =
+        FrameBody(EncodeCycleResponse(responses, stalls, world_shutdown));
+    std::vector<int> waiters = std::move(slot.waiters);
+    cycles_.erase(key);  // queued responses ARE delivery; GC the slot now
+    for (int w : waiters) QueueWrite(w, framed);
+    QueueWrite(fd, framed);
   }
 
   std::string EncodeCycleResponse(const std::vector<Response>& responses,
                                   const std::vector<std::string>& stalls,
                                   bool shutdown) {
+    double tuned_cycle_ms;
+    {
+      std::lock_guard<std::mutex> guard(mutex_);
+      tuned_cycle_ms = tuned_cycle_ms_;
+    }
     Writer w;
     w.Put<uint8_t>(0);
     w.Put<uint8_t>(shutdown ? 1 : 0);
     // Tuned cycle time piggybacks to every rank, the role of the
     // reference's Params broadcast (parameter_manager.cc:213 SyncParams).
-    w.Put<uint8_t>(tuned_cycle_ms_ > 0 ? 1 : 0);
-    w.Put<double>(tuned_cycle_ms_);
+    w.Put<uint8_t>(tuned_cycle_ms > 0 ? 1 : 0);
+    w.Put<double>(tuned_cycle_ms);
     w.Put<uint32_t>(static_cast<uint32_t>(responses.size()));
     for (const Response& resp : responses) {
       w.Put<uint8_t>(static_cast<uint8_t>(resp.type));
@@ -605,75 +783,65 @@ class ControllerServer {
     return w.out;
   }
 
-  std::string HandlePayload(int fd, Reader* r) {
+  void HandlePayload(int fd, Reader* r) {
     int32_t rank = r->Get<int32_t>();
     uint64_t cycle_no = r->Get<uint64_t>();
     uint32_t idx = r->Get<uint32_t>();
     uint64_t data_len = r->Get<uint64_t>();
-    if (!r->ok || r->n < data_len) return ErrorResp("malformed payload");
+    if (!r->ok || r->n < data_len)
+      return QueueWrite(fd, ErrorResp("malformed payload"));
     std::string data = r->GetBytes(data_len);
 
-    std::unique_lock<std::mutex> lock(mutex_);
-    conn_ranks_[fd] = rank;
-    if (!abort_reason_.empty()) return ErrorResp(abort_reason_);
+    conns_[fd].rank = rank;
+    {
+      std::lock_guard<std::mutex> guard(mutex_);
+      if (!abort_reason_.empty())
+        return QueueWrite(fd, ErrorResp(abort_reason_));
+    }
     auto hist_it = history_.find(static_cast<int64_t>(cycle_no));
-    if (hist_it == history_.end() ||
-        idx >= hist_it->second.size())
-      return ErrorResp("payload references an unknown cycle/response");
+    if (hist_it == history_.end() || idx >= hist_it->second.size())
+      return QueueWrite(
+          fd, ErrorResp("payload references an unknown cycle/response"));
     const Response resp = hist_it->second[idx];  // copy: history may be
-                                                 // pruned once unlocked
+                                                 // pruned before combine
     if (resp.type == RespType::ERROR)
-      return ErrorResp("payload submitted for an error response: " +
-                       resp.error);
+      return QueueWrite(
+          fd, ErrorResp("payload submitted for an error response: " +
+                        resp.error));
     auto key = std::make_pair(static_cast<int64_t>(cycle_no),
                               static_cast<int64_t>(idx));
     PayloadSlot& slot = payloads_[key];
     slot.data[rank] = std::move(data);
-    if (static_cast<int>(slot.data.size()) == size_) {
-      // Combine + frame outside the service mutex: summing a fused
-      // multi-MB buffer across N ranks (plus the HMAC over the result)
-      // must not block every other connection's cycle handling.
-      std::map<int, std::string> gathered = std::move(slot.data);
-      lock.unlock();
-      std::string framed;
-      std::string error;
-      try {
-        std::string combined = Combine(resp, gathered);
-        Writer w;
-        w.Put<uint8_t>(0);
-        w.Put<uint64_t>(combined.size());
-        w.PutBytes(combined);
-        framed = FrameBody(w.out);
-      } catch (const std::exception& e) {
-        error = e.what();
-      }
-      lock.lock();
-      if (!error.empty()) {
-        // Poison the slot for every waiting rank, like the Python
-        // rendezvous does on a compute failure.
-        Writer w;
-        w.Put<uint8_t>(1);
-        w.Put<uint32_t>(static_cast<uint32_t>(error.size()));
-        w.PutBytes(error);
-        framed = FrameBody(w.out);
-      }
-      slot.framed = std::move(framed);
-      slot.done = true;
-      cv_.notify_all();
-    } else {
-      cv_.wait(lock, [&] {
-        return slot.done || !abort_reason_.empty() || stopping_;
-      });
-      if (!slot.done)
-        return ErrorResp(abort_reason_.empty() ? "controller stopping"
-                                               : abort_reason_);
+    if (static_cast<int>(slot.data.size()) < size_) {
+      slot.waiters.push_back(fd);
+      return;
     }
-    std::string framed = slot.framed;
-    if (++payload_delivered_[key] == size_) {
-      payloads_.erase(key);
-      payload_delivered_.erase(key);
+    // Last payload in: combine on the loop thread (the reference's
+    // coordinator likewise combines on its one background thread) and
+    // answer everyone.
+    std::map<int, std::string> gathered = std::move(slot.data);
+    std::string framed;
+    try {
+      std::string combined = Combine(resp, gathered);
+      Writer w;
+      w.Put<uint8_t>(0);
+      w.Put<uint64_t>(combined.size());
+      w.PutBytes(combined);
+      framed = FrameBody(w.out);
+    } catch (const std::exception& e) {
+      // Poison the slot for every waiting rank, like the Python
+      // rendezvous does on a compute failure.
+      const std::string error = e.what();
+      Writer w;
+      w.Put<uint8_t>(1);
+      w.Put<uint32_t>(static_cast<uint32_t>(error.size()));
+      w.PutBytes(error);
+      framed = FrameBody(w.out);
     }
-    return framed;
+    std::vector<int> waiters = std::move(slot.waiters);
+    payloads_.erase(key);
+    for (int w : waiters) QueueWrite(w, framed);
+    QueueWrite(fd, framed);
   }
 
   std::string Combine(const Response& resp,
@@ -714,26 +882,26 @@ class ControllerServer {
 
   int listen_fd_ = -1;
   int port_ = 0;
-  std::thread accept_thread_;
-  std::thread monitor_thread_;
-  std::vector<std::thread> conn_threads_;
+  int epoll_fd_ = -1;
+  int wake_fd_ = -1;
+  std::thread loop_thread_;
 
+  // loop-thread-owned (no lock):
+  std::unordered_map<int, Conn> conns_;
+  std::vector<int> watch_fds_;  // parked abort-watch connections
+  std::unordered_map<int, int64_t> rank_cycles_;
+  std::map<int64_t, CycleSlot> cycles_;
+  int64_t cycle_no_ = 0;
+  std::map<int64_t, std::vector<Response>> history_;
+  std::map<std::pair<int64_t, int64_t>, PayloadSlot> payloads_;
+
+  // shared with external API threads; guarded by mutex_:
   std::mutex mutex_;
-  std::condition_variable cv_;
   bool stopping_ = false;
   bool world_shutdown_ = false;
   std::string abort_reason_;
-  std::vector<int> conn_fds_;
-  std::unordered_map<int, int> conn_ranks_;  // fd -> rank
-  std::unordered_map<int, int64_t> rank_cycles_;
-  std::map<int64_t, CycleSlot> cycles_;
-  std::map<int64_t, int> delivered_;
-  int64_t cycle_no_ = 0;
-  double tuned_cycle_ms_ = 0;  // 0 = untuned; guarded by mutex_
+  double tuned_cycle_ms_ = 0;  // 0 = untuned
   std::vector<std::pair<double, double>> stats_;  // (bytes, active_us)
-  std::map<int64_t, std::vector<Response>> history_;
-  std::map<std::pair<int64_t, int64_t>, PayloadSlot> payloads_;
-  std::map<std::pair<int64_t, int64_t>, int> payload_delivered_;
 };
 
 }  // namespace

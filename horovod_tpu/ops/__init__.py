@@ -53,6 +53,7 @@ def _auto_name(op: str, name: Optional[str]) -> str:
 
 
 _jitted_copy = None
+_jitted_copy_lock = threading.Lock()
 
 
 def _device_snapshot(tensor):
@@ -61,10 +62,12 @@ def _device_snapshot(tensor):
     ``jnp.array(copy=True)`` on the submit path."""
     global _jitted_copy
     if _jitted_copy is None:
-        import jax
-        import jax.numpy as jnp
+        with _jitted_copy_lock:
+            if _jitted_copy is None:
+                import jax
+                import jax.numpy as jnp
 
-        _jitted_copy = jax.jit(jnp.copy)
+                _jitted_copy = jax.jit(jnp.copy)
     return _jitted_copy(tensor)
 
 

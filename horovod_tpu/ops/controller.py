@@ -395,6 +395,12 @@ class ControllerService:
         self._conn_ranks: Dict[int, int] = {}
         self._world_shutdown = False
         self._abort_fired = False
+        # Failure-push channel: "watch" requests park here until the world
+        # aborts (or the service stops), giving ranks blocked inside a
+        # compiled device collective — which no control-plane response can
+        # reach — an asynchronous SHUT_DOWN_ERROR signal.
+        self._watch_event = threading.Event()
+        self._watch_reason: Optional[str] = None
         self._service = BasicService(
             "horovod-controller", self._handle, secret=secret, port=port,
             bind_host=bind_host, on_disconnect=self._on_disconnect)
@@ -419,6 +425,10 @@ class ControllerService:
         exc = RuntimeError(f"rank {rank} exited mid-job. {SHUT_DOWN_ERROR}")
         self._cycles.abort(exc)  # first abort wins inside the rendezvous
         self._payloads.abort(exc)
+        with self._lock:
+            if self._watch_reason is None:
+                self._watch_reason = str(exc)
+        self._watch_event.set()
 
     def _handle(self, req: Any, _sock: Any) -> Any:
         kind = req[0]
@@ -429,6 +439,16 @@ class ControllerService:
             with self._lock:
                 self._conn_ranks.pop(id(_sock), None)
             return ("ok",)
+        if kind == "watch":
+            # Abort push channel: the response is DEFERRED until the world
+            # aborts or the service stops. Deliberately anonymous — no rank
+            # registration — so tearing the watch connection down is never
+            # mistaken for a rank death. (Handler threads are daemons; a
+            # parked watcher cannot hang service shutdown.)
+            self._watch_event.wait()
+            with self._lock:
+                reason = self._watch_reason
+            return ("abort", reason) if reason else ("ok", "stopping")
         # Every other message carries the sender's rank at req[1]: bind the
         # connection to it for failure detection. "hello" exists so ranks
         # identify at connect time (a rank can die before its first cycle),
@@ -515,6 +535,7 @@ class ControllerService:
         response_list.tuned_cycle_ms = self._tuned_cycle_ms
 
     def shutdown(self) -> None:
+        self._watch_event.set()  # release parked watchers with a clean stop
         self._service.shutdown()
 
     def wait_world_shutdown(self, timeout_s: float) -> bool:
@@ -553,6 +574,70 @@ def _combine(resp: Response, slot: Dict[int, bytes]) -> bytes:
     raise ValueError(f"cannot combine payload for {resp.response_type}")
 
 
+def spawn_watch_thread(addr, secret, request_reason, on_abort) -> None:
+    """Shared scaffolding for both controller clients' failure-push
+    channel: a daemon thread opens a second, anonymous connection and
+    performs one deferred-response request via ``request_reason(client)``
+    (returns the abort reason, or None for a clean stop). Any terminal
+    outcome — abort, controller death, clean stop — invokes
+    ``on_abort(reason)``; the clean-stop case is harmless by construction
+    because after the negotiated shutdown cycle nothing is blocked in a
+    collective.
+
+    Resilience: the connection idles with zero traffic for the whole job
+    (keepalive enabled against NAT/conntrack expiry), and a CONNECTION
+    loss is retried — a transient drop must re-park, not falsely abort a
+    healthy world. Only repeated reconnect failure (the controller is
+    really gone, so the world is over regardless) aborts. A CLEAN
+    controller stop (request_reason returns None) fires nothing: the world
+    negotiated its shutdown, and a spurious abort here would race the
+    engine's finalizer draining its last still-completing batches. If the
+    world aborted while the channel was down, the re-sent watch request is
+    answered immediately (both services check the abort state first)."""
+    from ..core.status import SHUT_DOWN_ERROR
+
+    def _loop() -> None:
+        failures = 0
+        while True:
+            client = None
+            try:
+                client = BasicClient(addr, secret=secret, timeout_s=None,
+                                     attempts=10)
+                client.enable_keepalive()
+                failures = 0
+                reason = request_reason(client)
+                if reason is None:  # clean stop: no abort to deliver
+                    try:
+                        client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    return
+            except Exception as exc:  # noqa: BLE001 - channel lost
+                if client is not None:
+                    try:
+                        client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                failures += 1
+                if failures < 3:
+                    time.sleep(1.0)
+                    continue  # transient: reconnect and re-park
+                reason = (f"{SHUT_DOWN_ERROR} (cause: watch channel lost: "
+                          f"{exc})")
+            try:
+                on_abort(reason)
+            finally:
+                if client is not None:
+                    try:
+                        client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+            return
+
+    threading.Thread(target=_loop, name="horovod-abort-watch",
+                     daemon=True).start()
+
+
 class ControllerClient:
     """Worker-side handle on the controller (one per process)."""
 
@@ -566,6 +651,8 @@ class ControllerClient:
         # reference's driver registration (``util/timeout.py``).
         self._client = BasicClient(addr, secret=secret, timeout_s=timeout_s,
                                    attempts=connect_attempts)
+        self._addr = addr
+        self._secret = secret
         self._cycle_no = 0
         self._rank = rank
         if rank is not None:
@@ -588,6 +675,21 @@ class ControllerClient:
     def payload(self, rank: int, response_idx: int, data: bytes) -> bytes:
         return self._client.request(
             ("payload", rank, self._last_cycle, response_idx, data))
+
+    def watch(self, on_abort: Callable[[str], None]) -> None:
+        """Failure-push channel for ranks that can block OUTSIDE the
+        control plane (inside a compiled device collective, which no
+        poisoned rendezvous response can reach): one deferred-response
+        "watch" request the controller answers only on abort/stop."""
+
+        def _request_reason(client) -> Optional[str]:
+            resp = client.request(("watch",))
+            if resp and resp[0] == "abort" and resp[1]:
+                return resp[1]
+            return None  # clean stop
+
+        spawn_watch_thread(self._addr, self._secret, _request_reason,
+                           on_abort)
 
     def close(self, detach: bool = True) -> None:
         """``detach=True`` (tooling/tests): clean goodbye, the departure is

@@ -27,7 +27,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -66,7 +66,7 @@ class TensorTableEntry:
 
     name: str
     op: RequestType
-    array: "np.ndarray"
+    array: Any  # np.ndarray | jax.Array, per the docstring contract
     handle: int
     root_rank: int = -1
 
@@ -148,6 +148,53 @@ class HandleManager:
             del self._done[handle]
         status.raise_if_error()
         return result
+
+
+class _DevicePlaneWorker:
+    """Sacrificial executor for device-plane collectives.
+
+    A compiled XLA collective blocks until every participant issues it;
+    Python cannot interrupt that execution. If a peer dies mid-collective
+    the survivors would hang until the transport's own (long or absent)
+    timeout — so the engine runs device-plane calls on this daemon thread
+    and waits abortably: when the controller pushes a world abort (watch
+    channel), the engine abandons the call and surfaces SHUT_DOWN_ERROR
+    (reference semantics, ``operations.cc:1942-1957``). The abandoned
+    thread may stay blocked in the dead collective; that is fine — the
+    world is over and the process is about to exit, exactly like the
+    reference's ranks after a NCCL comm abort.
+
+    Single worker thread: collectives keep the engine's launch order."""
+
+    def __init__(self) -> None:
+        import queue
+
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread = threading.Thread(
+            target=self._run, name="horovod-device-plane", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            fn, args, fut = self._q.get()
+            if fn is None:
+                return
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as exc:  # noqa: BLE001 - ship to waiter
+                fut.set_exception(exc)
+
+    def submit(self, fn, *args):
+        from concurrent.futures import Future
+
+        fut = Future()
+        self._q.put((fn, args, fut))
+        return fut
+
+    def stop(self) -> None:
+        self._q.put((None, None, None))
 
 
 class Engine:
@@ -255,6 +302,34 @@ class Engine:
 
         self._host_fallback_warned = set()
 
+        # XLA-plane failure propagation: a rank blocked inside a compiled
+        # collective is beyond the reach of a poisoned control-plane
+        # response, so subscribe to the controller's abort push channel and
+        # run device collectives on an abandonable worker thread.
+        self._abort_event = threading.Event()
+        self._abort_reason: Optional[str] = None
+        self._device_worker: Optional[_DevicePlaneWorker] = None
+        self._finalizer_q = None
+        self._crashed = False
+        if self._plane is not None and self._client is not None:
+            import queue
+
+            self._device_worker = _DevicePlaneWorker()
+            self._client.watch(self._on_world_abort)
+            # Completion signalling, the reference's CUDA-event-queue +
+            # finalizer-thread design (``operations.cc`` event_queue): XLA
+            # dispatch is asynchronous, so a just-dispatched collective is
+            # NOT done — handles must complete only when the device work
+            # does. The finalizer waits (abortably, on its own sacrificial
+            # worker) and then marks the handles, keeping the cycle loop
+            # free to negotiate the next batch while this one executes.
+            self._completion_worker = _DevicePlaneWorker()
+            self._finalizer_q = queue.SimpleQueue()
+            self._finalizer = threading.Thread(
+                target=self._finalize_loop, name="horovod-finalizer",
+                daemon=True)
+            self._finalizer.start()
+
         self._thread = threading.Thread(
             target=self._loop, name="horovod-background", daemon=True)
         self._thread.start()
@@ -273,6 +348,69 @@ class Engine:
             "back to the host TCP data plane, which is far slower at scale. "
             "Cast the tensor (e.g. to float32/int32) to keep it on-device.",
             op_name, tensor_name, array.dtype)
+
+    def _on_world_abort(self, reason: str) -> None:
+        """Watch-channel callback (daemon thread): record the reason and
+        wake any device call parked in ``_device_call``. Fires on clean
+        controller stop too — harmless, nothing is in a collective then."""
+        self._abort_reason = reason
+        self._abort_event.set()
+
+    def _device_call(self, fn, *args, worker=None):
+        """Run a device-plane call abortably (see ``_DevicePlaneWorker``).
+        Without a watch channel (size-1 worlds, host plane) it runs
+        inline."""
+        worker = worker or self._device_worker
+        if worker is None:
+            return fn(*args)
+        if self._abort_event.is_set():
+            raise RuntimeError(self._abort_reason or SHUT_DOWN_ERROR)
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        fut = worker.submit(fn, *args)
+        while True:
+            try:
+                return fut.result(timeout=0.25)
+            except _FutTimeout:
+                if self._abort_event.is_set():
+                    raise RuntimeError(
+                        self._abort_reason or SHUT_DOWN_ERROR) from None
+
+    def _finalize_loop(self) -> None:
+        """Mark device-path handles done only when the dispatched XLA
+        collective actually completed (reference completion semantics:
+        CUDA events + finalizer thread). A peer death leaves the wait
+        blocked forever on the sacrificial worker; the watch-channel abort
+        unparks this loop, which fails the handles with SHUT_DOWN_ERROR."""
+        import jax
+
+        while True:
+            item = self._finalizer_q.get()
+            if item is None:
+                self._completion_worker.stop()
+                return
+            entries, results = item
+            try:
+                self._device_call(jax.block_until_ready, results,
+                                  worker=self._completion_worker)
+            except Exception as exc:  # noqa: BLE001 - ship to handles
+                status = Status.unknown_error(str(exc))
+                for entry in entries:
+                    try:
+                        self.timeline.end(entry.name)
+                    except Exception:  # noqa: BLE001 - never lose the mark
+                        pass
+                    self.handles.mark_done(entry.handle, status, None)
+                continue
+            for entry, result in zip(entries, results):
+                # mark_done is the load-bearing call: a timeline hiccup
+                # must never leave a completed handle unmarked (a waiter
+                # would hang forever on it)
+                try:
+                    self.timeline.end(entry.name, shape=result.shape)
+                except Exception:  # noqa: BLE001
+                    pass
+                self.handles.mark_done(entry.handle, Status.ok(), result)
 
     # -- submission (API threads) --------------------------------------------
 
@@ -353,10 +491,19 @@ class Engine:
                 reason = f"{SHUT_DOWN_ERROR} (cause: {reason})"
             self._stop_requested = True  # before the flush: an enqueue
             # racing it must be rejected, not parked on a dead loop
+            self._crashed = True  # teardown ordering differs, see finally
             self._flush_outstanding(Status.unknown_error(reason))
         finally:
             self._stop_requested = True
             self._flush_outstanding(Status.unknown_error(SHUT_DOWN_ERROR))
+            crashed = getattr(self, "_crashed", False)
+            if not crashed and self._finalizer_q is not None:
+                # Clean shutdown: drain still-completing device batches
+                # BEFORE the control plane goes away. (FIFO: the sentinel
+                # lands behind them; the finalizer stops its own worker —
+                # stopping it here could strand an unsubmitted batch.)
+                self._finalizer_q.put(None)
+                self._finalizer.join(timeout=15.0)
             if self._client is not None:
                 # Never a clean detach: after a negotiated shutdown the
                 # controller ignores the drop anyway, and on the crash path
@@ -366,7 +513,29 @@ class Engine:
                 self._service.shutdown()
             if self._autotuner is not None:
                 self._autotuner.close()
-            self.timeline.close()
+            timeline_safe = True
+            if self._finalizer_q is not None:
+                if crashed:
+                    # Crash path: teardown first (the client drop IS the
+                    # death signal to peers — a 15 s drain would delay the
+                    # world abort), then drain; the watch-channel abort
+                    # unparks a finalizer stuck in a dead collective.
+                    self._finalizer_q.put(None)
+                    self._finalizer.join(timeout=15.0)
+                # Close the timeline only once the finalizer is done: it
+                # emits timeline events, and the native writer's close
+                # frees the C++ handle (a later write is a use-after-free).
+                timeline_safe = not self._finalizer.is_alive()
+            if self._device_worker is not None:
+                # best-effort: a worker blocked in a dead collective never
+                # consumes the sentinel, but it is a daemon thread
+                self._device_worker.stop()
+            if timeline_safe:
+                self.timeline.close()
+            else:
+                LOG.warning(
+                    "finalizer still completing at shutdown; leaving the "
+                    "timeline writer open to avoid a write-after-free")
             self._stopped.set()
 
     def _request_of(self, entry: TensorTableEntry) -> Request:
@@ -416,9 +585,16 @@ class Engine:
                 results = self._run_allgather(idx, entries[0], resp)
             else:
                 results = self._run_broadcast(idx, entries[0], resp)
-            for entry, result in zip(entries, results):
-                tl.end(entry.name, shape=result.shape)
-                self.handles.mark_done(entry.handle, Status.ok(), result)
+            if self._finalizer_q is not None and any(
+                    _is_jax_array(r) for r in results):
+                # Device results are asynchronous dispatches, not completed
+                # collectives: the finalizer marks these handles when the
+                # device work finishes (or the world aborts).
+                self._finalizer_q.put((entries, results))
+            else:
+                for entry, result in zip(entries, results):
+                    tl.end(entry.name, shape=result.shape)
+                    self.handles.mark_done(entry.handle, Status.ok(), result)
         except Exception as exc:  # noqa: BLE001
             from ..runner.network import WireError
 
@@ -457,7 +633,8 @@ class Engine:
             # staying on-GPU through the NCCL fusion buffer).
             for e in entries:
                 tl.activity_start(e.name, "EXECUTE")
-            results = self._plane.allreduce_onchip([e.array for e in entries])
+            results = self._device_call(self._plane.allreduce_onchip,
+                                        [e.array for e in entries])
             for e in entries:
                 tl.activity_end(e.name)
             return results
@@ -479,7 +656,8 @@ class Engine:
             # alias the caller's input array.
             out = np.array(buf, copy=True)
         elif self._plane is not None and self._plane.supports(dtype_of(buf)):
-            out = self._plane.allreduce(np.ascontiguousarray(buf))
+            out = self._device_call(self._plane.allreduce,
+                                    np.ascontiguousarray(buf))
         else:
             if self._plane is not None:
                 self._warn_host_fallback("allreduce", entries[0].name, buf)
@@ -510,15 +688,16 @@ class Engine:
                 return [entry.array]
             if self._plane is not None and self._plane.supports_move(
                     dtype_of(entry.array)):
-                return [self._plane.allgather_onchip(
-                    entry.array, resp.tensor_sizes)]
+                return [self._device_call(self._plane.allgather_onchip,
+                                          entry.array, resp.tensor_sizes)]
         arr = np.asarray(entry.array)  # lazy D2H for device submissions
         if self._client is None:
             return [arr.copy()]
         if self._plane is not None and self._plane.supports_move(
                 dtype_of(arr)):
-            return [self._plane.allgather(
-                np.ascontiguousarray(arr), resp.tensor_sizes)]
+            return [self._device_call(self._plane.allgather,
+                                      np.ascontiguousarray(arr),
+                                      resp.tensor_sizes)]
         if self._plane is not None:
             self._warn_host_fallback("allgather", entry.name, arr)
         raw = self._client.payload(
@@ -537,14 +716,15 @@ class Engine:
                 return [entry.array]
             if self._plane is not None and self._plane.supports_move(
                     dtype_of(entry.array)):
-                return [self._plane.broadcast_onchip(entry.array, root)]
+                return [self._device_call(self._plane.broadcast_onchip,
+                                          entry.array, root)]
         arr = np.asarray(entry.array)  # lazy D2H for device submissions
         if self._client is None:
             return [arr.copy()]
         if self._plane is not None and self._plane.supports_move(
                 dtype_of(arr)):
-            return [self._plane.broadcast(
-                np.ascontiguousarray(arr), root)]
+            return [self._device_call(self._plane.broadcast,
+                                      np.ascontiguousarray(arr), root)]
         if self._plane is not None:
             self._warn_host_fallback("broadcast", entry.name, arr)
         payload = np.ascontiguousarray(arr).tobytes() \

@@ -35,7 +35,7 @@ from .messages import (
     ResponseType,
 )
 
-_HELLO, _BYE, _CYCLE, _PAYLOAD = 1, 2, 3, 4
+_HELLO, _BYE, _CYCLE, _PAYLOAD, _WATCH = 1, 2, 3, 4, 5
 
 
 # -- body codec ---------------------------------------------------------------
@@ -163,6 +163,8 @@ class NativeControllerClient:
         self._client = BasicClient(addr, secret=secret,
                                    attempts=connect_attempts,
                                    timeout_s=timeout_s)
+        self._addr = addr
+        self._secret = secret
         self._rank = rank
         self._log_stalls = log_stalls
         self._cycle_no = 0
@@ -183,6 +185,34 @@ class NativeControllerClient:
     def payload(self, rank: int, response_idx: int, data: bytes) -> bytes:
         return decode_payload_response(self._client.request_raw(
             encode_payload(rank, self._last_cycle, response_idx, data)))
+
+    def watch(self, on_abort) -> None:
+        """Failure-push channel (same contract as
+        ``ControllerClient.watch``): one deferred-response kWatch request;
+        the service answers only on abort (error frame carrying the
+        reason) or stop."""
+        from .controller import spawn_watch_thread
+
+        def _request_reason(client) -> Optional[str]:
+            try:
+                _decode_status(client.request_raw(struct.pack("<B", _WATCH)))
+                return None  # clean stop
+            except WireError as exc:
+                # Only a decoded service ERROR FRAME carries the abort
+                # reason; any other WireError (EOF mid-message, HMAC) is a
+                # transport loss — re-raise so the shared watch loop
+                # reconnects instead of falsely aborting a healthy world.
+                reason = str(exc)
+                prefix = "service-side failure: "
+                if reason.startswith(prefix):
+                    reason = reason[len(prefix):]
+                    # the native service answers parked watchers with this
+                    # exact text on a clean Stop(); not an abort
+                    return None if reason == "controller stopping" else reason
+                raise
+
+        spawn_watch_thread(self._addr, self._secret, _request_reason,
+                           on_abort)
 
     def close(self, detach: bool = True) -> None:
         if detach and self._rank is not None:

@@ -385,6 +385,20 @@ class BasicClient:
             f"unable to connect to service at any of "
             f"{sorted(candidates.values())}: {last_err}")
 
+    def enable_keepalive(self, idle_s: int = 60, interval_s: int = 20,
+                         count: int = 3) -> None:
+        """TCP keepalive for long-idle connections (the controller watch
+        channel parks with zero traffic for the whole job): keeps NAT /
+        conntrack mappings alive and turns a silent middlebox drop into a
+        detectable error instead of a black hole."""
+        s = self._sock
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        for opt, val in (("TCP_KEEPIDLE", idle_s),
+                         ("TCP_KEEPINTVL", interval_s),
+                         ("TCP_KEEPCNT", count)):
+            if hasattr(socket, opt):  # Linux; other platforms keep defaults
+                s.setsockopt(socket.IPPROTO_TCP, getattr(socket, opt), val)
+
     def request(self, obj: Any) -> Any:
         with self._lock:
             self._wire.write(obj, self._sock)

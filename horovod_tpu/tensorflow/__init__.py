@@ -50,6 +50,13 @@ __all__ = [
 _name_lock = threading.Lock()
 _name_counter = 0
 
+# The XLA compile fence, quoted verbatim by XLA's unsupported-op error
+# (load-bearing three ways: the error message IS the remedy, the fence
+# test asserts on its fragments, and docs/parity.md quotes it).
+_XLA_FENCE_OP_NAME = (
+    "hvd_host_collective__not_XLA_compilable__"
+    "use_plain_tf_function_or_the_JAX_frontend__see_docs_parity_md")
+
 
 def _auto_name(op: str) -> str:
     """Deterministic fallback names, assigned in Python call order — the
@@ -100,10 +107,20 @@ def _eager_roundtrip(submit, t, keep_shape: bool = True):
 
 def _graph_op(fn, t, out_dtype, out_shape):
     """Wrap an engine roundtrip as a graph node. The python body runs at
-    step time on the host; the name was fixed at trace time by the caller."""
+    step time on the host; the name was fixed at trace time by the caller.
+
+    Compile boundary: ``EagerPyFunc`` has no XLA kernel, so this node
+    cannot live inside ``tf.function(jit_compile=True)`` / a TPU-compiled
+    graph. That is undetectable at trace time (the ``_XlaMustCompile``
+    attr is applied to the call op after tracing, and the FuncGraph
+    carries no marker — verified empirically), so the fence is the op
+    *name*: XLA's unsupported-op error quotes the node name verbatim,
+    turning "No registered 'EagerPyFunc' OpKernel" into an actionable
+    message pointing at docs/parity.md (which says: use plain
+    ``tf.function``, or the JAX front-end for compiled TPU steps)."""
     import tensorflow as tf
 
-    out = tf.py_function(fn, [t], Tout=out_dtype)
+    out = tf.py_function(fn, [t], Tout=out_dtype, name=_XLA_FENCE_OP_NAME)
     out.set_shape(out_shape)
     return out
 
@@ -366,7 +383,8 @@ def _allreduce_grads(grads, compression, sparse_as_dense: bool,
         reduced = _run(*compressed)
     else:
         reduced = tf.py_function(
-            _run, list(compressed), Tout=[t.dtype for t in compressed])
+            _run, list(compressed), Tout=[t.dtype for t in compressed],
+            name=_XLA_FENCE_OP_NAME)
         if not isinstance(reduced, (list, tuple)):
             reduced = [reduced]
         for r, t in zip(reduced, compressed):

@@ -438,6 +438,54 @@ def main() -> None:
         elapsed = time.monotonic() - t0
         assert elapsed < 30.0, f"unblocked only after {elapsed:.1f}s"
 
+    elif scenario == "peer_death_xla":
+        # The realistic TPU failure mode: a rank dies while its peers are
+        # blocked INSIDE a compiled XLA collective (gloo/ICI — not a TCP
+        # recv the controller can poison). The controller attributes the
+        # death and pushes the abort over the watch channel; survivors'
+        # engines abandon the stuck collective (``_DevicePlaneWorker``)
+        # and every outstanding handle fails with SHUT_DOWN_ERROR.
+        import time
+
+        import jax.numpy as jnp
+
+        from horovod_tpu.ops.engine import get_engine
+
+        victim = size - 1
+        hvd.allreduce(np.ones((4,), np.float32), average=False,
+                      name="px.barrier")
+        engine = get_engine()
+        assert engine._plane is not None, "scenario requires the XLA plane"
+        if rank == victim:
+            # Deterministic timing: this rank negotiates the collective
+            # (so every peer will issue the compiled psum) but dies at
+            # execution time, exactly when the survivors are inside it.
+            engine._plane.allreduce_onchip = \
+                lambda arrays: os._exit(3)  # type: ignore[method-assign]
+            hvd.allreduce_async(jnp.ones((64,), jnp.float32),
+                                average=False, name="px.trap")
+            time.sleep(60.0)  # the engine executes + exits from its loop
+            raise AssertionError("victim failed to die")
+        h = hvd.allreduce_async(jnp.full((64,), float(rank), jnp.float32),
+                                average=False, name="px.trap")
+        t0 = time.monotonic()
+        try:
+            hvd.synchronize(h)
+        except hvd.HorovodInternalError as exc:
+            assert "shut down" in str(exc), exc
+        else:
+            raise AssertionError(
+                "expected SHUT_DOWN_ERROR after peer death inside a "
+                "compiled collective")
+        elapsed = time.monotonic() - t0
+        assert elapsed < 30.0, f"unblocked only after {elapsed:.1f}s"
+        # Survivors exit hard: the jax.distributed shutdown barrier can
+        # never complete with the victim gone (the coordination service
+        # would FATAL this process ~90s later at interpreter teardown) —
+        # like the reference's survivors after mpirun kills a world.
+        print(f"WORKER-OK {os.environ['HOROVOD_RANK']}", flush=True)
+        os._exit(0)
+
     elif scenario == "local_crash":
         # A rank whose ENGINE dies from a local fault while its process
         # stays alive must still be treated as a rank death: its crash-path

@@ -213,6 +213,22 @@ def test_mp_peer_death_unblocks_survivors(controller):
 
 
 @CONTROLLERS
+def test_mp_peer_death_xla_plane_unblocks_survivors(controller):
+    """The TPU-realistic failure mode: the victim dies at collective
+    EXECUTION time, while survivors are blocked inside the compiled XLA
+    psum (gloo here, ICI on pods) — a place no poisoned control-plane
+    response can reach. The controller's watch channel pushes the abort;
+    survivors' engines abandon the stuck collective and surface
+    SHUT_DOWN_ERROR within the bound (reference operations.cc:1942-1957)."""
+    coord = f"127.0.0.1:{_free_port()}"
+    _run_world("peer_death_xla", 3, timeout=120.0,
+               expected_codes={2: 3},
+               extra_env={"HOROVOD_DATA_PLANE": "xla",
+                          "HOROVOD_TEST_JAX_COORD": coord,
+                          **_ctrl_env(controller)})
+
+
+@CONTROLLERS
 @pytest.mark.parametrize("scenario", ["subset_02", "subset_12"])
 def test_mp_subset_world(scenario, controller):
     """hvd.init(ranks=[...]) on a 3-process world: members communicate in

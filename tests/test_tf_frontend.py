@@ -61,6 +61,22 @@ def test_tf_function_graph_mode(hvd):
     np.testing.assert_array_equal(step(t).numpy(), t.numpy())
 
 
+def test_jit_compile_boundary_is_fenced(hvd):
+    """The graph path cannot compile under jit_compile=True (EagerPyFunc
+    has no XLA kernel; undetectable at trace time). The fence is the op
+    name: XLA's error must quote the self-explanatory node name so the
+    user lands on the remedy (docs/parity.md 'TF compile boundary')."""
+
+    @tf.function(jit_compile=True)
+    def step(x):
+        return hvd_tf.allreduce(x, average=False, name="tf.jit.ar")
+
+    with pytest.raises(tf.errors.InvalidArgumentError) as exc_info:
+        step(tf.constant([1.0, 2.0]))
+    assert "not_XLA_compilable" in str(exc_info.value)
+    assert "JAX_frontend" in str(exc_info.value)
+
+
 def test_distributed_gradient_tape(hvd):
     v = tf.Variable([1.0, 2.0])
     with tf.GradientTape() as tape:

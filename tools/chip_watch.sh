@@ -1,0 +1,40 @@
+#!/bin/bash
+# Watch the axon TPU tunnel; the moment it answers, capture the full
+# benchmark sequence (resnet50 protocol row, resnet101 bs64 anchor row,
+# vgg16, inception3) into bench_results_r3/.  The chip wedges for hours
+# at a time (rounds 1-2), so capture must be automatic and immediate.
+set -u
+cd /root/repo
+OUT=bench_results_r3
+mkdir -p "$OUT"
+log() { echo "[chip_watch $(date +%H:%M:%S)] $*" >> "$OUT/watch.log"; }
+
+log "watcher started (pid $$)"
+while true; do
+    timeout 90 python -c "import jax; print(jax.devices())" \
+        > "$OUT/probe.out" 2>&1
+    rc=$?
+    if [ $rc -eq 0 ] && grep -qi "axon\|tpu" "$OUT/probe.out"; then
+        log "chip ANSWERED: $(tail -1 "$OUT/probe.out")"
+        break
+    fi
+    log "probe rc=$rc (wedged); sleeping 240s"
+    sleep 240
+done
+
+run_bench() {
+    name="$1"; shift
+    log "bench $name starting: $*"
+    HOROVOD_BENCH_MEASURE_TIMEOUT=900 HOROVOD_BENCH_ATTEMPTS=2 \
+        timeout 2400 python bench.py "$@" \
+        > "$OUT/$name.json" 2> "$OUT/$name.log"
+    rc=$?
+    log "bench $name done rc=$rc: $(cat "$OUT/$name.json" 2>/dev/null | tail -1)"
+}
+
+run_bench resnet50
+run_bench resnet101_bs64 --model resnet101 --batch-size 64
+run_bench vgg16 --model vgg16
+run_bench inception3 --model inception3
+run_bench resnet50_bs128 --model resnet50 --batch-size 128
+log "ALL BENCHES DONE"

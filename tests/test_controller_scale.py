@@ -166,12 +166,7 @@ def test_payload_exchange_correct_at_scale(size):
         np.testing.assert_array_equal(results[rank], expected)
 
 
-def test_controller_bench_native_256_ranks():
-    """The scaling-evidence harness (docs/benchmarks.md table) must run and
-    the native service must keep 256-rank cycles bounded — the closest this
-    environment gets to the reference's 512-rank/5 ms coordinator
-    (``operations.cc:2030``). Bound is ~10x the measured median (9.9 ms on
-    this hardware) to absorb CI noise while still catching a collapse."""
+def _native_bench_median(size: int, cycles: int = 10) -> float:
     import os
     import subprocess
     import sys
@@ -184,10 +179,34 @@ def test_controller_bench_native_256_ranks():
     result = subprocess.run(
         [sys.executable, os.path.join(root, "benchmarks",
                                       "controller_bench.py"),
-         "--sizes", "256", "--impl", "native", "--cycles", "10"],
+         "--sizes", str(size), "--impl", "native", "--cycles", str(cycles)],
         cwd=root, capture_output=True, text=True, timeout=300)
     assert result.returncode == 0, result.stderr
+    # a child-side native-core load failure prints "native skipped: ..."
+    # and exits 0 — surface the cause, don't parse it as a data row
+    assert "skipped" not in result.stdout, result.stdout
     row = [l for l in result.stdout.splitlines()
            if l.startswith("native ")][0]
-    median_ms = float(row.split()[2])
+    return float(row.split()[2])
+
+
+def test_controller_bench_native_256_ranks():
+    """The scaling-evidence harness (docs/benchmarks.md table) must run and
+    the native service must keep 256-rank cycles bounded. Bound is ~10x
+    the measured median (9.4 ms epoll on this hardware) to absorb CI
+    noise while still catching a collapse."""
+    median_ms = _native_bench_median(256)
     assert median_ms < 100, f"256-rank median cycle {median_ms:.1f} ms"
+
+
+def test_controller_bench_native_512_ranks():
+    """512 ranks — the reference's published coordinator scale
+    (``operations.cc:2030``, 5 ms cycles). The epoll event loop measures
+    19.9 ms median here with every client GIL-bound on this machine's one
+    core; the coordinator-side share is ~2 ms (attribution in
+    docs/benchmarks.md). The bound catches a collapse (the old
+    thread-per-rank design would also pass this bound today — the epoll
+    win is thread count, worst-case latency, and memory, not median on a
+    one-core harness)."""
+    median_ms = _native_bench_median(512)
+    assert median_ms < 150, f"512-rank median cycle {median_ms:.1f} ms"

@@ -305,6 +305,16 @@ def main() -> None:
         step_flops = float(ca.get("flops", 0.0)) or None
     except Exception as e:  # noqa: BLE001 - cost model is best-effort
         log(f"cost_analysis unavailable: {e!r}")
+    dump = os.environ.get("HOROVOD_BENCH_DUMP_HLO")
+    if dump:
+        # the backend-optimized HLO (post AllReduceCombiner / fusion): the
+        # artifact for auditing dtypes and host transfers on real hardware
+        try:
+            with open(dump, "w") as f:
+                f.write(compiled.as_text())
+            log(f"compiled HLO written to {dump}")
+        except Exception as e:  # noqa: BLE001
+            log(f"HLO dump failed: {e!r}")
 
     def run_batch():
         nonlocal params, opt_state, batch_stats
@@ -348,7 +358,9 @@ def main() -> None:
         # FLOP/s at steps/s executed is already a per-device figure
         steps_per_s = mean / global_batch
         achieved = step_flops * steps_per_s
-        result["tflops_per_device"] = round(achieved / 1e12, 2)
+        # 4 decimals: tiny CPU validation runs land around 1e-3 TFLOP/s
+        # and must not round to a meaningless 0.0
+        result["tflops_per_device"] = round(achieved / 1e12, 4)
         peak_tf = _peak_tflops(jax.devices()[0])
         if peak_tf:
             result["mfu_pct"] = round(100.0 * achieved / (peak_tf * 1e12), 1)

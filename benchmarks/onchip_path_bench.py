@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Single-chip measurement of the device-resident eager path's claim.
+
+The eager engine keeps ``jax.Array`` submissions on-device through the
+fusion buffer (``ops/xla_plane.py`` ``allreduce_onchip``: jitted pack →
+bucketed psum → jitted unpack), the TPU analog of reference tensors
+staying on-GPU through the NCCL fusion buffer
+(``operations.cc:1115-1208``). The claim is that this beats staging the
+batch through host memory (per-entry D2H, host pack, H2D, collective,
+D2H, per-entry H2D back) — which is what the host-fed path costs a rank
+whose tensors live on an accelerator.
+
+A multi-process device-plane world cannot run on this environment's ONE
+real chip (one process per rank owns the chip), so this bench isolates
+exactly the staging difference on a single device: both paths run the
+same bucketed psum program over a 1-device mesh through the same
+``XlaDataPlane`` code; only the residency of the pack/unpack differs.
+Isolated this way the on-chip path wins even on CPU (~1.9x measured,
+docs/benchmarks.md) — the slower CPU number in fusion_bench's 2-process
+jax-input row comes from per-cycle negotiation, not from this staging
+path. On a real accelerator the avoided transfers cross PCIe, where the
+claim has teeth.
+
+Usage: python benchmarks/onchip_path_bench.py [--tensors 64]
+           [--elems 25000] [--rounds 20]
+Prints one JSON line: {"platform", "host_tensors_per_s",
+"onchip_tensors_per_s", "onchip_speedup"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--tensors", type=int, default=64)
+    parser.add_argument("--elems", type=int, default=25_000)
+    parser.add_argument("--rounds", type=int, default=20)
+    args = parser.parse_args()
+
+    import jax
+
+    pin = os.environ.get("HOROVOD_BENCH_PLATFORM")
+    if pin:
+        jax.config.update("jax_platforms", pin)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.ops.xla_plane import XlaDataPlane
+
+    class _Topo:
+        rank = 0
+        size = 1
+
+    plane = XlaDataPlane(_Topo())
+    platform = jax.devices()[0].platform
+    tensors = [jnp.full((args.elems,), float(i), jnp.float32)
+               for i in range(args.tensors)]
+    jax.block_until_ready(tensors)
+    shapes = [t.shape for t in tensors]
+
+    def host_path() -> None:
+        # the host-fed fused batch for device-resident inputs: D2H every
+        # entry, one host pack, then the shared collective (H2D + psum +
+        # D2H inside plane.allreduce), then per-entry H2D back
+        buf = np.concatenate([np.asarray(t).ravel() for t in tensors])
+        out = plane.allreduce(buf)
+        outs, off = [], 0
+        for shape in shapes:
+            n = int(np.prod(shape))
+            outs.append(jax.device_put(out[off:off + n].reshape(shape)))
+            off += n
+        jax.block_until_ready(outs)
+
+    def onchip_path() -> None:
+        jax.block_until_ready(plane.allreduce_onchip(tensors))
+
+    def measure(fn) -> float:
+        fn()  # warm the compile caches
+        fn()
+        t0 = time.perf_counter()
+        for _ in range(args.rounds):
+            fn()
+        dt = time.perf_counter() - t0
+        return args.rounds * args.tensors / dt
+
+    host_rate = measure(host_path)
+    onchip_rate = measure(onchip_path)
+    print(json.dumps({
+        "platform": platform,
+        "host_tensors_per_s": round(host_rate, 1),
+        "onchip_tensors_per_s": round(onchip_rate, 1),
+        "onchip_speedup": round(onchip_rate / host_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

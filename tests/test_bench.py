@@ -16,7 +16,13 @@ import sys
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_bench_end_to_end_cpu():
+def test_bench_end_to_end_cpu(tmp_path):
+    """One CPU run covers the whole artifact: the result line (including
+    the MFU additions — achieved TFLOP/s from the compiled module's cost
+    analysis; mfu_pct only appears on real accelerators) and the
+    HOROVOD_BENCH_DUMP_HLO audit dump, so the multi-minute AOT compile is
+    paid once."""
+    hlo_path = str(tmp_path / "step_hlo.txt")
     bootstrap = (
         "import jax; jax.config.update('jax_platforms', 'cpu'); "
         "import sys, runpy; "
@@ -28,7 +34,8 @@ def test_bench_end_to_end_cpu():
     )
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
-    env["HOROVOD_BENCH_PREFLIGHT"] = "0"
+    env.update({"HOROVOD_BENCH_PREFLIGHT": "0",
+                "HOROVOD_BENCH_DUMP_HLO": hlo_path})
     result = subprocess.run(
         [sys.executable, "-c", bootstrap], cwd=_ROOT, env=env,
         capture_output=True, text=True, timeout=560)
@@ -41,6 +48,28 @@ def test_bench_end_to_end_cpu():
     assert line["value"] > 0
     assert line["unit"] == "img/s"
     assert isinstance(line["vs_baseline"], float)
+    assert line["tflops_per_device"] > 0
+    assert "mfu_pct" not in line  # meaningless on CPU, by design
+    with open(hlo_path) as f:
+        hlo = f.read()
+    assert "ENTRY" in hlo or "HloModule" in hlo
+
+
+def test_onchip_path_bench_cpu():
+    """The single-device residency bench (docs/benchmarks.md) must run and
+    produce its comparison row."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["HOROVOD_BENCH_PLATFORM"] = "cpu"
+    result = subprocess.run(
+        [sys.executable,
+         os.path.join(_ROOT, "benchmarks", "onchip_path_bench.py"),
+         "--tensors", "8", "--elems", "1024", "--rounds", "3"],
+        cwd=_ROOT, env=env, capture_output=True, text=True, timeout=560)
+    assert result.returncode == 0, result.stderr
+    line = json.loads(result.stdout.strip().splitlines()[-1])
+    assert line["host_tensors_per_s"] > 0
+    assert line["onchip_tensors_per_s"] > 0
 
 
 def test_bench_supervised_path_cpu():

@@ -113,3 +113,47 @@ def test_eager_spmd_equivalence(hvd):
                                  out_specs=P()))(x)
     eager_out = hvd.allreduce(np.full((4,), 2.0, np.float32), average=True)
     np.testing.assert_allclose(np.asarray(spmd_out)[0], np.asarray(eager_out))
+
+
+def test_dp_step_compiles_to_one_fused_allreduce(hvd):
+    """Perf hygiene on the multi-chip product path: the compiled DP train
+    step must carry its ~100 per-leaf gradient psums + BN pmeans as a
+    handful of fused all-reduces spanning the whole mesh (XLA's
+    AllReduceCombiner is the compiled-away fusion buffer), and must not
+    reshard replicated params (no all-to-all / collective-permute /
+    all-gather / reduce-scatter). A regression here — e.g. an optimizer
+    change that breaks combining, or a spec change that secretly shards
+    params — multiplies per-step collective launches or moves param-sized
+    traffic every step, the two failure modes that silently destroy
+    scaling efficiency."""
+    import re
+
+    import optax
+    from jax.sharding import Mesh
+
+    from benchmarks._dp_step import make_dp_train_step
+    from horovod_tpu.models import ResNet
+    from horovod_tpu.models.resnet import BottleneckResNetBlock
+
+    devices = jax.devices()[:8]
+    mesh = Mesh(np.asarray(devices).reshape(2, 4), ("dcn", "ici"))
+    model = ResNet(stage_sizes=[1, 1], num_filters=8, num_classes=10,
+                   block_cls=BottleneckResNetBlock, dtype=jnp.float32)
+    x = jnp.ones((16, 16, 16, 3), jnp.float32)
+    y = jnp.zeros((16,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01),
+                                   axis_name=("dcn", "ici"))
+    opt_state = opt.init(params)
+    step = make_dp_train_step(model, opt, mesh, axis_name=("dcn", "ici"))
+    hlo = step.lower(params, opt_state, batch_stats, x, y).compile().as_text()
+
+    n_ar = len(re.findall(r"all-reduce\(|all-reduce-start", hlo))
+    assert 1 <= n_ar <= 4, f"{n_ar} all-reduce ops (combiner broken?)"
+    groups = set(re.findall(r"replica_groups=(\{\{[^}]*\}\})", hlo))
+    assert groups == {"{{0,1,2,3,4,5,6,7}}"}, groups  # whole-mesh groups
+    # bare substrings so the async -start/-done spellings match too
+    for op in ("all-to-all", "collective-permute", "all-gather",
+               "reduce-scatter"):
+        assert op not in hlo, f"unexpected {op} in the DP step"

@@ -210,3 +210,76 @@ def test_controller_bench_native_512_ranks():
     one-core harness)."""
     median_ms = _native_bench_median(512)
     assert median_ms < 150, f"512-rank median cycle {median_ms:.1f} ms"
+
+
+def test_watch_channel_reconnects_on_transient_drop():
+    """The abort-push channel idles for the whole job; a transient
+    connection failure must RECONNECT and re-park (a false abort would
+    kill a healthy world), and the eventual real abort must be delivered
+    through the re-established channel exactly once."""
+    from horovod_tpu.runner.network import BasicService
+
+    state = {"watch_requests": 0}
+    gate = threading.Event()
+
+    def handle(req, _sock):
+        assert req == ("watch",)
+        state["watch_requests"] += 1
+        if state["watch_requests"] == 1:
+            # -> RemoteError -> client-side WireError -> reconnect path
+            raise RuntimeError("synthetic transient watch failure")
+        gate.wait(timeout=30)
+        return ("abort", "rank 1 exited mid-job. shut down")
+
+    svc = BasicService("fake-controller", handle, secret=SECRET, port=0)
+    client = ControllerClient(("127.0.0.1", svc.port), secret=SECRET)
+    reasons: list[str] = []
+    fired = threading.Event()
+
+    def on_abort(reason: str) -> None:
+        reasons.append(reason)
+        fired.set()
+
+    client.watch(on_abort)
+    deadline = time.monotonic() + 20
+    while state["watch_requests"] < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert state["watch_requests"] == 2, "watch did not reconnect"
+    assert not fired.is_set(), "transient drop must not abort the world"
+    gate.set()
+    assert fired.wait(10), "abort was not delivered after reconnect"
+    assert reasons == ["rank 1 exited mid-job. shut down"]
+    svc.shutdown()
+    client.close()
+
+
+def test_watch_channel_clean_stop_fires_nothing():
+    """A clean controller stop answers parked watchers with a non-abort
+    response; the callback must NOT fire (a spurious abort would race the
+    engine's finalizer draining its last batches at shutdown)."""
+    cfg = Config.from_env()
+    service = ControllerService(2, make_negotiator(2, cfg),
+                                secret=SECRET, port=0)
+    client = ControllerClient(("127.0.0.1", service.port), secret=SECRET)
+    fired = threading.Event()
+    client.watch(lambda reason: fired.set())
+    time.sleep(0.8)  # let the watch request park
+    service.shutdown()
+    assert not fired.wait(2.0), "clean stop fired the abort callback"
+    # and the watcher must have RETURNED — a parked-forever watcher or one
+    # stuck in the reconnect loop would also leave `fired` unset, but
+    # those are the hang/spurious-abort regressions this test guards
+    _assert_watch_threads_exit()
+    client.close()
+
+
+def _assert_watch_threads_exit(timeout_s: float = 10.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name == "horovod-abort-watch" and t.is_alive()]
+        if not alive:
+            return
+        time.sleep(0.1)
+    raise AssertionError(
+        f"watch thread(s) still running after clean stop: {alive}")

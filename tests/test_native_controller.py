@@ -252,3 +252,22 @@ def test_cycle_latency_bounded_at_32_ranks_native():
     assert median < 0.1, f"median cycle {median * 1e3:.1f} ms at 32 ranks"
     assert max(latencies) < 0.5, \
         f"worst cycle {max(latencies) * 1e3:.0f} ms at 32 ranks"
+
+
+def test_native_watch_clean_stop_fires_nothing():
+    """Parity with the Python twin: the native service answers parked
+    watchers with 'controller stopping' on a clean Stop(), which the
+    client maps to a clean termination — no abort callback, and the
+    watcher thread returns (vs parking forever / reconnect-looping)."""
+    from test_controller_scale import _assert_watch_threads_exit
+
+    svc = _service(2)
+    client = NativeControllerClient(("127.0.0.1", svc.port), secret=SECRET,
+                                    rank=0)
+    fired = threading.Event()
+    client.watch(lambda reason: fired.set())
+    time.sleep(0.8)  # let the watch request park
+    svc.shutdown()
+    assert not fired.wait(2.0), "clean stop fired the abort callback"
+    _assert_watch_threads_exit()
+    client.close()

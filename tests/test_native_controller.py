@@ -271,3 +271,72 @@ def test_native_watch_clean_stop_fires_nothing():
     assert not fired.wait(2.0), "clean stop fired the abort callback"
     _assert_watch_threads_exit()
     client.close()
+
+
+def test_native_controller_survives_adversarial_connections():
+    """Epoll-loop robustness: garbage, oversized length claims, partial
+    frames, a parked slow-loris, and rapid anonymous connect/close churn
+    (the NIC-probe pattern) must neither crash the coordinator nor abort
+    a healthy world sharing it — anonymous connections carry no rank, so
+    their disconnects are never rank deaths, and a malformed or
+    unauthenticated frame costs exactly that one connection."""
+    import socket
+    import struct
+
+    svc = _service(2)
+    addr = ("127.0.0.1", svc.port)
+    held: list = []
+    try:
+        # 1. oversized length claim (> the 2^31 bound): dropped pre-alloc
+        s = socket.create_connection(addr)
+        held.append(s)
+        s.sendall(b"\x00" * 32 + struct.pack(">Q", 1 << 40) + b"x" * 64)
+        # 2. plausible length, garbage HMAC: dropped at authentication
+        s2 = socket.create_connection(addr)
+        held.append(s2)
+        s2.sendall(b"\xab" * 32 + struct.pack(">Q", 16) + b"y" * 16)
+        # 3. partial frame then abrupt close
+        s3 = socket.create_connection(addr)
+        s3.sendall(b"\x01\x02\x03")
+        s3.close()
+        # 4. slow loris: a valid-looking header prefix, then silence — the
+        #    parked fd must not block the event loop for everyone else
+        s4 = socket.create_connection(addr)
+        held.append(s4)
+        s4.sendall(b"\x00" * 20)
+        # 5. connect/close churn (anonymous probes)
+        for _ in range(50):
+            socket.create_connection(addr).close()
+
+        # a healthy 2-rank world on the SAME (attacked) coordinator must
+        # still negotiate — clients connect to svc.port, not a fresh one
+        outs = {}
+        errors = []
+
+        def worker(rank):
+            try:
+                client = NativeControllerClient(addr, secret=SECRET,
+                                                rank=rank)
+                out = client.cycle(rank, RequestList(
+                    rank=rank, requests=[_request(rank, "adv.t")]))
+                outs[rank] = [n for r in out.responses
+                              for n in r.tensor_names]
+                client.close()
+            except BaseException as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert outs == {0: ["adv.t"], 1: ["adv.t"]}
+    finally:
+        for sock in held:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        svc.shutdown()

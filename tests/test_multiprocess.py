@@ -177,6 +177,17 @@ def test_mp_xla_plane_three_ranks(scenario):
     _run_world_xla(scenario, 3)
 
 
+@pytest.mark.skipif(not _cc.available(),
+                    reason="native core not built")
+def test_mp_xla_plane_eight_ranks():
+    """The largest real-process device-plane world the suite runs: 8
+    gloo-backed processes through the epoll coordinator, watch channels,
+    and finalizer completion — the host-plane sibling is
+    test_mp_allreduce_eight_ranks_native."""
+    _run_world_xla("allreduce", 8, timeout=420.0,
+                   extra_env=_ctrl_env("native"))
+
+
 @CONTROLLERS
 def test_mp_autotune_end_to_end(tmp_path, controller):
     """HOROVOD_AUTOTUNE=1 on a real 2-process world: the coordinator's

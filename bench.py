@@ -327,6 +327,31 @@ def main() -> None:
     jax.block_until_ready(params)
 
     img_secs = []
+    # HOROVOD_BENCH_PROFILE=<dir>: capture a device profile (XPlane trace,
+    # readable in TensorBoard/xprof) of one warm batch BEFORE the timed
+    # iterations, so trace overhead never pollutes the reported numbers —
+    # the artifact that attributes a low-MFU step to its actual bottleneck
+    # (HBM-bound kernels, gaps, host sync) on real hardware.
+    profile_dir = os.environ.get("HOROVOD_BENCH_PROFILE")
+    if profile_dir:
+        tracing = False
+        try:
+            jax.profiler.start_trace(profile_dir)
+            tracing = True
+            run_batch()
+            jax.block_until_ready(params)
+            log(f"profile written to {profile_dir}")
+        except Exception as e:  # noqa: BLE001 - profiling is best-effort
+            log(f"profile capture failed: {e!r}")
+        finally:
+            if tracing:
+                # always stop: a live trace across the timed loop below
+                # would silently deflate every reported number
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:  # noqa: BLE001
+                    log(f"stop_trace failed: {e!r}")
+
     for i in range(args.num_iters):
         t0 = time.perf_counter()
         for _ in range(args.num_batches_per_iter):

@@ -32,7 +32,8 @@ run_bench() {
     log "bench $name done rc=$rc: $(cat "$OUT/$name.json" 2>/dev/null | tail -1)"
 }
 
-HOROVOD_BENCH_DUMP_HLO="$OUT/resnet50_hlo.txt" run_bench resnet50
+HOROVOD_BENCH_DUMP_HLO="$OUT/resnet50_hlo.txt" \
+    HOROVOD_BENCH_PROFILE="$OUT/resnet50_profile" run_bench resnet50
 run_bench resnet101_bs64 --model resnet101 --batch-size 64
 run_bench vgg16 --model vgg16
 run_bench inception3 --model inception3

@@ -276,9 +276,9 @@ class ControllerServer {
     if (epoll_fd_ < 0 || wake_fd_ < 0) { *err = "epoll/eventfd failed"; return false; }
     epoll_event ev{};
     ev.events = EPOLLIN;
-    ev.data.fd = listen_fd_;
+    ev.data.u64 = Tag(listen_fd_, 0);
     ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
-    ev.data.fd = wake_fd_;
+    ev.data.u64 = Tag(wake_fd_, 0);
     ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
     loop_thread_ = std::thread([this] { EventLoop(); });
     return true;
@@ -348,7 +348,18 @@ class ControllerServer {
     size_t woff = 0;
     int rank = -1;      // set by hello/cycle/payload; -1 = anonymous probe
     bool out_armed = false;
+    uint32_t gen = 0;   // guards against stale events after fd reuse
   };
+
+  // epoll event payload: (generation << 32) | fd. A CloseConn + accept
+  // inside one epoll_wait batch can reuse the fd number; a stale event
+  // captured before the close must not act on the NEW connection (worst
+  // case: its EPOLLHUP would drop a fresh rank at init). The generation
+  // check makes stale entries inert.
+  static uint64_t Tag(int fd, uint32_t gen) {
+    return (static_cast<uint64_t>(gen) << 32) |
+           static_cast<uint32_t>(fd);
+  }
 
   void EventLoop() {
     std::vector<epoll_event> events(256);
@@ -360,7 +371,8 @@ class ControllerServer {
         break;
       }
       for (int i = 0; i < n; ++i) {
-        int fd = events[i].data.fd;
+        int fd = static_cast<int>(events[i].data.u64 & 0xffffffffu);
+        uint32_t gen = static_cast<uint32_t>(events[i].data.u64 >> 32);
         uint32_t ev = events[i].events;
         if (fd == wake_fd_) {
           uint64_t v;
@@ -372,7 +384,8 @@ class ControllerServer {
           continue;
         }
         auto it = conns_.find(fd);
-        if (it == conns_.end()) continue;  // closed earlier this batch
+        if (it == conns_.end() || it->second.gen != gen)
+          continue;  // closed earlier this batch (or the fd was reused)
         if (ev & (EPOLLHUP | EPOLLERR)) {
           CloseConn(fd);
           continue;
@@ -446,11 +459,13 @@ class ControllerServer {
       ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &idle, sizeof(idle));
       ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof(intvl));
       ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof(cnt));
+      Conn& c = conns_[fd];
+      c = Conn{};
+      c.gen = ++conn_gen_;
       epoll_event ev{};
       ev.events = EPOLLIN;
-      ev.data.fd = fd;
+      ev.data.u64 = Tag(fd, c.gen);
       ::epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
-      conns_[fd];
     }
   }
 
@@ -549,7 +564,7 @@ class ControllerServer {
     if (need_out != c->out_armed) {
       epoll_event ev{};
       ev.events = EPOLLIN | (need_out ? EPOLLOUT : 0u);
-      ev.data.fd = fd;
+      ev.data.u64 = Tag(fd, c->gen);
       ::epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, fd, &ev);
       c->out_armed = need_out;
     }
@@ -559,6 +574,7 @@ class ControllerServer {
     auto it = conns_.find(fd);
     if (it == conns_.end()) return;
     int rank = it->second.rank;
+    if (rank >= 0) DeidentifyConn(fd, rank);
     ::epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, fd, nullptr);
     ::close(fd);
     conns_.erase(it);
@@ -567,6 +583,30 @@ class ControllerServer {
     for (auto& kv : payloads_) EraseWaiter(&kv.second.waiters, fd);
     EraseWaiter(&watch_fds_, fd);
     if (rank >= 0) AbortWorld(rank);
+  }
+
+  // Bind fd to rank; a NEW connection for a rank SUPERSEDES any previous
+  // one (de-identified, not closed), so a client that reconnects — e.g.
+  // its hello reply was lost to a transient reset and it retried — does
+  // not get the stale connection's eventual close attributed as its own
+  // death. The rank_fds_ reverse map keeps the supersede O(1): an init
+  // hello storm at large world sizes must not become an O(N^2) scan on
+  // the one event-loop thread.
+  void IdentifyConn(int fd, int rank) {
+    Conn& c = conns_[fd];
+    if (c.rank == rank) return;
+    auto it = rank_fds_.find(rank);
+    if (it != rank_fds_.end() && it->second != fd) {
+      auto old = conns_.find(it->second);
+      if (old != conns_.end()) old->second.rank = -1;
+    }
+    rank_fds_[rank] = fd;
+    c.rank = rank;
+  }
+
+  void DeidentifyConn(int fd, int rank) {
+    auto it = rank_fds_.find(rank);
+    if (it != rank_fds_.end() && it->second == fd) rank_fds_.erase(it);
   }
 
   static void EraseWaiter(std::vector<int>* waiters, int fd) {
@@ -633,7 +673,7 @@ class ControllerServer {
     switch (kind) {
       case kHello: {
         int32_t rank = r.Get<int32_t>();
-        conns_[fd].rank = rank;
+        IdentifyConn(fd, rank);
         Writer w;
         w.Put<uint8_t>(0);
         return QueueWrite(fd, FrameBody(w.out));
@@ -641,7 +681,9 @@ class ControllerServer {
       case kBye: {
         // De-identify: the close that follows a farewell is orderly, not a
         // rank death (the threaded design erased conn_ranks_ the same way).
-        conns_[fd].rank = -1;
+        Conn& c = conns_[fd];
+        if (c.rank >= 0) DeidentifyConn(fd, c.rank);
+        c.rank = -1;
         Writer w;
         w.Put<uint8_t>(0);
         return QueueWrite(fd, FrameBody(w.out));
@@ -692,7 +734,7 @@ class ControllerServer {
     }
     if (!r->ok) return QueueWrite(fd, ErrorResp("malformed cycle request"));
 
-    conns_[fd].rank = rank;
+    IdentifyConn(fd, rank);
     {
       std::lock_guard<std::mutex> guard(mutex_);
       if (!abort_reason_.empty())
@@ -792,7 +834,7 @@ class ControllerServer {
       return QueueWrite(fd, ErrorResp("malformed payload"));
     std::string data = r->GetBytes(data_len);
 
-    conns_[fd].rank = rank;
+    IdentifyConn(fd, rank);
     {
       std::lock_guard<std::mutex> guard(mutex_);
       if (!abort_reason_.empty())
@@ -888,6 +930,8 @@ class ControllerServer {
 
   // loop-thread-owned (no lock):
   std::unordered_map<int, Conn> conns_;
+  std::unordered_map<int, int> rank_fds_;  // rank -> identified fd
+  uint32_t conn_gen_ = 0;  // per-accept generation for stale-event guard
   std::vector<int> watch_fds_;  // parked abort-watch connections
   std::unordered_map<int, int64_t> rank_cycles_;
   std::map<int64_t, CycleSlot> cycles_;

@@ -27,7 +27,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.logging import LOG
-from ..runner.network import BasicClient, BasicService, Preserialized
+from ..runner.network import (
+    BasicClient,
+    BasicService,
+    ConnectionClosedError,
+    Preserialized,
+    WireError,
+)
 from .messages import (
     DataType,
     Request,
@@ -393,6 +399,7 @@ class ControllerService:
         # it must be unblocked with SHUT_DOWN_ERROR (the reference's
         # "exception on one of the ranks" semantics, operations.cc:1942-1957).
         self._conn_ranks: Dict[int, int] = {}
+        self._rank_conns: Dict[int, int] = {}  # rank -> id(sock), reverse
         self._world_shutdown = False
         self._abort_fired = False
         # Failure-push channel: "watch" requests park here until the world
@@ -406,9 +413,17 @@ class ControllerService:
             bind_host=bind_host, on_disconnect=self._on_disconnect)
         self.port = self._service.port
 
+    def _deregister(self, sock: Any) -> Optional[int]:
+        """Drop the connection's rank binding (caller holds ``_lock``);
+        returns the rank it carried, if any."""
+        rank = self._conn_ranks.pop(id(sock), None)
+        if rank is not None and self._rank_conns.get(rank) == id(sock):
+            del self._rank_conns[rank]
+        return rank
+
     def _on_disconnect(self, sock: Any) -> None:
         with self._lock:
-            rank = self._conn_ranks.pop(id(sock), None)
+            rank = self._deregister(sock)
             if rank is None or self._world_shutdown:
                 return
             first = not self._abort_fired
@@ -437,7 +452,7 @@ class ControllerService:
             # world shutdown (tests, tooling): de-register so the
             # subsequent connection close is not mistaken for a rank death.
             with self._lock:
-                self._conn_ranks.pop(id(_sock), None)
+                self._deregister(_sock)
             return ("ok",)
         if kind == "watch":
             # Abort push channel: the response is DEFERRED until the world
@@ -456,6 +471,14 @@ class ControllerService:
         # close without sending) are never mistaken for dead ranks.
         rank = req[1]
         with self._lock:
+            # A NEW connection for a rank SUPERSEDES any previous one
+            # (de-identified, not closed): a client that reconnects — its
+            # hello reply lost to a transient reset — must not have the
+            # stale connection's close attributed as its own death.
+            old = self._rank_conns.get(rank)
+            if old is not None and old != id(_sock):
+                self._conn_ranks.pop(old, None)
+            self._rank_conns[rank] = id(_sock)
             self._conn_ranks[id(_sock)] = rank
         if kind == "hello":
             return ("ok",)
@@ -574,6 +597,39 @@ def _combine(resp: Response, slot: Dict[int, bytes]) -> bytes:
     raise ValueError(f"cannot combine payload for {resp.response_type}")
 
 
+def connect_with_hello(addr, secret, timeout_s, connect_attempts,
+                       hello) -> BasicClient:
+    """Connect and identify, retrying the connect+hello PAIR as a unit.
+
+    On re-init (``shutdown(); init()`` on the same port) a connect can
+    land in the DYING previous service's kernel backlog — accepted by the
+    kernel, closed unserved when its event loop exits — so the hello gets
+    EOF (or RST) despite a "successful" connect. Only connection-level
+    failures retry; a decoded server response (error frame / RemoteError,
+    e.g. protocol mismatch or an abort in progress) is deliberate and
+    final. The server side tolerates the retry of a hello whose reply was
+    lost: a new connection for a rank supersedes the old registration, so
+    the stale close is not a rank death."""
+    last: Optional[Exception] = None
+    for _ in range(10):
+        client = BasicClient(addr, secret=secret, timeout_s=timeout_s,
+                             attempts=connect_attempts)
+        try:
+            hello(client)
+            return client
+        except (WireError, OSError) as exc:
+            client.close()
+            # EOF (ConnectionClosedError) or RST/reset (OSError) are
+            # transport losses; any other WireError is a decoded server
+            # frame or an authentication failure — deliberate and final
+            if not isinstance(exc, (ConnectionClosedError, OSError)):
+                raise
+            last = exc
+            time.sleep(0.3)
+    raise WireError(
+        f"controller hello failed after retries: {last}") from last
+
+
 def spawn_watch_thread(addr, secret, request_reason, on_abort) -> None:
     """Shared scaffolding for both controller clients' failure-push
     channel: a daemon thread opens a second, anonymous connection and
@@ -646,20 +702,23 @@ class ControllerClient:
                  timeout_s: Optional[float] = None,
                  connect_attempts: int = 100,
                  rank: Optional[int] = None) -> None:
-        # Generous connect window: ranks race the coordinator's service
-        # startup (JAX import time dominates), like orted waiting on the
-        # reference's driver registration (``util/timeout.py``).
-        self._client = BasicClient(addr, secret=secret, timeout_s=timeout_s,
-                                   attempts=connect_attempts)
         self._addr = addr
         self._secret = secret
         self._cycle_no = 0
         self._rank = rank
-        if rank is not None:
-            # Identify immediately so the controller can attribute a
-            # connection drop to this rank even if the process dies before
-            # its first cycle.
-            self._client.request(("hello", rank))
+        # Generous connect window: ranks race the coordinator's service
+        # startup (JAX import time dominates), like orted waiting on the
+        # reference's driver registration (``util/timeout.py``). Identify
+        # immediately so the controller can attribute a connection drop to
+        # this rank even if the process dies before its first cycle.
+        if rank is None:
+            self._client = BasicClient(addr, secret=secret,
+                                       timeout_s=timeout_s,
+                                       attempts=connect_attempts)
+        else:
+            self._client = connect_with_hello(
+                addr, secret, timeout_s, connect_attempts,
+                hello=lambda c: c.request(("hello", rank)))
 
     def cycle(self, rank: int, request_list: RequestList) -> ResponseList:
         # The controller registers this connection under ``rank`` for

@@ -160,17 +160,25 @@ class NativeControllerClient:
                  log_stalls: bool = False) -> None:
         from ..runner.network import BasicClient
 
-        self._client = BasicClient(addr, secret=secret,
-                                   attempts=connect_attempts,
-                                   timeout_s=timeout_s)
         self._addr = addr
         self._secret = secret
         self._rank = rank
         self._log_stalls = log_stalls
         self._cycle_no = 0
         self._last_cycle = 0
-        if rank is not None:
-            _decode_status(self._client.request_raw(encode_hello(rank)))
+        if rank is None:
+            self._client = BasicClient(addr, secret=secret,
+                                       attempts=connect_attempts,
+                                       timeout_s=timeout_s)
+        else:
+            # connect+hello retried as a unit against a dying previous
+            # service on the same port (see connect_with_hello)
+            from .controller import connect_with_hello
+
+            self._client = connect_with_hello(
+                addr, secret, timeout_s, connect_attempts,
+                hello=lambda c: _decode_status(
+                    c.request_raw(encode_hello(rank))))
 
     def cycle(self, rank: int, request_list: RequestList) -> ResponseList:
         if self._rank is None:

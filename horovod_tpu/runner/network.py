@@ -31,6 +31,12 @@ class WireError(RuntimeError):
     pass
 
 
+class ConnectionClosedError(WireError):
+    """The peer closed mid-message — a transport-level loss, retryable by
+    callers that can reconnect (unlike decoded server error frames, which
+    are deliberate and final)."""
+
+
 class RemoteError:
     """Marker a service writes back when its handler raised; the client
     re-raises it as a WireError so request() never silently returns one."""
@@ -130,7 +136,7 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
-            raise WireError("connection closed mid-message")
+            raise ConnectionClosedError("connection closed mid-message")
         buf.extend(chunk)
     return bytes(buf)
 

@@ -283,3 +283,79 @@ def _assert_watch_threads_exit(timeout_s: float = 10.0) -> None:
         time.sleep(0.1)
     raise AssertionError(
         f"watch thread(s) still running after clean stop: {alive}")
+
+
+def test_hello_retries_through_dying_server_backlog():
+    """Re-init race (shutdown(); init() on the same port): a connect can
+    land in the DYING previous service's kernel backlog — the kernel
+    accepts it, the exiting event loop closes it unserved — so the hello
+    gets EOF despite a successful connect. The client must retry the
+    connect+hello pair, not give up on the first EOF."""
+    import socket
+
+    from horovod_tpu.runner.network import Wire
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    port = lsock.getsockname()[1]
+    wire = Wire(SECRET)
+    served = {"conns": 0}
+
+    def server() -> None:
+        # conn 1: the dying-server backlog victim — closed unserved
+        conn, _ = lsock.accept()
+        served["conns"] += 1
+        conn.close()
+        # conn 2: a live service — answer the hello properly
+        conn, _ = lsock.accept()
+        served["conns"] += 1
+        req = wire.read(conn)
+        assert req == ("hello", 0), req
+        conn.sendall(wire.frame(("ok",)))
+        conn.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    client = ControllerClient(("127.0.0.1", port), secret=SECRET, rank=0)
+    t.join(timeout=10)
+    assert served["conns"] == 2  # first EOF'd, second served the hello
+    client.close()
+    lsock.close()
+
+
+def test_reconnect_supersedes_old_connection():
+    """A second connection identifying as rank R supersedes the first:
+    the stale connection's abrupt close (no bye) must NOT be attributed
+    as rank R's death — the scenario behind a retried hello whose reply
+    was lost. The world must still complete a full cycle afterwards."""
+    cfg = Config.from_env()
+    service = ControllerService(2, make_negotiator(2, cfg),
+                                secret=SECRET, port=0)
+    addr = ("127.0.0.1", service.port)
+    c1 = ControllerClient(addr, secret=SECRET, rank=0)
+    c2 = ControllerClient(addr, secret=SECRET, rank=0)  # supersedes c1
+    c1._client.close()  # abrupt: no bye — must be an anonymous close now
+    time.sleep(0.5)  # give the disconnect monitor a chance to misfire
+    outs = {}
+    errors: list[BaseException] = []
+
+    def rank1() -> None:
+        try:
+            c = ControllerClient(addr, secret=SECRET, rank=1)
+            outs[1] = c.cycle(1, RequestList(
+                rank=1, requests=[_request(1, "sup.t")]))
+            c.close()
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    t = threading.Thread(target=rank1)
+    t.start()
+    outs[0] = c2.cycle(0, RequestList(rank=0,
+                                      requests=[_request(0, "sup.t")]))
+    t.join(timeout=30)
+    service.shutdown()
+    assert not errors, errors
+    for out in outs.values():
+        assert [n for r in out.responses for n in r.tensor_names] == \
+            ["sup.t"]

@@ -340,3 +340,37 @@ def test_native_controller_survives_adversarial_connections():
             except OSError:
                 pass
         svc.shutdown()
+
+
+def test_native_reconnect_supersedes_old_connection():
+    """Parity with the Python twin: a reconnecting rank's stale
+    connection close is not a rank death; the world still cycles."""
+    svc = _service(2)
+    addr = ("127.0.0.1", svc.port)
+    c1 = NativeControllerClient(addr, secret=SECRET, rank=0)
+    c2 = NativeControllerClient(addr, secret=SECRET, rank=0)  # supersedes
+    c1._client.close()  # abrupt, no bye
+    time.sleep(0.5)
+    outs = {}
+    errors = []
+
+    def rank1():
+        try:
+            c = NativeControllerClient(addr, secret=SECRET, rank=1)
+            outs[1] = c.cycle(1, RequestList(
+                rank=1, requests=[_request(1, "sup.t")]))
+            c.close()
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    t = threading.Thread(target=rank1)
+    t.start()
+    outs[0] = c2.cycle(0, RequestList(rank=0,
+                                      requests=[_request(0, "sup.t")]))
+    t.join(timeout=30)
+    c2.close()
+    svc.shutdown()
+    assert not errors, errors
+    for out in outs.values():
+        assert [n for r in out.responses for n in r.tensor_names] == \
+            ["sup.t"]

@@ -90,6 +90,19 @@ def test_run_fn_job():
     assert "OK" in out.stdout
 
 
+def test_jax_tabular_job():
+    """The end-to-end data job (keras_spark_rossmann analog): driver
+    feature engineering -> run_fn training world with sharded rows,
+    warmup, metric averaging, rank-0 checkpoint -> driver restore +
+    submission CSV."""
+    out = _run_example("jax_tabular_job.py",
+                       ["--rows", "768", "--epochs", "2",
+                        "--batch-size", "96"],
+                       env={"EXAMPLE_PLATFORM": "cpu"}, timeout=420.0)
+    assert "submission written" in out.stdout
+    assert "OK" in out.stdout
+
+
 def test_jax_mnist():
     out = _run_example("jax_mnist.py",
                        ["--epochs", "1", "--batch-size", "8"])

@@ -17,7 +17,10 @@ set -u
 cd /root/repo
 OUT=bench_results_r3
 mkdir -p "$OUT"
-export JAX_COMPILATION_CACHE_DIR="$OUT/jax_cache"
+# bench.py defaults JAX_COMPILATION_CACHE_DIR to the repo-local
+# .jax_bench_cache shared by watcher/driver/human runs; the probe below
+# exports it explicitly so its own tiny compile also lands there.
+export JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_bench_cache"
 log() { echo "[chip_watch2 $(date +%H:%M:%S)] $*" >> "$OUT/watch.log"; }
 
 compute_probe() {

@@ -69,8 +69,14 @@ def _preflight_backend(attempts: Optional[int] = None,
     backoff (a chip being released frees within seconds), and on exhaustion
     print every actionable fact we can gather before exiting nonzero.
     """
-    probe = ("import jax; d = jax.devices(); "
-             "print(d[0].platform, len(d), flush=True)")
+    # Probe with an actual jitted computation, not a device listing: the
+    # tunnel has been observed answering jax.devices() in seconds while
+    # real compute still hung (round-3 log: listing-probe OK, then both
+    # 1100 s measurement attempts died before the first compile finished).
+    probe = ("import jax, jax.numpy as jnp; "
+             "x = jnp.ones((512, 512), jnp.bfloat16); "
+             "jax.block_until_ready(jax.jit(lambda a: (a @ a).sum())(x)); "
+             "d = jax.devices(); print(d[0].platform, len(d), flush=True)")
     log = lambda *a: print(*a, file=sys.stderr, flush=True)  # noqa: E731
     if attempts is None:
         # The shared TPU pool has multi-minute busy windows; a driver with
@@ -318,7 +324,7 @@ def main() -> None:
     # jitted step — and leave the AOT train-step compile as the attempt's
     # ONLY big accelerator compile.
     init_device = None
-    if not platform_pin and jax.devices()[0].platform != "cpu":
+    if jax.devices()[0].platform != "cpu":
         try:
             init_device = jax.local_devices(backend="cpu")[0]
         except Exception:  # noqa: BLE001 - no host backend: init on device

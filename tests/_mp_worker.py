@@ -582,4 +582,16 @@ def _subset_scenario(scenario: str) -> None:
 
 if __name__ == "__main__":
     main()
-    print(f"WORKER-OK {os.environ['HOROVOD_RANK']}")
+    print(f"WORKER-OK {os.environ['HOROVOD_RANK']}", flush=True)
+    if _coord:
+        # _exit skips atexit, so leave the multi-process JAX world
+        # gracefully first — an abrupt drop of the rank-0 coordination
+        # service errors peers still inside their own teardown barrier.
+        jax.distributed.shutdown()
+    # Skip interpreter teardown: with torch AND jax loaded in one process,
+    # C++ static-destructor ordering at exit can abort (SIGABRT) under
+    # heavy scheduling pressure — observed once on the loaded single-core
+    # CI box (torch_grad rank died -6 AFTER all assertions and
+    # hvd.shutdown() completed). Everything the scenarios verify has
+    # already run; _exit only skips the hazardous library unwind.
+    os._exit(0)

@@ -20,6 +20,34 @@ Typical use, mirroring the reference README:
     params = hvd.broadcast_parameters(params, root_rank=0)
 """
 
+import os as _os
+
+# HOROVOD_PLATFORM: pin the JAX platform before ANY backend starts (the
+# env var JAX_PLATFORMS alone is insufficient on TPU images whose plugin
+# prepends itself to the list). Applied at import so launcher-spawned
+# workers — which import this package before their first device query —
+# are steered without code changes; see docs/running.md.
+_platform = _os.environ.get("HOROVOD_PLATFORM")
+if _platform:
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _platform)
+    try:  # diagnose the one case the pin cannot fix: a live backend
+        _live = bool(_jax._src.xla_bridge._backends)
+    except Exception:  # noqa: BLE001 - private probe, best-effort
+        _live = False
+    if _live:
+        import warnings as _warnings
+
+        _warnings.warn(
+            f"HOROVOD_PLATFORM={_platform!r} was applied AFTER a JAX "
+            f"backend initialized; existing computations stay on the old "
+            f"platform. Import horovod_tpu (or set the env var) before "
+            f"any jax device use.", RuntimeWarning, stacklevel=2)
+        del _warnings
+    del _jax, _live
+del _os, _platform
+
 from . import callbacks, checkpoint, parallel, runner
 from .basics import (
     cross_rank,

@@ -46,6 +46,16 @@ HOROVOD_CONTROLLER_ADDR = "HOROVOD_CONTROLLER_ADDR"
 HOROVOD_CONTROLLER_PORT = "HOROVOD_CONTROLLER_PORT"
 HOROVOD_SECRET_KEY = "HOROVOD_SECRET_KEY"
 HOROVOD_START_TIMEOUT = "HOROVOD_START_TIMEOUT"
+# Force the JAX platform ("cpu", "tpu", ...) before any backend starts.
+# An env var (JAX_PLATFORMS) is NOT enough on TPU images whose plugin
+# prepends itself to the platform list, so ``import horovod_tpu`` applies
+# this via jax.config. The debug analog of the reference running an MPI
+# job with CUDA_VISIBLE_DEVICES= hidden: the same launcher command line
+# can be steered onto CPU for debugging (docs/running.md).
+HOROVOD_PLATFORM = "HOROVOD_PLATFORM"
+# Launcher: set to "0" to stop the launcher from pinning one TPU chip per
+# local rank (TPU_VISIBLE_DEVICES et al.) when a host runs several slots.
+HOROVOD_LAUNCHER_PIN_DEVICES = "HOROVOD_LAUNCHER_PIN_DEVICES"
 # Data plane selection for eager cross-process collectives:
 #   "auto" — XLA collectives over the global device mesh when a multi-process
 #            JAX runtime is initialized; TCP/host reduction otherwise.

@@ -42,7 +42,9 @@ def build_rank_env(rank: int, size: int, port: int, secret: str,
                    local_rank: Optional[int] = None,
                    local_size: Optional[int] = None,
                    cross_rank: int = 0, cross_size: int = 1,
-                   controller_addr: str = "127.0.0.1") -> Dict[str, str]:
+                   controller_addr: str = "127.0.0.1",
+                   env_extra: Optional[Dict[str, str]] = None
+                   ) -> Dict[str, str]:
     """Env block one rank needs — the analog of mpirun's exported world.
 
     Defaults describe a single-host world (local == global); multi-host
@@ -64,7 +66,44 @@ def build_rank_env(rank: int, size: int, port: int, secret: str,
     })
     if host_data_plane:
         env[_config.HOROVOD_DATA_PLANE] = "host"
+    if env_extra:
+        # merged BEFORE the pin so user topology / the opt-out knob passed
+        # programmatically are seen by (and win over) the default pin
+        env.update(env_extra)
+    _pin_local_device(env, local_rank if local_rank is not None else rank,
+                      local_size if local_size is not None else size)
     return env
+
+
+# libtpu env recipe for several independent single-chip processes on one
+# host: restrict each process to its local_rank's chip and declare a
+# standalone 1x1x1 process grid. The TPU analog of the reference's
+# one-GPU-per-process model (mpirun rank -> ``torch.cuda.set_device(
+# hvd.local_rank())`` in user code, CUDA_VISIBLE_DEVICES from the
+# scheduler); on TPU the runtime locks chips to the first process that
+# initializes them, so WITHOUT this every slot beyond the first would die
+# with "device busy" — the pin must come from the launcher, not user code.
+_TPU_PIN_VARS = ("TPU_VISIBLE_DEVICES", "TPU_CHIPS_PER_PROCESS_BOUNDS",
+                 "TPU_PROCESS_BOUNDS")
+
+
+def _pin_local_device(env: Dict[str, str], local_rank: int,
+                      local_size: int) -> None:
+    """One TPU chip per slot when a host runs several (slots > 1).
+
+    Respects explicit user topology (any of the pin vars already set) and
+    the single-process-per-host model (slots == 1 keeps all local chips —
+    the TPU-native layout). ``HOROVOD_LAUNCHER_PIN_DEVICES=0`` disables.
+    Harmless off-TPU: libtpu vars are ignored by CPU/GPU backends."""
+    if local_size <= 1:
+        return
+    if env.get(_config.HOROVOD_LAUNCHER_PIN_DEVICES, "1") == "0":
+        return
+    if any(v in env for v in _TPU_PIN_VARS):
+        return
+    env["TPU_VISIBLE_DEVICES"] = str(local_rank)
+    env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
+    env["TPU_PROCESS_BOUNDS"] = "1,1,1"
 
 
 def parse_hosts(spec: str) -> List[tuple]:
@@ -114,6 +153,10 @@ def _rsh_wrap(rsh_agent: Sequence[str], host: str,
         _config.HOROVOD_CONTROLLER_ADDR, _config.HOROVOD_CONTROLLER_PORT,
         _config.HOROVOD_SECRET_KEY, _config.HOROVOD_DATA_PLANE,
         "HOROVOD_CONTROLLER_BIND",
+        # per-slot chip pinning + platform steering must reach remote
+        # workers too — they are part of the world description
+        *_TPU_PIN_VARS, _config.HOROVOD_PLATFORM,
+        _config.HOROVOD_LAUNCHER_PIN_DEVICES,
     ]
     keys = world_keys + [k for k in extra_keys if k not in world_keys]
     assignments = [f"{k}={env[k]}" for k in keys if k in env]
@@ -176,9 +219,7 @@ def launch_hosts(command: Sequence[str], hosts: List[tuple],
                     host_data_plane=host_data_plane,
                     local_rank=local_rank, local_size=slots,
                     cross_rank=cross_rank, cross_size=len(hosts),
-                    controller_addr=controller_addr)
-                if env_extra:
-                    env.update(env_extra)
+                    controller_addr=controller_addr, env_extra=env_extra)
                 if rank == 0 and remote:
                     # remote workers dial in over a real NIC; the per-job
                     # secret satisfies the non-loopback bind guard
@@ -231,9 +272,8 @@ def launch(command: Sequence[str], np: int,
     try:
         for rank in range(np):
             env = build_rank_env(rank, np, port, secret,
-                                 host_data_plane=host_data_plane)
-            if env_extra:
-                env.update(env_extra)
+                                 host_data_plane=host_data_plane,
+                                 env_extra=env_extra)
             procs.append(subprocess.Popen(
                 list(command), env=env,
                 start_new_session=True))  # own process group for clean kill

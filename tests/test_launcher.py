@@ -229,3 +229,66 @@ def test_horovodrun_cli_np_and_hosts_conflict():
         capture_output=True, text=True, timeout=60)
     assert rc.returncode != 0
     assert "exactly one of" in rc.stderr
+
+
+def test_build_rank_env_pins_tpu_chip_per_slot():
+    """Several slots on one host -> one chip per process (the TPU analog
+    of the reference's one-GPU-per-process model: the runtime locks chips
+    to the first process that initializes them, so the pin must come from
+    the launcher env, not user code)."""
+    from horovod_tpu.runner.launcher import build_rank_env
+
+    env = build_rank_env(5, 8, 1234, "s", base_env={}, local_rank=1,
+                         local_size=4)
+    assert env["TPU_VISIBLE_DEVICES"] == "1"
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
+    assert env["TPU_PROCESS_BOUNDS"] == "1,1,1"
+    # one process per host (the TPU-native model): all chips stay visible
+    env1 = build_rank_env(0, 4, 1234, "s", base_env={}, local_rank=0,
+                          local_size=1)
+    assert "TPU_VISIBLE_DEVICES" not in env1
+    # explicit user topology wins over the launcher's default pin
+    env2 = build_rank_env(0, 4, 1234, "s",
+                          base_env={"TPU_PROCESS_BOUNDS": "2,2,1"},
+                          local_rank=0, local_size=4)
+    assert "TPU_VISIBLE_DEVICES" not in env2
+    assert env2["TPU_PROCESS_BOUNDS"] == "2,2,1"
+    # documented opt-out
+    env3 = build_rank_env(
+        0, 4, 1234, "s",
+        base_env={"HOROVOD_LAUNCHER_PIN_DEVICES": "0"},
+        local_rank=0, local_size=4)
+    assert "TPU_VISIBLE_DEVICES" not in env3
+    # programmatic env_extra merges BEFORE the pin: the opt-out and user
+    # topology passed via launch(env_extra=...) must also be honored
+    env4 = build_rank_env(
+        0, 4, 1234, "s", base_env={}, local_rank=0, local_size=4,
+        env_extra={"HOROVOD_LAUNCHER_PIN_DEVICES": "0"})
+    assert "TPU_VISIBLE_DEVICES" not in env4
+    env5 = build_rank_env(
+        0, 4, 1234, "s", base_env={}, local_rank=0, local_size=4,
+        env_extra={"TPU_PROCESS_BOUNDS": "2,2,1"})
+    assert "TPU_VISIBLE_DEVICES" not in env5
+    assert env5["TPU_PROCESS_BOUNDS"] == "2,2,1"
+
+
+def test_cli_example_composition():
+    """The documented user flow, end to end: the CLI launcher driving a
+    real example across 2 ranks (the exact command in
+    examples/pytorch_mnist.py's header), steered onto CPU via
+    HOROVOD_PLATFORM — the knob exists because JAX_PLATFORMS alone cannot
+    keep workers off a TPU plugin that prepends itself to the list."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["HOROVOD_PLATFORM"] = "cpu"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         "--host-data-plane", sys.executable,
+         os.path.join(root, "examples", "pytorch_mnist.py"),
+         "--epochs", "1"],
+        cwd=root, env=env, capture_output=True, text=True, timeout=420)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "epoch 0: loss=" in result.stdout

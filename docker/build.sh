@@ -1,0 +1,6 @@
+#!/bin/bash
+# Build the horovod_tpu image (analog of the reference's
+# build-docker-images.sh, which bakes its CUDA/MPI matrix).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+docker build -f docker/Dockerfile -t horovod_tpu:latest .

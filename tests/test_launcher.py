@@ -292,3 +292,18 @@ def test_cli_example_composition():
         cwd=root, env=env, capture_output=True, text=True, timeout=420)
     assert result.returncode == 0, result.stdout + result.stderr
     assert "epoch 0: loss=" in result.stdout
+
+
+def test_rsh_wrap_forwards_pin_and_steering_vars():
+    """Remote workers must receive the chip pin and platform steering —
+    they are part of the world description, not local-only state."""
+    from horovod_tpu.runner.launcher import _rsh_wrap, build_rank_env
+
+    env = build_rank_env(1, 4, 1234, "s", base_env={"HOROVOD_PLATFORM": "cpu"},
+                         local_rank=1, local_size=4)
+    argv = _rsh_wrap(["ssh"], "remotehost", env, ["python", "train.py"])
+    remote = argv[-1]
+    assert "TPU_VISIBLE_DEVICES=1" in remote
+    assert "TPU_CHIPS_PER_PROCESS_BOUNDS=1,1,1" in remote
+    assert "TPU_PROCESS_BOUNDS=1,1,1" in remote
+    assert "HOROVOD_PLATFORM=cpu" in remote

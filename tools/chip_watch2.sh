@@ -24,7 +24,7 @@ mkdir -p "$OUT"
 log() { echo "[chip_watch2 $(date +%H:%M:%S)] $*" >> "$OUT/watch.log"; }
 
 compute_probe() {
-    timeout 240 python -c "
+    timeout 150 python -c "
 import jax, jax.numpy as jnp
 x = jnp.ones((1024, 1024), jnp.bfloat16)
 y = jax.jit(lambda a: (a @ a).sum())(x)
@@ -82,8 +82,10 @@ while true; do
         have_result "$name" && continue
         missing=$((missing + 1))
         if ! compute_probe; then
-            log "round $round: chip not computing; sleeping 240s"
-            sleep 240
+            # short sleep: chip-free windows can be minutes long (03:15
+            # today answered for <60 s) — detection latency must be small
+            log "round $round: chip not computing; sleeping 120s"
+            sleep 120
             continue
         fi
         log "round $round: chip computes OK -> $name"

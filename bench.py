@@ -314,42 +314,27 @@ def main() -> None:
                 jax.random.randint(rng, (global_batch,), 0, 1000))
 
     # Model init and data synthesis are full extra device compiles that
-    # contribute nothing to the measurement, and the shared tunnel's
-    # dominant failure mode is a hung compile RPC (round-2/3 postmortems:
-    # probe OK, hvd.init OK, then the first big compile hangs). Run both
-    # on the host CPU backend when the accelerator is remote, ship the
-    # results over with plain transfers — placed with the step's own
-    # shardings (batch split on the data axis, everything else
-    # replicated), since committed arrays are never auto-resharded by the
-    # jitted step — and leave the AOT train-step compile as the attempt's
-    # ONLY big accelerator compile.
-    init_device = None
-    if jax.devices()[0].platform != "cpu":
-        try:
-            init_device = jax.local_devices(backend="cpu")[0]
-        except Exception:  # noqa: BLE001 - no host backend: init on device
-            pass
-    variables = None
-    if init_device is not None:
-        try:
-            with jax.default_device(init_device):
-                images, labels = synthesize()
-                variables = model.init(
-                    jax.random.PRNGKey(1),
-                    np.zeros((2, side, side, 3), np.float32))
-            log("init done on host CPU; transferring to accelerator...")
-            from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as P
-            batch_sh = NamedSharding(mesh, P("data"))
-            repl_sh = NamedSharding(mesh, P())
-            images = jax.device_put(images, batch_sh)
-            labels = jax.device_put(labels, batch_sh)
-            variables = jax.device_put(variables, repl_sh)
-            jax.block_until_ready(variables)
-        except Exception as e:  # noqa: BLE001 - fall back to on-device init
-            log(f"host-CPU init failed ({e!r}); initializing on device")
-            variables = None
-    if variables is None:
+    # contribute nothing to the measurement; run both on the host CPU
+    # backend (see core.platform.init_on_host_cpu for the postmortem) and
+    # place the transfers with the step's own shardings — batch split on
+    # the data axis, everything else replicated; committed arrays are
+    # never auto-resharded by the jitted step. The AOT train-step compile
+    # stays the attempt's ONLY big accelerator compile.
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.core.platform import init_on_host_cpu
+
+    placed = init_on_host_cpu(
+        lambda: (*synthesize(),
+                 model.init(jax.random.PRNGKey(1),
+                            np.zeros((2, side, side, 3), np.float32))),
+        (NamedSharding(mesh, P("data")), NamedSharding(mesh, P("data")),
+         NamedSharding(mesh, P())))
+    if placed is not None:
+        log("init done on host CPU; transferred to accelerator")
+        images, labels, variables = placed
+    else:
         images, labels = synthesize()
         variables = model.init(jax.random.PRNGKey(1), images[:2])
     log("model initialized")

@@ -51,3 +51,45 @@ def pin_cpu_platform(n_devices: int = 8) -> None:
             f"{len(devices)} {devices[0].platform!r} device(s). A backend "
             f"was already initialized before the pin ran — call "
             f"pin_cpu_platform before any jax.devices()/array operation.")
+
+
+def init_on_host_cpu(make, placement):
+    """Run ``make()`` on the host CPU backend and ship the result to
+    ``placement`` (a device, a sharding, or a pytree-prefix of either
+    matching ``make``'s return).
+
+    Why: on a remote accelerator the dominant failure mode of this
+    environment is a hung compile RPC (rounds 2-3: probe OK, then the
+    first big compile hangs for >18 min). Model/data initialization is a
+    full extra device compile that contributes nothing to the caller's
+    real work, so running it on the separate CPU backend and paying plain
+    transfers instead halves the hang surface per attempt. PRNG key
+    creation must happen INSIDE ``make`` — a key built outside dispatches
+    a jitted seed computation on the accelerator, re-opening the exact
+    window this helper closes.
+
+    Returns the placed pytree, or None when there is no separate host
+    backend or anything fails — callers fall back to on-device init.
+    The transfer is blocked on inside the failure boundary so async
+    transfer errors select the fallback instead of escaping to first use.
+    """
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        return None
+    try:
+        cpu0 = jax.local_devices(backend="cpu")[0]
+    except Exception:  # noqa: BLE001 - no separate host backend
+        return None
+    try:
+        with jax.default_device(cpu0):
+            out = make()
+        out = jax.device_put(out, placement)
+        jax.block_until_ready(out)
+        return out
+    except Exception as exc:  # noqa: BLE001 - caller falls back
+        from .logging import LOG
+
+        LOG.warning("host-CPU init failed (%r); falling back to "
+                    "on-device init", exc)
+        return None

@@ -60,6 +60,14 @@
 namespace htpu {
 namespace {
 
+// Retryable refusal for next-world clients reaching a dying service on a
+// re-used port. EXACT text contract with core/status.py
+// CONTROLLER_RESTARTING and both controller clients' retry checks
+// (tests/test_native_controller.py pins the equivalence).
+constexpr const char* kControllerRestarting =
+    "controller world has shut down; a next-world client should retry "
+    "its connect against the successor service";
+
 // ---- binary body codec ------------------------------------------------------
 
 struct Reader {
@@ -673,6 +681,25 @@ class ControllerServer {
     switch (kind) {
       case kHello: {
         int32_t rank = r.Get<int32_t>();
+        bool world_over = world_shutdown_;
+        std::string extra;
+        if (!world_over) {
+          std::lock_guard<std::mutex> guard(mutex_);
+          if (!abort_reason_.empty()) {  // aborted world: same race; the
+            world_over = true;           // reason rides inside the
+            extra = " (predecessor world aborted: " + abort_reason_ + ")";
+          }
+        }
+        if (world_over) {
+          // A hello after this world's negotiated shutdown is a
+          // NEXT-world client reaching the dying service on the shared
+          // port. Refuse with the retryable sentinel (exact text shared
+          // with the Python service and both clients' retry checks) —
+          // serving it would leave its first cycle to EOF at stop,
+          // which surfaced as a spurious world abort (re-init soak).
+          return QueueWrite(
+              fd, ErrorResp(std::string(kControllerRestarting) + extra));
+        }
         IdentifyConn(fd, rank);
         Writer w;
         w.Put<uint8_t>(0);
@@ -697,6 +724,12 @@ class ControllerServer {
           std::lock_guard<std::mutex> guard(mutex_);
           if (!abort_reason_.empty())
             return QueueWrite(fd, ErrorResp(abort_reason_));
+        }
+        if (world_shutdown_) {
+          // next-world watcher on the shared port: refuse retryably
+          // instead of parking (a park would answer "clean stop" and
+          // leave the successor world silently unwatched)
+          return QueueWrite(fd, ErrorResp(kControllerRestarting));
         }
         watch_fds_.push_back(fd);  // parked until abort or stop
         return;

@@ -75,6 +75,19 @@ SHUT_DOWN_ERROR = (
 )
 
 
+# Refusal a controller service answers NEW registrations (hello) and
+# fresh watch parks with once its world has negotiated shutdown: on
+# shutdown(); init() re-use of the same port, a next-world client can
+# reach the dying previous service — served hello + first-cycle EOF
+# looked like a world abort (found by a randomized re-init soak). Both
+# controller implementations emit this EXACT text and both clients
+# treat it as retry-the-connect, not a final error.
+CONTROLLER_RESTARTING = (
+    "controller world has shut down; a next-world client should retry "
+    "its connect against the successor service"
+)
+
+
 class HorovodInternalError(RuntimeError):
     """Raised when a collective completes with a non-OK status.
 

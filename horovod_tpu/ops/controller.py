@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.logging import LOG
+from ..core.status import CONTROLLER_RESTARTING, SHUT_DOWN_ERROR
 from ..runner.network import (
     BasicClient,
     BasicService,
@@ -428,8 +429,6 @@ class ControllerService:
                 return
             first = not self._abort_fired
             self._abort_fired = True
-        from ..core.status import SHUT_DOWN_ERROR
-
         if first:
             LOG.warning("rank %d disconnected before shutdown; aborting "
                         "in-flight collectives on all ranks", rank)
@@ -459,7 +458,14 @@ class ControllerService:
             # aborts or the service stops. Deliberately anonymous — no rank
             # registration — so tearing the watch connection down is never
             # mistaken for a rank death. (Handler threads are daemons; a
-            # parked watcher cannot hang service shutdown.)
+            # parked watcher cannot hang service shutdown.) A watcher
+            # arriving AFTER the world negotiated shutdown belongs to the
+            # NEXT world on this port: refuse retryably instead of parking
+            # (a park would answer "clean stop" and leave the next world
+            # silently unwatched).
+            with self._lock:
+                if self._world_shutdown and self._watch_reason is None:
+                    raise RuntimeError(CONTROLLER_RESTARTING)
             self._watch_event.wait()
             with self._lock:
                 reason = self._watch_reason
@@ -470,6 +476,30 @@ class ControllerService:
         # while anonymous connections (NIC reachability probes open and
         # close without sending) are never mistaken for dead ranks.
         rank = req[1]
+        if kind == "hello":
+            # A hello after this world's negotiated shutdown is a
+            # NEXT-world client that reached the dying service on the
+            # shared port: refuse with the retryable sentinel (its
+            # connect+hello loop re-dials until the successor binds).
+            # Without this, the dying service served the hello and the
+            # client's FIRST CYCLE hit EOF at service stop — surfacing as
+            # a spurious world abort mid-epoch (re-init soak finding).
+            with self._lock:
+                # an aborted world's dying service is the same shared-port
+                # race as a negotiated shutdown's (a current-world rank
+                # re-helloing after an abort is equally over); watchers
+                # keep the abort answer — an already-parked current-world
+                # watcher reconnecting after a transient drop must still
+                # receive the reason (spawn_watch_thread contract). The
+                # abort reason rides INSIDE the retryable sentinel so a
+                # rank whose retried hello lost the race is not
+                # misdirected toward a re-init problem.
+                if self._world_shutdown or self._abort_fired:
+                    reason = CONTROLLER_RESTARTING
+                    if self._abort_fired and self._watch_reason:
+                        reason += (" (predecessor world aborted: "
+                                   f"{self._watch_reason})")
+                    raise RuntimeError(reason)
         with self._lock:
             # A NEW connection for a rank SUPERSEDES any previous one
             # (de-identified, not closed): a client that reconnects — its
@@ -611,7 +641,12 @@ def connect_with_hello(addr, secret, timeout_s, connect_attempts,
     lost: a new connection for a rank supersedes the old registration, so
     the stale close is not a rank death."""
     last: Optional[Exception] = None
-    for _ in range(10):
+    # ~30 s of re-dialing: a refused hello burns one iteration, and the
+    # gap between a world's negotiated shutdown and the successor service
+    # binding can span a slow rank's whole teardown — a 3 s budget made
+    # the retryable refusal terminally fatal in exactly the race it
+    # exists to survive.
+    for _ in range(100):
         client = BasicClient(addr, secret=secret, timeout_s=timeout_s,
                              attempts=connect_attempts)
         try:
@@ -620,9 +655,12 @@ def connect_with_hello(addr, secret, timeout_s, connect_attempts,
         except (WireError, OSError) as exc:
             client.close()
             # EOF (ConnectionClosedError) or RST/reset (OSError) are
-            # transport losses; any other WireError is a decoded server
-            # frame or an authentication failure — deliberate and final
-            if not isinstance(exc, (ConnectionClosedError, OSError)):
+            # transport losses, and a decoded CONTROLLER_RESTARTING frame
+            # is the dying previous world's service explicitly telling a
+            # next-world client to re-dial; any other WireError is a
+            # deliberate server decision — final.
+            if not (isinstance(exc, (ConnectionClosedError, OSError))
+                    or CONTROLLER_RESTARTING in str(exc)):
                 raise
             last = exc
             time.sleep(0.3)
@@ -650,8 +688,6 @@ def spawn_watch_thread(addr, secret, request_reason, on_abort) -> None:
     engine's finalizer draining its last still-completing batches. If the
     world aborted while the channel was down, the re-sent watch request is
     answered immediately (both services check the abort state first)."""
-    from ..core.status import SHUT_DOWN_ERROR
-
     def _loop() -> None:
         failures = 0
         while True:
@@ -674,6 +710,17 @@ def spawn_watch_thread(addr, secret, request_reason, on_abort) -> None:
                         client.close()
                     except Exception:  # noqa: BLE001
                         pass
+                if CONTROLLER_RESTARTING in str(exc):
+                    # Authoritative "your world ended by negotiated
+                    # shutdown": both services answer a watch with the
+                    # abort reason BEFORE this sentinel, so a watcher can
+                    # only see it when there is nothing to deliver — exit
+                    # cleanly like the parked clean-stop path. (A fresh
+                    # watcher of a live successor world cannot reach a
+                    # dying listener: the old one closes before the new
+                    # one binds, and the engine's hello to the successor
+                    # precedes the watch spawn.)
+                    return
                 failures += 1
                 if failures < 3:
                     time.sleep(1.0)

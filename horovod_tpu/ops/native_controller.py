@@ -210,13 +210,21 @@ class NativeControllerClient:
                 # reason; any other WireError (EOF mid-message, HMAC) is a
                 # transport loss — re-raise so the shared watch loop
                 # reconnects instead of falsely aborting a healthy world.
+                from ..core.status import CONTROLLER_RESTARTING
+
                 reason = str(exc)
                 prefix = "service-side failure: "
                 if reason.startswith(prefix):
                     reason = reason[len(prefix):]
                     # the native service answers parked watchers with this
                     # exact text on a clean Stop(); not an abort
-                    return None if reason == "controller stopping" else reason
+                    if reason == "controller stopping":
+                        return None
+                    if CONTROLLER_RESTARTING in reason:
+                        # dying previous world on the shared port: let the
+                        # shared loop re-dial for the successor service
+                        raise
+                    return reason
                 raise
 
         spawn_watch_thread(self._addr, self._secret, _request_reason,

@@ -1,0 +1,57 @@
+"""Soak worker: randomized eager collectives, correctness-checked.
+
+Driven by test_soak.py; duration via SOAK_S (seconds)."""
+import os, sys, time
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import horovod_tpu as hvd
+
+DURATION_S = float(os.environ.get("SOAK_S", "900"))
+rank = int(os.environ["HOROVOD_RANK"])
+size = int(os.environ["HOROVOD_SIZE"])
+rng = np.random.default_rng(1234)  # same stream on every rank
+t_end = time.time() + DURATION_S
+round_no = 0
+ops_done = 0
+while time.time() < t_end:
+    hvd.init()
+    # several cycles of mixed traffic per init epoch
+    for cyc in range(30):
+        n_tensors = int(rng.integers(1, 12))
+        handles = []
+        checks = []
+        for i in range(n_tensors):
+            kind = int(rng.integers(0, 3))
+            dt = [np.float32, np.float64, np.int32][int(rng.integers(0, 3))]
+            shape = tuple(int(s) for s in rng.integers(1, 40, size=int(rng.integers(1, 3))))
+            name = f"soak.{round_no}.{cyc}.{i}"
+            base = np.arange(np.prod(shape), dtype=dt).reshape(shape)
+            if kind == 0:
+                arr = base + rank
+                h = hvd.allreduce_async(arr, average=False, name=name)
+                want = base * size + sum(range(size))
+                checks.append(("ar", h, want))
+            elif kind == 1 and dt != np.float64:
+                rows = rank + 1
+                g = np.full((rows,) + shape, float(rank), dtype=np.float32)
+                h = hvd.allgather_async(g, name=name)
+                want = np.concatenate([np.full((r + 1,) + shape, float(r), np.float32)
+                                       for r in range(size)])
+                checks.append(("ag", h, want))
+            else:
+                root = int(rng.integers(0, size))
+                b = base + (rank * 7)
+                h = hvd.broadcast_async(b, root_rank=root, name=name)
+                want = base + root * 7
+                checks.append(("bc", h, want))
+        for kind, h, want in checks:
+            out = hvd.synchronize(h)
+            np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6,
+                err_msg=f"{kind} mismatch rank {rank} round {round_no}")
+            ops_done += 1
+    hvd.shutdown()
+    round_no += 1
+print(f"SOAK-OK rank {rank} rounds={round_no} ops={ops_done}", flush=True)
+os._exit(0)

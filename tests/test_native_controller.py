@@ -374,3 +374,100 @@ def test_native_reconnect_supersedes_old_connection():
     for out in outs.values():
         assert [n for r in out.responses for n in r.tensor_names] == \
             ["sup.t"]
+
+
+def test_hello_after_world_shutdown_refused_retryably():
+    """A next-world client reaching the DYING service on a re-used port
+    must get the retryable CONTROLLER_RESTARTING refusal, not a served
+    hello whose first cycle EOFs at stop (re-init soak finding); and its
+    connect+hello loop must then reach a successor service. The refusal
+    text is an exact contract between both services and both clients."""
+    from horovod_tpu.core.status import CONTROLLER_RESTARTING
+    from horovod_tpu.ops.controller import connect_with_hello
+    from horovod_tpu.ops.native_controller import (
+        _decode_status,
+        encode_hello,
+    )
+
+    svc = _service(2)
+    try:
+        def body(rank, client):
+            client.cycle(rank, RequestList(rank=rank, requests=[],
+                                           shutdown=True))
+
+        threads = [threading.Thread(target=lambda r=r: body(
+            r, NativeControllerClient(("127.0.0.1", svc.port), secret=SECRET,
+                                      rank=r))) for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert svc.wait_world_shutdown(10.0)
+
+        # the world negotiated shutdown; a fresh hello must be refused
+        # with the exact sentinel (raw wire client: no retry loop)
+        from horovod_tpu.runner.network import BasicClient as _BC
+        raw = _BC(("127.0.0.1", svc.port), secret=SECRET, timeout_s=10.0,
+                  attempts=1)
+        with pytest.raises(WireError) as excinfo:
+            try:
+                _decode_status(raw.request_raw(encode_hello(0)))
+            finally:
+                raw.close()
+        assert CONTROLLER_RESTARTING in str(excinfo.value)
+        port = svc.port
+    finally:
+        svc.shutdown()
+
+    # ...but connect_with_hello re-dials through it and reaches the
+    # successor service once it binds the port
+    successor = NativeControllerService(2, Config.from_env(), secret=SECRET,
+                                        port=port)
+    try:
+        client = connect_with_hello(
+            ("127.0.0.1", port), SECRET, timeout_s=10.0, connect_attempts=3,
+            hello=lambda c: _decode_status(c.request_raw(encode_hello(0))))
+        client.close()
+    finally:
+        successor.shutdown()
+
+
+def test_python_service_hello_refusal_matches_native():
+    """Same contract on the Python service: identical sentinel text,
+    identical retry semantics (behavior parity across controllers)."""
+    from horovod_tpu.core.status import CONTROLLER_RESTARTING
+    from horovod_tpu.ops.controller import (
+        ControllerClient,
+        ControllerService,
+        Negotiator,
+    )
+    from horovod_tpu.runner.network import BasicClient
+    from horovod_tpu.ops.messages import RequestList as _RL
+
+    svc = ControllerService(2, Negotiator(2, 1 << 26), secret=SECRET,
+                            port=0)
+    try:
+        def body(rank):
+            client = ControllerClient(("127.0.0.1", svc.port), secret=SECRET,
+                                      rank=rank)
+            client.cycle(rank, _RL(rank=rank, requests=[], shutdown=True))
+
+        threads = [threading.Thread(target=body, args=(r,))
+                   for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert svc.wait_world_shutdown(10.0)
+
+        # raw (no-retry-through) check: the refusal carries the sentinel
+        with pytest.raises(WireError) as excinfo:
+            client = BasicClient(("127.0.0.1", svc.port), secret=SECRET,
+                                 timeout_s=10.0, attempts=1)
+            try:
+                client.request(("hello", 0))
+            finally:
+                client.close()
+        assert CONTROLLER_RESTARTING in str(excinfo.value)
+    finally:
+        svc.shutdown()

@@ -1,0 +1,27 @@
+"""Randomized re-init soak — the test that found the shared-port
+re-registration race (CONTROLLER_RESTARTING refusal, ops/controller.py).
+
+Each rank loops ``init(); <30 cycles of randomized named collectives,
+correctness-checked>; shutdown()`` for a fixed wall-clock budget, so the
+world continuously tears down and rebuilds its controller on one port —
+the reference lifecycle (``hvd.init`` after ``hvd.shutdown``) under churn.
+A dying previous service serving a next-world hello used to surface as a
+spurious mid-epoch SHUT_DOWN_ERROR within ~60 s of this workload."""
+
+import os
+import sys
+
+from horovod_tpu.runner import launch
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "_soak_worker.py")
+
+
+def test_reinit_soak_three_ranks():
+    env = dict(os.environ)
+    env["SOAK_S"] = "45"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    rc = launch([sys.executable, _WORKER], np=3, host_data_plane=True,
+                env_extra=env, job_timeout_s=240.0)
+    assert rc == 0

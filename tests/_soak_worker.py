@@ -15,8 +15,17 @@ rng = np.random.default_rng(1234)  # same stream on every rank
 t_end = time.time() + DURATION_S
 round_no = 0
 ops_done = 0
-while time.time() < t_end:
+while True:
     hvd.init()
+    # agreed stop: rank 0's clock decides, broadcast through the product
+    # itself - per-rank clock checks would let a fast rank exit for good
+    # while a slow rank re-inits into a world that can never form
+    cont = np.asarray(hvd.broadcast(
+        np.array([time.time() < t_end], np.int32), root_rank=0,
+        name=f"soak.cont.{round_no}"))
+    if not bool(cont[0]):
+        hvd.shutdown()
+        break
     # several cycles of mixed traffic per init epoch
     for cyc in range(30):
         n_tensors = int(rng.integers(1, 12))

@@ -1,0 +1,69 @@
+"""Thread soak: several API threads submit concurrently on every rank.
+
+The reference's eager path receives submissions from framework hook
+threads in arbitrary interleavings; the coordinator tolerates runtime
+reorder because names, not order, drive negotiation. Each thread owns a
+disjoint name space with the same rng stream on every rank, so all
+ranks submit the same global set in different per-rank interleavings —
+correctness-checked end to end."""
+import os
+import sys
+import threading
+
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+import horovod_tpu as hvd
+
+# COUNT-based, not time-based: ranks must submit identical sets, and a
+# wall-clock budget lets a fast rank finish + shutdown while a slow rank
+# still submits - correctly yielding the reference's SHUT_DOWN_ERROR,
+# which is not what this soak measures.
+CYCLES = int(os.environ.get("SOAK_CYCLES", "150"))
+N_THREADS = 3
+rank = int(os.environ["HOROVOD_RANK"])
+size = int(os.environ["HOROVOD_SIZE"])
+hvd.init()
+errors = []
+
+
+def submitter(tid: int) -> None:
+    try:
+        rng = np.random.default_rng(1000 + tid)  # same per tid on all ranks
+        for cyc in range(CYCLES):
+            checks = []
+            for i in range(int(rng.integers(1, 6))):
+                shape = (int(rng.integers(1, 128)),)
+                name = f"tsoak.{tid}.{cyc}.{i}"
+                base = np.arange(shape[0], dtype=np.float32)
+                kind = int(rng.integers(0, 2))
+                if kind == 0:
+                    h = hvd.allreduce_async(base + rank, average=False,
+                                            name=name)
+                    checks.append((h, base * size + sum(range(size))))
+                else:
+                    root = int(rng.integers(0, size))
+                    h = hvd.broadcast_async(base + rank * 5, root_rank=root,
+                                            name=name)
+                    checks.append((h, base + root * 5))
+            for h, want in checks:
+                np.testing.assert_allclose(
+                    np.asarray(hvd.synchronize(h)), want, rtol=1e-6)
+    except Exception as exc:  # noqa: BLE001 - surface via main thread
+        errors.append(exc)
+
+
+threads = [threading.Thread(target=submitter, args=(t,))
+           for t in range(N_THREADS)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+hvd.shutdown()
+if errors:
+    raise errors[0]
+print(f"TSOAK-OK rank {rank}", flush=True)
+os._exit(0)

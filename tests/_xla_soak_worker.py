@@ -1,0 +1,75 @@
+"""Device-plane soak: one long world, randomized mixed numpy/jax traffic.
+
+Targets the round-3 finalizer/completion machinery: async dispatch,
+union waits, launch-order compatibility between host-fed and
+device-resident ranks. Same rng stream on every rank => identical
+submission sets; per-rank values differ so correctness is checkable."""
+import os, sys, time
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+coord = os.environ["HOROVOD_TEST_JAX_COORD"]
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(coord,
+                           num_processes=int(os.environ["HOROVOD_SIZE"]),
+                           process_id=int(os.environ["HOROVOD_RANK"]))
+import numpy as np
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+DURATION_S = float(os.environ.get("SOAK_S", "300"))
+rank = int(os.environ["HOROVOD_RANK"])
+size = int(os.environ["HOROVOD_SIZE"])
+hvd.init()
+rng = np.random.default_rng(99)
+t_end = time.time() + DURATION_S
+ops_done = 0
+cyc = 0
+while True:
+    # agreed stop: rank 0's clock decides, broadcast through the product
+    # itself - per-rank `time.time() < t_end` checks would let a fast
+    # rank shut down while a slow one submits one more cycle (the
+    # documented finished-rank SHUT_DOWN_ERROR, not a soak failure)
+    cont = np.asarray(hvd.broadcast(
+        np.array([time.time() < t_end], np.int32), root_rank=0,
+        name=f"xsoak.cont.{cyc}"))
+    if not bool(cont[0]):
+        break
+    n_tensors = int(rng.integers(1, 10))
+    checks = []
+    for i in range(n_tensors):
+        kind = int(rng.integers(0, 3))
+        # device-resident (jax) or host-fed (numpy) submission: ranks may
+        # DISAGREE per tensor (launch-order compatibility contract)
+        as_jax = bool(rng.integers(0, 2) ^ (rank % 2 and i % 3 == 0))
+        shape = tuple(int(s) for s in rng.integers(1, 64, size=int(rng.integers(1, 3))))
+        name = f"xsoak.{cyc}.{i}"
+        base = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+        if kind == 0:
+            arr = base + rank
+            sub = jnp.asarray(arr) if as_jax else arr
+            h = hvd.allreduce_async(sub, average=False, name=name)
+            checks.append((h, base * size + sum(range(size))))
+        elif kind == 1:
+            rows = rank + 1
+            g = np.full((rows,) + shape, float(rank), np.float32)
+            sub = jnp.asarray(g) if as_jax else g
+            h = hvd.allgather_async(sub, name=name)
+            checks.append((h, np.concatenate(
+                [np.full((r + 1,) + shape, float(r), np.float32)
+                 for r in range(size)])))
+        else:
+            root = int(rng.integers(0, size))
+            b = base + rank * 3
+            sub = jnp.asarray(b) if as_jax else b
+            h = hvd.broadcast_async(sub, root_rank=root, name=name)
+            checks.append((h, base + root * 3))
+    for h, want in checks:
+        out = hvd.synchronize(h)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+        ops_done += 1
+    cyc += 1
+hvd.shutdown()
+print(f"XSOAK-OK rank {rank} cycles={cyc} ops={ops_done}", flush=True)
+jax.distributed.shutdown()
+os._exit(0)

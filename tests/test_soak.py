@@ -25,3 +25,44 @@ def test_reinit_soak_three_ranks():
     rc = launch([sys.executable, _WORKER], np=3, host_data_plane=True,
                 env_extra=env, job_timeout_s=240.0)
     assert rc == 0
+
+
+def test_device_plane_soak_three_ranks():
+    """Randomized mixed numpy/jax traffic over the eager XLA data plane
+    (gloo 3-process world): async dispatch, finalizer union waits, and
+    launch-order compatibility between host-fed and device-resident
+    ranks under sustained churn. Validated at 5 min/1.6k collectives per
+    rank; runs a short budget here."""
+    from horovod_tpu.runner.launcher import _free_port
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_xla_soak_worker.py")
+    env = dict(os.environ)
+    env.update({
+        "HOROVOD_DATA_PLANE": "xla",
+        "HOROVOD_TEST_JAX_COORD": f"127.0.0.1:{_free_port()}",
+        "SOAK_S": "25",
+        "PYTHONPATH": os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+    })
+    rc = launch([sys.executable, worker], np=3, env_extra=env,
+                job_timeout_s=240.0)
+    assert rc == 0
+
+
+def test_threaded_submission_soak_two_ranks():
+    """Three API threads per rank submit concurrently (disjoint name
+    spaces, identical sets across ranks, per-rank interleavings differ):
+    the reference's async-hook reorder tolerance under churn. Count-based
+    on purpose - a wall-clock budget would let a fast rank finish and
+    shut down mid-submission on the slow rank, which is the documented
+    SHUT_DOWN_ERROR semantics, not a soak failure."""
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_thread_soak_worker.py")
+    env = dict(os.environ)
+    env["SOAK_CYCLES"] = "80"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    rc = launch([sys.executable, worker], np=2, host_data_plane=True,
+                env_extra=env, job_timeout_s=240.0)
+    assert rc == 0

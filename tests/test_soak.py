@@ -11,14 +11,30 @@ spurious mid-epoch SHUT_DOWN_ERROR within ~60 s of this workload."""
 import os
 import sys
 
+import pytest
+
+from horovod_tpu import cc
 from horovod_tpu.runner import launch
 
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "_soak_worker.py")
 
 
-def test_reinit_soak_three_ranks():
+@pytest.mark.parametrize("knobs", [
+    {},
+    # live autotuner (fusion threshold / cycle time mutation) + timeline
+    # writer churn across every world lifecycle (155 lifecycles validated
+    # clean at 150 s before shortening for CI); autotune requires the
+    # native core, so this variant skips where cc is not built
+    pytest.param(
+        {"HOROVOD_AUTOTUNE": "1", "HOROVOD_TIMELINE": "@tmp@"},
+        marks=pytest.mark.skipif(not cc.available(),
+                                 reason="autotune needs the native core")),
+], ids=["plain", "autotune-timeline"])
+def test_reinit_soak_three_ranks(knobs, tmp_path):
     env = dict(os.environ)
+    env.update({k: (str(tmp_path / "soak_tl.json") if v == "@tmp@" else v)
+                for k, v in knobs.items()})
     env["SOAK_S"] = "45"
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))

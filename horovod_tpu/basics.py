@@ -123,7 +123,7 @@ def init(ranks=None, comm=None) -> None:
             # no shutdown cycle to wait for.)
             from .ops.engine import start_subset_service
 
-            start_subset_service(len(ranks))
+            start_subset_service(list(ranks))
         LOG.debug(
             "horovod_tpu initialized: rank=%d size=%d local_rank=%d "
             "local_size=%d devices=%d/%d",

@@ -114,7 +114,8 @@ def _declare(lib) -> None:
     lib.htpu_controller_start.restype = c.c_void_p
     lib.htpu_controller_start.argtypes = [
         c.c_int, c.c_char_p, c.c_int, c.c_char_p, c.c_int, c.c_longlong,
-        c.c_double, c.c_int, c.c_char_p, c.c_int, c.c_char_p, c.c_int]
+        c.c_double, c.c_int, c.c_char_p, c.c_int, c.c_char_p, c.c_char_p,
+        c.c_int]
     lib.htpu_controller_port.restype = c.c_int
     lib.htpu_controller_port.argtypes = [c.c_void_p]
     lib.htpu_controller_world_shutdown.restype = c.c_int

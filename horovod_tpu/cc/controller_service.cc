@@ -60,6 +60,16 @@
 namespace htpu {
 namespace {
 
+// Refusal for a hello/watch whose world identity differs from this
+// service's (co-scheduled worlds share the port under subset schedules).
+// Exact-text contract with controller.world_mismatch_error().
+std::string WorldMismatchError(const std::string& service_id,
+                               const std::string& caller_id) {
+  return "controller serves a different world (service=" + service_id +
+         ", caller=" + caller_id + "); retry against this port's "
+         "successor service";
+}
+
 // Retryable refusal for next-world clients reaching a dying service on a
 // re-used port. EXACT text contract with core/status.py
 // CONTROLLER_RESTARTING and both controller clients' retry checks
@@ -248,11 +258,13 @@ class ControllerServer {
  public:
   ControllerServer(int size, std::string secret, int64_t fusion_threshold,
                    double stall_warning_s, bool stall_check_disable,
-                   std::string shutdown_error, bool collect_stats)
+                   std::string shutdown_error, bool collect_stats,
+                   std::string world_id)
       : size_(size),
         secret_(std::move(secret)),
         shutdown_error_(std::move(shutdown_error)),
         collect_stats_(collect_stats),
+        world_id_(std::move(world_id)),
         negotiator_(size, fusion_threshold, stall_warning_s,
                     stall_check_disable) {}
 
@@ -681,6 +693,19 @@ class ControllerServer {
     switch (kind) {
       case kHello: {
         int32_t rank = r.Get<int32_t>();
+        std::string caller_wid;
+        if (r.n >= 2) {
+          uint16_t wid_len = r.Get<uint16_t>();
+          caller_wid = r.GetBytes(wid_len);
+        }
+        if (r.ok && !caller_wid.empty() && !world_id_.empty() &&
+            caller_wid != world_id_) {
+          // a co-scheduled different world's client (subset schedules
+          // share this port): refusing prevents its remapped rank from
+          // superseding a LIVE member of this world
+          return QueueWrite(
+              fd, ErrorResp(WorldMismatchError(world_id_, caller_wid)));
+        }
         bool world_over = world_shutdown_;
         std::string extra;
         if (!world_over) {
@@ -720,6 +745,17 @@ class ControllerServer {
       case kPayload:
         return HandlePayload(fd, &r);
       case kWatch: {
+        std::string caller_wid;
+        if (r.n >= 2) {
+          uint16_t wid_len = r.Get<uint16_t>();
+          caller_wid = r.GetBytes(wid_len);
+        }
+        if (r.ok && !caller_wid.empty() && !world_id_.empty() &&
+            caller_wid != world_id_) {
+          // wrong world: must neither park nor receive THIS world's abort
+          return QueueWrite(
+              fd, ErrorResp(WorldMismatchError(world_id_, caller_wid)));
+        }
         {
           std::lock_guard<std::mutex> guard(mutex_);
           if (!abort_reason_.empty())
@@ -973,6 +1009,7 @@ class ControllerServer {
   std::map<std::pair<int64_t, int64_t>, PayloadSlot> payloads_;
 
   // shared with external API threads; guarded by mutex_:
+  std::string world_id_;  // loop-thread-read only after construction
   std::mutex mutex_;
   bool stopping_ = false;
   bool world_shutdown_ = false;
@@ -991,12 +1028,14 @@ void* htpu_controller_start(int size, const char* bind_host, int port,
                             long long fusion_threshold,
                             double stall_warning_s, int stall_check_disable,
                             const char* shutdown_error, int collect_stats,
+                            const char* world_id,
                             char* err_out, int err_cap) {
   auto* server = new htpu::ControllerServer(
       size, std::string(reinterpret_cast<const char*>(secret),
                         static_cast<size_t>(secret_len)),
       fusion_threshold, stall_warning_s, stall_check_disable != 0,
-      shutdown_error, collect_stats != 0);
+      shutdown_error, collect_stats != 0,
+      world_id ? world_id : "");
   std::string err;
   if (!server->Start(bind_host, port, &err)) {
     std::snprintf(err_out, static_cast<size_t>(err_cap), "%s", err.c_str());

@@ -87,6 +87,16 @@ CONTROLLER_RESTARTING = (
     "its connect against the successor service"
 )
 
+# Refusal for a hello/watch whose world identity does not match the
+# service's: subset schedules let a non-member of world N race ahead
+# into world N+1 while N's service is still LIVE on the shared port —
+# without the identity check its remapped-rank hello superseded a live
+# member's registration and aborted world N with a spurious rank death
+# (found by the subset churn soak). Retryable: the caller's own world's
+# service has not bound the port yet. Both controller implementations
+# emit this exact prefix.
+WORLD_MISMATCH = "controller serves a different world"
+
 
 class HorovodInternalError(RuntimeError):
     """Raised when a collective completes with a non-OK status.

@@ -26,6 +26,7 @@ from __future__ import annotations
 import os
 import socket
 from dataclasses import dataclass
+from typing import Optional
 
 from . import config as _config
 
@@ -54,6 +55,11 @@ class Topology:
     # 1 (collectives work locally, nothing deadlocks) instead of the
     # reference's ill-defined MPI_COMM_WORLD fallback.
     is_member: bool = True
+    # The subset composition (launcher ranks, in communicator order) for
+    # ``init(ranks=[...])`` worlds; None for the full world. Defines the
+    # world identity the controller protocol uses to keep co-scheduled
+    # worlds on one port from cross-registering (core.status.WORLD_MISMATCH).
+    members: Optional[tuple] = None
 
     def __post_init__(self):
         if self.world_rank < 0:
@@ -117,7 +123,8 @@ def discover(use_jax: bool = True, subset=None) -> Topology:
             cross_size=1, local_device_count=full.local_device_count,
             global_device_count=full.local_device_count,
             hostname=full.hostname, world_rank=full.rank,
-            world_size=full.size, is_member=False)
+            world_size=full.size, is_member=False,
+            members=tuple(subset))
     index = subset.index(full.rank)
     return Topology(
         rank=index, size=len(subset), local_rank=full.local_rank,
@@ -126,7 +133,7 @@ def discover(use_jax: bool = True, subset=None) -> Topology:
         local_device_count=full.local_device_count,
         global_device_count=full.local_device_count * len(subset),
         hostname=full.hostname, world_rank=full.rank,
-        world_size=full.size, is_member=True)
+        world_size=full.size, is_member=True, members=tuple(subset))
 
 
 def _discover_full(use_jax: bool = True) -> Topology:

@@ -27,7 +27,11 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.logging import LOG
-from ..core.status import CONTROLLER_RESTARTING, SHUT_DOWN_ERROR
+from ..core.status import (
+    CONTROLLER_RESTARTING,
+    SHUT_DOWN_ERROR,
+    WORLD_MISMATCH,
+)
 from ..runner.network import (
     BasicClient,
     BasicService,
@@ -370,6 +374,25 @@ class _Rendezvous:
             self._cond.notify_all()
 
 
+def world_id_of(members, size: int) -> str:
+    """Canonical identity of a world instance on the shared controller
+    port. Subset worlds are identified by their composition (launcher
+    ranks in communicator order); full worlds by size — two successive
+    same-identity worlds cannot overlap (every member participates in
+    the negotiated shutdown before any re-inits), while co-scheduled
+    DIFFERENT worlds (a subset schedule's epochs) must not
+    cross-register (core.status.WORLD_MISMATCH)."""
+    if members is None:
+        return f"full:{size}"
+    return "sub:" + ",".join(str(r) for r in members)
+
+
+def world_mismatch_error(service_id: str, caller_id: str) -> str:
+    """Exact-text contract with the native service (tests pin it)."""
+    return (f"{WORLD_MISMATCH} (service={service_id}, caller={caller_id}); "
+            f"retry against this port's successor service")
+
+
 class ControllerService:
     """Rank-0 TCP controller: cycle negotiation + host-mode payload exchange.
 
@@ -384,8 +407,9 @@ class ControllerService:
     def __init__(self, size: int, negotiator: Negotiator,
                  secret: Optional[bytes] = None, port: int = 0,
                  bind_host: str = "127.0.0.1",
-                 autotuner=None) -> None:
+                 autotuner=None, world_id: str = "") -> None:
         self._negotiator = negotiator
+        self._world_id = world_id
         self._cycles = _Rendezvous(size)
         self._payloads = _Rendezvous(size)
         self._cycle_no = 0
@@ -458,11 +482,18 @@ class ControllerService:
             # aborts or the service stops. Deliberately anonymous — no rank
             # registration — so tearing the watch connection down is never
             # mistaken for a rank death. (Handler threads are daemons; a
-            # parked watcher cannot hang service shutdown.) A watcher
-            # arriving AFTER the world negotiated shutdown belongs to the
-            # NEXT world on this port: refuse retryably instead of parking
-            # (a park would answer "clean stop" and leave the next world
-            # silently unwatched).
+            # parked watcher cannot hang service shutdown.) A watcher from
+            # a DIFFERENT world (subset schedules co-locate worlds on one
+            # port) is refused before anything else — it must neither park
+            # nor receive THIS world's abort; a watcher arriving AFTER the
+            # world negotiated shutdown belongs to the successor: refuse
+            # retryably instead of parking (a park would answer "clean
+            # stop" and leave the next world silently unwatched).
+            caller_wid = req[1] if len(req) > 1 else ""
+            if caller_wid and self._world_id and \
+                    caller_wid != self._world_id:
+                raise RuntimeError(
+                    world_mismatch_error(self._world_id, caller_wid))
             with self._lock:
                 if self._world_shutdown and self._watch_reason is None:
                     raise RuntimeError(CONTROLLER_RESTARTING)
@@ -477,6 +508,14 @@ class ControllerService:
         # close without sending) are never mistaken for dead ranks.
         rank = req[1]
         if kind == "hello":
+            caller_wid = req[2] if len(req) > 2 else ""
+            if caller_wid and self._world_id and \
+                    caller_wid != self._world_id:
+                # a co-scheduled different world's client (subset
+                # schedules share this port): refusing is what prevents
+                # its remapped rank from superseding a LIVE member here
+                raise RuntimeError(
+                    world_mismatch_error(self._world_id, caller_wid))
             # A hello after this world's negotiated shutdown is a
             # NEXT-world client that reached the dying service on the
             # shared port: refuse with the retryable sentinel (its
@@ -660,7 +699,8 @@ def connect_with_hello(addr, secret, timeout_s, connect_attempts,
             # next-world client to re-dial; any other WireError is a
             # deliberate server decision — final.
             if not (isinstance(exc, (ConnectionClosedError, OSError))
-                    or CONTROLLER_RESTARTING in str(exc)):
+                    or CONTROLLER_RESTARTING in str(exc)
+                    or WORLD_MISMATCH in str(exc)):
                 raise
             last = exc
             time.sleep(0.3)
@@ -710,7 +750,16 @@ def spawn_watch_thread(addr, secret, request_reason, on_abort) -> None:
                         client.close()
                     except Exception:  # noqa: BLE001
                         pass
-                if CONTROLLER_RESTARTING in str(exc):
+                if WORLD_MISMATCH in str(exc):
+                    # A watcher only dials after its own world's hello
+                    # succeeded on this port, so a mismatch means that
+                    # service was REPLACED: this watcher's world is over.
+                    # Fire the abort path — harmless if the engine already
+                    # shut down cleanly, and it unparks a rank that a
+                    # missed abort (world died while the channel was down)
+                    # left blocked inside a collective.
+                    reason = (f"{SHUT_DOWN_ERROR} (cause: {exc})")
+                elif CONTROLLER_RESTARTING in str(exc):
                     # Authoritative "your world ended by negotiated
                     # shutdown": both services answer a watch with the
                     # abort reason BEFORE this sentinel, so a watcher can
@@ -721,12 +770,13 @@ def spawn_watch_thread(addr, secret, request_reason, on_abort) -> None:
                     # one binds, and the engine's hello to the successor
                     # precedes the watch spawn.)
                     return
-                failures += 1
-                if failures < 3:
-                    time.sleep(1.0)
-                    continue  # transient: reconnect and re-park
-                reason = (f"{SHUT_DOWN_ERROR} (cause: watch channel lost: "
-                          f"{exc})")
+                else:
+                    failures += 1
+                    if failures < 3:
+                        time.sleep(1.0)
+                        continue  # transient: reconnect and re-park
+                    reason = (f"{SHUT_DOWN_ERROR} (cause: watch channel "
+                              f"lost: {exc})")
             try:
                 on_abort(reason)
             finally:
@@ -748,11 +798,13 @@ class ControllerClient:
                  secret: Optional[bytes] = None,
                  timeout_s: Optional[float] = None,
                  connect_attempts: int = 100,
-                 rank: Optional[int] = None) -> None:
+                 rank: Optional[int] = None,
+                 world_id: str = "") -> None:
         self._addr = addr
         self._secret = secret
         self._cycle_no = 0
         self._rank = rank
+        self._world_id = world_id
         # Generous connect window: ranks race the coordinator's service
         # startup (JAX import time dominates), like orted waiting on the
         # reference's driver registration (``util/timeout.py``). Identify
@@ -765,7 +817,7 @@ class ControllerClient:
         else:
             self._client = connect_with_hello(
                 addr, secret, timeout_s, connect_attempts,
-                hello=lambda c: c.request(("hello", rank)))
+                hello=lambda c: c.request(("hello", rank, world_id)))
 
     def cycle(self, rank: int, request_list: RequestList) -> ResponseList:
         # The controller registers this connection under ``rank`` for
@@ -789,7 +841,7 @@ class ControllerClient:
         "watch" request the controller answers only on abort/stop."""
 
         def _request_reason(client) -> Optional[str]:
-            resp = client.request(("watch",))
+            resp = client.request(("watch", self._world_id))
             if resp and resp[0] == "abort" and resp[1]:
                 return resp[1]
             return None  # clean stop

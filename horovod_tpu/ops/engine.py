@@ -270,6 +270,9 @@ class Engine:
             # library availability, identical on every rank (the two speak
             # different wires).
             use_native = native_controller_enabled(cfg)
+            from .controller import world_id_of
+
+            world_id = world_id_of(topo.members, self._size)
             if topo.world_rank == 0:
                 # Controller duty follows the launcher's advertised address
                 # (world rank 0), not the subset rank numbering.
@@ -278,12 +281,14 @@ class Engine:
                 if use_native:
                     self._service = NativeControllerService(
                         self._size, cfg, secret=secret, port=port,
-                        bind_host=bind_host, autotuner=self._autotuner)
+                        bind_host=bind_host, autotuner=self._autotuner,
+                        world_id=world_id)
                 else:
                     negotiator = make_negotiator(self._size, cfg)
                     self._service = ControllerService(
                         self._size, negotiator, secret=secret, port=port,
-                        bind_host=bind_host, autotuner=self._autotuner)
+                        bind_host=bind_host, autotuner=self._autotuner,
+                        world_id=world_id)
                 port = self._service.port
             # The launcher may advertise several controller addresses
             # (comma-separated: every NIC of the controller host); the
@@ -297,7 +302,7 @@ class Engine:
                           else ControllerClient)
             self._client = client_cls(
                 {a: (a, port) for a in addr_list}, secret=secret,
-                timeout_s=None, rank=self._rank,
+                timeout_s=None, rank=self._rank, world_id=world_id,
                 **({"log_stalls": self._rank == 0} if use_native else {}))
 
         self._host_fallback_warned = set()
@@ -778,7 +783,7 @@ class Engine:
         self._stopped.wait(timeout)
 
 
-def start_subset_service(subset_size: int) -> None:
+def start_subset_service(subset_ranks) -> None:
     """Host the controller service for a subset world this process is NOT
     a member of (launcher world-rank 0 outside ``init(ranks=...)``): the
     launcher advertised this host's address, so the subset's control
@@ -789,19 +794,25 @@ def start_subset_service(subset_size: int) -> None:
         native_controller_enabled,
     )
 
+    from .controller import world_id_of
+
     cfg = basics.config()
+    subset_ranks = list(subset_ranks)
+    subset_size = len(subset_ranks)
+    # the SAME identity the members compute from their topology
+    world_id = world_id_of(tuple(subset_ranks), subset_size)
     port = int(os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "0"))
     bind_host = os.environ.get("HOROVOD_CONTROLLER_BIND", "127.0.0.1")
     autotuner = Autotuner(cfg) if cfg.autotune else None
     if native_controller_enabled(cfg):  # same decision the members make
         service = NativeControllerService(
             subset_size, cfg, secret=default_secret(), port=port,
-            bind_host=bind_host, autotuner=autotuner)
+            bind_host=bind_host, autotuner=autotuner, world_id=world_id)
     else:
         service = ControllerService(
             subset_size, make_negotiator(subset_size, cfg),
             secret=default_secret(), port=port, bind_host=bind_host,
-            autotuner=autotuner)
+            autotuner=autotuner, world_id=world_id)
 
     def _teardown() -> None:
         # Grace period: the host's own shutdown (often atexit) must not

@@ -40,8 +40,14 @@ _HELLO, _BYE, _CYCLE, _PAYLOAD, _WATCH = 1, 2, 3, 4, 5
 
 # -- body codec ---------------------------------------------------------------
 
-def encode_hello(rank: int) -> bytes:
-    return struct.pack("<Bi", _HELLO, rank)
+def encode_hello(rank: int, world_id: str = "") -> bytes:
+    wid = world_id.encode("utf-8")
+    return struct.pack("<BiH", _HELLO, rank, len(wid)) + wid
+
+
+def encode_watch(world_id: str = "") -> bytes:
+    wid = world_id.encode("utf-8")
+    return struct.pack("<BH", _WATCH, len(wid)) + wid
 
 
 def encode_bye(rank: int) -> bytes:
@@ -157,12 +163,13 @@ class NativeControllerClient:
                  timeout_s: Optional[float] = None,
                  connect_attempts: int = 100,
                  rank: Optional[int] = None,
-                 log_stalls: bool = False) -> None:
+                 log_stalls: bool = False, world_id: str = "") -> None:
         from ..runner.network import BasicClient
 
         self._addr = addr
         self._secret = secret
         self._rank = rank
+        self._world_id = world_id
         self._log_stalls = log_stalls
         self._cycle_no = 0
         self._last_cycle = 0
@@ -178,7 +185,7 @@ class NativeControllerClient:
             self._client = connect_with_hello(
                 addr, secret, timeout_s, connect_attempts,
                 hello=lambda c: _decode_status(
-                    c.request_raw(encode_hello(rank))))
+                    c.request_raw(encode_hello(rank, world_id))))
 
     def cycle(self, rank: int, request_list: RequestList) -> ResponseList:
         if self._rank is None:
@@ -203,14 +210,18 @@ class NativeControllerClient:
 
         def _request_reason(client) -> Optional[str]:
             try:
-                _decode_status(client.request_raw(struct.pack("<B", _WATCH)))
+                _decode_status(client.request_raw(
+                    encode_watch(self._world_id)))
                 return None  # clean stop
             except WireError as exc:
                 # Only a decoded service ERROR FRAME carries the abort
                 # reason; any other WireError (EOF mid-message, HMAC) is a
                 # transport loss — re-raise so the shared watch loop
                 # reconnects instead of falsely aborting a healthy world.
-                from ..core.status import CONTROLLER_RESTARTING
+                from ..core.status import (
+                    CONTROLLER_RESTARTING,
+                    WORLD_MISMATCH,
+                )
 
                 reason = str(exc)
                 prefix = "service-side failure: "
@@ -220,9 +231,11 @@ class NativeControllerClient:
                     # exact text on a clean Stop(); not an abort
                     if reason == "controller stopping":
                         return None
-                    if CONTROLLER_RESTARTING in reason:
-                        # dying previous world on the shared port: let the
-                        # shared loop re-dial for the successor service
+                    if CONTROLLER_RESTARTING in reason or \
+                            WORLD_MISMATCH in reason:
+                        # succession sentinels are NOT this world's abort
+                        # reason: re-raise so the shared watch loop applies
+                        # its clean-end / replaced-world semantics
                         raise
                     return reason
                 raise
@@ -250,7 +263,7 @@ class NativeControllerService:
 
     def __init__(self, size: int, cfg, secret: Optional[bytes] = None,
                  port: int = 0, bind_host: str = "127.0.0.1",
-                 autotuner=None) -> None:
+                 autotuner=None, world_id: str = "") -> None:
         import ctypes
 
         from .. import cc
@@ -268,7 +281,8 @@ class NativeControllerService:
             cfg.fusion_threshold_bytes, cfg.stall_warning_time_s,
             1 if cfg.stall_check_disable else 0,
             SHUT_DOWN_ERROR.encode("utf-8"),
-            1 if autotuner is not None else 0, err, len(err))
+            1 if autotuner is not None else 0,
+            world_id.encode("utf-8"), err, len(err))
         if not self._handle:
             raise RuntimeError(
                 f"native controller failed to start: {err.value.decode()}")

@@ -1,0 +1,56 @@
+"""Subset-world churn soak: alternating memberships across lifecycles.
+
+Exercises ``hvd.init(ranks=[...])`` under the same shared-port
+succession pressure as the plain re-init soak: subset service creation
+(launcher world-rank 0 hosts it even as a NON-member), non-member
+self-worlds, rank remapping, and member/non-member teardown ordering.
+Count-based: every launcher rank runs the same epoch schedule, so no
+cross-world stop agreement is needed (a non-member cannot join a
+member-world continue broadcast)."""
+import os
+import sys
+
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+import horovod_tpu as hvd
+
+ROUNDS = int(os.environ.get("SOAK_ROUNDS", "40"))
+world_rank = int(os.environ["HOROVOD_RANK"])
+world_size = int(os.environ["HOROVOD_SIZE"])
+assert world_size == 4, "schedule below assumes 4 launcher ranks"
+SCHEDULE = [
+    [0, 1, 2, 3],   # full world
+    [0, 1, 2],      # member coordinator host
+    [1, 2, 3],      # NON-member coordinator host
+    [0, 3],         # sparse pair
+    [2, 1],         # reordered pair: list order defines rank mapping
+]
+
+for round_no in range(ROUNDS):
+    subset = SCHEDULE[round_no % len(SCHEDULE)]
+    hvd.init(ranks=subset)
+    if world_rank in subset:
+        my = subset.index(world_rank)
+        assert hvd.rank() == my, (hvd.rank(), my)
+        assert hvd.size() == len(subset)
+        out = hvd.allreduce(
+            np.full((8,), float(world_rank), np.float32),
+            average=False, name=f"ssoak.{round_no}")
+        np.testing.assert_array_equal(np.asarray(out), float(sum(subset)))
+        root = round_no % len(subset)
+        b = hvd.broadcast(np.full((4,), float(world_rank), np.float32),
+                          root_rank=root, name=f"ssoak.b.{round_no}")
+        np.testing.assert_array_equal(np.asarray(b), float(subset[root]))
+    else:
+        assert hvd.rank() == 0 and hvd.size() == 1
+        out = hvd.allreduce(np.full((2,), 5.0, np.float32),
+                            average=False, name=f"ssoak.self.{round_no}")
+        np.testing.assert_array_equal(np.asarray(out), 5.0)
+    hvd.shutdown()
+
+print(f"SSOAK-OK rank {world_rank} rounds={ROUNDS}", flush=True)
+os._exit(0)

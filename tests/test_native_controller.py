@@ -471,3 +471,51 @@ def test_python_service_hello_refusal_matches_native():
         assert CONTROLLER_RESTARTING in str(excinfo.value)
     finally:
         svc.shutdown()
+
+
+def test_world_mismatch_refusal_text_parity():
+    """Both services must emit the EXACT world_mismatch_error() text for a
+    wrong-world hello — the substring is what both clients' retry checks
+    key on, and the full text is the cross-controller contract."""
+    from horovod_tpu.core.status import WORLD_MISMATCH
+    from horovod_tpu.ops.controller import (
+        ControllerService,
+        Negotiator,
+        world_mismatch_error,
+    )
+    from horovod_tpu.ops.native_controller import (
+        _decode_status,
+        encode_hello,
+    )
+    from horovod_tpu.runner.network import BasicClient
+
+    expected = world_mismatch_error("sub:0,1", "sub:9")
+    assert WORLD_MISMATCH in expected
+
+    svc = NativeControllerService(2, Config.from_env(), secret=SECRET,
+                                  port=0, world_id="sub:0,1")
+    try:
+        raw = BasicClient(("127.0.0.1", svc.port), secret=SECRET,
+                          timeout_s=10.0, attempts=1)
+        with pytest.raises(WireError) as excinfo:
+            try:
+                _decode_status(raw.request_raw(encode_hello(0, "sub:9")))
+            finally:
+                raw.close()
+        assert expected in str(excinfo.value)
+    finally:
+        svc.shutdown()
+
+    psvc = ControllerService(2, Negotiator(2, 1 << 26), secret=SECRET,
+                             port=0, world_id="sub:0,1")
+    try:
+        raw = BasicClient(("127.0.0.1", psvc.port), secret=SECRET,
+                          timeout_s=10.0, attempts=1)
+        with pytest.raises(WireError) as excinfo:
+            try:
+                raw.request(("hello", 0, "sub:9"))
+            finally:
+                raw.close()
+        assert expected in str(excinfo.value)
+    finally:
+        psvc.shutdown()

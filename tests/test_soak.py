@@ -82,3 +82,29 @@ def test_threaded_submission_soak_two_ranks():
     rc = launch([sys.executable, worker], np=2, host_data_plane=True,
                 env_extra=env, job_timeout_s=240.0)
     assert rc == 0
+
+
+@pytest.mark.parametrize("controller", [
+    pytest.param("native",
+                 marks=pytest.mark.skipif(not cc.available(),
+                                          reason="native core not built")),
+    "python",
+], ids=["native", "python"])
+def test_subset_churn_soak_four_ranks(controller):
+    """Alternating subset memberships across world lifecycles — the soak
+    that found the cross-world registration race (a non-member of world N
+    racing into world N+1 superseded a LIVE member's rank on the shared
+    port; fixed by the world-identity protocol, WORLD_MISMATCH in
+    core.status). Count-based: all launcher ranks run the same epoch
+    schedule, and a non-member cannot join a member-world stop broadcast.
+    Validated at 150 rounds; runs a shorter budget here."""
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_subset_soak_worker.py")
+    env = dict(os.environ)
+    env["SOAK_ROUNDS"] = "25"
+    env["HOROVOD_NATIVE_CONTROLLER"] = "1" if controller == "native" else "0"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    rc = launch([sys.executable, worker], np=4, host_data_plane=True,
+                env_extra=env, job_timeout_s=240.0)
+    assert rc == 0

@@ -1,0 +1,60 @@
+"""Failure-injection churn: a victim dies at a RANDOMIZED point in a
+randomized collective stream; survivors must surface SHUT_DOWN_ERROR
+within a bound, every time.
+
+The single-shot peer_death scenario (tests/_mp_worker.py) pins one
+timing; this worker is run many times by test_soak.py with different
+HOROVOD_TEST_KILL_CYCLE values so the death lands during negotiation,
+payload exchange, or idle — wherever the seed puts it. Victim exits 7;
+survivors exit 0 after ASSERTING the error semantics (so the harness
+distinguishes 'survived correctly' from 'hung/crashed')."""
+import os
+import sys
+import time
+
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+import horovod_tpu as hvd
+
+rank = int(os.environ["HOROVOD_RANK"])
+size = int(os.environ["HOROVOD_SIZE"])
+kill_cycle = int(os.environ["HOROVOD_TEST_KILL_CYCLE"])
+seed = int(os.environ.get("HOROVOD_TEST_SEED", "7"))
+victim = size - 1
+
+hvd.init()
+rng = np.random.default_rng(seed)
+# formed-world barrier: a death during init is a different failure class
+hvd.allreduce(np.ones((2,), np.float32), average=False, name="ds.barrier")
+
+t0 = time.monotonic()
+try:
+    for cyc in range(10_000):
+        if rank == victim and cyc == kill_cycle:
+            # die with tensors possibly in flight - a real crash: no
+            # shutdown message, no atexit
+            os._exit(7)
+        handles = []
+        for i in range(int(rng.integers(1, 6))):
+            shape = (int(rng.integers(1, 100)),)
+            handles.append(hvd.allreduce_async(
+                np.full(shape, float(rank), np.float32), average=False,
+                name=f"ds.{cyc}.{i}"))
+        for h in handles:
+            hvd.synchronize(h)
+except RuntimeError as exc:
+    # HorovodInternalError via synchronize, OR the engine's plain
+    # RuntimeError(SHUT_DOWN_ERROR) when the randomized kill point lands
+    # an enqueue after the background loop already stopped - both are the
+    # correct reference semantics (HorovodInternalError is a RuntimeError)
+    assert "shut down" in str(exc), exc
+    elapsed = time.monotonic() - t0
+    assert elapsed < 60.0, f"unblocked only after {elapsed:.1f}s"
+    print(f"DSOAK-OK rank {rank} (peer death surfaced cleanly)",
+          flush=True)
+    os._exit(0)
+raise AssertionError("victim never died or survivors never noticed")

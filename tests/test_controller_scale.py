@@ -223,7 +223,7 @@ def test_watch_channel_reconnects_on_transient_drop():
     gate = threading.Event()
 
     def handle(req, _sock):
-        assert req == ("watch",)
+        assert req == ("watch", "")  # world id rides the watch wire
         state["watch_requests"] += 1
         if state["watch_requests"] == 1:
             # -> RemoteError -> client-side WireError -> reconnect path
@@ -311,7 +311,7 @@ def test_hello_retries_through_dying_server_backlog():
         conn, _ = lsock.accept()
         served["conns"] += 1
         req = wire.read(conn)
-        assert req == ("hello", 0), req
+        assert req == ("hello", 0, ""), req  # world id rides the hello
         conn.sendall(wire.frame(("ok",)))
         conn.close()
 

@@ -108,3 +108,54 @@ def test_subset_churn_soak_four_ranks(controller):
     rc = launch([sys.executable, worker], np=4, host_data_plane=True,
                 env_extra=env, job_timeout_s=240.0)
     assert rc == 0
+
+
+@pytest.mark.parametrize("kill_cycle", [0, 2, 9, 33])
+def test_death_churn_soak_three_ranks(kill_cycle):
+    """Failure injection at randomized stream positions: the victim dies
+    at a different collective cycle each case (during negotiation,
+    payload exchange, or idle — wherever the cycle lands), and every
+    survivor must assert SHUT_DOWN_ERROR semantics within the bound.
+    Direct Popen control: the launcher's die-together policy would
+    terminate survivors before they can assert."""
+    import subprocess
+
+    from horovod_tpu.runner.launcher import _free_port, build_rank_env
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_death_soak_worker.py")
+    port = _free_port()
+    from horovod_tpu.runner.network import make_secret
+    secret = make_secret()
+    size = 3
+    procs = []
+    for rank in range(size):
+        env = build_rank_env(rank, size, port, secret,
+                             host_data_plane=True)
+        env.update({
+            "HOROVOD_TEST_KILL_CYCLE": str(kill_cycle),
+            "HOROVOD_TEST_SEED": str(11 + kill_cycle),
+            "HOROVOD_CYCLE_TIME": "2",
+            "PYTHONPATH": os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+        })
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    victim = size - 1
+    for rank, proc in enumerate(procs):
+        try:
+            out, err = proc.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise AssertionError(
+                f"rank {rank} hung after peer death (kill_cycle="
+                f"{kill_cycle})")
+        if rank == victim:
+            assert proc.returncode == 7, (out, err)
+        else:
+            assert proc.returncode == 0, (
+                f"survivor {rank} rc={proc.returncode}\n{out}\n{err}")
+            assert "DSOAK-OK" in out

@@ -1,0 +1,83 @@
+"""Torch front-end churn: many real train steps through the hook path.
+
+The collectives engine has its own soaks; this one targets the torch
+binding's stateful machinery under sustained stepping — gradient hooks
+firing per backward, handle bookkeeping, ``backward_passes_per_step``
+accumulation windows, fp16 wire compression, and EVERY step's
+force-allreduce of the dead head's untouched parameters (the model
+carries a layer that never feeds the loss, the reference
+``test_force_allreduce`` situation) — with the cross-rank
+identical-weights invariant (every step applies the world-averaged
+gradient) checked every 10 steps."""
+import os
+import sys
+
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+import horovod_tpu as hvd
+
+STEPS = int(os.environ.get("SOAK_STEPS", "120"))
+ACCUM = 3  # backward_passes_per_step
+rank = int(os.environ["HOROVOD_RANK"])
+size = int(os.environ["HOROVOD_SIZE"])
+
+import torch
+
+import horovod_tpu.torch as hvd_torch
+
+hvd.init()
+torch.manual_seed(77)  # same init everywhere
+
+
+class Net(torch.nn.Module):
+    def __init__(self) -> None:
+        super().__init__()
+        self.body = torch.nn.Sequential(
+            torch.nn.Linear(6, 8), torch.nn.ReLU(), torch.nn.Linear(8, 2))
+        # dead head: registered, never feeds the loss — its grads stay
+        # None and the optimizer must force-allreduce them EVERY step
+        # (reference test_force_allreduce; scenario torch_unused is the
+        # single-shot pin, this soaks it)
+        self.dead_head = torch.nn.Linear(6, 3)
+
+    def forward(self, x):
+        return self.body(x)
+
+
+model = Net()
+opt = hvd_torch.DistributedOptimizer(
+    torch.optim.SGD(model.parameters(), lr=0.05),
+    named_parameters=model.named_parameters(),
+    compression=hvd_torch.Compression.fp16,
+    backward_passes_per_step=ACCUM)
+hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+hvd_torch.broadcast_optimizer_state(opt, root_rank=0)
+
+g = torch.Generator().manual_seed(123)  # same data stream shape-wise
+for step_no in range(STEPS):
+    opt.zero_grad()
+    for micro in range(ACCUM):
+        # rank-dependent data: averaging is what keeps ranks identical
+        x = torch.randn(4, 6, generator=g) + rank * 0.1
+        y = torch.randn(4, 2, generator=g)
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+    opt.step()
+    if step_no % 10 == 0:
+        # cross-rank weight equivalence: the product's core invariant
+        flat = torch.cat([p.detach().reshape(-1)
+                          for p in model.parameters()])
+        gathered = hvd_torch.allgather(flat.unsqueeze(0),
+                                       name=f"tw.eq.{step_no}")
+        for r in range(size):
+            np.testing.assert_allclose(
+                gathered[r].numpy(), flat.numpy(), rtol=1e-4,
+                err_msg=f"rank weights diverged at step {step_no}")
+
+hvd.shutdown()
+print(f"TORCHSOAK-OK rank {rank} steps={STEPS}", flush=True)
+os._exit(0)

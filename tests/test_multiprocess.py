@@ -25,10 +25,14 @@ def _free_port() -> int:
 
 
 def _run_world(scenario: str, size: int, timeout: float = 90.0,
-               extra_env=None, expected_codes=None):
+               extra_env=None, expected_codes=None, worker: str = None,
+               ok_marker: str = "WORKER-OK"):
     """Spawn a world; assert per-rank exit codes (default: everyone exits 0
     and prints WORKER-OK; ``expected_codes={rank: code}`` overrides
-    individual ranks, e.g. a deliberately crashing victim)."""
+    individual ranks, e.g. a deliberately crashing victim). ``worker``
+    substitutes another worker script for ``_mp_worker.py`` (the soak
+    workers in test_soak.py reuse this harness); its ``ok_marker`` is the
+    success line those rank-0-exit workers must print."""
     expected_codes = expected_codes or {}
     port = _free_port()
     procs = []
@@ -50,7 +54,8 @@ def _run_world(scenario: str, size: int, timeout: float = 90.0,
             env.update(extra_env)
         env.pop("JAX_PLATFORMS", None)
         procs.append(subprocess.Popen(
-            [sys.executable, _WORKER, scenario],
+            [sys.executable, worker or _WORKER] +
+            ([scenario] if scenario else []),
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True))
     results = []
@@ -68,7 +73,7 @@ def _run_world(scenario: str, size: int, timeout: float = 90.0,
             f"rank {rank} exited {code}, expected {want} in scenario "
             f"{scenario!r}\nstdout:\n{out}\nstderr:\n{err}")
         if want == 0:
-            assert f"WORKER-OK {rank}" in out
+            assert ok_marker in out, (rank, out)
     return results
 
 

@@ -116,46 +116,36 @@ def test_death_churn_soak_three_ranks(kill_cycle):
     at a different collective cycle each case (during negotiation,
     payload exchange, or idle — wherever the cycle lands), and every
     survivor must assert SHUT_DOWN_ERROR semantics within the bound.
-    Direct Popen control: the launcher's die-together policy would
-    terminate survivors before they can assert."""
-    import subprocess
+    Reuses test_multiprocess's direct-Popen world harness: the
+    launcher's die-together policy would terminate survivors before
+    they can assert."""
+    import test_multiprocess as mp
 
-    from horovod_tpu.runner.launcher import _free_port, build_rank_env
-
-    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "_death_soak_worker.py")
-    port = _free_port()
-    from horovod_tpu.runner.network import make_secret
-    secret = make_secret()
     size = 3
-    procs = []
-    for rank in range(size):
-        env = build_rank_env(rank, size, port, secret,
-                             host_data_plane=True)
-        env.update({
+    mp._run_world(
+        None, size, timeout=120.0,
+        worker=os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "_death_soak_worker.py"),
+        extra_env={
             "HOROVOD_TEST_KILL_CYCLE": str(kill_cycle),
             "HOROVOD_TEST_SEED": str(11 + kill_cycle),
-            "HOROVOD_CYCLE_TIME": "2",
             "PYTHONPATH": os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__))),
-        })
-        env.pop("JAX_PLATFORMS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, worker], env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True))
-    victim = size - 1
-    for rank, proc in enumerate(procs):
-        try:
-            out, err = proc.communicate(timeout=120)
-        except subprocess.TimeoutExpired:
-            for p in procs:
-                p.kill()
-            raise AssertionError(
-                f"rank {rank} hung after peer death (kill_cycle="
-                f"{kill_cycle})")
-        if rank == victim:
-            assert proc.returncode == 7, (out, err)
-        else:
-            assert proc.returncode == 0, (
-                f"survivor {rank} rc={proc.returncode}\n{out}\n{err}")
-            assert "DSOAK-OK" in out
+        },
+        expected_codes={size - 1: 7}, ok_marker="DSOAK-OK")
+
+
+def test_torch_train_churn_two_ranks():
+    """Sustained real training through the torch binding: per-backward
+    gradient hooks, backward_passes_per_step accumulation windows, fp16
+    wire compression, and the cross-rank identical-weights invariant
+    checked every 10 steps (validated at 120 steps; shorter here)."""
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_torch_soak_worker.py")
+    env = dict(os.environ)
+    env["SOAK_STEPS"] = "60"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    rc = launch([sys.executable, worker], np=2, host_data_plane=True,
+                env_extra=env, job_timeout_s=240.0)
+    assert rc == 0

@@ -1,0 +1,66 @@
+"""TF2 front-end churn: sustained DistributedGradientTape stepping.
+
+Targets the TF binding's stateful machinery — trace-time op names inside
+``tf.function`` (one graph, many executions), the custom-gradient
+collective rules, and the batched py_function grad path — with the
+cross-rank identical-weights invariant checked periodically."""
+import os
+import sys
+
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+import horovod_tpu as hvd
+
+STEPS = int(os.environ.get("SOAK_STEPS", "60"))
+rank = int(os.environ["HOROVOD_RANK"])
+size = int(os.environ["HOROVOD_SIZE"])
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd_tf
+
+hvd.init()
+tf.random.set_seed(4242)
+model = tf.keras.Sequential([
+    tf.keras.Input(shape=(6,)),
+    tf.keras.layers.Dense(8, activation="relu"),
+    tf.keras.layers.Dense(2),
+])
+opt = tf.keras.optimizers.SGD(0.05)
+hvd_tf.broadcast_variables(model.variables, root_rank=0)
+
+g = tf.random.Generator.from_seed(99)  # same stream on every rank
+
+
+@tf.function  # ONE traced graph executed STEPS times: the trace-time
+def step(x, y):  # name assignment must hold across executions
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_mean(tf.square(model(x, training=True) - y))
+    tape = hvd_tf.DistributedGradientTape(tape)
+    grads = tape.gradient(loss, model.trainable_variables)
+    opt.apply_gradients(zip(grads, model.trainable_variables))
+    return loss
+
+
+for step_no in range(STEPS):
+    x = g.normal((4, 6)) + rank * 0.1
+    y = g.normal((4, 2))
+    step(x, y)
+    if step_no % 10 == 0:
+        flat = np.concatenate([v.numpy().ravel()
+                               for v in model.trainable_variables])
+        gathered = hvd_tf.allgather(
+            tf.constant(flat[None, :]), name=f"tfw.eq.{step_no}").numpy()
+        for r in range(size):
+            np.testing.assert_allclose(
+                gathered[r], flat, rtol=1e-4,
+                err_msg=f"rank weights diverged at step {step_no}")
+
+hvd.shutdown()
+print(f"TFSOAK-OK rank {rank} steps={STEPS}", flush=True)
+os._exit(0)

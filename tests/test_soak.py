@@ -140,6 +140,7 @@ def test_torch_train_churn_two_ranks():
     gradient hooks, backward_passes_per_step accumulation windows, fp16
     wire compression, and the cross-rank identical-weights invariant
     checked every 10 steps (validated at 120 steps; shorter here)."""
+    pytest.importorskip("torch")
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "_torch_soak_worker.py")
     env = dict(os.environ)
@@ -148,4 +149,21 @@ def test_torch_train_churn_two_ranks():
         os.path.abspath(__file__)))
     rc = launch([sys.executable, worker], np=2, host_data_plane=True,
                 env_extra=env, job_timeout_s=240.0)
+    assert rc == 0
+
+
+def test_tf_train_churn_two_ranks():
+    """Sustained DistributedGradientTape stepping through ONE traced
+    tf.function graph: trace-time collective names must hold across many
+    executions, with the cross-rank identical-weights invariant checked
+    every 10 steps."""
+    pytest.importorskip("tensorflow")
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_tf_soak_worker.py")
+    env = dict(os.environ)
+    env["SOAK_STEPS"] = "40"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    rc = launch([sys.executable, worker], np=2, host_data_plane=True,
+                env_extra=env, job_timeout_s=300.0)
     assert rc == 0

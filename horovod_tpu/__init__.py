@@ -32,9 +32,18 @@ if _platform:
     import jax as _jax
 
     _jax.config.update("jax_platforms", _platform)
-    try:  # diagnose the one case the pin cannot fix: a live backend
-        _live = bool(_jax._src.xla_bridge._backends)
-    except Exception:  # noqa: BLE001 - private probe, best-effort
+    try:  # diagnose the one case the pin cannot fix: a live backend.
+        # backends_are_initialized() is the purpose-built passive query
+        # (jax.config itself uses it to validate late config changes);
+        # there is no fully-public equivalent that doesn't itself
+        # initialize a backend.
+        _live = _jax._src.xla_bridge.backends_are_initialized()
+    except Exception as _exc:  # noqa: BLE001 - probe moved in a future JAX
+        from .core.logging import LOG as _LOG
+
+        _LOG.debug("HOROVOD_PLATFORM late-backend probe unavailable "
+                   f"({_exc!r}); cannot warn if the pin came too late")
+        del _LOG
         _live = False
     if _live:
         import warnings as _warnings

@@ -698,7 +698,15 @@ class ControllerServer {
           uint16_t wid_len = r.Get<uint16_t>();
           caller_wid = r.GetBytes(wid_len);
         }
-        if (r.ok && !caller_wid.empty() && !world_id_.empty() &&
+        // A declared world-id length that overruns the frame (r.ok false)
+        // must REFUSE, not fall through as if the hello carried no world
+        // id — that would let a corrupt frame from a wrong-world client
+        // bypass the identity guard (the Python service errors on a
+        // malformed request tuple the same way).
+        if (!r.ok)
+          return QueueWrite(fd, ErrorResp("malformed hello: world id "
+                                          "length overruns the frame"));
+        if (!caller_wid.empty() && !world_id_.empty() &&
             caller_wid != world_id_) {
           // a co-scheduled different world's client (subset schedules
           // share this port): refusing prevents its remapped rank from
@@ -750,7 +758,10 @@ class ControllerServer {
           uint16_t wid_len = r.Get<uint16_t>();
           caller_wid = r.GetBytes(wid_len);
         }
-        if (r.ok && !caller_wid.empty() && !world_id_.empty() &&
+        if (!r.ok)  // same malformed-length refusal as kHello above
+          return QueueWrite(fd, ErrorResp("malformed watch: world id "
+                                          "length overruns the frame"));
+        if (!caller_wid.empty() && !world_id_.empty() &&
             caller_wid != world_id_) {
           // wrong world: must neither park nor receive THIS world's abort
           return QueueWrite(

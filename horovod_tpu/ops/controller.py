@@ -680,12 +680,23 @@ def connect_with_hello(addr, secret, timeout_s, connect_attempts,
     lost: a new connection for a rank supersedes the old registration, so
     the stale close is not a rank death."""
     last: Optional[Exception] = None
-    # ~30 s of re-dialing: a refused hello burns one iteration, and the
-    # gap between a world's negotiated shutdown and the successor service
-    # binding can span a slow rank's whole teardown — a 3 s budget made
-    # the retryable refusal terminally fatal in exactly the race it
-    # exists to survive.
-    for _ in range(100):
+    # Time-based re-dial windows, NOT a fixed iteration count. Two distinct
+    # waits hide behind a refused/failed hello:
+    #   * transport losses / CONTROLLER_RESTARTING — the gap between a
+    #     world's negotiated shutdown and the successor service binding,
+    #     bounded by a slow rank's teardown (seconds);
+    #   * WORLD_MISMATCH — a non-member of world N racing ahead into world
+    #     N+1 while N's service still holds the shared port, which lasts
+    #     however long world N's REMAINING WORKLOAD runs (an epoch can be
+    #     minutes). A fixed 100-iteration budget was terminally exhausted
+    #     in exactly the race it existed to survive; this window is tied
+    #     to HOROVOD_START_TIMEOUT — the same generous, user-tunable knob
+    #     that governs initial connects (core.config.start_timeout_s).
+    from ..core.config import Config
+    start_timeout_s = max(Config.from_env().start_timeout_s, 30.0)
+    deadline = time.monotonic() + 30.0  # transport-loss budget
+    mismatch_deadline = time.monotonic() + start_timeout_s
+    while True:
         client = BasicClient(addr, secret=secret, timeout_s=timeout_s,
                              attempts=connect_attempts)
         try:
@@ -698,11 +709,22 @@ def connect_with_hello(addr, secret, timeout_s, connect_attempts,
             # is the dying previous world's service explicitly telling a
             # next-world client to re-dial; any other WireError is a
             # deliberate server decision — final.
+            mismatch = WORLD_MISMATCH in str(exc)
             if not (isinstance(exc, (ConnectionClosedError, OSError))
                     or CONTROLLER_RESTARTING in str(exc)
-                    or WORLD_MISMATCH in str(exc)):
+                    or mismatch):
                 raise
             last = exc
+            now = time.monotonic()
+            if mismatch:
+                # every refusal proves the old service is still up; the
+                # transport-loss budget must cover the teardown gap AFTER
+                # the last refusal, so it rolls forward with each one
+                deadline = max(deadline, now + 30.0)
+                if now >= mismatch_deadline:
+                    break
+            elif now >= deadline:
+                break
             time.sleep(0.3)
     raise WireError(
         f"controller hello failed after retries: {last}") from last

@@ -101,12 +101,21 @@ class HandleManager:
     (``torch/handle_manager.cc:22-52``). Results carry the numpy output so
     ``synchronize`` can hand it back to the framework layer. Completed
     results remain readable after the engine stops — only never-completed
-    entries get flushed with SHUT_DOWN_ERROR."""
+    entries get flushed with SHUT_DOWN_ERROR.
+
+    Eviction contract: past ``MAX_RETAINED`` completed-but-unclaimed
+    results, the oldest lose their PAYLOAD (the numpy array — the part
+    that matters for memory) but keep a tombstone, so a late
+    ``poll``/``wait`` gets a self-explanatory eviction error rather than
+    ``unknown handle``. Tombstones are only dropped entirely past
+    ``MAX_TOMBSTONES`` — at that point the caller abandoned >1M handles
+    and ``unknown handle`` is accurate."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._done: Dict[int, threading.Event] = {}
         self._results: Dict[int, tuple] = {}
+        self._evicted: Dict[int, None] = {}  # insertion-ordered set
 
     def allocate(self) -> int:
         with self._lock:
@@ -116,8 +125,14 @@ class HandleManager:
 
     # Abandoned handles (fired-and-forgotten async ops) must not grow the
     # result table without bound in week-long jobs; evict oldest completed
-    # entries past this many outstanding results.
+    # payloads past this many outstanding results, oldest tombstones past
+    # MAX_TOMBSTONES. Tombstoned handles share one pre-set Event (they are
+    # all completed by construction) so a tombstone costs two dict slots,
+    # not a live Event.
     MAX_RETAINED = 1 << 16
+    MAX_TOMBSTONES = 1 << 18
+    _TOMBSTONE_EVENT = threading.Event()
+    _TOMBSTONE_EVENT.set()
 
     def mark_done(self, handle: int, status: Status,
                   result: Optional[np.ndarray]) -> None:
@@ -127,7 +142,12 @@ class HandleManager:
             while len(self._results) > self.MAX_RETAINED:
                 oldest = next(iter(self._results))
                 del self._results[oldest]
-                self._done.pop(oldest, None)
+                self._evicted[oldest] = None
+                self._done[oldest] = self._TOMBSTONE_EVENT
+            while len(self._evicted) > self.MAX_TOMBSTONES:
+                stale = next(iter(self._evicted))
+                del self._evicted[stale]
+                self._done.pop(stale, None)
 
     def poll(self, handle: int) -> bool:
         with self._lock:
@@ -144,6 +164,14 @@ class HandleManager:
         if not event.wait(timeout):
             raise TimeoutError(f"collective handle {handle} did not complete")
         with self._lock:
+            if handle in self._evicted:
+                del self._evicted[handle]
+                self._done.pop(handle, None)
+                raise ValueError(
+                    f"handle {handle}: result evicted — it completed but "
+                    f"went unclaimed while > {self.MAX_RETAINED} newer "
+                    f"results piled up; synchronize() or release() handles "
+                    f"promptly")
             status, result = self._results.pop(handle)
             del self._done[handle]
         status.raise_if_error()

@@ -45,13 +45,33 @@ class RemoteError:
         self.message = message
 
 
+_warned_default_secret = False
+
+
 def default_secret() -> bytes:
     """Per-job HMAC key (``spark/util/secret.py``): the launcher generates a
-    random key and exports it; standalone single-host runs fall back to a
-    fixed development key."""
+    random key and exports it (``make_secret``); standalone single-host runs
+    fall back to a fixed development key — and warn loudly, once, because a
+    well-known key means any local process can speak to the controller. The
+    reference never runs with a shared static key (its launcher always
+    distributes a random per-job secret); here the standalone path keeps
+    working for tests/dev, but production jobs must come through the
+    launcher or export HOROVOD_SECRET_KEY."""
     raw = os.environ.get("HOROVOD_SECRET_KEY", "")
     if raw:
         return bytes.fromhex(raw)
+    global _warned_default_secret
+    if not _warned_default_secret:
+        _warned_default_secret = True
+        import warnings
+
+        warnings.warn(
+            "HOROVOD_SECRET_KEY is not set: falling back to the fixed "
+            "development HMAC key, so ANY local process can talk to the "
+            "controller. Launch through horovodrun (which exports a random "
+            "per-job key) or set HOROVOD_SECRET_KEY=$(python -c 'import "
+            "os; print(os.urandom(32).hex())').", RuntimeWarning,
+            stacklevel=2)
     return b"horovod-tpu-insecure-default-key"
 
 

@@ -73,7 +73,13 @@ def _run_world(scenario: str, size: int, timeout: float = 90.0,
             f"rank {rank} exited {code}, expected {want} in scenario "
             f"{scenario!r}\nstdout:\n{out}\nstderr:\n{err}")
         if want == 0:
-            assert ok_marker in out, (rank, out)
+            # The default worker prints a rank-qualified "WORKER-OK <rank>";
+            # requiring the qualified form means a worker echoing another
+            # rank's marker (or a partial world) cannot pass for everyone.
+            # Substitute workers (the soak scripts) own their marker text.
+            marker = (f"{ok_marker} {rank}" if worker is None
+                      and ok_marker == "WORKER-OK" else ok_marker)
+            assert marker in out, (rank, marker, out)
     return results
 
 

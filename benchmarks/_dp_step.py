@@ -12,18 +12,32 @@ from __future__ import annotations
 
 
 def make_dp_train_step(model, opt, mesh, axis_name: str = "data",
-                       donate: bool = True):
+                       donate: bool = True, hierarchical=None):
     """Build the jitted DP train step over ``mesh``'s ``axis_name``.
 
     Returns ``step(params, opt_state, batch_stats, x, y) -> (params,
     opt_state, batch_stats)`` with x/y sharded on the data axis and
     everything else replicated. Models without BatchNorm pass
     ``batch_stats={}`` through unchanged.
+
+    ``hierarchical`` (default: follow ``HOROVOD_HIERARCHICAL_ALLREDUCE``
+    via the optimizer's own resolution) selects the two-level factored
+    gradient reduction over a (dcn, ici) ``axis_name`` pair. That mode
+    traces with ``check_vma=False``: under vma tracking shard_map pre-sums
+    replicated-param cotangents with a flat whole-mesh psum before the
+    optimizer's transform runs, which would silently bypass the factored
+    reduce_scatter/psum/all_gather route (``operations.cc:1284-1436``'s
+    TPU analog in ``parallel/hierarchical.py``).
     """
     import jax
     import optax
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    if hierarchical is None:
+        from horovod_tpu.optimizers import _use_hierarchical
+
+        hierarchical = _use_hierarchical(axis_name, None)
 
     def loss_fn(params, batch_stats, x, y):
         logits, updated = model.apply(
@@ -46,5 +60,6 @@ def make_dp_train_step(model, opt, mesh, axis_name: str = "data",
     return jax.jit(
         shard_map(train_step, mesh=mesh,
                   in_specs=(P(), P(), P(), P(axis_name), P(axis_name)),
-                  out_specs=(P(), P(), P())),
+                  out_specs=(P(), P(), P()),
+                  check_vma=not hierarchical),
         donate_argnums=(0, 1, 2) if donate else ())

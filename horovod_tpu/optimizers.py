@@ -41,8 +41,16 @@ def _use_hierarchical(axis_name, hierarchical) -> bool:
             len(tuple(axis_name)) != 2:
         return False
     # HOROVOD_HIERARCHICAL_ALLREDUCE knob, as in the reference
-    # (operations.cc:1880-1890); requires an initialized world.
-    return basics.is_initialized() and basics.config().hierarchical_allreduce
+    # (operations.cc:1880-1890). Resolution must not depend on init order:
+    # make_dp_train_step consults this at BUILD time to pick check_vma, and
+    # a step built before hvd.init() would otherwise silently lose the
+    # factored route (vma tracking pre-psums the cotangents). Initialized
+    # worlds use the pinned config; otherwise read the env directly.
+    if basics.is_initialized():
+        return basics.config().hierarchical_allreduce
+    from .core.config import Config
+
+    return Config.from_env().hierarchical_allreduce
 
 
 def allreduce_gradients(grads: Any, axis_name=None, average: bool = True,
@@ -59,14 +67,22 @@ def allreduce_gradients(grads: Any, axis_name=None, average: bool = True,
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if axis_name is not None:
         if _use_hierarchical(axis_name, hierarchical):
-            from .ops.spmd import _varies_over
+            from .ops.spmd import _varies_over, _vma_tracking_active
             from .parallel.hierarchical import hierarchical_grad_allreduce
 
             dcn_axis, ici_axis = tuple(axis_name)
+            # The factored route applies when the gradient still needs
+            # cross-device summing: a varying cotangent under vma tracking,
+            # or ANY cotangent under legacy tracing (check_vma=False, where
+            # shard_map does not auto-psum transposes — the mode a
+            # hierarchical step should be built in, because vma tracking
+            # pre-sums replicated-param grads with a flat whole-mesh psum
+            # before this transform ever sees them, silencing the knob).
+            legacy = not _vma_tracking_active(axis_name)
             reduced = []
             for g in leaves:
                 comp, ctx = compression.compress(g)
-                if _varies_over(comp, axis_name):
+                if legacy or _varies_over(comp, axis_name):
                     red = hierarchical_grad_allreduce(
                         comp, dcn_axis, ici_axis, average=average)
                 else:

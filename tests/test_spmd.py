@@ -157,3 +157,129 @@ def test_dp_step_compiles_to_one_fused_allreduce(hvd):
     for op in ("all-to-all", "collective-permute", "all-gather",
                "reduce-scatter"):
         assert op not in hlo, f"unexpected {op} in the DP step"
+
+
+def test_hierarchical_dp_step_two_level_collectives():
+    """The hierarchical twin of the fused-allreduce shape test, at 16
+    virtual devices on a (4 dcn, 4 ici) mesh with
+    HOROVOD_HIERARCHICAL_ALLREDUCE=1 (round-3 verdict, next-round #5):
+    gradient traffic must compile to the factored two-level pattern of
+    ``parallel/hierarchical.py`` — reduce-scatter over the ici axis,
+    all-reduce of the 1/|ici| shard over the dcn axis, all-gather back
+    over ici (``operations.cc:1284-1436``'s bandwidth shape) — not a flat
+    whole-mesh all-reduce per gradient. Subprocess: needs its own
+    device-count global (16 > the suite's 8)."""
+    import subprocess
+    import sys
+    import os
+
+    prog = r"""
+import os, re
+os.environ.pop("JAX_PLATFORMS", None)
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, jax.numpy as jnp, optax
+from jax.sharding import Mesh
+import horovod_tpu as hvd
+from benchmarks._dp_step import make_dp_train_step
+from horovod_tpu.models import ResNet
+from horovod_tpu.models.resnet import BottleneckResNetBlock
+
+hvd.init()
+devices = jax.devices()[:16]
+mesh = Mesh(np.asarray(devices).reshape(4, 4), ("dcn", "ici"))
+model = ResNet(stage_sizes=[1, 1], num_filters=8, num_classes=10,
+               block_cls=BottleneckResNetBlock, dtype=jnp.float32)
+x = jnp.ones((32, 16, 16, 3), jnp.float32)
+y = jnp.zeros((32,), jnp.int32)
+variables = model.init(jax.random.PRNGKey(0), x)
+params, batch_stats = variables["params"], variables["batch_stats"]
+opt = hvd.DistributedOptimizer(optax.sgd(0.01), axis_name=("dcn", "ici"))
+opt_state = opt.init(params)
+step = make_dp_train_step(model, opt, mesh, axis_name=("dcn", "ici"))
+hlo = step.lower(params, opt_state, batch_stats, x, y).compile().as_text()
+
+# device id = 4*dcn + ici, so ici groups are contiguous quads and dcn
+# groups are stride-4 quads
+ICI = "{{0,1,2,3},{4,5,6,7},{8,9,10,11},{12,13,14,15}}"
+DCN = "{{0,4,8,12},{1,5,9,13},{2,6,10,14},{3,7,11,15}}"
+
+def groups_of(op):
+    pat = op + r"[^\n]*replica_groups=(\{\{[0-9,{}]*\}\})"
+    return set(re.findall(pat, hlo))
+
+rs, ag, ar = (groups_of("reduce-scatter"), groups_of("all-gather"),
+              groups_of("all-reduce"))
+assert ICI in rs, ("reduce-scatter not over ici", rs)
+assert ICI in ag, ("all-gather not over ici", ag)
+assert DCN in ar, ("no dcn-axis all-reduce of the reduced shard", ar)
+# gradient bytes must NOT ride a flat whole-mesh all-reduce; the only
+# legitimate whole-mesh reduces are the BN-stat/loss pmeans the step
+# does outside the optimizer, so whole-mesh groups may appear — but the
+# factored legs above prove the gradient path took the hierarchy.
+step_flat = make_dp_train_step(
+    model, hvd.DistributedOptimizer(optax.sgd(0.01),
+                                    axis_name=("dcn", "ici"),
+                                    hierarchical=False),
+    mesh, axis_name=("dcn", "ici"), hierarchical=False)
+hlo_flat = step_flat.lower(params, opt_state, batch_stats, x,
+                           y).compile().as_text()
+assert "reduce-scatter" not in hlo_flat, "flat path grew a reduce-scatter?"
+hvd.shutdown()
+print("HIER-OK")
+"""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    result = subprocess.run([sys.executable, "-c", prog], cwd=root, env=env,
+                            capture_output=True, text=True, timeout=600)
+    assert result.returncode == 0, result.stderr
+    assert "HIER-OK" in result.stdout
+
+
+def test_hierarchical_step_matches_flat_numerically(hvd):
+    """The factored reduce_scatter/psum/all_gather route must be a pure
+    implementation detail: one hierarchical train step from a shared init
+    produces the same parameters as the flat whole-mesh psum step."""
+    import optax
+    from jax.sharding import Mesh
+
+    from benchmarks._dp_step import make_dp_train_step
+    from horovod_tpu.models import ResNet
+    from horovod_tpu.models.resnet import BottleneckResNetBlock
+
+    devices = jax.devices()[:8]
+    mesh = Mesh(np.asarray(devices).reshape(2, 4), ("dcn", "ici"))
+    model = ResNet(stage_sizes=[1, 1], num_filters=8, num_classes=10,
+                   block_cls=BottleneckResNetBlock, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(7)
+    x = jax.random.normal(rng, (16, 16, 16, 3), jnp.float32)
+    y = jnp.arange(16, dtype=jnp.int32) % 10
+    variables = model.init(jax.random.PRNGKey(0), x)
+
+    outs = {}
+    for hier in (False, True):
+        params = variables["params"]
+        batch_stats = variables["batch_stats"]
+        opt = hvd.DistributedOptimizer(optax.sgd(0.01),
+                                       axis_name=("dcn", "ici"),
+                                       hierarchical=hier)
+        opt_state = opt.init(params)
+        step = make_dp_train_step(model, opt, mesh,
+                                  axis_name=("dcn", "ici"),
+                                  donate=False, hierarchical=hier)
+        outs[hier] = step(params, opt_state, batch_stats, x, y)
+
+    flat_p, _, flat_bn = outs[False]
+    hier_p, _, hier_bn = outs[True]
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        flat_p, hier_p)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        flat_bn, hier_bn)

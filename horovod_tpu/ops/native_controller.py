@@ -263,7 +263,8 @@ class NativeControllerService:
 
     def __init__(self, size: int, cfg, secret: Optional[bytes] = None,
                  port: int = 0, bind_host: str = "127.0.0.1",
-                 autotuner=None, world_id: str = "") -> None:
+                 autotuner=None, world_id: str = "",
+                 collect_stats: bool = False) -> None:
         import ctypes
 
         from .. import cc
@@ -276,12 +277,15 @@ class NativeControllerService:
         secret = secret if secret is not None else default_secret()
         err = ctypes.create_string_buffer(256)
         self._lib = lib
+        # collect_stats without an autotuner: the caller (controller_bench)
+        # drains the per-cycle (bytes, active µs) observations itself for a
+        # direct server-side cycle-time measurement.
         self._handle = lib.htpu_controller_start(
             size, bind_host.encode(), port, secret, len(secret),
             cfg.fusion_threshold_bytes, cfg.stall_warning_time_s,
             1 if cfg.stall_check_disable else 0,
             SHUT_DOWN_ERROR.encode("utf-8"),
-            1 if autotuner is not None else 0,
+            1 if (autotuner is not None or collect_stats) else 0,
             world_id.encode("utf-8"), err, len(err))
         if not self._handle:
             raise RuntimeError(
@@ -334,6 +338,28 @@ class NativeControllerService:
                 LOG.error("native autotune observation failed: %s", exc)
             if stopping:
                 return
+
+    def drain_stats(self, cap: int = 4096):
+        """Drain the server's per-cycle (payload bytes, active µs) samples.
+
+        Active µs is measured INSIDE the epoll loop — first rank's cycle
+        request arriving to the response broadcast being queued — so it is
+        a direct server-side cycle time, with no client/harness overhead in
+        it. Only populated when constructed with ``collect_stats=True`` (or
+        an autotuner, which then consumes the same buffer — don't mix)."""
+        import ctypes
+
+        if not self._handle:
+            return []
+        bytes_buf = (ctypes.c_double * cap)()
+        us_buf = (ctypes.c_double * cap)()
+        out = []
+        while True:
+            n = self._lib.htpu_controller_drain_stats(
+                self._handle, bytes_buf, us_buf, cap)
+            out.extend((bytes_buf[i], us_buf[i]) for i in range(n))
+            if n < cap:
+                return out
 
     def wait_world_shutdown(self, timeout_s: float) -> bool:
         import time

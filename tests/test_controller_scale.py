@@ -359,3 +359,34 @@ def test_reconnect_supersedes_old_connection():
     for out in outs.values():
         assert [n for r in out.responses for n in r.tensor_names] == \
             ["sup.t"]
+
+
+def test_controller_bench_multiprocess_mode():
+    """The round-3 verdict's direct-measurement ask: controller_bench
+    --procs spreads clients over real worker processes and reports a
+    SERVER-side cycle time drained from the service itself (native:
+    htpu_controller_drain_stats; python: the autotune sink). Pin the whole
+    path: worker spawn, rank-0 latency relay, server stat drain."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    result = subprocess.run(
+        [sys.executable,
+         os.path.join(root, "benchmarks", "controller_bench.py"),
+         "--sizes", "8", "--cycles", "6", "--procs", "2"],
+        cwd=root, env=env, capture_output=True, text=True, timeout=300)
+    assert result.returncode == 0, result.stderr
+    rows = [ln for ln in result.stdout.splitlines()
+            if re.match(r"(python|native)\s+8\s", ln)]
+    assert rows, result.stdout
+    for row in rows:
+        cols = row.split()
+        # impl ranks client_med client_worst server_med server_worst
+        assert len(cols) == 6, row
+        for v in cols[2:]:
+            assert float(v) > 0, row

@@ -41,3 +41,26 @@ def test_init_on_host_cpu_noop_on_cpu():
     from horovod_tpu.core.platform import init_on_host_cpu
 
     assert init_on_host_cpu(lambda: 1, None) is None
+
+
+def test_dryrun_multichip_hierarchical_16():
+    """The hierarchical dryrun twin (round-3 verdict next #5): at 16
+    virtual devices with HOROVOD_HIERARCHICAL_ALLREDUCE=1 the full DP
+    step must compile and execute through the factored two-level route
+    (the HLO shape itself is pinned in test_spmd)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    result = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(16); print('OK')"],
+        cwd=root, env=env, capture_output=True, text=True, timeout=500)
+    assert result.returncode == 0, result.stderr
+    assert "OK" in result.stdout
+    assert "hierarchical allreduce: ON" in result.stderr

@@ -84,6 +84,18 @@ while true; do
             HOROVOD_BENCH_DUMP_HLO="$OUT/resnet50_hlo.txt" \
             HOROVOD_BENCH_PROFILE="$OUT/resnet50_profile" \
                 run_bench "$name"
+            # summarize only when the bench actually landed its number —
+            # a timed-out attempt can leave a partial trace on disk, and
+            # attributing from it would put wrong evidence next to nothing
+            if have_result resnet50 && [ -d "$OUT/resnet50_profile" ]; then
+                # the captured XPlane -> bottleneck attribution, written
+                # next to the numbers (the bs32 MFU-cap evidence)
+                timeout 300 python tools/profile_summary.py \
+                    "$OUT/resnet50_profile" \
+                    --out "$OUT/resnet50_profile_summary.md" \
+                    > "$OUT/resnet50_profile_summary.log" 2>&1
+                log "profile summary rc=$?"
+            fi
         else
             # shellcheck disable=SC2086
             run_bench "$name" $benchargs

@@ -59,6 +59,56 @@ def _peak_tflops(device) -> Optional[float]:
     return None  # CPU runs: MFU is meaningless, skip the field
 
 
+def _setup_accelerator_cache(jax_module) -> None:
+    """Default the persistent compile cache ON for accelerator runs.
+
+    The shared-pool tunnel wedges most often during the multi-minute first
+    compile, and a warm cache turns a re-run's compile into a file read.
+    One repo-local dir so consecutive runs — watcher, driver, human —
+    share it. Gate on the RESOLVED backend (not env strings: an unpinned
+    run on a CPU-only box has no platform env at all) so CPU CI sweeps
+    don't accrete unbounded cache entries; set JAX_COMPILATION_CACHE_DIR
+    to opt in anywhere. Safe post-init: the cache config is read at
+    compile time. Shared by bench.py and benchmarks/lm_bench.py."""
+    if (not os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            and jax_module.default_backend() != "cpu"):
+        jax_module.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_bench_cache"))
+
+
+def _step_flops_of(compiled, log) -> Optional[float]:
+    """XLA's own FLOP count for one compiled step (per-device SPMD
+    program) — what MFU should be computed from; an analytic 2*MACs
+    estimate would miss rematerialization and the optimizer/BN work XLA
+    actually runs. Best-effort: None when the backend has no cost model."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0)) or None
+    except Exception as exc:  # noqa: BLE001 - cost model is best-effort
+        log(f"cost_analysis unavailable: {exc!r}")
+        return None
+
+
+def _add_mfu_fields(result: dict, step_flops: Optional[float],
+                    steps_per_s: float, device, log) -> None:
+    """Attach achieved TFLOP/s (+ mfu_pct on recognized accelerators)."""
+    if not step_flops:
+        return
+    achieved = step_flops * steps_per_s
+    # 4 decimals: tiny CPU validation runs land around 1e-3 TFLOP/s
+    # and must not round to a meaningless 0.0
+    result["tflops_per_device"] = round(achieved / 1e12, 4)
+    peak_tf = _peak_tflops(device)
+    if peak_tf:
+        result["mfu_pct"] = round(100.0 * achieved / (peak_tf * 1e12), 1)
+        log(f"MFU: {result['mfu_pct']}% "
+            f"({result['tflops_per_device']} of {peak_tf} TFLOP/s peak)")
+
+
 def _preflight_backend(attempts: Optional[int] = None,
                        probe_timeout_s: float = 120.0,
                        fatal: bool = True):
@@ -372,22 +422,7 @@ def main() -> None:
     platform_pin = os.environ.get("HOROVOD_BENCH_PLATFORM")
     if platform_pin:
         jax.config.update("jax_platforms", platform_pin)
-    if (not os.environ.get("JAX_COMPILATION_CACHE_DIR")
-            and jax.default_backend() != "cpu"):
-        # Persistent compile cache, on by default for accelerator runs: the
-        # shared-pool tunnel wedges most often during the multi-minute
-        # first compile, and a warm cache turns a re-run's compile into a
-        # file read. One repo-local dir (no per-run override) so
-        # consecutive runs — watcher, driver, human — share it. Gate on the
-        # RESOLVED backend (not env strings: an unpinned run on a CPU-only
-        # box has no platform env at all) so CPU CI sweeps don't accrete
-        # unbounded cache entries; set JAX_COMPILATION_CACHE_DIR to opt in
-        # anywhere. Safe to set post-init: the cache config is read at
-        # compile time, and the first compile is far below.
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         ".jax_bench_cache"))
+    _setup_accelerator_cache(jax)
     import jax.numpy as jnp
     import optax
 
@@ -449,21 +484,12 @@ def main() -> None:
 
     step = make_dp_train_step(model, opt, mesh, axis_name="data")
 
-    # AOT-compile once: the compiled executable exposes cost_analysis()
-    # (XLA's own FLOP count for the whole fwd+bwd+update program), which is
-    # what MFU should be computed from — an analytic 2*MACs estimate would
-    # miss rematerialization and the optimizer/BN work XLA actually runs.
+    # AOT-compile once; _step_flops_of reads the executable's own cost
+    # analysis for the MFU denominator's numerator.
     log("Compiling train step (AOT)...")
     compiled = step.lower(params, opt_state, batch_stats, images,
                           labels).compile()
-    step_flops = None
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        step_flops = float(ca.get("flops", 0.0)) or None
-    except Exception as e:  # noqa: BLE001 - cost model is best-effort
-        log(f"cost_analysis unavailable: {e!r}")
+    step_flops = _step_flops_of(compiled, log)
     dump = os.environ.get("HOROVOD_BENCH_DUMP_HLO")
     if dump:
         # the backend-optimized HLO (post AllReduceCombiner / fusion): the
@@ -544,19 +570,10 @@ def main() -> None:
         "n_devices": n_dev,
         "captured_at": round(time.time(), 1),
     }
-    if step_flops:
-        # cost_analysis() reports the per-device SPMD program, so achieved
-        # FLOP/s at steps/s executed is already a per-device figure
-        steps_per_s = mean / global_batch
-        achieved = step_flops * steps_per_s
-        # 4 decimals: tiny CPU validation runs land around 1e-3 TFLOP/s
-        # and must not round to a meaningless 0.0
-        result["tflops_per_device"] = round(achieved / 1e12, 4)
-        peak_tf = _peak_tflops(jax.devices()[0])
-        if peak_tf:
-            result["mfu_pct"] = round(100.0 * achieved / (peak_tf * 1e12), 1)
-            log(f"MFU: {result['mfu_pct']}% "
-                f"({result['tflops_per_device']} of {peak_tf} TFLOP/s peak)")
+    # cost_analysis() reports the per-device SPMD program, so achieved
+    # FLOP/s at steps/s executed is already a per-device figure
+    _add_mfu_fields(result, step_flops, mean / global_batch,
+                    jax.devices()[0], log)
     print(json.dumps(result))
     hvd.shutdown()
 

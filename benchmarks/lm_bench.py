@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Transformer-LM synthetic benchmark: tokens/s/device + MFU.
+
+The reference's benchmark family is conv nets (its 2019 vintage predates
+LM training at scale); this is the framework's second flagship workload —
+matmul-dominated, so it shows what the MXU can actually sustain where
+ResNet-50 at bs32 is bandwidth-bound (docs/benchmarks.md "Why bs32
+caps"). Same measurement protocol as ``bench.py``
+(``examples/pytorch_synthetic_benchmark.py:24-110``): synthetic data,
+10 warmup batches, ``--num-iters`` x ``--num-batches-per-iter`` timed
+batches, mean ± 1.96σ; the step is the framework's product path
+(``hvd.DistributedOptimizer`` over the data axis, jit + shard_map,
+donated buffers, AOT-compiled).
+
+Defaults are GPT-2-small-shaped (12 layers, 12 heads, d_model 768,
+d_ff 3072, seq 1024, vocab 32768) with the Pallas flash-attention kernel
+(``--attention dense`` for the XLA-fused baseline; the kernel
+auto-interprets off-TPU so CPU CI drives the identical code path).
+
+Prints ONE JSON line like bench.py, metric
+``transformer_lm_tokens_per_sec_per_device`` (vs_baseline null — the
+reference publishes no LM figure).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawTextHelpFormatter)
+    parser.add_argument("--num-layers", type=int, default=12)
+    parser.add_argument("--num-heads", type=int, default=12)
+    parser.add_argument("--d-model", type=int, default=768)
+    parser.add_argument("--d-ff", type=int, default=3072)
+    parser.add_argument("--vocab-size", type=int, default=32768)
+    parser.add_argument("--seq-len", type=int, default=1024)
+    parser.add_argument("--batch-size", type=int, default=8,
+                        help="sequences per device")
+    parser.add_argument("--attention", default="flash",
+                        choices=["dense", "flash"])
+    parser.add_argument("--remat", action="store_true",
+                        help="jax.checkpoint each block (long-seq memory)")
+    parser.add_argument("--num-warmup-batches", type=int, default=10)
+    parser.add_argument("--num-batches-per-iter", type=int, default=10)
+    parser.add_argument("--num-iters", type=int, default=10)
+    return parser.parse_args(argv)
+
+
+def main() -> None:
+    args = _parse_args()
+
+    import jax
+
+    platform_pin = os.environ.get("HOROVOD_BENCH_PLATFORM")
+    if platform_pin:
+        jax.config.update("jax_platforms", platform_pin)
+    from bench import (
+        _add_mfu_fields,
+        _log as log,
+        _setup_accelerator_cache,
+        _step_flops_of,
+    )
+
+    _setup_accelerator_cache(jax)
+    import jax.numpy as jnp
+    import optax
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.core.platform import init_on_host_cpu
+    from horovod_tpu.models import TransformerLM, lm_loss
+
+    hvd.init()
+    n_dev = hvd.local_device_count()
+    mesh = hvd.parallel.data_parallel_mesh()
+    log(f"TransformerLM: {args.num_layers}L/{args.num_heads}H/"
+        f"d{args.d_model}/ff{args.d_ff}, vocab {args.vocab_size}, "
+        f"seq {args.seq_len}, batch {args.batch_size}/device, "
+        f"attention={args.attention}, devices: {n_dev} "
+        f"({jax.devices()[0].platform})")
+
+    model = TransformerLM(
+        vocab_size=args.vocab_size, num_layers=args.num_layers,
+        num_heads=args.num_heads, d_model=args.d_model, d_ff=args.d_ff,
+        max_seq_len=args.seq_len, attention=args.attention,
+        remat=args.remat)
+    global_batch = args.batch_size * n_dev
+
+    def synthesize_and_init():
+        rng = jax.random.PRNGKey(0)
+        tokens = jax.random.randint(
+            rng, (global_batch, args.seq_len), 0, args.vocab_size,
+            dtype=jnp.int32)
+        # init with dense attention on tiny tokens: the pallas kernel's
+        # shapes are irrelevant to parameter shapes, and interpreting it
+        # on the host init backend would be minutes of wasted work
+        init_model = model.clone(attention="dense")
+        variables = init_model.init(jax.random.PRNGKey(1), tokens[:2, :8])
+        return tokens, variables
+
+    placed = init_on_host_cpu(
+        synthesize_and_init,
+        (NamedSharding(mesh, P("data")), NamedSharding(mesh, P())))
+    if placed is not None:
+        log("init done on host CPU; transferred to accelerator")
+        tokens, variables = placed
+    else:
+        tokens, variables = synthesize_and_init()
+    params = variables["params"]
+    log("model initialized")
+
+    opt = hvd.DistributedOptimizer(
+        optax.adamw(3e-4, weight_decay=0.01), axis_name="data")
+    opt_state = opt.init(params)
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    def train_step(params, opt_state, tokens):
+        def f(p):
+            return lm_loss(model.apply({"params": p}, tokens), tokens)
+
+        loss, grads = jax.value_and_grad(f)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state,
+                jax.lax.pmean(loss, "data"))
+
+    step = jax.jit(
+        shard_map(train_step, mesh=mesh,
+                  in_specs=(P(), P(), P("data")),
+                  out_specs=(P(), P(), P())),
+        donate_argnums=(0, 1))
+
+    log("Compiling LM train step (AOT)...")
+    compiled = step.lower(params, opt_state, tokens).compile()
+    step_flops = _step_flops_of(compiled, log)
+
+    loss = None
+
+    def run_batch():
+        nonlocal params, opt_state, loss
+        params, opt_state, loss = compiled(params, opt_state, tokens)
+
+    log(f"Running {args.num_warmup_batches} warmup batches...")
+    for _ in range(args.num_warmup_batches):
+        run_batch()
+    jax.block_until_ready(params)
+
+    tok_secs = []
+    tokens_per_batch = global_batch * args.seq_len
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            run_batch()
+        jax.block_until_ready(params)
+        dt = time.perf_counter() - t0
+        rate = tokens_per_batch * args.num_batches_per_iter / dt
+        tok_secs.append(rate)
+        log(f"Iter #{i}: {rate:.0f} tokens/sec total")
+
+    mean = float(np.mean(tok_secs))
+    conf = float(1.96 * np.std(tok_secs))
+    per_device = mean / n_dev
+    log(f"Tokens/sec/device: {per_device:.0f} +- {conf / n_dev:.0f} "
+        f"(loss {float(loss):.3f})")
+
+    result = {
+        "metric": "transformer_lm_tokens_per_sec_per_device",
+        "value": round(per_device, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,  # the reference publishes no LM figure
+        "live": True,
+        "attention": args.attention,
+        "seq_len": args.seq_len,
+        "batch_size": args.batch_size,
+        "n_devices": n_dev,
+        "captured_at": round(time.time(), 1),
+    }
+    # steps/s, not tokens/s: step_flops is the whole per-device step
+    _add_mfu_fields(result, step_flops, mean / tokens_per_batch,
+                    jax.devices()[0], log)
+    print(json.dumps(result))
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -229,6 +229,25 @@ def _from_bh(x, batch, heads):
     return x.reshape(batch, heads, seq, head_dim).transpose(0, 2, 1, 3)
 
 
+def _sds(shape, dtype, *like):
+    """ShapeDtypeStruct carrying the union of ``like`` operands' vma type.
+
+    Inside a vma-tracking ``shard_map`` (check_vma=True, the default),
+    ``pallas_call`` outputs must declare how they vary over mesh axes —
+    a kernel output varies exactly as much as its operands do. Outside
+    shard_map (or on JAX versions without vma) fall back to the plain
+    struct."""
+    from .spmd import operand_vma
+
+    vma = operand_vma(*like)
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # jax.typeof has vma but the struct kwarg is absent
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret, q_offset):
     batch, seq_q, heads, head_dim = q.shape
     seq_k = k.shape[1]
@@ -253,8 +272,8 @@ def _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret, q_offset):
             pl.BlockSpec((1, block_q), lambda bh, i, kk: (bh, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((batch * heads, seq_q, head_dim), q.dtype),
-            jax.ShapeDtypeStruct((batch * heads, seq_q), jnp.float32),
+            _sds((batch * heads, seq_q, head_dim), q.dtype, q, k, v),
+            _sds((batch * heads, seq_q), jnp.float32, q, k, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, head_dim), jnp.float32),
@@ -295,8 +314,8 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         in_specs=[qkv_spec_q, qkv_spec_k, qkv_spec_k, qkv_spec_q,
                   row_spec, row_spec],
         out_specs=qkv_spec_q,
-        out_shape=jax.ShapeDtypeStruct((batch * heads, seq_q, head_dim),
-                                       q.dtype),
+        out_shape=_sds((batch * heads, seq_q, head_dim), q.dtype,
+                       q, k, v, do),
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -320,8 +339,8 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_q, block_k,
                   kv_row_spec, kv_row_spec],
         out_specs=[kv_k_spec, kv_k_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((batch * heads, seq_k, head_dim), k.dtype),
-            jax.ShapeDtypeStruct((batch * heads, seq_k, head_dim), v.dtype),
+            _sds((batch * heads, seq_k, head_dim), k.dtype, q, k, v, do),
+            _sds((batch * heads, seq_k, head_dim), v.dtype, q, k, v, do),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, head_dim), jnp.float32),
                         pltpu.VMEM((block_k, head_dim), jnp.float32)],

@@ -66,6 +66,22 @@ def _varies_over(x, axis_name: AxisName) -> bool:
     return any(a in vma for a in _axes(axis_name))
 
 
+def operand_vma(*xs):
+    """Union of the operands' varying-manual-axes types, or ``None`` under
+    legacy tracing (a JAX without vma types, or a ``check_vma=False``
+    trace). The single compat point for the version-dependent
+    ``jax.typeof(x).vma`` probe — pallas out-shape typing
+    (``ops.pallas_attention``) and ring-attention accumulator typing
+    (``parallel.ring_attention``) both key off it."""
+    try:
+        out = frozenset()
+        for x in xs:
+            out |= jax.typeof(x).vma
+        return out
+    except (AttributeError, TypeError):
+        return None
+
+
 def allreduce(x: jax.Array, axis_name: AxisName, average: bool = True) -> jax.Array:
     """Sum (or average) across the named mesh axis.
 

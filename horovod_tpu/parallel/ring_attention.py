@@ -64,11 +64,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # the operands vary on (e.g. a batch axis when composing ring attention
     # with data parallelism on a 2-D mesh), since the body's outputs pick
     # up the operands' full vma set
-    try:
-        acc_axes = tuple(jax.typeof(q).vma | jax.typeof(k).vma |
-                         jax.typeof(v).vma | {axis_name})
-    except (AttributeError, TypeError):  # legacy tracing: no vma types
-        acc_axes = (axis_name,)
+    from ..ops.spmd import operand_vma
+
+    vma = operand_vma(q, k, v)
+    acc_axes = (axis_name,) if vma is None else tuple(vma | {axis_name})
 
     def _varying(x):
         return lax.pcast(x, acc_axes, to="varying")

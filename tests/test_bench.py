@@ -248,3 +248,28 @@ def test_preflight_nonfatal_returns_none(monkeypatch):
     monkeypatch.setenv("HOROVOD_BENCH_PREFLIGHT_ATTEMPTS", "2")
     assert bench._preflight_backend(fatal=False) is None
     assert len(calls) == 2
+
+
+def test_lm_bench_end_to_end_cpu():
+    """The Transformer-LM benchmark (second flagship workload) must run
+    end to end on CPU for both attention backends and emit the JSON line
+    — the watcher drives the same script on TPU."""
+    for attention in ("dense", "flash"):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["HOROVOD_BENCH_PLATFORM"] = "cpu"
+        result = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "benchmarks",
+                                          "lm_bench.py"),
+             "--num-layers", "1", "--num-heads", "2", "--d-model", "32",
+             "--d-ff", "64", "--vocab-size", "128", "--seq-len", "128",
+             "--batch-size", "1", "--num-warmup-batches", "1",
+             "--num-batches-per-iter", "1", "--num-iters", "1",
+             "--attention", attention],
+            cwd=_ROOT, env=env, capture_output=True, text=True, timeout=560)
+        assert result.returncode == 0, (attention, result.stderr)
+        line = json.loads(result.stdout.strip().splitlines()[-1])
+        assert line["metric"] == "transformer_lm_tokens_per_sec_per_device"
+        assert line["value"] > 0
+        assert line["attention"] == attention
+        assert line["tflops_per_device"] > 0

@@ -135,3 +135,43 @@ def test_flash_trains_in_transformer():
         variables, opt_state, loss = step(variables, opt_state)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_flash_under_vma_shard_map_matches_dense():
+    """The flash kernel must be legal inside a vma-tracking shard_map (the
+    DP product path wraps whole models in one): pallas_call outputs carry
+    the union of their operands' vma type (_sds). Data-parallel over the
+    batch, gradients and outputs must match the dense reference."""
+    import jax
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.parallel import data_parallel_mesh
+
+    mesh = data_parallel_mesh()
+    rng = np.random.default_rng(3)
+    q, k, v = (jnp.asarray(rng.normal(size=(8, 128, 2, 64)).astype(
+        np.float32)) for _ in range(3))
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (dense_attention(q, k, v, causal=True) ** 2).sum()
+
+    def sharded(fn):
+        def inner(q, k, v):
+            val, grads = jax.value_and_grad(fn, argnums=(0, 1, 2))(q, k, v)
+            return jax.lax.psum(val, "data"), grads
+
+        return jax.jit(shard_map(
+            inner, mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+            out_specs=(P(), (P("data"), P("data"), P("data")))))
+
+    val_f, grads_f = sharded(loss_flash)(q, k, v)
+    val_d, grads_d = sharded(loss_dense)(q, k, v)
+    np.testing.assert_allclose(float(val_f), float(val_d), rtol=2e-4)
+    for gf, gd in zip(grads_f, grads_d):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   rtol=2e-3, atol=2e-3)

@@ -56,6 +56,14 @@ run_onchip() {
     log "onchip path bench rc=$?: $(tail -1 "$OUT/onchip_tpu.json" 2>/dev/null)"
 }
 
+run_lm() {  # $1 = name, rest = lm_bench args
+    local name="$1"; shift
+    log "lm bench $name starting: $*"
+    timeout 2400 python benchmarks/lm_bench.py "$@" \
+        > "$OUT/$name.json" 2> "$OUT/$name.log"
+    log "lm bench $name done rc=$?: $(tail -1 "$OUT/$name.json" 2>/dev/null)"
+}
+
 log "watcher v3 started (pid $$)"
 round=0
 while true; do
@@ -66,6 +74,8 @@ while true; do
         "resnet101_bs64|--model resnet101 --batch-size 64" \
         "resnet50_bs128|--model resnet50 --batch-size 128" \
         "resnet50_bs256|--model resnet50 --batch-size 256" \
+        "lm_flash|LM --attention flash" \
+        "lm_dense|LM --attention dense" \
         "vgg16|--model vgg16" \
         "inception3|--model inception3" \
         "onchip_tpu|ONCHIP"; do
@@ -80,6 +90,9 @@ while true; do
         log "round $round: chip computes OK -> $name"
         if [ "$benchargs" = "ONCHIP" ]; then
             run_onchip
+        elif [ "${benchargs%% *}" = "LM" ]; then
+            # shellcheck disable=SC2086
+            run_lm "$name" ${benchargs#LM }
         elif [ "$name" = "resnet50" ]; then
             HOROVOD_BENCH_DUMP_HLO="$OUT/resnet50_hlo.txt" \
             HOROVOD_BENCH_PROFILE="$OUT/resnet50_profile" \

@@ -96,3 +96,27 @@ jax.profiler.stop_trace()
     assert "capture sessions" in result.stderr, result.stderr
     summary = json.loads(result.stdout.strip().splitlines()[-1])
     assert summary["total_self_time_us"] > 0
+
+
+def test_bench_table_renders_captures(tmp_path):
+    """tools/bench_table.py turns watcher captures into the docs table."""
+    (tmp_path / "resnet50.json").write_text(json.dumps({
+        "metric": "resnet50_synthetic_train_images_per_sec_per_device",
+        "value": 1700.0, "unit": "img/s", "vs_baseline": 16.4,
+        "live": True, "batch_size": 32, "mfu_pct": 10.8,
+        "tflops_per_device": 21.2}) + "\n")
+    (tmp_path / "junk.json").write_text("not json\n")
+    result = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "bench_table.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert result.returncode == 0, result.stderr
+    assert "ResNet-50, bs 32" in result.stdout
+    assert "10.8%" in result.stdout
+    empty = tmp_path / "none"
+    empty.mkdir()
+    result = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "bench_table.py"),
+         str(empty)],
+        capture_output=True, text=True, timeout=120)
+    assert result.returncode == 1

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Render the measured-results markdown table from watcher captures.
+
+    python tools/bench_table.py bench_results_r4
+
+Reads every ``*.json`` bench capture in the directory (one JSON line per
+file, as written by ``tools/chip_watch3.sh``) and prints the
+docs/benchmarks.md measured table — config, img|tokens/s/device, ±1.96σ
+when present, achieved TFLOP/s, MFU, and vs-reference ratio — so landing
+a capture into the docs is one copy-paste, not hand-transcription.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+_LABELS = {
+    "resnet50": "ResNet-50, bs {batch_size}",
+    "resnet101": "ResNet-101, bs {batch_size}",
+    "vgg16": "VGG-16, bs {batch_size}",
+    "inception3": "Inception V3, bs {batch_size}",
+    "transformer_lm": "Transformer LM ({attention}, seq {seq_len}, "
+                      "bs {batch_size})",
+}
+
+
+def _label(rec: dict) -> str:
+    model = rec.get("metric", "").split("_synthetic")[0]
+    model = model.replace("_train_images_per_sec_per_device", "")
+    model = model.replace("_tokens_per_sec_per_device", "")
+    tmpl = _LABELS.get(model, model or "?")
+    try:
+        return tmpl.format(**rec)
+    except KeyError:
+        return tmpl
+
+
+def main() -> None:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "bench_results_r4"
+    rows = []
+    for path in sorted(glob.glob(os.path.join(out_dir, "*.json"))):
+        try:
+            with open(path) as f:
+                lines = [ln for ln in f.read().splitlines()
+                         if ln.startswith("{")]
+            rec = json.loads(lines[-1])
+        except (OSError, ValueError, IndexError):
+            continue
+        if "metric" not in rec or "value" not in rec:
+            continue  # onchip bench etc. have their own tables
+        rows.append((os.path.basename(path), rec))
+    if not rows:
+        print(f"(no parseable captures in {out_dir})", file=sys.stderr)
+        sys.exit(1)
+    print("| Config | per-device rate | TFLOP/s | MFU | vs reference |"
+          " live |")
+    print("|---|---|---|---|---|---|")
+    for name, rec in rows:
+        unit = rec.get("unit", "")
+        tf = rec.get("tflops_per_device")
+        mfu = rec.get("mfu_pct")
+        vs = rec.get("vs_baseline")
+        print(f"| {_label(rec)} | {rec['value']} {unit} | "
+              f"{tf if tf is not None else '—'} | "
+              f"{str(mfu) + '%' if mfu is not None else '—'} | "
+              f"{str(vs) + 'x' if vs is not None else '—'} | "
+              f"{'yes' if rec.get('live', True) else 'watcher'} |")
+
+
+if __name__ == "__main__":
+    main()

@@ -109,6 +109,51 @@ def _add_mfu_fields(result: dict, step_flops: Optional[float],
             f"({result['tflops_per_device']} of {peak_tf} TFLOP/s peak)")
 
 
+def _maybe_dump_hlo(compiled, log) -> None:
+    """HOROVOD_BENCH_DUMP_HLO=<path>: write the backend-optimized HLO
+    (post AllReduceCombiner / fusion) — the artifact for auditing dtypes
+    and host transfers on real hardware. Shared env contract for every
+    benchmark script."""
+    dump = os.environ.get("HOROVOD_BENCH_DUMP_HLO")
+    if not dump:
+        return
+    try:
+        with open(dump, "w") as f:
+            f.write(compiled.as_text())
+        log(f"compiled HLO written to {dump}")
+    except Exception as exc:  # noqa: BLE001
+        log(f"HLO dump failed: {exc!r}")
+
+
+def _maybe_profile_one_batch(run_batch, wait_on, log) -> None:
+    """HOROVOD_BENCH_PROFILE=<dir>: capture a device profile (XPlane, see
+    tools/profile_summary.py) of ONE warm batch BEFORE the timed
+    iterations, so trace overhead never pollutes the reported numbers.
+    ``wait_on()`` must block until the dispatched batch completes. The
+    trace is always stopped — a live trace across the timed loop would
+    silently deflate every reported number."""
+    profile_dir = os.environ.get("HOROVOD_BENCH_PROFILE")
+    if not profile_dir:
+        return
+    import jax
+
+    tracing = False
+    try:
+        jax.profiler.start_trace(profile_dir)
+        tracing = True
+        run_batch()
+        wait_on()
+        log(f"profile written to {profile_dir}")
+    except Exception as exc:  # noqa: BLE001 - profiling is best-effort
+        log(f"profile capture failed: {exc!r}")
+    finally:
+        if tracing:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as exc:  # noqa: BLE001
+                log(f"stop_trace failed: {exc!r}")
+
+
 def _preflight_backend(attempts: Optional[int] = None,
                        probe_timeout_s: float = 120.0,
                        fatal: bool = True):
@@ -490,16 +535,7 @@ def main() -> None:
     compiled = step.lower(params, opt_state, batch_stats, images,
                           labels).compile()
     step_flops = _step_flops_of(compiled, log)
-    dump = os.environ.get("HOROVOD_BENCH_DUMP_HLO")
-    if dump:
-        # the backend-optimized HLO (post AllReduceCombiner / fusion): the
-        # artifact for auditing dtypes and host transfers on real hardware
-        try:
-            with open(dump, "w") as f:
-                f.write(compiled.as_text())
-            log(f"compiled HLO written to {dump}")
-        except Exception as e:  # noqa: BLE001
-            log(f"HLO dump failed: {e!r}")
+    _maybe_dump_hlo(compiled, log)
 
     def run_batch():
         nonlocal params, opt_state, batch_stats
@@ -512,30 +548,8 @@ def main() -> None:
     jax.block_until_ready(params)
 
     img_secs = []
-    # HOROVOD_BENCH_PROFILE=<dir>: capture a device profile (XPlane trace,
-    # readable in TensorBoard/xprof) of one warm batch BEFORE the timed
-    # iterations, so trace overhead never pollutes the reported numbers —
-    # the artifact that attributes a low-MFU step to its actual bottleneck
-    # (HBM-bound kernels, gaps, host sync) on real hardware.
-    profile_dir = os.environ.get("HOROVOD_BENCH_PROFILE")
-    if profile_dir:
-        tracing = False
-        try:
-            jax.profiler.start_trace(profile_dir)
-            tracing = True
-            run_batch()
-            jax.block_until_ready(params)
-            log(f"profile written to {profile_dir}")
-        except Exception as e:  # noqa: BLE001 - profiling is best-effort
-            log(f"profile capture failed: {e!r}")
-        finally:
-            if tracing:
-                # always stop: a live trace across the timed loop below
-                # would silently deflate every reported number
-                try:
-                    jax.profiler.stop_trace()
-                except Exception as e:  # noqa: BLE001
-                    log(f"stop_trace failed: {e!r}")
+    _maybe_profile_one_batch(run_batch,
+                             lambda: jax.block_until_ready(params), log)
 
     for i in range(args.num_iters):
         t0 = time.perf_counter()

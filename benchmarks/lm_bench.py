@@ -67,6 +67,8 @@ def main() -> None:
     from bench import (
         _add_mfu_fields,
         _log as log,
+        _maybe_dump_hlo,
+        _maybe_profile_one_batch,
         _setup_accelerator_cache,
         _step_flops_of,
     )
@@ -143,6 +145,7 @@ def main() -> None:
     log("Compiling LM train step (AOT)...")
     compiled = step.lower(params, opt_state, tokens).compile()
     step_flops = _step_flops_of(compiled, log)
+    _maybe_dump_hlo(compiled, log)
 
     loss = None
 
@@ -154,6 +157,9 @@ def main() -> None:
     for _ in range(args.num_warmup_batches):
         run_batch()
     jax.block_until_ready(params)
+
+    _maybe_profile_one_batch(run_batch,
+                             lambda: jax.block_until_ready(params), log)
 
     tok_secs = []
     tokens_per_batch = global_batch * args.seq_len

@@ -264,9 +264,9 @@ class ControllerServer {
         secret_(std::move(secret)),
         shutdown_error_(std::move(shutdown_error)),
         collect_stats_(collect_stats),
-        world_id_(std::move(world_id)),
         negotiator_(size, fusion_threshold, stall_warning_s,
-                    stall_check_disable) {}
+                    stall_check_disable),
+        world_id_(std::move(world_id)) {}
 
   bool Start(const char* bind_host, int port, std::string* err) {
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);

@@ -91,8 +91,16 @@ while true; do
         if [ "$benchargs" = "ONCHIP" ]; then
             run_onchip
         elif [ "${benchargs%% *}" = "LM" ]; then
-            # shellcheck disable=SC2086
-            run_lm "$name" ${benchargs#LM }
+            if [ "$name" = "lm_flash" ]; then
+                # the flash kernel's on-TPU HLO + device profile ride the
+                # first LM capture (same artifacts as the resnet50 entry)
+                HOROVOD_BENCH_DUMP_HLO="$OUT/lm_flash_hlo.txt" \
+                HOROVOD_BENCH_PROFILE="$OUT/lm_flash_profile" \
+                    run_lm "$name" ${benchargs#LM }
+            else
+                # shellcheck disable=SC2086
+                run_lm "$name" ${benchargs#LM }
+            fi
         elif [ "$name" = "resnet50" ]; then
             HOROVOD_BENCH_DUMP_HLO="$OUT/resnet50_hlo.txt" \
             HOROVOD_BENCH_PROFILE="$OUT/resnet50_profile" \

@@ -12,13 +12,21 @@ from __future__ import annotations
 
 
 def make_dp_train_step(model, opt, mesh, axis_name: str = "data",
-                       donate: bool = True, hierarchical=None):
+                       donate: bool = True, hierarchical=None,
+                       scan_batches: int = 1):
     """Build the jitted DP train step over ``mesh``'s ``axis_name``.
 
     Returns ``step(params, opt_state, batch_stats, x, y) -> (params,
     opt_state, batch_stats)`` with x/y sharded on the data axis and
     everything else replicated. Models without BatchNorm pass
     ``batch_stats={}`` through unchanged.
+
+    ``scan_batches > 1`` wraps the step body in ``lax.scan`` so ONE
+    dispatched call executes N batches back to back on device (same
+    static batch — the synthetic-benchmark situation). Diagnostic, not
+    protocol: comparing it against N separate dispatches isolates
+    Python-dispatch / pipeline-drain overhead from true device time
+    (docs/benchmarks.md "Why bs32 caps", item 2).
 
     ``hierarchical`` (default: follow ``HOROVOD_HIERARCHICAL_ALLREDUCE``
     via the optimizer's own resolution) selects the two-level factored
@@ -56,6 +64,17 @@ def make_dp_train_step(model, opt, mesh, axis_name: str = "data",
         new_stats = jax.tree_util.tree_map(
             lambda s: jax.lax.pmean(s, axis_name), new_stats)
         return optax.apply_updates(params, updates), opt_state, new_stats
+
+    if scan_batches > 1:
+        single = train_step
+
+        def train_step(params, opt_state, batch_stats, x, y):  # noqa: F811
+            def body(carry, _):
+                return single(*carry, x, y), None
+
+            carry, _ = jax.lax.scan(body, (params, opt_state, batch_stats),
+                                    None, length=scan_batches)
+            return carry
 
     return jax.jit(
         shard_map(train_step, mesh=mesh,

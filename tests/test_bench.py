@@ -273,3 +273,60 @@ def test_lm_bench_end_to_end_cpu():
         assert line["value"] > 0
         assert line["attention"] == attention
         assert line["tflops_per_device"] > 0
+
+
+def test_scan_mode_marked_and_excluded_from_fallback(tmp_path):
+    """HOROVOD_BENCH_SCAN_BATCHES runs are a diagnostic (one lax.scan-ned
+    device call per iteration), NOT the reference protocol: the result
+    line must carry scan_batches, and the wedge fallback must never
+    substitute such a capture for a protocol run."""
+    out = tmp_path / "caps"
+    out.mkdir()
+    _write_capture(out / "scan.json", value=9999.0, captured_at=9e9,
+                   scan_batches=10)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "nonexistent_backend",
+        "HOROVOD_BENCH_PREFLIGHT_ATTEMPTS": "1",
+        "HOROVOD_BENCH_FALLBACK_GLOB": str(out / "*.json"),
+    })
+    result = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py")],
+        cwd=_ROOT, env=env, capture_output=True, text=True, timeout=560)
+    assert result.returncode == 1  # scan capture must not satisfy protocol
+    assert result.stdout.strip() == ""
+
+    # and the scan wrapper itself: N scanned batches == N separate steps
+    # (tiny model in-process; a full bench.py scan run costs minutes of
+    # ResNet-50 compile and belongs on the chip, not in CI)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh
+
+    import horovod_tpu as hvd
+    from benchmarks._dp_step import make_dp_train_step
+    from horovod_tpu.models import ResNet
+    from horovod_tpu.models.resnet import ResNetBlock
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    model = ResNet(stage_sizes=[1], num_filters=8, num_classes=10,
+                   block_cls=ResNetBlock, dtype=jnp.float32)
+    x = jnp.ones((8, 16, 16, 3), jnp.float32)
+    y = jnp.zeros((8,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01), axis_name="data")
+    opt_state = opt.init(params)
+
+    single = make_dp_train_step(model, opt, mesh, donate=False)
+    scanned = make_dp_train_step(model, opt, mesh, donate=False,
+                                 scan_batches=3)
+    p1, s1, b1 = params, opt_state, batch_stats
+    for _ in range(3):
+        p1, s1, b1 = single(p1, s1, b1, x, y)
+    p3, s3, b3 = scanned(params, opt_state, batch_stats, x, y)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), p1, p3)

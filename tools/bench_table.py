@@ -33,9 +33,14 @@ def _label(rec: dict) -> str:
     model = model.replace("_tokens_per_sec_per_device", "")
     tmpl = _LABELS.get(model, model or "?")
     try:
-        return tmpl.format(**rec)
+        label = tmpl.format(**rec)
     except KeyError:
-        return tmpl
+        label = tmpl
+    if rec.get("scan_batches"):
+        # non-protocol dispatch-overhead diagnostic; must never read as a
+        # second protocol row
+        label += f" — scan diagnostic ({rec['scan_batches']}/call)"
+    return label
 
 
 def main() -> None:

@@ -74,6 +74,7 @@ while true; do
         "resnet101_bs64|--model resnet101 --batch-size 64" \
         "resnet50_bs128|--model resnet50 --batch-size 128" \
         "resnet50_bs256|--model resnet50 --batch-size 256" \
+        "resnet50_scan|SCAN" \
         "lm_flash|LM --attention flash" \
         "lm_dense|LM --attention dense" \
         "vgg16|--model vgg16" \
@@ -90,6 +91,11 @@ while true; do
         log "round $round: chip computes OK -> $name"
         if [ "$benchargs" = "ONCHIP" ]; then
             run_onchip
+        elif [ "$benchargs" = "SCAN" ]; then
+            # dispatch-overhead diagnostic: same bs32 point, one scanned
+            # device call per iteration — scan==separate rules dispatch
+            # out of the cap attribution; scan>separate convicts it
+            HOROVOD_BENCH_SCAN_BATCHES=1 run_bench "$name"
         elif [ "${benchargs%% *}" = "LM" ]; then
             if [ "$name" = "lm_flash" ]; then
                 # the flash kernel's on-TPU HLO + device profile ride the

@@ -302,6 +302,8 @@ def _emit_fallback(args, log) -> bool:
             continue
         if rec.get("scan_batches"):
             continue  # diagnostic scan-mode runs are not the protocol
+        if bool(rec.get("fp16_allreduce")) != args.fp16_allreduce:
+            continue  # compression changes the measured step
         captured = rec.get("captured_at")
         if not isinstance(captured, (int, float)):
             try:
@@ -338,6 +340,11 @@ def _parse_args(argv=None):
                              "(docs/benchmarks.md:5-6)")
     parser.add_argument("--batch-size", type=int, default=32,
                         help="batch size per device (reference default 32)")
+    parser.add_argument("--fp16-allreduce", action="store_true",
+                        default=False,
+                        help="gradient compression during allreduce "
+                             "(reference flag; rides bf16 on TPU — the "
+                             "MXU-native 16-bit format)")
     parser.add_argument("--num-warmup-batches", type=int, default=10)
     parser.add_argument("--num-batches-per-iter", type=int, default=10)
     parser.add_argument("--num-iters", type=int, default=10)
@@ -369,7 +376,8 @@ def _supervise(args) -> None:
                   "--batch-size", str(args.batch_size),
                   "--num-warmup-batches", str(args.num_warmup_batches),
                   "--num-batches-per-iter", str(args.num_batches_per_iter),
-                  "--num-iters", str(args.num_iters)]
+                  "--num-iters", str(args.num_iters)] + \
+        (["--fp16-allreduce"] if args.fp16_allreduce else [])
     import signal
     import subprocess as sp
 
@@ -525,7 +533,13 @@ def main() -> None:
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
 
-    opt = hvd.DistributedOptimizer(optax.sgd(0.01), axis_name="data")
+    # --fp16-allreduce maps to bf16 cast-compression on TPU (the format
+    # the ICI collectives and MXU natively carry; fp16 would round-trip
+    # through an alien dtype); reference flag semantics otherwise
+    compression = (hvd.Compression.bf16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01), axis_name="data",
+                                   compression=compression)
     opt_state = opt.init(params)
     params = hvd.broadcast_parameters(params, root_rank=0)
 
@@ -549,7 +563,11 @@ def main() -> None:
         log(f"scan mode: {scan_batches} batches per dispatched call "
             f"(NOT the reference protocol)")
     step = make_dp_train_step(model, opt, mesh, axis_name="data",
-                              scan_batches=scan_batches)
+                              scan_batches=scan_batches,
+                              # compressed allreduce must CARRY the bytes:
+                              # see _dp_step's explicit_grad_reduce note
+                              explicit_grad_reduce=args.fp16_allreduce
+                              or None)
 
     # AOT-compile once; _step_flops_of reads the executable's own cost
     # analysis for the MFU denominator's numerator.
@@ -614,6 +632,8 @@ def main() -> None:
     }
     if scan_mode:
         result["scan_batches"] = scan_batches  # marked: not the protocol
+    if args.fp16_allreduce:
+        result["fp16_allreduce"] = True
     # cost_analysis() reports the per-device SPMD program's flops — and for
     # a lax.scan program it counts the loop BODY once, not times the trip
     # count (verified empirically: scan(length=10) of a matmul reports ~1x

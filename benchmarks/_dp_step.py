@@ -13,7 +13,7 @@ from __future__ import annotations
 
 def make_dp_train_step(model, opt, mesh, axis_name: str = "data",
                        donate: bool = True, hierarchical=None,
-                       scan_batches: int = 1):
+                       scan_batches: int = 1, explicit_grad_reduce=None):
     """Build the jitted DP train step over ``mesh``'s ``axis_name``.
 
     Returns ``step(params, opt_state, batch_stats, x, y) -> (params,
@@ -36,6 +36,13 @@ def make_dp_train_step(model, opt, mesh, axis_name: str = "data",
     optimizer's transform runs, which would silently bypass the factored
     reduce_scatter/psum/all_gather route (``operations.cc:1284-1436``'s
     TPU analog in ``parallel/hierarchical.py``).
+
+    ``explicit_grad_reduce`` (default: equals ``hierarchical``) forces the
+    same ``check_vma=False`` tracing WITHOUT the factored route — needed
+    whenever the optimizer's own reduction must carry the bytes, e.g.
+    gradient compression: under vma tracking the auto-inserted psum runs
+    in f32 BEFORE the compress hook, so the cast would be numerics-only
+    and never shrink the collective's wire traffic.
     """
     import jax
     import optax
@@ -46,6 +53,8 @@ def make_dp_train_step(model, opt, mesh, axis_name: str = "data",
         from horovod_tpu.optimizers import _use_hierarchical
 
         hierarchical = _use_hierarchical(axis_name, None)
+    if explicit_grad_reduce is None:
+        explicit_grad_reduce = hierarchical
 
     def loss_fn(params, batch_stats, x, y):
         logits, updated = model.apply(
@@ -80,5 +89,5 @@ def make_dp_train_step(model, opt, mesh, axis_name: str = "data",
         shard_map(train_step, mesh=mesh,
                   in_specs=(P(), P(), P(), P(axis_name), P(axis_name)),
                   out_specs=(P(), P(), P()),
-                  check_vma=not hierarchical),
+                  check_vma=not (hierarchical or explicit_grad_reduce)),
         donate_argnums=(0, 1, 2) if donate else ())

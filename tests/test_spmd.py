@@ -283,3 +283,57 @@ def test_hierarchical_step_matches_flat_numerically(hvd):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
         flat_bn, hier_bn)
+
+
+def test_compressed_dp_step_reduces_in_bf16(hvd):
+    """--fp16-allreduce must COMPRESS THE WIRE: with explicit_grad_reduce
+    the compiled gradient all-reduce carries bf16 operands (under vma
+    tracking the auto-psum would run f32 before the compress hook, making
+    the flag numerics-only). Parameters stay close to the uncompressed
+    step."""
+    import optax
+
+    from benchmarks._dp_step import make_dp_train_step
+    from horovod_tpu.models import ResNet
+    from horovod_tpu.models.resnet import ResNetBlock
+
+    mesh = _mesh()
+    model = ResNet(stage_sizes=[1], num_filters=8, num_classes=10,
+                   block_cls=ResNetBlock, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(5), (16, 16, 16, 3),
+                          jnp.float32)
+    y = jnp.arange(16, dtype=jnp.int32) % 10
+    variables = model.init(jax.random.PRNGKey(0), x)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    def bf16_all_reduces(step, opt_state):
+        # assert on the LOWERED program (what the step requests): backend
+        # passes may promote bf16 reduces to f32 on CPU (no native bf16),
+        # but TPU executes them natively — the request is the contract
+        txt = step.lower(params, opt_state, batch_stats, x, y).as_text()
+        ars = txt.split('"stablehlo.all_reduce"')[1:]
+        return (len(ars),
+                sum(1 for a in ars if "-> tensor<" in a
+                    and "bf16>" in a.split("->", 1)[1][:60]))
+
+    opt_c = hvd.DistributedOptimizer(optax.sgd(0.01), axis_name=DATA_AXIS,
+                                     compression=hvd.Compression.bf16)
+    step_c = make_dp_train_step(model, opt_c, mesh, axis_name=DATA_AXIS,
+                                donate=False, explicit_grad_reduce=True)
+    total, bf16_n = bf16_all_reduces(step_c, opt_c.init(params))
+    # a format change that breaks the scan must fail loudly, not pass 0>=0
+    assert total > 0, "no stablehlo.all_reduce found in the lowered text"
+    # every gradient leaf reduces in bf16; only BN-stat pmeans + the loss
+    # legitimately stay f32
+    assert bf16_n >= total // 2, (
+        f"only {bf16_n}/{total} all_reduces are bf16 — compression is "
+        f"not on the wire")
+
+    opt_p = hvd.DistributedOptimizer(optax.sgd(0.01), axis_name=DATA_AXIS)
+    step_p = make_dp_train_step(model, opt_p, mesh, axis_name=DATA_AXIS,
+                                donate=False)
+    pc, _, _ = step_c(params, opt_c.init(params), batch_stats, x, y)
+    pp, _, _ = step_p(params, opt_p.init(params), batch_stats, x, y)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-3), pc, pp)

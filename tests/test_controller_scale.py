@@ -166,7 +166,7 @@ def test_payload_exchange_correct_at_scale(size):
         np.testing.assert_array_equal(results[rank], expected)
 
 
-def _native_bench_median(size: int, cycles: int = 10) -> float:
+def _native_bench_median(size: int, cycles: int = 10) -> tuple:
     import os
     import subprocess
     import sys
@@ -187,7 +187,8 @@ def _native_bench_median(size: int, cycles: int = 10) -> float:
     assert "skipped" not in result.stdout, result.stdout
     row = [l for l in result.stdout.splitlines()
            if l.startswith("native ")][0]
-    return float(row.split()[2])
+    # columns: impl ranks client_med client_worst SERVER_med SERVER_worst
+    return float(row.split()[2]), float(row.split()[4])
 
 
 def test_controller_bench_native_256_ranks():
@@ -195,7 +196,7 @@ def test_controller_bench_native_256_ranks():
     the native service must keep 256-rank cycles bounded. Bound is ~10x
     the measured median (9.4 ms epoll on this hardware) to absorb CI
     noise while still catching a collapse."""
-    median_ms = _native_bench_median(256)
+    median_ms, _ = _native_bench_median(256)
     assert median_ms < 100, f"256-rank median cycle {median_ms:.1f} ms"
 
 
@@ -203,13 +204,16 @@ def test_controller_bench_native_512_ranks():
     """512 ranks — the reference's published coordinator scale
     (``operations.cc:2030``, 5 ms cycles). The epoll event loop measures
     19.9 ms median here with every client GIL-bound on this machine's one
-    core; the coordinator-side share is ~2 ms (attribution in
-    docs/benchmarks.md). The bound catches a collapse (the old
-    thread-per-rank design would also pass this bound today — the epoll
-    win is thread count, worst-case latency, and memory, not median on a
-    one-core harness)."""
-    median_ms = _native_bench_median(512)
+    core; the SERVER column is the service's own active window (4.6 ms
+    with worker processes, ~20 ms threaded because GIL-serialized clients
+    stretch the arrival spread — docs/benchmarks.md "Direct server-side
+    measurement"). Bounds catch a collapse, not a regression to
+    thread-per-rank medians."""
+    median_ms, server_ms = _native_bench_median(512)
     assert median_ms < 150, f"512-rank median cycle {median_ms:.1f} ms"
+    assert server_ms < 100, (
+        f"512-rank SERVER-side median {server_ms:.1f} ms — the epoll "
+        f"loop's own active window collapsed")
 
 
 def test_watch_channel_reconnects_on_transient_drop():

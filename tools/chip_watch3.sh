@@ -77,6 +77,7 @@ while true; do
         "resnet50_scan|SCAN" \
         "lm_flash|LM --attention flash" \
         "lm_dense|LM --attention dense" \
+        "lm_flash_4k|LM --attention flash --seq-len 4096 --batch-size 2 --remat" \
         "vgg16|--model vgg16" \
         "inception3|--model inception3" \
         "onchip_tpu|ONCHIP"; do

@@ -78,6 +78,60 @@ def _setup_accelerator_cache(jax_module) -> None:
                          ".jax_bench_cache"))
 
 
+def _git_head() -> Optional[str]:
+    """Short HEAD sha of the repo this script lives in (shared helper:
+    ``horovod_tpu.core.provenance``). Stamped into every capture so the
+    wedge-fallback path can tell when the freshest capture was measured on
+    an older revision."""
+    from horovod_tpu.core.provenance import git_head_sha
+
+    return git_head_sha(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _scan_cost_counts_body_once(log) -> bool:
+    """Verify, on this backend, that ``cost_analysis()`` counts a
+    ``lax.scan`` body once rather than times the trip count.
+
+    The scan-mode MFU fields rest on that assumption; if a JAX/XLA
+    version multiplied body flops by the trip count, mfu_pct/tflops
+    would silently inflate by ``scan_batches``. Two toy compiles
+    (64x64 matmul scanned 1x vs 4x) settle it at runtime; on any
+    failure to measure, answer False so MFU is omitted rather than
+    risk emitting inflated numbers.
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        def flops_at(length):
+            def f(x):
+                y, _ = jax.lax.scan(lambda c, _: (c @ c, None), x,
+                                    None, length=length)
+                return y
+            comp = jax.jit(f).lower(
+                jnp.ones((64, 64), jnp.float32)).compile()
+            ca = comp.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            return float(ca.get("flops", 0.0))
+
+        f1, f4 = flops_at(1), flops_at(4)
+        if not f1 or not f4:
+            log("scan cost-model check inconclusive (no flops reported); "
+                "omitting MFU fields for the scan-mode row")
+            return False
+        once = f4 < 2.0 * f1
+        if not once:
+            log(f"cost_analysis multiplies scan body by trip count on this "
+                f"backend (flops x{f4 / f1:.1f} at length 4); omitting MFU "
+                f"fields for the scan-mode row")
+        return once
+    except Exception as exc:  # noqa: BLE001 - check is best-effort
+        log(f"scan cost-model check failed ({exc!r}); omitting MFU fields "
+            f"for the scan-mode row")
+        return False
+
+
 def _step_flops_of(compiled, log) -> Optional[float]:
     """XLA's own FLOP count for one compiled step (per-device SPMD
     program) — what MFU should be computed from; an analytic 2*MACs
@@ -155,7 +209,7 @@ def _maybe_profile_one_batch(run_batch, wait_on, log) -> None:
 
 
 def _preflight_backend(attempts: Optional[int] = None,
-                       probe_timeout_s: float = 120.0,
+                       probe_timeout_s: Optional[float] = None,
                        fatal: bool = True):
     """Verify the accelerator backend initializes before touching it here.
 
@@ -182,6 +236,11 @@ def _preflight_backend(attempts: Optional[int] = None,
         # a generous job timeout can raise this to ride one out.
         attempts = int(os.environ.get("HOROVOD_BENCH_PREFLIGHT_ATTEMPTS",
                                       "4"))
+    if probe_timeout_s is None:
+        # Env-tunable so CI tests that exercise the wedge/fallback paths
+        # against a nonexistent backend don't pay the full hang budget.
+        probe_timeout_s = float(os.environ.get(
+            "HOROVOD_BENCH_PROBE_TIMEOUT_S", "120"))
     if os.environ.get("HOROVOD_BENCH_PREFLIGHT", "1") == "0":
         # CI/CPU validation runs pre-pin the platform themselves; the
         # probe would re-discover the (possibly absent) accelerator.
@@ -281,7 +340,11 @@ def _emit_fallback(args, log) -> bool:
     pattern = os.environ.get(
         "HOROVOD_BENCH_FALLBACK_GLOB",
         os.path.join(root, "bench_results_*", "*.json"))
-    best = None  # (captured_at, record, path)
+    head = _git_head()
+    # Prefer captures measured on the CURRENT revision; fall back to the
+    # newest capture of any revision but say so in the emitted line — a
+    # within-round capture can still predate perf-relevant commits.
+    best = None  # ((revision_matches, captured_at), record, path)
     for path in glob.glob(pattern):
         try:
             with open(path) as f:
@@ -312,17 +375,25 @@ def _emit_fallback(args, log) -> bool:
                 continue
         if now - captured > max_age_s:
             continue
-        if best is None or captured > best[0]:
-            best = (captured, rec, path)
+        rev_match = bool(head) and rec.get("git_sha") == head
+        key = (rev_match, captured)
+        if best is None or key > best[0]:
+            best = (key, rec, path)
     if best is None:
         log("[fallback] no previously captured measurement matches "
             f"metric={expected} batch_size={args.batch_size}")
         return False
-    captured, rec, path = best
+    (rev_match, captured), rec, path = best
     rec["live"] = False
     rec["captured_by"] = "chip_watch"
     rec["captured_at"] = captured
     rec["captured_from"] = os.path.relpath(path, root)
+    if head is not None:
+        rec["revision_match"] = rev_match
+        if not rev_match:
+            log(f"[fallback] NOTE: capture was measured on revision "
+                f"{rec.get('git_sha') or 'unknown'}, current HEAD is {head} "
+                f"— the number may predate perf-relevant commits")
     log(f"[fallback] live measurement impossible — emitting the most "
         f"recent real capture ({path}, captured_at={captured:.0f})")
     print(json.dumps(rec), flush=True)
@@ -406,14 +477,12 @@ def _supervise(args) -> None:
             # relay the one JSON result line (last stdout line). Validate it
             # parses: a line truncated mid-write by the SIGKILL must fall
             # through to the retry path, not reach the driver as corrupt JSON.
-            for line in reversed((stdout or "").strip().splitlines()):
-                if line.startswith("{"):
-                    try:
-                        json.loads(line)
-                    except ValueError:
-                        continue
-                    print(line, flush=True)
-                    return
+            from horovod_tpu.core.provenance import last_json_line
+
+            line, _ = last_json_line(stdout, want=dict)
+            if line is not None:
+                print(line, flush=True)
+                return
             log(f"[supervise {attempt}/{attempts}] no JSON result line "
                 f"{'salvaged from the killed child' if timed_out else 'in child stdout'}: "
                 f"{(stdout or '')[-200:]!r}")
@@ -629,18 +698,21 @@ def main() -> None:
         "batch_size": args.batch_size,
         "n_devices": n_dev,
         "captured_at": round(time.time(), 1),
+        "git_sha": _git_head(),
     }
     if scan_mode:
         result["scan_batches"] = scan_batches  # marked: not the protocol
     if args.fp16_allreduce:
         result["fp16_allreduce"] = True
     # cost_analysis() reports the per-device SPMD program's flops — and for
-    # a lax.scan program it counts the loop BODY once, not times the trip
-    # count (verified empirically: scan(length=10) of a matmul reports ~1x
-    # the matmul's flops). One body == one batch in either mode, so the
-    # rate to multiply by is batches/s.
-    _add_mfu_fields(result, step_flops, mean / global_batch,
-                    jax.devices()[0], log)
+    # a lax.scan program it must count the loop BODY once, not times the
+    # trip count, or mfu/tflops inflate by scan_batches. One body == one
+    # batch in either mode, so the rate to multiply by is batches/s — but
+    # in scan mode only after verifying the count-once behavior on this
+    # backend (two toy compiles; omit MFU fields if it doesn't hold).
+    if not scan_mode or _scan_cost_counts_body_once(log):
+        _add_mfu_fields(result, step_flops, mean / global_batch,
+                        jax.devices()[0], log)
     print(json.dumps(result))
     hvd.shutdown()
 

@@ -184,8 +184,17 @@ def _measure(impl: str, size: int, n_cycles: int, tensors_per_cycle: int,
                     raise RuntimeError(
                         f"{impl} @ {size}: worker failed:\n{err[-2000:]}")
                 outs.append(out)
-            # rank 0 lives in worker 0; its stdout is a JSON latency list
-            latencies = json.loads(outs[0].strip().splitlines()[-1])
+            # rank 0 lives in worker 0; scan its stdout in reverse for the
+            # JSON latency list — a library banner or interpreter-shutdown
+            # warning printed after the json.dumps must not break the parse
+            # (shared tolerant parse with bench.py's supervisor).
+            from horovod_tpu.core.provenance import last_json_line
+
+            _, latencies = last_json_line(outs[0], want=list)
+            if latencies is None:
+                raise RuntimeError(
+                    f"{impl} @ {size}: no JSON latency list in worker 0 "
+                    f"stdout:\n{outs[0][-2000:]}")
         server_us = drain()
     finally:
         service.shutdown()

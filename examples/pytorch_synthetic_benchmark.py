@@ -82,6 +82,10 @@ def main() -> None:
     parser.add_argument("--num-warmup-batches", type=int, default=2)
     parser.add_argument("--num-batches-per-iter", type=int, default=2)
     parser.add_argument("--num-iters", type=int, default=3)
+    parser.add_argument("--json", action="store_true",
+                        help="emit one self-describing JSON result line "
+                             "(the bench.py capture protocol) so the chip "
+                             "watcher can record this run with provenance")
     args = parser.parse_args()
 
     hvd.init()
@@ -128,6 +132,30 @@ def main() -> None:
     log(f"Img/sec per rank: {mean:.1f} +- {conf:.1f}")
     log(f"Total img/sec on {hvd.size()} rank(s): "
         f"{mean * hvd.size():.1f} +- {conf * hvd.size():.1f}")
+    if args.json and hvd.rank() == 0:
+        # Same self-describing capture line as bench.py: the watcher files
+        # this under torch_synthetic.json; model compute is torch-CPU (torch
+        # has no TPU backend in this image) — what the entry measures is the
+        # eager hook→engine→data-plane path, so the plane is stamped in.
+        import json
+
+        from horovod_tpu.core.provenance import git_head_sha
+
+        sha = git_head_sha(os.path.dirname(os.path.abspath(__file__)))
+        print(json.dumps({
+            "metric": "torch_synthetic_train_images_per_sec_per_rank",
+            "value": round(float(mean), 2),
+            "unit": "img/s",
+            "vs_baseline": None,
+            "live": True,
+            "front_end": "torch",
+            "data_plane": os.environ.get("HOROVOD_DATA_PLANE", "auto"),
+            "batch_size": args.batch_size,
+            "image_size": args.image_size,
+            "n_ranks": hvd.size(),
+            "captured_at": round(time.time(), 1),
+            "git_sha": sha,
+        }), flush=True)
     hvd.shutdown()
 
 

@@ -84,6 +84,12 @@ def init(ranks=None, comm=None) -> None:
         _global.config = Config.from_env()
         _global.topology = discover(subset=list(ranks) if ranks else None)
         _global.initialized = True
+        # Steps traced before init resolved the hierarchical knob from the
+        # env and keep that routing baked in; warn if the pinned config now
+        # disagrees (optimizers.check_build_time_resolutions).
+        from . import optimizers as _optimizers
+
+        _optimizers.check_build_time_resolutions(_global.config)
         topo = _global.topology
         if _global.config.jax_profile_dir and topo.rank == 0 \
                 and topo.is_member:

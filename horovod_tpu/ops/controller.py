@@ -697,13 +697,19 @@ def connect_with_hello(addr, secret, timeout_s, connect_attempts,
     deadline = time.monotonic() + 30.0  # transport-loss budget
     mismatch_deadline = time.monotonic() + start_timeout_s
     while True:
-        client = BasicClient(addr, secret=secret, timeout_s=timeout_s,
-                             attempts=connect_attempts)
+        client = None
         try:
+            # Construction inside the try: the constructor's own connect
+            # attempts can exhaust with OSError, and that failure must ride
+            # the same time-based windows as a lost hello instead of
+            # escaping them (round-4 advisor).
+            client = BasicClient(addr, secret=secret, timeout_s=timeout_s,
+                                 attempts=connect_attempts)
             hello(client)
             return client
         except (WireError, OSError) as exc:
-            client.close()
+            if client is not None:
+                client.close()
             # EOF (ConnectionClosedError) or RST/reset (OSError) are
             # transport losses, and a decoded CONTROLLER_RESTARTING frame
             # is the dying previous world's service explicitly telling a

@@ -261,6 +261,28 @@ class Engine:
         self._plane = None
         if self._size == 1:
             self._negotiator = make_negotiator(1, cfg)
+            if cfg.data_plane == "xla" and not _jax_multiprocess() \
+                    and not topo.in_subset_world:
+                # Explicit HOROVOD_DATA_PLANE=xla in a world of one: still
+                # build the device plane so host tensors ride H2D → compiled
+                # reduce on the accelerator → D2H. This is how the eager
+                # front-ends (torch hooks → engine → XLA plane) get a
+                # measured single-chip number; "auto" keeps the pure-host
+                # short-circuit. Guarded like the size>1 branch: a size-1
+                # self-world inside a multi-process JAX world (subset
+                # non-member, or HOROVOD_DATA_PLANE=xla exported
+                # pod-wide) must not touch the global device mesh —
+                # XlaDataPlane requires one JAX process per rank.
+                from .xla_plane import XlaDataPlane
+
+                self._plane = XlaDataPlane(topo)
+            elif cfg.data_plane == "xla":
+                LOG.warning(
+                    "HOROVOD_DATA_PLANE=xla ignored for this size-1 world: "
+                    "the device plane spans all JAX processes, and this "
+                    "world does not own them (multi-process JAX world or "
+                    "subset non-member). Collectives short-circuit on "
+                    "host.")
         else:
             if topo.in_subset_world:
                 # The device plane spans the FULL jax process world; a
@@ -718,13 +740,16 @@ class Engine:
             buf = np.asarray(entries[0].array).ravel()
         for e in entries:
             tl.activity_start(e.name, "EXECUTE")
-        if self._client is None:
+        if self._plane is not None and self._plane.supports(dtype_of(buf)):
+            # Preferred whenever a device plane exists — including the
+            # explicit size-1 plane, where the single-rank psum is how the
+            # eager path's bytes actually traverse the chip.
+            out = self._device_call(self._plane.allreduce,
+                                    np.ascontiguousarray(buf))
+        elif self._client is None:
             # world of one: sum over a single rank. Copy so results never
             # alias the caller's input array.
             out = np.array(buf, copy=True)
-        elif self._plane is not None and self._plane.supports(dtype_of(buf)):
-            out = self._device_call(self._plane.allreduce,
-                                    np.ascontiguousarray(buf))
         else:
             if self._plane is not None:
                 self._warn_host_fallback("allreduce", entries[0].name, buf)

@@ -229,6 +229,9 @@ def _from_bh(x, batch, heads):
     return x.reshape(batch, heads, seq, head_dim).transpose(0, 2, 1, 3)
 
 
+_warned_vma_kwarg_missing = False
+
+
 def _sds(shape, dtype, *like):
     """ShapeDtypeStruct carrying the union of ``like`` operands' vma type.
 
@@ -244,7 +247,23 @@ def _sds(shape, dtype, *like):
         return jax.ShapeDtypeStruct(shape, dtype)
     try:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    except TypeError:  # jax.typeof has vma but the struct kwarg is absent
+    except TypeError:
+        # jax.typeof reports vma but ShapeDtypeStruct lacks the kwarg: a
+        # JAX-version mismatch. The dropped vma will surface later as an
+        # opaque check_vma error inside shard_map — name the cause here so
+        # that error is attributable. Once per process, not per out-shape:
+        # every fwd+bwd trace builds several structs.
+        global _warned_vma_kwarg_missing
+        if not _warned_vma_kwarg_missing:
+            _warned_vma_kwarg_missing = True
+            from ..core.logging import LOG
+
+            LOG.warning(
+                "this JAX version (%s) tracks vma types but "
+                "jax.ShapeDtypeStruct does not accept a vma= kwarg; "
+                "dropping the vma annotation on pallas_call out-shapes. "
+                "If a downstream shard_map(check_vma=True) error mentions "
+                "vma, this version mismatch is the cause.", jax.__version__)
         return jax.ShapeDtypeStruct(shape, dtype)
 
 

@@ -31,7 +31,14 @@ import optax
 from jax import lax
 
 from . import basics, ops
+from .core.logging import LOG
 from .ops.compression import Compression
+
+# Build-time hierarchical resolutions made BEFORE hvd.init() (env reads).
+# init() audits these against the pinned config: a step traced before init
+# keeps its build-time routing forever, so a divergence would otherwise be
+# silent (see check_build_time_resolutions).
+_prebuild_hierarchical_resolutions: list = []
 
 
 def _use_hierarchical(axis_name, hierarchical) -> bool:
@@ -50,7 +57,34 @@ def _use_hierarchical(axis_name, hierarchical) -> bool:
         return basics.config().hierarchical_allreduce
     from .core.config import Config
 
-    return Config.from_env().hierarchical_allreduce
+    resolved = Config.from_env().hierarchical_allreduce
+    _prebuild_hierarchical_resolutions.append(resolved)
+    return resolved
+
+
+def check_build_time_resolutions(cfg) -> None:
+    """Called by ``hvd.init()``: warn when a step traced before init
+    resolved the hierarchical knob differently from the now-pinned config
+    (env changed between build and init, or ``init(config=...)`` overrode
+    it). The traced step silently keeps its build-time behavior — XLA has
+    already baked the collective routing in — so the only honest remedy is
+    to rebuild the step or align the config."""
+    stale = {v for v in _prebuild_hierarchical_resolutions
+             if v != cfg.hierarchical_allreduce}
+    # Consume the audited entries: a later shutdown/re-init must only audit
+    # steps built since THIS init, not re-warn about ones already reported
+    # (which may have been rebuilt by then).
+    _prebuild_hierarchical_resolutions.clear()
+    if stale:
+        built = "ON" if True in stale else "off"
+        pinned = "ON" if cfg.hierarchical_allreduce else "off"
+        LOG.warning(
+            "a train step was built before hvd.init() with hierarchical "
+            "allreduce %s, but the initialized world pins it %s. Steps "
+            "traced before init keep their build-time collective routing; "
+            "rebuild them after init (or align "
+            "HOROVOD_HIERARCHICAL_ALLREDUCE / init(config=...)) so the "
+            "routing matches the pinned config.", built, pinned)
 
 
 def allreduce_gradients(grads: Any, axis_name=None, average: bool = True,
@@ -80,15 +114,32 @@ def allreduce_gradients(grads: Any, axis_name=None, average: bool = True,
             # before this transform ever sees them, silencing the knob).
             legacy = not _vma_tracking_active(axis_name)
             reduced = []
+            factored_leaves = 0
             for g in leaves:
                 comp, ctx = compression.compress(g)
                 if legacy or _varies_over(comp, axis_name):
+                    factored_leaves += 1
                     red = hierarchical_grad_allreduce(
                         comp, dcn_axis, ici_axis, average=average)
                 else:
                     # pre-summed cotangent (see ops.spmd.allreduce)
                     red = ops.spmd.allreduce(comp, axis_name, average=average)
                 reduced.append(compression.decompress(red, ctx))
+            if leaves and not factored_leaves:
+                # The knob is ON but every cotangent arrived pre-summed by
+                # vma tracking's flat whole-mesh psum — the factored
+                # reduce_scatter/psum/all_gather route never fires. Runs at
+                # trace time, so this warns once per trace, not per step.
+                source = ("hierarchical=True" if hierarchical
+                          else "HOROVOD_HIERARCHICAL_ALLREDUCE")
+                LOG.warning(
+                    "hierarchical allreduce is enabled (via %s) but every "
+                    "gradient leaf arrived pre-summed (vma tracking inserts "
+                    "a flat whole-mesh psum in the shard_map transpose), so "
+                    "the factored hierarchical route is inert for this "
+                    "step. Build the step with shard_map(..., "
+                    "check_vma=False) so cotangents reach the optimizer "
+                    "unsummed (see benchmarks/_dp_step.py).", source)
             return jax.tree_util.tree_unflatten(treedef, reduced)
         reduced = [
             ops.allreduce(g, average=average, compression=compression,

@@ -128,6 +128,7 @@ def test_wedge_fallback_emits_latest_real_capture(tmp_path):
     env.update({
         # an unknown platform makes the probe fail fast instead of hanging
         "JAX_PLATFORMS": "nonexistent_backend",
+        "HOROVOD_BENCH_PROBE_TIMEOUT_S": "10",
         "HOROVOD_BENCH_PREFLIGHT_ATTEMPTS": "1",
         "HOROVOD_BENCH_FALLBACK_GLOB": str(out / "*.json"),
     })
@@ -143,6 +144,57 @@ def test_wedge_fallback_emits_latest_real_capture(tmp_path):
     assert line["captured_by"] == "chip_watch"
     assert line["captured_at"] == 9e9
     assert line["captured_from"].endswith("newest.json")
+
+
+def test_fallback_prefers_revision_matched_capture(tmp_path):
+    """Round-4 advisor: the 24h freshness bound alone can emit a number
+    measured on older code within the same round. A capture stamped with
+    the current HEAD sha must beat a NEWER capture from another revision;
+    when only a mismatched-revision capture exists it is still emitted
+    (a real number beats rc=1) but flagged revision_match=false."""
+    head = subprocess.run(
+        ["git", "-C", _ROOT, "rev-parse", "--short", "HEAD"],
+        capture_output=True, text=True).stdout.strip()
+    assert head
+
+    def run_with(captures):
+        out = tmp_path / "revs"
+        if out.exists():
+            import shutil
+            shutil.rmtree(out)
+        out.mkdir()
+        for name, overrides in captures.items():
+            _write_capture(out / name, **overrides)
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "nonexistent_backend",
+            "HOROVOD_BENCH_PROBE_TIMEOUT_S": "10",
+            "HOROVOD_BENCH_PREFLIGHT_ATTEMPTS": "1",
+            "HOROVOD_BENCH_FALLBACK_GLOB": str(out / "*.json"),
+        })
+        result = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "bench.py")],
+            cwd=_ROOT, env=env, capture_output=True, text=True, timeout=560)
+        assert result.returncode == 0, result.stderr
+        return json.loads(result.stdout.strip().splitlines()[-1]), result
+
+    # current-revision capture wins over a newer foreign-revision one
+    rec, _ = run_with({
+        "old_rev.json": dict(value=999.0, captured_at=9.5e9,
+                             git_sha="0000000"),
+        "cur_rev.json": dict(value=1720.0, captured_at=9e9, git_sha=head),
+    })
+    assert rec["value"] == 1720.0
+    assert rec["revision_match"] is True
+
+    # only a mismatched capture: emitted, flagged, and logged
+    rec, result = run_with({
+        "old_rev.json": dict(value=999.0, captured_at=9.5e9,
+                             git_sha="0000000"),
+    })
+    assert rec["value"] == 999.0
+    assert rec["revision_match"] is False
+    assert "measured on revision" in result.stderr
 
 
 def test_wedge_fallback_disabled_or_empty_stays_red(tmp_path):
@@ -162,6 +214,7 @@ def test_wedge_fallback_disabled_or_empty_stays_red(tmp_path):
         env = dict(os.environ)
         env.update({
             "JAX_PLATFORMS": "nonexistent_backend",
+            "HOROVOD_BENCH_PROBE_TIMEOUT_S": "10",
             "HOROVOD_BENCH_PREFLIGHT_ATTEMPTS": "1",
             "HOROVOD_BENCH_FALLBACK_GLOB": str(glob_dir / "*.json"),
         })
@@ -187,6 +240,7 @@ def test_stale_fallback_capture_is_ignored(tmp_path):
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "nonexistent_backend",
+        "HOROVOD_BENCH_PROBE_TIMEOUT_S": "10",
         "HOROVOD_BENCH_PREFLIGHT_ATTEMPTS": "1",
         "HOROVOD_BENCH_FALLBACK_GLOB": str(out / "*.json"),
     })
@@ -287,6 +341,7 @@ def test_scan_mode_marked_and_excluded_from_fallback(tmp_path):
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "nonexistent_backend",
+        "HOROVOD_BENCH_PROBE_TIMEOUT_S": "10",
         "HOROVOD_BENCH_PREFLIGHT_ATTEMPTS": "1",
         "HOROVOD_BENCH_FALLBACK_GLOB": str(out / "*.json"),
     })

@@ -84,6 +84,29 @@ def test_pytorch_synthetic_benchmark():
     assert "Img/sec per rank" in out.stdout
 
 
+def test_pytorch_synthetic_benchmark_device_plane_json():
+    """The watcher's torch_synthetic entry: explicit size-1 XLA data plane
+    (grad bytes ride H2D -> compiled reduce -> D2H) and a self-describing
+    JSON capture line in the bench.py protocol."""
+    import json
+
+    out = _run_example(
+        "pytorch_synthetic_benchmark.py",
+        ["--batch-size", "4", "--image-size", "32", "--num-iters", "2",
+         "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+         "--json"],
+        env={"HOROVOD_DATA_PLANE": "xla"})
+    line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "torch_synthetic_train_images_per_sec_per_rank"
+    assert rec["data_plane"] == "xla"
+    assert rec["front_end"] == "torch"
+    assert rec["live"] is True
+    assert rec["value"] > 0
+    assert rec["n_ranks"] == 1
+    assert rec["git_sha"]
+
+
 def test_run_fn_job():
     out = _run_example("run_fn_job.py", [],
                        env={"EXAMPLE_PLATFORM": "cpu"})

@@ -66,6 +66,7 @@ def main() -> None:
         jax.config.update("jax_platforms", platform_pin)
     from bench import (
         _add_mfu_fields,
+        _git_head as _git_sha,
         _log as log,
         _maybe_dump_hlo,
         _maybe_profile_one_batch,
@@ -190,6 +191,7 @@ def main() -> None:
         "batch_size": args.batch_size,
         "n_devices": n_dev,
         "captured_at": round(time.time(), 1),
+        "git_sha": _git_sha(),
     }
     # steps/s, not tokens/s: step_flops is the whole per-device step
     _add_mfu_fields(result, step_flops, mean / tokens_per_batch,

@@ -24,7 +24,7 @@ claim has teeth.
 Usage: python benchmarks/onchip_path_bench.py [--tensors 64]
            [--elems 25000] [--rounds 20]
 Prints one JSON line: {"platform", "host_tensors_per_s",
-"onchip_tensors_per_s", "onchip_speedup"}.
+"onchip_tensors_per_s", "onchip_speedup", "captured_at", "git_sha"}.
 """
 
 from __future__ import annotations
@@ -93,11 +93,15 @@ def main() -> None:
 
     host_rate = measure(host_path)
     onchip_rate = measure(onchip_path)
+    from horovod_tpu.core.provenance import git_head_sha
+
     print(json.dumps({
         "platform": platform,
         "host_tensors_per_s": round(host_rate, 1),
         "onchip_tensors_per_s": round(onchip_rate, 1),
         "onchip_speedup": round(onchip_rate / host_rate, 2),
+        "captured_at": round(time.time(), 1),
+        "git_sha": git_head_sha(os.path.dirname(os.path.abspath(__file__))),
     }))
 
 

@@ -36,6 +36,9 @@ from horovod_tpu.ops.messages import (
     RequestType,
 )
 
+# Subprocess/soak-heavy by design: excluded from the quick tier (-m "not soak").
+pytestmark = pytest.mark.soak
+
 SECRET = b"s" * 32
 
 

@@ -11,6 +11,10 @@ import sys
 
 import pytest
 
+
+# Subprocess/soak-heavy by design: excluded from the quick tier (-m "not soak").
+pytestmark = pytest.mark.soak
+
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

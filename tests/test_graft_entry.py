@@ -3,6 +3,11 @@ compile check) and ``dryrun_multichip`` (virtual-mesh sharding check) gate
 external credit for the build, so their contracts are pinned here."""
 
 import numpy as np
+import pytest
+
+# Full-model compiles in subprocesses (~3 min): excluded from the quick
+# tier (-m "not soak").
+pytestmark = pytest.mark.soak
 
 
 def test_entry_compiles_and_runs():

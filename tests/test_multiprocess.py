@@ -89,6 +89,10 @@ def _run_world(scenario: str, size: int, timeout: float = 90.0,
 # (native skips where the core cannot build, like test_native_controller).
 from horovod_tpu import cc as _cc  # noqa: E402
 
+
+# Subprocess/soak-heavy by design: excluded from the quick tier (-m "not soak").
+pytestmark = pytest.mark.soak
+
 CONTROLLERS = pytest.mark.parametrize("controller", [
     pytest.param("native", marks=pytest.mark.skipif(
         not _cc.available(), reason=f"native core: {_cc.load_error()}")),

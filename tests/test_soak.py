@@ -16,6 +16,10 @@ import pytest
 from horovod_tpu import cc
 from horovod_tpu.runner import launch
 
+
+# Subprocess/soak-heavy by design: excluded from the quick tier (-m "not soak").
+pytestmark = pytest.mark.soak
+
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "_soak_worker.py")
 

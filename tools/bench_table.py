@@ -4,7 +4,7 @@
     python tools/bench_table.py bench_results_r4
 
 Reads every ``*.json`` bench capture in the directory (one JSON line per
-file, as written by ``tools/chip_watch3.sh``) and prints the
+file, as written by ``tools/chip_watch4.sh``) and prints the
 docs/benchmarks.md measured table — config, img|tokens/s/device, ±1.96σ
 when present, achieved TFLOP/s, MFU, and vs-reference ratio — so landing
 a capture into the docs is one copy-paste, not hand-transcription.
@@ -24,6 +24,8 @@ _LABELS = {
     "inception3": "Inception V3, bs {batch_size}",
     "transformer_lm": "Transformer LM ({attention}, seq {seq_len}, "
                       "bs {batch_size})",
+    "torch": "Torch front-end (hooks → engine → {data_plane} plane), "
+             "bs {batch_size}",
 }
 
 
@@ -44,7 +46,7 @@ def _label(rec: dict) -> str:
 
 
 def main() -> None:
-    out_dir = sys.argv[1] if len(sys.argv) > 1 else "bench_results_r4"
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "bench_results_r5"
     rows = []
     for path in sorted(glob.glob(os.path.join(out_dir, "*.json"))):
         try:

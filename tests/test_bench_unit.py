@@ -2,17 +2,21 @@
 (tests/test_bench.py is soak-marked wholesale: every test there executes
 bench.py in a subprocess)."""
 
+import functools
 import os
+import sys
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@functools.cache
 def _load_bench():
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
         "bench", os.path.join(_ROOT, "bench.py"))
     bench = importlib.util.module_from_spec(spec)
+    sys.modules["bench"] = bench
     spec.loader.exec_module(bench)
     return bench
 

@@ -38,6 +38,13 @@ def test_dryrun_multichip_subprocess():
         cwd=root, env=env, capture_output=True, text=True, timeout=300)
     assert result.returncode == 0, result.stderr
     assert "OK" in result.stdout
+    # The default dryrun certifies BOTH collective routes (round-4 verdict
+    # next #2): the driver artifact's tail must show the flat step, the
+    # forced-hierarchical step, and the factored HLO evidence.
+    assert "DP step OK (hierarchical allreduce: off (flat psum))" \
+        in result.stderr
+    assert "DP step OK (hierarchical allreduce: ON)" in result.stderr
+    assert "factored-step HLO" in result.stderr
 
 
 def test_init_on_host_cpu_noop_on_cpu():

@@ -279,3 +279,42 @@ def test_size1_explicit_xla_plane(monkeypatch):
             assert (engine._plane is not None) == expect_plane, plane_env
         finally:
             hvd_mod.shutdown()
+
+
+def test_size1_xla_plane_guarded_in_foreign_worlds(monkeypatch):
+    """The explicit size-1 device plane must NOT build when the size-1
+    world does not own the JAX process world — a subset non-member or a
+    pod-wide HOROVOD_DATA_PLANE=xla export would otherwise crash init on
+    XlaDataPlane's one-process-per-rank requirement. It is skipped with a
+    warning and collectives short-circuit on host."""
+    import logging
+
+    import horovod_tpu as hvd_mod
+    from horovod_tpu.core.logging import LOG
+    from horovod_tpu.ops import engine as engine_mod
+
+    class Capture(logging.Handler):
+        def __init__(self):
+            super().__init__(level=logging.WARNING)
+            self.messages = []
+
+        def emit(self, record):
+            self.messages.append(record.getMessage())
+
+    monkeypatch.setenv("HOROVOD_DATA_PLANE", "xla")
+    # simulate a multi-process JAX world around this size-1 engine
+    monkeypatch.setattr(engine_mod, "_jax_multiprocess", lambda: True)
+    cap = Capture()
+    LOG.addHandler(cap)
+    try:
+        # inside the try: a guard regression makes init() itself raise,
+        # and the handler/world must still be cleaned up
+        hvd_mod.init()
+        out = hvd_mod.allreduce(np.full((64,), 3.0, np.float32),
+                                average=False)
+        np.testing.assert_array_equal(np.asarray(out), 3.0)
+        assert engine_mod.get_engine()._plane is None
+    finally:
+        LOG.removeHandler(cap)
+        hvd_mod.shutdown()
+    assert any("ignored for this size-1 world" in m for m in cap.messages)

@@ -421,7 +421,31 @@ def _parse_args(argv=None):
     parser.add_argument("--num-iters", type=int, default=10)
     parser.add_argument("--_measure", action="store_true",
                         help=argparse.SUPPRESS)  # internal: child mode
+    parser.add_argument("--warm-init-cache", action="store_true",
+                        default=False,
+                        help="build this config's host-init cache entry "
+                             "on CPU and exit without touching the "
+                             "accelerator (run with "
+                             "HOROVOD_BENCH_PLATFORM=cpu); a warm entry "
+                             "lets a real attempt reach its first device "
+                             "op in seconds instead of after a ~90s host "
+                             "init, which matters when the tunnel's "
+                             "healthy windows are short")
+    parser.add_argument("--warm-devices", type=int, default=1,
+                        help="device count of the topology --warm-init-"
+                             "cache targets (global batch = batch-size x "
+                             "this); default 1, the single-chip bench")
     return parser.parse_args(argv)
+
+
+def _init_cache_path(args, global_batch, side) -> str:
+    """Host-init cache entry for this bench config (shared policy:
+    ``core.platform.init_cache_path`` — this file is hashed in so editing
+    ``synthesize()``/init code here invalidates its own entries)."""
+    from horovod_tpu.core.platform import init_cache_path
+
+    return init_cache_path(f"{args.model}_gb{global_batch}_s{side}",
+                           extra_sources=[os.path.abspath(__file__)])
 
 
 def _supervise(args) -> None:
@@ -525,7 +549,12 @@ def _supervise(args) -> None:
 def main() -> None:
     args = _parse_args()
 
-    if not args._measure:
+    if args.warm_init_cache:
+        # Warm mode never needs the accelerator: pin CPU (unless the
+        # caller pinned something else) and skip preflight/supervision.
+        os.environ.setdefault("HOROVOD_BENCH_PLATFORM", "cpu")
+
+    if not args._measure and not args.warm_init_cache:
         preflight_on = os.environ.get("HOROVOD_BENCH_PREFLIGHT", "1") != "0"
         if preflight_on:
             if _preflight_backend(fatal=False) is None:
@@ -565,7 +594,12 @@ def main() -> None:
                  "vgg16": VGG16, "inception3": InceptionV3}[args.model]
     model = model_cls(num_classes=1000)
     side = 299 if args.model == "inception3" else 224
-    global_batch = args.batch_size * n_dev
+    # Warm mode runs on the host backend, whose device count is not the
+    # topology being warmed for — size the arrays for the target instead
+    # so a real attempt's cache lookup hits (--warm-devices, default the
+    # single-chip bench).
+    global_batch = args.batch_size * (args.warm_devices
+                                      if args.warm_init_cache else n_dev)
 
     def synthesize():
         rng = jax.random.PRNGKey(0)
@@ -583,18 +617,32 @@ def main() -> None:
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
-    from horovod_tpu.core.platform import init_on_host_cpu
+    from horovod_tpu.core.platform import host_init_cached, init_on_host_cpu
+
+    cache_path = _init_cache_path(args, global_batch, side)
+
+    def make_host():
+        return (*synthesize(),
+                model.init(jax.random.PRNGKey(1),
+                           np.zeros((2, side, side, 3), np.float32)))
+
+    if args.warm_init_cache:
+        # CPU-only mode: build the cache entry and stop before any
+        # accelerator contact (pin HOROVOD_BENCH_PLATFORM=cpu when the
+        # session env points at the chip).
+        host_init_cached(cache_path, make_host, log=log)
+        log("init cache warmed; exiting without accelerator contact")
+        return
 
     placed = init_on_host_cpu(
-        lambda: (*synthesize(),
-                 model.init(jax.random.PRNGKey(1),
-                            np.zeros((2, side, side, 3), np.float32))),
+        lambda: host_init_cached(cache_path, make_host, log=log),
         (NamedSharding(mesh, P("data")), NamedSharding(mesh, P("data")),
-         NamedSharding(mesh, P())))
+         NamedSharding(mesh, P())), log=log)
     if placed is not None:
-        log("init done on host CPU; transferred to accelerator")
         images, labels, variables = placed
     else:
+        log("host-CPU init/placement unavailable (see warning above); "
+            "initializing on device")
         images, labels = synthesize()
         variables = model.init(jax.random.PRNGKey(1), images[:2])
     log("model initialized")

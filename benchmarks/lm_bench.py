@@ -53,11 +53,36 @@ def _parse_args(argv=None):
     parser.add_argument("--num-warmup-batches", type=int, default=10)
     parser.add_argument("--num-batches-per-iter", type=int, default=10)
     parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--warm-init-cache", action="store_true",
+                        default=False,
+                        help="build this config's host-init cache entry "
+                             "on CPU and exit before any accelerator "
+                             "contact (see bench.py --warm-init-cache)")
+    parser.add_argument("--warm-devices", type=int, default=1,
+                        help="device count the warmed entry targets "
+                             "(see bench.py --warm-devices)")
     return parser.parse_args(argv)
+
+
+def _init_cache_path(args, global_batch) -> str:
+    """Host-init cache entry for this LM config (shared policy:
+    ``core.platform.init_cache_path``; this file is hashed in).
+    Deliberately NOT keyed by ``--attention``/``--remat``: params come
+    from a dense-clone init and tokens depend only on (batch, seq,
+    vocab), so flash and dense share one entry."""
+    from horovod_tpu.core.platform import init_cache_path
+
+    cfg = (f"lm_{args.num_layers}x{args.num_heads}_d{args.d_model}"
+           f"_ff{args.d_ff}_v{args.vocab_size}_s{args.seq_len}"
+           f"_gb{global_batch}")
+    return init_cache_path(cfg, extra_sources=[os.path.abspath(__file__)])
 
 
 def main() -> None:
     args = _parse_args()
+
+    if args.warm_init_cache:
+        os.environ.setdefault("HOROVOD_BENCH_PLATFORM", "cpu")
 
     import jax
 
@@ -81,7 +106,7 @@ def main() -> None:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     import horovod_tpu as hvd
-    from horovod_tpu.core.platform import init_on_host_cpu
+    from horovod_tpu.core.platform import host_init_cached, init_on_host_cpu
     from horovod_tpu.models import TransformerLM, lm_loss
 
     hvd.init()
@@ -98,7 +123,10 @@ def main() -> None:
         num_heads=args.num_heads, d_model=args.d_model, d_ff=args.d_ff,
         max_seq_len=args.seq_len, attention=args.attention,
         remat=args.remat)
-    global_batch = args.batch_size * n_dev
+    # see bench.py: warm mode sizes arrays for the --warm-devices target
+    # topology, not the host backend it happens to run on
+    global_batch = args.batch_size * (args.warm_devices
+                                      if args.warm_init_cache else n_dev)
 
     def synthesize_and_init():
         rng = jax.random.PRNGKey(0)
@@ -112,13 +140,21 @@ def main() -> None:
         variables = init_model.init(jax.random.PRNGKey(1), tokens[:2, :8])
         return tokens, variables
 
+    cache_path = _init_cache_path(args, global_batch)
+    if args.warm_init_cache:
+        host_init_cached(cache_path, synthesize_and_init, log=log)
+        log("init cache warmed; exiting without accelerator contact")
+        return
+
     placed = init_on_host_cpu(
-        synthesize_and_init,
-        (NamedSharding(mesh, P("data")), NamedSharding(mesh, P())))
+        lambda: host_init_cached(cache_path, synthesize_and_init, log=log),
+        (NamedSharding(mesh, P("data")), NamedSharding(mesh, P())),
+        log=log)
     if placed is not None:
-        log("init done on host CPU; transferred to accelerator")
         tokens, variables = placed
     else:
+        log("host-CPU init/placement unavailable (see warning above); "
+            "initializing on device")
         tokens, variables = synthesize_and_init()
     params = variables["params"]
     log("model initialized")

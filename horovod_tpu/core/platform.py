@@ -53,7 +53,85 @@ def pin_cpu_platform(n_devices: int = 8) -> None:
             f"pin_cpu_platform before any jax.devices()/array operation.")
 
 
-def init_on_host_cpu(make, placement):
+def init_cache_path(config_key, extra_sources=()):
+    """Resolve the on-disk host-init cache entry for ``config_key``.
+
+    One shared policy for every bench entry point: the filename carries an
+    md5 of the model-zoo sources (``horovod_tpu/models/*.py``), the
+    caller's own source file(s) (``extra_sources`` — the synthesize/init
+    code that actually generates the arrays), and the jax version, so
+    editing any of them invalidates stale entries instead of silently
+    measuring them. ``HOROVOD_BENCH_INIT_CACHE=0`` disables (returns "");
+    any other value overrides the cache directory."""
+    import glob
+    import hashlib
+
+    knob = os.environ.get("HOROVOD_BENCH_INIT_CACHE", "")
+    if knob == "0":
+        return ""
+    import jax
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    cache_dir = knob or os.path.join(root, ".bench_init_cache")
+    h = hashlib.md5(jax.__version__.encode())
+    sources = sorted(glob.glob(
+        os.path.join(root, "horovod_tpu", "models", "*.py")))
+    sources += [os.path.abspath(s) for s in extra_sources]
+    for src in sources:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    return os.path.join(cache_dir, f"{config_key}_{h.hexdigest()[:10]}.pkl")
+
+
+def host_init_cached(cache_path, make, log=None):
+    """Run ``make()`` (host-side model/data init) with an on-disk cache.
+
+    Why: on the shared-tunnel accelerator, healthy windows can be shorter
+    than the ~60-90 s a ResNet-class host init takes, so an attempt's
+    first device touch lands after the window has already closed (round
+    5: probe OK at +0 s, first device op at +90 s, wedged). The init
+    arrays are deterministic per config (fixed PRNG keys), so cache the
+    numpy pytree; a warm attempt reaches its first accelerator op in
+    seconds. The pickle is a repo-local artifact written and read only by
+    the bench harness on this box — not an interchange format. Callers
+    key the path by config AND model-source hash so editing a model
+    invalidates its entries (see bench.py ``_init_cache_path``).
+
+    ``cache_path`` None/empty disables caching entirely."""
+    import pickle
+
+    log = log or (lambda *_: None)
+    if cache_path:
+        try:
+            with open(cache_path, "rb") as f:
+                out = pickle.load(f)
+            log(f"host-init cache hit ({cache_path})")
+            return out
+        except FileNotFoundError:
+            pass
+        except Exception as exc:  # noqa: BLE001 - stale/corrupt: rebuild
+            log(f"host-init cache unreadable ({exc!r}); rebuilding")
+    out = make()
+    if cache_path:
+        try:
+            import jax
+            import numpy as np
+
+            host = jax.tree_util.tree_map(np.asarray, out)
+            os.makedirs(os.path.dirname(cache_path), exist_ok=True)
+            tmp = f"{cache_path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, cache_path)  # atomic: never a torn cache file
+            log(f"host-init cache written ({cache_path})")
+            return host
+        except Exception as exc:  # noqa: BLE001 - cache is best-effort
+            log(f"host-init cache write failed ({exc!r}); continuing")
+    return out
+
+
+def init_on_host_cpu(make, placement, log=None):
     """Run ``make()`` on the host CPU backend and ship the result to
     ``placement`` (a device, a sharding, or a pytree-prefix of either
     matching ``make``'s return).
@@ -81,11 +159,18 @@ def init_on_host_cpu(make, placement):
         cpu0 = jax.local_devices(backend="cpu")[0]
     except Exception:  # noqa: BLE001 - no separate host backend
         return None
+    log = log or (lambda *_: None)
     try:
         with jax.default_device(cpu0):
             out = make()
+        # The transfer is the first accelerator touch of the attempt and
+        # the tunnel's observed wedge point (round 5, attempt 1: probe OK,
+        # then 18 min of silence before any post-init line) — bracket it
+        # so a killed attempt's last log line says which side of it died.
+        log("host init done; placing onto accelerator...")
         out = jax.device_put(out, placement)
         jax.block_until_ready(out)
+        log("accelerator placement done")
         return out
     except Exception as exc:  # noqa: BLE001 - caller falls back
         from .logging import LOG

@@ -45,3 +45,75 @@ def test_git_head_matches_shared_helper():
     bench = _load_bench()
     assert bench._git_head() == git_head_sha(_ROOT)
     assert bench._git_head()  # this repo is a git checkout
+
+
+def test_host_init_cached_roundtrip(tmp_path):
+    """host_init_cached: build→write, hit without rebuilding, corrupt
+    entry rebuilds, empty path disables. The cache exists so a bench
+    attempt's first accelerator touch lands seconds after the preflight
+    probe instead of after a ~90s host init (round-5: the tunnel's
+    healthy windows can be shorter than the init)."""
+    import numpy as np
+
+    from horovod_tpu.core.platform import host_init_cached
+
+    path = str(tmp_path / "sub" / "entry.pkl")  # parent dir auto-created
+    calls = []
+
+    def make():
+        calls.append(1)
+        return {"w": np.arange(4.0, dtype=np.float32)}
+
+    logs = []
+    out1 = host_init_cached(path, make, log=logs.append)
+    assert len(calls) == 1 and os.path.exists(path)
+    assert any("cache written" in m for m in logs)
+
+    out2 = host_init_cached(path, make, log=logs.append)
+    assert len(calls) == 1  # hit: make() not rerun
+    np.testing.assert_array_equal(out1["w"], out2["w"])
+    assert any("cache hit" in m for m in logs)
+
+    with open(path, "wb") as f:
+        f.write(b"not a pickle")
+    out3 = host_init_cached(path, make, log=logs.append)
+    assert len(calls) == 2  # corrupt: rebuilt, not crashed
+    np.testing.assert_array_equal(out1["w"], out3["w"])
+    assert any("unreadable" in m for m in logs)
+
+    host_init_cached("", make, log=logs.append)
+    assert len(calls) == 3  # disabled: no caching, still builds
+
+
+def test_init_cache_path_policy(monkeypatch):
+    """The shared key policy (core.platform.init_cache_path): knob
+    disables/redirects, and the hash covers extra_sources so the
+    synthesize/init code that generates the arrays invalidates its own
+    entries, not only the model zoo."""
+    monkeypatch.delenv("HOROVOD_BENCH_INIT_CACHE", raising=False)
+    bench = _load_bench()
+
+    class A:
+        model = "resnet50"
+
+    args = A()
+    p1 = bench._init_cache_path(args, 32, 224)
+    assert p1.endswith(".pkl") and "resnet50_gb32_s224" in p1
+
+    monkeypatch.setenv("HOROVOD_BENCH_INIT_CACHE", "0")
+    assert bench._init_cache_path(args, 32, 224) == ""
+
+    monkeypatch.setenv("HOROVOD_BENCH_INIT_CACHE", "/tmp/elsewhere")
+    p2 = bench._init_cache_path(args, 32, 224)
+    assert p2.startswith("/tmp/elsewhere/")
+    # same config+sources -> same basename regardless of directory
+    assert os.path.basename(p2) == os.path.basename(p1)
+
+    # extra_sources participate in the digest: a different caller file
+    # (different generating code) must produce a different entry
+    from horovod_tpu.core.platform import init_cache_path
+
+    monkeypatch.delenv("HOROVOD_BENCH_INIT_CACHE", raising=False)
+    here = os.path.abspath(__file__)
+    p3 = init_cache_path("resnet50_gb32_s224", extra_sources=[here])
+    assert os.path.basename(p3) != os.path.basename(p1)

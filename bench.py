@@ -556,7 +556,13 @@ def main() -> None:
 
     if not args._measure and not args.warm_init_cache:
         preflight_on = os.environ.get("HOROVOD_BENCH_PREFLIGHT", "1") != "0"
-        if preflight_on:
+        # The chip watcher runs a jitted-matmul compute probe seconds
+        # before spawning the bench; its runs skip only this INITIAL
+        # preflight (one fewer backend spin-up inside a healthy window)
+        # while keeping the supervisor's inter-attempt backend wait.
+        initial_on = os.environ.get("HOROVOD_BENCH_PREFLIGHT_INITIAL",
+                                    "1") != "0"
+        if preflight_on and initial_on:
             if _preflight_backend(fatal=False) is None:
                 if _emit_fallback(args, _log):
                     return

@@ -104,6 +104,32 @@ def test_bench_supervised_path_cpu():
     assert line["value"] > 0
 
 
+def test_bench_watcher_env_skips_initial_preflight_cpu():
+    """The chip watcher's exact env: preflight ON (so the supervisor's
+    inter-attempt backend wait stays armed) but the INITIAL preflight
+    skipped (HOROVOD_BENCH_PREFLIGHT_INITIAL=0) because the watcher's own
+    compute probe ran seconds earlier — one fewer backend spin-up inside
+    a short healthy window. Asserts supervision still runs and no initial
+    preflight probe line precedes it."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update({"HOROVOD_BENCH_PREFLIGHT_INITIAL": "0",
+                "HOROVOD_BENCH_PLATFORM": "cpu"})
+    result = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py"),
+         "--batch-size", "2", "--num-warmup-batches", "1",
+         "--num-batches-per-iter", "1", "--num-iters", "1"],
+        cwd=_ROOT, env=env, capture_output=True, text=True, timeout=560)
+    assert result.returncode == 0, (
+        f"bench.py failed\nstdout:\n{result.stdout}\n"
+        f"stderr:\n{result.stderr}")
+    assert "[supervise 1/" in result.stderr
+    pre_supervise = result.stderr.split("[supervise 1/")[0]
+    assert "[preflight" not in pre_supervise
+    line = json.loads(result.stdout.strip().splitlines()[-1])
+    assert line["value"] > 0
+
+
 def _write_capture(path, **overrides):
     rec = {"metric": "resnet50_synthetic_train_images_per_sec_per_device",
            "value": 1699.5, "unit": "img/s", "vs_baseline": 16.412,

@@ -1,0 +1,155 @@
+#!/bin/bash
+# Chip watcher v5 (round 5).  v4 (45s idle cadence, torch entry, r5 output
+# dir) plus two time-to-first-device-op cuts, because the 08:32 window
+# closed before the first bench attempt's device op landed:
+#   * HOROVOD_BENCH_PREFLIGHT_INITIAL=0 on bench runs — the watcher's own
+#     compute probe (a jitted matmul, stronger than preflight's
+#     jax.devices()) ran seconds earlier, so the bench's INITIAL preflight
+#     is a redundant extra backend spin-up over the tunnel; the
+#     supervisor's inter-attempt backend wait stays on;
+#   * bench.py's host-init disk cache (pre-warmed for every entry) makes
+#     the measure child's first accelerator touch follow within seconds.
+# Kill it with: pkill -f chip_watch5
+set -u
+cd /root/repo
+OUT=bench_results_r5
+mkdir -p "$OUT"
+log() { echo "[chip_watch5 $(date +%H:%M:%S)] $*" >> "$OUT/watch.log"; }
+
+compute_probe() {
+    timeout 150 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((1024, 1024), jnp.bfloat16)
+y = jax.jit(lambda a: (a @ a).sum())(x)
+jax.block_until_ready(y)
+print('COMPUTE_OK', jax.devices()[0].platform, flush=True)
+" > "$OUT/probe.out" 2>&1
+    local rc=$?
+    if [ $rc -eq 0 ] && grep -q COMPUTE_OK "$OUT/probe.out"; then
+        return 0
+    fi
+    log "compute probe failed rc=$rc: $(tail -1 "$OUT/probe.out" 2>/dev/null)"
+    return 1
+}
+
+have_result() {  # a bench is done when its .json holds a parseable line
+    python - "$OUT/$1.json" <<'EOF' >/dev/null 2>&1
+import json, sys
+with open(sys.argv[1]) as f:
+    lines = [l for l in f.read().splitlines() if l.startswith("{")]
+json.loads(lines[-1])
+EOF
+}
+
+run_bench() {
+    local name="$1"; shift
+    log "bench $name starting: $*"
+    HOROVOD_BENCH_MEASURE_TIMEOUT=1100 HOROVOD_BENCH_MEASURE_ATTEMPTS=2 \
+    HOROVOD_BENCH_PREFLIGHT_ATTEMPTS=2 HOROVOD_BENCH_PREFLIGHT_INITIAL=0 \
+    HOROVOD_BENCH_FALLBACK=0 \
+        timeout 3300 python bench.py "$@" \
+        > "$OUT/$name.json" 2> "$OUT/$name.log"
+    log "bench $name done rc=$?: $(tail -1 "$OUT/$name.json" 2>/dev/null)"
+}
+
+run_onchip() {
+    log "onchip path bench starting"
+    timeout 900 python benchmarks/onchip_path_bench.py \
+        > "$OUT/onchip_tpu.json" 2> "$OUT/onchip_tpu.log"
+    log "onchip path bench rc=$?: $(tail -1 "$OUT/onchip_tpu.json" 2>/dev/null)"
+}
+
+run_torch() {
+    # Torch front-end on the device plane: model compute is torch-CPU (no
+    # torch TPU backend in this image); the measured path is the per-step
+    # hook->engine->XLA-plane round trip through the real chip.
+    log "torch synthetic bench starting"
+    HOROVOD_DATA_PLANE=xla timeout 1200 \
+        python examples/pytorch_synthetic_benchmark.py --json \
+        --num-iters 5 --num-batches-per-iter 2 \
+        > "$OUT/torch_synthetic.json" 2> "$OUT/torch_synthetic.log"
+    log "torch bench rc=$?: $(tail -1 "$OUT/torch_synthetic.json" 2>/dev/null)"
+}
+
+run_lm() {  # $1 = name, rest = lm_bench args
+    local name="$1"; shift
+    log "lm bench $name starting: $*"
+    timeout 2400 python benchmarks/lm_bench.py "$@" \
+        > "$OUT/$name.json" 2> "$OUT/$name.log"
+    log "lm bench $name done rc=$?: $(tail -1 "$OUT/$name.json" 2>/dev/null)"
+}
+
+log "watcher v5 started (pid $$)"
+round=0
+while true; do
+    round=$((round + 1))
+    missing=0
+    for entry in \
+        "resnet50|" \
+        "resnet101_bs64|--model resnet101 --batch-size 64" \
+        "resnet50_bs128|--model resnet50 --batch-size 128" \
+        "resnet50_bs256|--model resnet50 --batch-size 256" \
+        "resnet50_scan|SCAN" \
+        "torch_synthetic|TORCH" \
+        "lm_flash|LM --attention flash" \
+        "lm_dense|LM --attention dense" \
+        "lm_flash_4k|LM --attention flash --seq-len 4096 --batch-size 2 --remat" \
+        "vgg16|--model vgg16" \
+        "inception3|--model inception3" \
+        "onchip_tpu|ONCHIP"; do
+        name="${entry%%|*}"; benchargs="${entry#*|}"
+        have_result "$name" && continue
+        missing=$((missing + 1))
+        if ! compute_probe; then
+            log "round $round: chip not computing; sleeping 45s"
+            sleep 45
+            continue
+        fi
+        log "round $round: chip computes OK -> $name"
+        if [ "$benchargs" = "ONCHIP" ]; then
+            run_onchip
+        elif [ "$benchargs" = "TORCH" ]; then
+            run_torch
+        elif [ "$benchargs" = "SCAN" ]; then
+            # dispatch-overhead diagnostic: same bs32 point, one scanned
+            # device call per iteration — scan==separate rules dispatch
+            # out of the cap attribution; scan>separate convicts it
+            HOROVOD_BENCH_SCAN_BATCHES=1 run_bench "$name"
+        elif [ "${benchargs%% *}" = "LM" ]; then
+            if [ "$name" = "lm_flash" ]; then
+                # the flash kernel's on-TPU HLO + device profile ride the
+                # first LM capture (same artifacts as the resnet50 entry)
+                HOROVOD_BENCH_DUMP_HLO="$OUT/lm_flash_hlo.txt" \
+                HOROVOD_BENCH_PROFILE="$OUT/lm_flash_profile" \
+                    run_lm "$name" ${benchargs#LM }
+            else
+                # shellcheck disable=SC2086
+                run_lm "$name" ${benchargs#LM }
+            fi
+        elif [ "$name" = "resnet50" ]; then
+            HOROVOD_BENCH_DUMP_HLO="$OUT/resnet50_hlo.txt" \
+            HOROVOD_BENCH_PROFILE="$OUT/resnet50_profile" \
+                run_bench "$name"
+            # summarize only when the bench actually landed its number —
+            # a timed-out attempt can leave a partial trace on disk, and
+            # attributing from it would put wrong evidence next to nothing
+            if have_result resnet50 && [ -d "$OUT/resnet50_profile" ]; then
+                # the captured XPlane -> bottleneck attribution, written
+                # next to the numbers (the bs32 MFU-cap evidence)
+                timeout 300 python tools/profile_summary.py \
+                    "$OUT/resnet50_profile" \
+                    --out "$OUT/resnet50_profile_summary.md" \
+                    > "$OUT/resnet50_profile_summary.log" 2>&1
+                log "profile summary rc=$?"
+            fi
+        else
+            # shellcheck disable=SC2086
+            run_bench "$name" $benchargs
+        fi
+    done
+    if [ $missing -eq 0 ]; then
+        log "ALL BENCHES CAPTURED after $round round(s)"
+        break
+    fi
+    sleep 30
+done

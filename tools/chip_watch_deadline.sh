@@ -1,0 +1,56 @@
+#!/bin/bash
+# Deadline supervisor for the chip watcher (round-5 tail).
+#
+# The builder session that killed watcher v5 at 19:35 expected the round to
+# end immediately; the driver instead restarted the builder, leaving free
+# tail minutes in which a late healthy tunnel window could still land the
+# queued series.  This wrapper re-runs chip_watch5.sh but guarantees the
+# end-of-round hygiene rule (the driver's bench run must own the tunnel
+# alone) mechanically: at DEADLINE_EPOCH it SIGKILLs the watcher's whole
+# process group, including any in-flight bench child.
+#
+# Usage: setsid bash tools/chip_watch_deadline.sh <deadline_epoch> &
+set -u
+DEADLINE=${1:?usage: chip_watch_deadline.sh <deadline_epoch>}
+case "$DEADLINE" in
+    ''|*[!0-9]*) echo "deadline must be a unix epoch, got: $DEADLINE" >&2; exit 2 ;;
+esac
+if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "deadline $DEADLINE is already in the past; refusing to start" >&2
+    exit 2
+fi
+cd /root/repo
+OUT=bench_results_r5
+mkdir -p "$OUT"
+log() { echo "[deadline $(date +%H:%M:%S)] $*" >> "$OUT/watch.log"; }
+
+# Refuse to start while a prior watcher or an orphaned bench child is
+# alive: the group kill below only covers the watcher THIS script spawns,
+# so strays from an earlier instance (e.g. a `pkill -f chip_watch5` that
+# killed the watcher bash but not its bench child) would survive the
+# deadline.  Patterns are anchored so they can't match this script or
+# unrelated processes whose argv merely mentions the file names.
+if pgrep -f 'chip_watch5\.sh' >/dev/null || pgrep -f '^python bench\.py' >/dev/null; then
+    echo "a chip_watch5/bench process is already running; kill it first" >&2
+    exit 2
+fi
+
+# setsid makes the watcher a session+group leader, so its pgid == $WPID —
+# no ps round-trip (which races the child's setsid()) needed.
+setsid bash tools/chip_watch5.sh &
+WPID=$!
+log "watcher restarted for round tail (pid/pgid $WPID), hard deadline $(date -d @"$DEADLINE" +%H:%M:%S)"
+
+while kill -0 "$WPID" 2>/dev/null; do
+    if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+        log "deadline reached: killing watcher group $WPID so the driver's bench owns the tunnel"
+        break
+    fi
+    r=$(( DEADLINE - $(date +%s) ))
+    sleep $(( r < 10 ? (r > 0 ? r : 1) : 10 ))
+done
+# Unconditional group kill on every exit path: if the watcher bash died
+# (e.g. pkill -f chip_watch5) while a bench child survived in its group,
+# the orphan must not hold the tunnel past the deadline either.
+kill -KILL -- "-$WPID" 2>/dev/null
+log "deadline supervisor exiting (group $WPID killed)"

@@ -367,6 +367,8 @@ def _emit_fallback(args, log) -> bool:
             continue  # diagnostic scan-mode runs are not the protocol
         if bool(rec.get("fp16_allreduce")) != args.fp16_allreduce:
             continue  # compression changes the measured step
+        if bool(rec.get("int8_allreduce")) != args.int8_allreduce:
+            continue
         captured = rec.get("captured_at")
         if not isinstance(captured, (int, float)):
             try:
@@ -376,14 +378,21 @@ def _emit_fallback(args, log) -> bool:
         if now - captured > max_age_s:
             continue
         rev_match = bool(head) and rec.get("git_sha") == head
-        key = (rev_match, captured)
+        # full-protocol captures beat partials (a run killed mid-protocol
+        # banked its completed iterations — honest but lower-confidence),
+        # then current-revision beats stale-revision, then freshest wins
+        key = (not rec.get("partial", False), rev_match, captured)
         if best is None or key > best[0]:
             best = (key, rec, path)
     if best is None:
         log("[fallback] no previously captured measurement matches "
             f"metric={expected} batch_size={args.batch_size}")
         return False
-    (rev_match, captured), rec, path = best
+    (full_protocol, rev_match, captured), rec, path = best
+    if not full_protocol:
+        log(f"[fallback] NOTE: best capture is a PARTIAL line "
+            f"({rec.get('iters_completed')} of the protocol's iterations "
+            f"completed before the run was killed)")
     rec["live"] = False
     rec["captured_by"] = "chip_watch"
     rec["captured_at"] = captured
@@ -416,6 +425,12 @@ def _parse_args(argv=None):
                         help="gradient compression during allreduce "
                              "(reference flag; rides bf16 on TPU — the "
                              "MXU-native 16-bit format)")
+    parser.add_argument("--int8-allreduce", action="store_true",
+                        default=False,
+                        help="EQuARX-style block-quantized int8 gradient "
+                             "allreduce: ~4x fewer wire bytes than f32 at "
+                             "a bounded block-relative error "
+                             "(docs/compression.md)")
     parser.add_argument("--num-warmup-batches", type=int, default=10)
     parser.add_argument("--num-batches-per-iter", type=int, default=10)
     parser.add_argument("--num-iters", type=int, default=10)
@@ -435,7 +450,13 @@ def _parse_args(argv=None):
                         help="device count of the topology --warm-init-"
                              "cache targets (global batch = batch-size x "
                              "this); default 1, the single-chip bench")
-    return parser.parse_args(argv)
+    args = parser.parse_args(argv)
+    if args.fp16_allreduce and args.int8_allreduce:
+        # reject before preflight/supervision spin up the accelerator: a
+        # CLI usage error must not reach the wedge/fallback machinery
+        parser.error("--fp16-allreduce and --int8-allreduce are "
+                     "mutually exclusive")
+    return args
 
 
 def _init_cache_path(args, global_batch, side) -> str:
@@ -472,7 +493,8 @@ def _supervise(args) -> None:
                   "--num-warmup-batches", str(args.num_warmup_batches),
                   "--num-batches-per-iter", str(args.num_batches_per_iter),
                   "--num-iters", str(args.num_iters)] + \
-        (["--fp16-allreduce"] if args.fp16_allreduce else [])
+        (["--fp16-allreduce"] if args.fp16_allreduce else []) + \
+        (["--int8-allreduce"] if args.int8_allreduce else [])
     import signal
     import subprocess as sp
 
@@ -553,6 +575,16 @@ def main() -> None:
         # Warm mode never needs the accelerator: pin CPU (unless the
         # caller pinned something else) and skip preflight/supervision.
         os.environ.setdefault("HOROVOD_BENCH_PLATFORM", "cpu")
+        resolved = os.environ["HOROVOD_BENCH_PLATFORM"].strip().lower()
+        if resolved != "cpu":
+            # The documented contract is ZERO accelerator contact; a
+            # session-pinned platform would silently turn the warm pass
+            # into a full accelerator session. Refuse before any backend
+            # query so the contract holds even in the failure path.
+            _log(f"--warm-init-cache requires the CPU backend but "
+                 f"HOROVOD_BENCH_PLATFORM={resolved!r} is pinned; unset it "
+                 f"or set HOROVOD_BENCH_PLATFORM=cpu for the warm pass.")
+            sys.exit(2)
 
     if not args._measure and not args.warm_init_cache:
         preflight_on = os.environ.get("HOROVOD_BENCH_PREFLIGHT", "1") != "0"
@@ -635,7 +667,16 @@ def main() -> None:
     if args.warm_init_cache:
         # CPU-only mode: build the cache entry and stop before any
         # accelerator contact (pin HOROVOD_BENCH_PLATFORM=cpu when the
-        # session env points at the chip).
+        # session env points at the chip). Belt-and-braces platform
+        # check on the CONFIG, never on jax.devices() — a device query
+        # would itself initialize the accelerator backend this guard
+        # exists to refuse (and hang on a wedged chip).
+        resolved_cfg = str(getattr(jax.config, "jax_platforms", "") or "")
+        if resolved_cfg != "cpu":
+            log(f"--warm-init-cache requires jax_platforms='cpu' but the "
+                f"config resolved to {resolved_cfg!r} — refusing to warm "
+                f"the cache through an accelerator session.")
+            sys.exit(2)
         host_init_cached(cache_path, make_host, log=log)
         log("init cache warmed; exiting without accelerator contact")
         return
@@ -658,8 +699,11 @@ def main() -> None:
 
     # --fp16-allreduce maps to bf16 cast-compression on TPU (the format
     # the ICI collectives and MXU natively carry; fp16 would round-trip
-    # through an alien dtype); reference flag semantics otherwise
-    compression = (hvd.Compression.bf16 if args.fp16_allreduce
+    # through an alien dtype); --int8-allreduce rides the EQuARX
+    # block-quantized wire; reference flag semantics otherwise
+    # (mutual exclusion enforced in _parse_args)
+    compression = (hvd.Compression.int8 if args.int8_allreduce
+                   else hvd.Compression.bf16 if args.fp16_allreduce
                    else hvd.Compression.none)
     opt = hvd.DistributedOptimizer(optax.sgd(0.01), axis_name="data",
                                    compression=compression)
@@ -689,7 +733,8 @@ def main() -> None:
                               scan_batches=scan_batches,
                               # compressed allreduce must CARRY the bytes:
                               # see _dp_step's explicit_grad_reduce note
-                              explicit_grad_reduce=args.fp16_allreduce
+                              explicit_grad_reduce=(args.fp16_allreduce
+                                                    or args.int8_allreduce)
                               or None)
 
     # AOT-compile once; _step_flops_of reads the executable's own cost
@@ -718,6 +763,24 @@ def main() -> None:
     _maybe_profile_one_batch(run_batch,
                              lambda: jax.block_until_ready(params), log)
 
+    # Provenance stamps shared by partial and final lines: captures are
+    # self-describing so the wedge-fallback path (_emit_fallback) can
+    # match them to a requested config and rank them by freshness.
+    provenance = {
+        "metric": f"{args.model}_synthetic_train_images_per_sec_per_device",
+        "unit": "img/s",
+        "live": True,
+        "batch_size": args.batch_size,
+        "n_devices": n_dev,
+        "git_sha": _git_head(),
+    }
+    if scan_mode:
+        provenance["scan_batches"] = scan_batches  # marked: not protocol
+    if args.fp16_allreduce:
+        provenance["fp16_allreduce"] = True
+    if args.int8_allreduce:
+        provenance["int8_allreduce"] = True
+
     for i in range(args.num_iters):
         t0 = time.perf_counter()
         for _ in range(calls_per_iter):
@@ -727,6 +790,21 @@ def main() -> None:
         rate = global_batch * args.num_batches_per_iter / dt
         img_secs.append(rate)
         log(f"Iter #{i}: {rate:.1f} img/sec total")
+        # Incremental partial capture: a run killed at iteration k still
+        # banks an honest number — the line is provenance-marked
+        # (partial: true, iters_completed) so the supervisor's salvage and
+        # the wedge fallback can use it while preferring full-protocol
+        # lines. The FINAL result line below is printed last, so
+        # last-JSON-line consumers see partials only when the run died.
+        if i + 1 < args.num_iters:
+            partial = dict(provenance)
+            partial.update({
+                "value": round(float(np.mean(img_secs)) / n_dev, 2),
+                "iters_completed": i + 1,
+                "partial": True,
+                "captured_at": round(time.time(), 1),
+            })
+            print(json.dumps(partial), flush=True)
 
     mean = float(np.mean(img_secs))
     conf = float(1.96 * np.std(img_secs))
@@ -740,24 +818,12 @@ def main() -> None:
     vs_baseline = (round(per_device / REFERENCE_PER_DEVICE_IMG_S, 3)
                    if args.model.startswith("resnet") and not scan_mode
                    else None)
-    result = {
-        "metric": f"{args.model}_synthetic_train_images_per_sec_per_device",
+    result = dict(provenance)
+    result.update({
         "value": round(per_device, 2),
-        "unit": "img/s",
         "vs_baseline": vs_baseline,
-        # Provenance stamps: captures are self-describing so the
-        # wedge-fallback path (_emit_fallback) can match an old capture to
-        # the requested config and mark how fresh it is.
-        "live": True,
-        "batch_size": args.batch_size,
-        "n_devices": n_dev,
         "captured_at": round(time.time(), 1),
-        "git_sha": _git_head(),
-    }
-    if scan_mode:
-        result["scan_batches"] = scan_batches  # marked: not the protocol
-    if args.fp16_allreduce:
-        result["fp16_allreduce"] = True
+    })
     # cost_analysis() reports the per-device SPMD program's flops — and for
     # a lax.scan program it must count the loop BODY once, not times the
     # trip count, or mfu/tflops inflate by scan_batches. One body == one

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Quantized-allreduce micro-benchmark: wire bytes + numerical agreement.
+
+The EQuARX data plane (``ops.spmd.quantized_allreduce``) claims ~4x fewer
+collective wire bytes than f32 at a bounded block-relative error. This
+benchmark AUDITS both claims from the compiled programs themselves, on the
+virtual 8-device CPU mesh (identical lowering to the ICI collectives):
+
+* **wire bytes** — every collective instruction in the compiled HLO is
+  parsed (operand shape x dtype width) and costed with the standard ring
+  model (all-reduce moves 2B(n-1)/n per rank, reduce-scatter/all-to-all
+  B(n-1)/n, all-gather B_out(n-1)/n), so the reported reduction counts
+  the quantized path's OWN overheads: the f32 ``pmax`` scale exchange and
+  the int8 all-gather return leg, not just the headline payload cast.
+* **agreement** — flat ``pmean`` vs quantized mean on random data, checked
+  against the documented bound (per-element: across-ranks block absmax x
+  ``codec.ERROR_BOUND``; int8: 1/127 — one half-step from quantization
+  plus one half-step from re-quantizing the averaged sum).
+
+Usage:  python benchmarks/compression_bench.py [--codec int8] [--devices 8]
+
+Prints one table row per bucket size in the standard sweep (64 KiB ..
+16 MiB of f32, the fusion-buffer range ``docs/tensor-fusion.md`` targets)
+plus one JSON summary line:
+
+  {"metric": "int8_allreduce_wire_byte_reduction", "value": R, ...}
+
+where R is the MINIMUM reduction across the sweep (the honest headline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# dtype byte widths for HLO shape strings like f32[8,512] / s8[4096]
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8,
+}
+
+def _shape_bytes(shape: str) -> int:
+    m = re.match(r"([a-z0-9]+)\[([0-9,]*)\]", shape)
+    if not m or m.group(1) not in _DTYPE_BYTES:
+        return 0
+    dims = m.group(2)
+    elems = 1
+    for d in dims.split(","):
+        if d:
+            elems *= int(d)
+    return elems * _DTYPE_BYTES[m.group(1)]
+
+
+def collective_wire_bytes(hlo: str, n: int) -> dict:
+    """Per-rank ring-model wire bytes of every collective in ``hlo``,
+    grouped by op kind. Parses instruction lines of the form
+    ``<result-shape(s)> <op>(...)`` — the result may be a TUPLE (CPU
+    all-to-all returns one buffer per peer), so every ``dtype[dims]``
+    token in the result type is summed. ``-start`` spellings count,
+    ``-done`` halves carry no new traffic."""
+    out: dict = {}
+    for line in hlo.splitlines():
+        m = re.search(
+            r"=\s*(.*?)\s(all-reduce|reduce-scatter|all-gather|all-to-all|"
+            r"collective-permute)(-start)?\(", line)
+        if not m:
+            continue
+        op = m.group(2)
+        nbytes = sum(_shape_bytes(s) for s in
+                     re.findall(r"[a-z0-9]+\[[0-9,]*\]", m.group(1)))
+        if op == "all-reduce":
+            wire = 2 * nbytes * (n - 1) // n
+        elif op == "all-gather":
+            wire = nbytes * (n - 1) // n  # result IS the gathered output
+        elif op == "reduce-scatter":
+            wire = nbytes * (n - 1)  # result is the 1/n shard
+        elif op == "collective-permute":
+            wire = nbytes
+        else:  # all-to-all: result total == payload total
+            wire = nbytes * (n - 1) // n
+        out[op] = out.get(op, 0) + wire
+    return out
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--codec", default="int8", choices=["int8", "fp8"])
+    parser.add_argument("--devices", type=int, default=8)
+    args = parser.parse_args()
+
+    from horovod_tpu.core.platform import pin_cpu_platform
+
+    pin_cpu_platform(args.devices)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.ops import spmd
+    from horovod_tpu.ops.compression import Compression
+
+    codec = Compression.lookup(args.codec)
+    n = args.devices
+    mesh = Mesh(np.array(jax.devices()[:n]), ("data",))
+    # standard bucket sweep: 64 KiB .. 16 MiB of f32 per device
+    sweep = [16 * 1024, 256 * 1024, 1024 * 1024, 4 * 1024 * 1024]
+
+    print(f"# quantized allreduce audit: {args.codec}, {n}-device mesh, "
+          f"block={codec.BLOCK}")
+    print(f"{'bucket':>10} {'flat B/rank':>12} {'quant B/rank':>12} "
+          f"{'reduction':>9} {'max err':>10} {'bound':>10} {'ok':>3}")
+
+    worst_reduction = None
+    worst_err_ratio = 0.0
+    rng = np.random.RandomState(0)
+    for elems in sweep:
+        xs = (rng.randn(n, elems).astype(np.float32)
+              * np.logspace(-1, 1, n)[:, None])
+        x = jnp.asarray(xs.reshape(-1))
+
+        flat_fn = jax.jit(shard_map(
+            lambda v: jax.lax.pmean(v, "data"), mesh=mesh,
+            in_specs=P("data"), out_specs=P(), check_vma=False))
+        quant_fn = jax.jit(shard_map(
+            lambda v: spmd.quantized_allreduce(v, "data", average=True,
+                                               codec=codec),
+            mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False))
+
+        flat_bytes = sum(collective_wire_bytes(
+            flat_fn.lower(x).compile().as_text(), n).values())
+        quant_bytes = sum(collective_wire_bytes(
+            quant_fn.lower(x).compile().as_text(), n).values())
+        reduction = flat_bytes / max(quant_bytes, 1)
+
+        flat_out = np.asarray(flat_fn(x))
+        quant_out = np.asarray(quant_fn(x))
+        err = np.abs(quant_out - flat_out)
+        # documented bound: per-element block absmax (across ranks) x
+        # codec.ERROR_BOUND, over the codec's own block geometry
+        block, padded = codec.block_layout(elems, n)
+        absmax = np.zeros((n, padded), np.float32)
+        absmax[:, :elems] = np.abs(xs)
+        bmax = absmax.max(axis=0).reshape(-1, block).max(axis=1)
+        bound = np.repeat(bmax * codec.ERROR_BOUND, block)[:elems]
+        ok = bool((err <= bound + 1e-7).all())
+        ratio = float((err / np.maximum(bound, 1e-30)).max())
+        worst_err_ratio = max(worst_err_ratio, ratio)
+        worst_reduction = reduction if worst_reduction is None else \
+            min(worst_reduction, reduction)
+        print(f"{elems * 4 // 1024:>9}K {flat_bytes:>12} {quant_bytes:>12} "
+              f"{reduction:>8.2f}x {err.max():>10.2e} {bound.max():>10.2e} "
+              f"{'y' if ok else 'N'}", flush=True)
+        if not ok:
+            print(f"AGREEMENT FAILURE at bucket {elems}: max err "
+                  f"{err.max()} exceeds the documented bound", flush=True)
+            sys.exit(1)
+
+    print(json.dumps({
+        "metric": f"{args.codec}_allreduce_wire_byte_reduction",
+        "value": round(worst_reduction, 2),
+        "unit": "x_vs_f32",
+        "devices": n,
+        "max_err_over_bound": round(worst_err_ratio, 3),
+        "agreement_within_bound": True,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

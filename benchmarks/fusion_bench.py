@@ -31,6 +31,11 @@ import subprocess
 import sys
 import time
 
+# repo root on the path once, for the byte-ledger's bucket import (the
+# worker body does its own insert before importing the full package)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 
 def _worker() -> None:
     """Rank body: submit --tensors async allreduces per round, synchronize
@@ -96,6 +101,55 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _wire_bytes_per_round(plane: str, threshold: int, tensors: int,
+                          elems: int, codec: str = "none") -> int:
+    """Per-rank wire-byte accounting for one round of this benchmark —
+    the fusion claim is about PER-OP overhead, but the byte ledger shows
+    what each configuration actually moves (incl. the bucket padding the
+    xla plane pays and the ~4x the int8 codec saves; docs/compression.md).
+
+    host plane: payload crosses the TCP wire twice (rank->controller,
+    controller->rank), unpadded. xla plane: the SAME power-of-two bucket
+    function the plane allocates with (ops.xla_plane._next_bucket), with
+    the fusion threshold packing greedily by payload bytes exactly like
+    the negotiator's fusion loop — a round larger than the threshold
+    splits into several buckets, not one oversized one. Costed with the
+    ring all-reduce model (2B(n-1)/n, n=2 here); the int8 codec's ledger
+    adds its f32 pmax scale exchange and halves nothing else it doesn't
+    pay."""
+    from horovod_tpu.ops.compression import Compression
+    from horovod_tpu.ops.xla_plane import _next_bucket
+
+    n = 2  # this benchmark's world size
+    if plane == "host":
+        return tensors * elems * 4 * 2
+
+    if threshold > 0:
+        # greedy byte-packing, as the negotiator fuses: each bucket takes
+        # as many whole tensors as fit under the threshold
+        per_bucket = max(1, threshold // (elems * 4))
+        buckets = []
+        left = tensors
+        while left > 0:
+            take = min(per_bucket, left)
+            buckets.append(_next_bucket(take * elems))
+            left -= take
+    else:
+        buckets = [_next_bucket(elems)] * tensors
+    total = 0
+    for b in buckets:
+        if codec in ("int8", "fp8"):
+            # scatter leg (all_to_all) + gather leg (all_gather) of the
+            # 1-byte payload, plus the f32 block-scale pmax (all-reduce);
+            # scale count comes from the codec's OWN block geometry
+            block, padded = Compression.lookup(codec).block_layout(b, n)
+            scales_b = (padded // block) * 4
+            total += 2 * (b * (n - 1) // n) + 2 * scales_b * (n - 1) // n
+        else:
+            total += 2 * b * 4 * (n - 1) // n  # ring all-reduce of f32
+    return total
+
+
 def _run_world(plane: str, threshold: int, args, tensor_input="numpy") -> dict:
     port = _free_port()
     coord = f"127.0.0.1:{_free_port()}" if plane == "xla" else ""
@@ -140,7 +194,8 @@ def main() -> None:
     print(f"# fusion micro-benchmark: 2 ranks, {args.tensors} x "
           f"{args.elems * 4 / 1e3:.0f} KB tensors/round ({mb:.1f} MB), "
           f"{args.rounds} rounds")
-    print(f"{'plane':<10} {'threshold':>10} {'tensors/s':>10} {'speedup':>8}")
+    print(f"{'plane':<10} {'threshold':>10} {'tensors/s':>10} {'speedup':>8} "
+          f"{'wire MB/rd':>10}")
     # xla+jax = device-resident submissions (the TPU deployment shape:
     # jax.Arrays in, on-chip pack→psum→unpack, jax.Arrays out)
     for plane, tensor_input in (("host", "numpy"), ("xla", "numpy"),
@@ -152,8 +207,21 @@ def main() -> None:
                 base = r["tensors_per_s"]
             label = "0" if threshold == 0 else "64MiB"
             name = plane if tensor_input == "numpy" else f"{plane}+jax"
+            wire_mb = _wire_bytes_per_round(plane, threshold, args.tensors,
+                                            args.elems) / 1e6
             print(f"{name:<10} {label:>10} {r['tensors_per_s']:>10.0f} "
-                  f"{r['tensors_per_s'] / base:>7.1f}x", flush=True)
+                  f"{r['tensors_per_s'] / base:>7.1f}x {wire_mb:>9.1f}M",
+                  flush=True)
+    # codec byte ledger (no timed run: byte accounting is analytic; the
+    # timed int8 world needs >=2 jax processes and is covered by
+    # benchmarks/compression_bench.py's HLO audit)
+    fused = 64 * 1024 * 1024
+    f32_b = _wire_bytes_per_round("xla", fused, args.tensors, args.elems)
+    int8_b = _wire_bytes_per_round("xla", fused, args.tensors, args.elems,
+                                   codec="int8")
+    print(f"# fused-bucket wire bytes: f32 {f32_b / 1e6:.1f} MB vs int8 "
+          f"codec {int8_b / 1e6:.1f} MB ({f32_b / int8_b:.1f}x reduction)",
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -102,10 +102,11 @@ def main() -> None:
     _setup_accelerator_cache(jax)
     import jax.numpy as jnp
     import optax
+
+    import horovod_tpu as hvd  # first: installs the jax compat aliases
+
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
-
-    import horovod_tpu as hvd
     from horovod_tpu.core.platform import host_init_cached, init_on_host_cpu
     from horovod_tpu.models import TransformerLM, lm_loss
 

@@ -22,6 +22,14 @@ Typical use, mirroring the reference README:
 
 import os as _os
 
+# Bridge JAX API drift (jax.shard_map / check_vma / lax.axis_size on
+# older pinned releases) before anything — including test modules that do
+# `from jax import shard_map` after importing this package — touches jax.
+from .core import jax_compat as _jax_compat
+
+_jax_compat.install()
+del _jax_compat
+
 # HOROVOD_PLATFORM: pin the JAX platform before ANY backend starts (the
 # env var JAX_PLATFORMS alone is insufficient on TPU images whose plugin
 # prepends itself to the list). Applied at import so launcher-spawned

@@ -41,7 +41,7 @@ def create_distributed_optimizer(
         optimizer: optax.GradientTransformation,
         *,
         axis_name=None,
-        compression=Compression.none,
+        compression=None,
         average: bool = True,
         backward_passes_per_step: int = 1,
         hierarchical: Optional[bool] = None,
@@ -51,7 +51,9 @@ def create_distributed_optimizer(
     The reference builds a dynamic subclass overriding ``get_gradients``
     (``_keras/__init__.py:20-70``); in optax the seam is the gradient
     transformation itself, so the wrap is a transformation that averages
-    before delegating to the inner optimizer.
+    before delegating to the inner optimizer. ``compression=None`` follows
+    the ``HOROVOD_COMPRESSION`` knob (none/fp16/bf16/int8/fp8, see
+    docs/compression.md); pass ``Compression.*`` to pin a codec.
     """
     return DistributedOptimizer(
         optimizer, axis_name=axis_name, compression=compression,

@@ -138,7 +138,20 @@ def load_error() -> Optional[str]:
 
 
 class NativeNegotiator:
-    """Same interface as ``ops.controller.Negotiator``, backed by C++."""
+    """Same interface as ``ops.controller.Negotiator``, backed by C++.
+
+    Wire-compression codecs (``Request.codec``, the EQuARX int8/fp8 data
+    plane) postdate the C++ core's request/response schema, so this
+    wrapper keeps the codec bookkeeping in Python: codecs are recorded
+    per tensor name at ``add_request_list`` time and stamped onto the
+    constructed responses, with mixed-codec fused batches SPLIT into
+    codec-pure sub-batches (the C++ fusion loop cannot key on a field it
+    does not know). The negotiator runs once per world — on the
+    controller service (or the size-1 local world) — and its ResponseList
+    is what every rank executes, so the stamping is rank-consistent by
+    construction. Cross-rank codec mismatches become coordinator ERROR
+    responses, the same contract the Python ``Negotiator`` enforces for
+    dtype and codec mismatches."""
 
     def __init__(self, size: int, fusion_threshold_bytes: int,
                  stall_warning_s: float = 60.0,
@@ -147,6 +160,8 @@ class NativeNegotiator:
         if lib is None:
             raise RuntimeError(f"native core unavailable: {_load_error}")
         self._lib = lib
+        self._codecs: dict = {}  # in-flight tensor name -> codec tag
+        self._mismatched: dict = {}  # name -> (codec_a, codec_b)
         self._handle = lib.htpu_negotiator_new(
             size, fusion_threshold_bytes, stall_warning_s,
             1 if stall_check_disable else 0)
@@ -159,12 +174,69 @@ class NativeNegotiator:
         if rl.shutdown:
             self._lib.htpu_negotiator_shutdown(self._handle)
         for req in rl.requests:
+            codec = getattr(req, "codec", "none")
+            prev = self._codecs.setdefault(req.tensor_name, codec)
+            if prev != codec:
+                self._mismatched.setdefault(req.tensor_name, (prev, codec))
             dims = (ctypes.c_longlong * len(req.tensor_shape))(
                 *req.tensor_shape)
             self._lib.htpu_negotiator_add_request(
                 self._handle, req.request_rank, int(req.request_type),
                 int(req.tensor_type), req.tensor_name.encode("utf-8"),
                 req.root_rank, len(req.tensor_shape), dims)
+
+    def _stamp_codecs(self, responses):
+        """Attach negotiated codecs. Mixed-codec ALLREDUCE batches split
+        into adjacent codec-pure runs (execution order preserved);
+        cross-rank codec mismatches carve out per-tensor ERROR responses
+        (the Python Negotiator's contract)."""
+        from ..ops.messages import Response, ResponseType
+
+        out: List = []
+        for resp in responses:
+            codecs = []
+            for n in resp.tensor_names:
+                codec = self._codecs.pop(n, "none")
+                if n in self._mismatched:
+                    a, b = self._mismatched.pop(n)
+                    codec = Response(
+                        ResponseType.ERROR, tensor_names=[n],
+                        error_message=(
+                            f"Mismatched compression codecs: one rank "
+                            f"sent {a!r}, another sent {b!r} for tensor "
+                            f"{n}."))
+                codecs.append(codec)
+            if resp.response_type != ResponseType.ALLREDUCE:
+                # non-fused ops carry one name; a mismatch there still
+                # surfaces as the carved-out error
+                if codecs and isinstance(codecs[0], Response):
+                    out.append(codecs[0])
+                    continue
+                resp.tensor_codec = codecs[0] if codecs else "none"
+                out.append(resp)
+                continue
+            start = 0
+            bytes_left = resp.payload_bytes
+            for i in range(1, len(codecs) + 1):
+                if i < len(codecs) and codecs[i] == codecs[start] and \
+                        not isinstance(codecs[start], Response):
+                    continue
+                if isinstance(codecs[start], Response):  # carved error
+                    out.append(codecs[start])
+                else:
+                    out.append(Response(
+                        ResponseType.ALLREDUCE,
+                        tensor_names=resp.tensor_names[start:i],
+                        tensor_dtype=resp.tensor_dtype,
+                        # per-tensor bytes are unknown here; the batch
+                        # total rides the FIRST non-error sub-batch so
+                        # autotuner byte accounting stays conserved
+                        # across the split
+                        payload_bytes=bytes_left,
+                        tensor_codec=codecs[start]))
+                    bytes_left = 0
+                start = i
+        return out
 
     def construct_response_list(self):
         from ..core.logging import LOG
@@ -178,7 +250,10 @@ class NativeNegotiator:
         doc = json.loads(raw)
         for warning in doc.get("stall_warnings", []):
             LOG.warning("%s", warning)
-        return parse_response_json(doc)
+        response_list = parse_response_json(doc)
+        response_list.responses = self._stamp_codecs(
+            response_list.responses)
+        return response_list
 
     def __del__(self) -> None:
         handle = getattr(self, "_handle", None)

@@ -15,6 +15,8 @@ def parse_response_json(doc: dict) -> ResponseList:
             tensor_sizes=list(item.get("sizes", [])),
             tensor_dtype=DataType(item["dtype"]),
             payload_bytes=int(item.get("bytes", 0)),
+            # the native wire predates quantized codecs; absent == none
+            tensor_codec=str(item.get("codec", "none")),
         ))
     return ResponseList(responses=responses,
                         shutdown=bool(doc.get("shutdown", 0)))

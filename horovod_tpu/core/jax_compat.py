@@ -1,0 +1,57 @@
+"""Compatibility aliases for JAX API drift.
+
+The codebase targets the current public JAX surface — ``jax.shard_map``
+(with ``check_vma=``), ``lax.axis_size`` — while deployment images pin
+older releases where ``shard_map`` still lives in ``jax.experimental``
+(spelled ``check_rep=``) and ``lax.axis_size`` does not exist yet.
+``install()`` bridges the gap by installing the missing names on the jax
+modules when (and only when) they are absent, with semantics-preserving
+adapters:
+
+* ``jax.shard_map``: the experimental ``shard_map`` with replication
+  checking FORCED OFF (``check_rep=False``), whatever the caller passed
+  for ``check_vma``. Old rep-tracking pre-sums replicated-param
+  cotangents in the transpose *without* exposing the vma value types the
+  library keys off (``jax.typeof(x).vma``), so
+  ``ops.spmd._vma_tracking_active`` would report legacy semantics while
+  the pre-sum still happened — every ``hvd.allreduce`` of a cotangent
+  would then double-reduce (the classic 8x-gradient bug
+  ``ops.spmd.allreduce`` exists to prevent). With checking off, old
+  shard_map neither pre-sums nor type-checks — exactly the "legacy
+  tracing" mode the whole library detects and handles correctly.
+* ``lax.axis_size``: the static bound-axis size, read from the axis-env
+  frame (older JAX returns the frame as the bare int).
+
+Installed at ``import horovod_tpu`` time, before any test/bench module
+does ``from jax import shard_map`` — library code, tests, benchmarks and
+the driver entry all run unchanged on both JAX generations. Aliases are
+only ever ADDED; on a current JAX this module is a no-op.
+"""
+
+from __future__ import annotations
+
+
+def install() -> None:
+    import jax
+    from jax import lax
+
+    if not hasattr(jax, "shard_map"):
+        try:
+            from jax.experimental.shard_map import shard_map as _shard_map
+
+            def shard_map(f, *args, **kwargs):
+                kwargs.pop("check_vma", None)
+                kwargs["check_rep"] = False  # see module docstring
+                return _shard_map(f, *args, **kwargs)
+
+            shard_map.__doc__ = _shard_map.__doc__
+            jax.shard_map = shard_map
+        except ImportError:  # pragma: no cover - no shard_map at all
+            pass
+
+    if not hasattr(lax, "axis_size"):
+        def axis_size(axis_name):
+            frame = jax.core.axis_frame(axis_name)
+            return frame if isinstance(frame, int) else frame.size
+
+        lax.axis_size = axis_size

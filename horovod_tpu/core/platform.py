@@ -57,26 +57,42 @@ def init_cache_path(config_key, extra_sources=()):
     """Resolve the on-disk host-init cache entry for ``config_key``.
 
     One shared policy for every bench entry point: the filename carries an
-    md5 of the model-zoo sources (``horovod_tpu/models/*.py``), the
+    md5 of the model-zoo sources (``horovod_tpu/models/**/*.py``,
+    recursive so a future models/ subpackage invalidates too), the
     caller's own source file(s) (``extra_sources`` — the synthesize/init
-    code that actually generates the arrays), and the jax version, so
-    editing any of them invalidates stale entries instead of silently
-    measuring them. ``HOROVOD_BENCH_INIT_CACHE=0`` disables (returns "");
+    code that actually generates the arrays), and the jax AND flax
+    versions (flax initializers generate the cached param values), so
+    editing/upgrading any of them invalidates stale entries instead of
+    silently measuring them.
+
+    Knob semantics: ``HOROVOD_BENCH_INIT_CACHE=0`` disables (returns "");
+    unset/empty/``1`` enable with the default repo-local directory — a
+    bare ``1`` is an on/off answer, NOT a relative directory named ``1``;
     any other value overrides the cache directory."""
     import glob
     import hashlib
 
-    knob = os.environ.get("HOROVOD_BENCH_INIT_CACHE", "")
-    if knob == "0":
+    knob = os.environ.get("HOROVOD_BENCH_INIT_CACHE", "").strip()
+    if knob.lower() in ("0", "false", "off"):
         return ""
     import jax
 
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    cache_dir = knob or os.path.join(root, ".bench_init_cache")
+    if knob.lower() in ("", "1", "true", "on"):
+        cache_dir = os.path.join(root, ".bench_init_cache")
+    else:
+        cache_dir = knob
     h = hashlib.md5(jax.__version__.encode())
+    try:
+        import flax
+
+        h.update(getattr(flax, "__version__", "?").encode())
+    except Exception:  # noqa: BLE001 - flax-less callers still get a key
+        h.update(b"no-flax")
     sources = sorted(glob.glob(
-        os.path.join(root, "horovod_tpu", "models", "*.py")))
+        os.path.join(root, "horovod_tpu", "models", "**", "*.py"),
+        recursive=True))
     sources += [os.path.abspath(s) for s in extra_sources]
     for src in sources:
         with open(src, "rb") as f:

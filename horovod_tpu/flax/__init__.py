@@ -82,7 +82,7 @@ class DistributedTrainState(_train_state.TrainState):
     @classmethod
     def create(cls, *, apply_fn, params, tx,
                axis_name=None,
-               compression=Compression.none,
+               compression=None,  # None: follow HOROVOD_COMPRESSION
                average: bool = True,
                backward_passes_per_step: int = 1,
                hierarchical: Optional[bool] = None,

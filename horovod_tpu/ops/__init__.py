@@ -87,6 +87,11 @@ def _submit(op: RequestType, tensor: Any, name: Optional[str],
             "axis_name= to use the SPMD collective instead.")
     name = _auto_name(OP_NAMES[op], name)
     compressed, comp_ctx = compression.compress(tensor)
+    # Quantized codecs compress INSIDE the collective (shared block scales
+    # need a cross-rank pmax, impossible pre-submit); the negotiation
+    # metadata carries the codec tag so every rank picks the same wire.
+    codec = getattr(compression, "codec_name", "none") \
+        if getattr(compression, "quantized", False) else "none"
     if _is_jax(compressed):
         # JAX arrays stay device-resident: the engine fuses and reduces
         # them with on-chip programs (no host round-trip) whenever the
@@ -100,7 +105,7 @@ def _submit(op: RequestType, tensor: Any, name: Optional[str],
     else:
         arr = _to_numpy(compressed)
     engine = get_engine()
-    handle = engine.enqueue(op, arr, name, root_rank=root_rank)
+    handle = engine.enqueue(op, arr, name, root_rank=root_rank, codec=codec)
     with _ctx_lock:
         # The handle stays bound to the engine that produced it: a completed
         # result must remain readable even after that engine stops (e.g. a
@@ -178,6 +183,12 @@ def allreduce(tensor: Any, average: bool = True, name: Optional[str] = None,
               axis_name: Optional[spmd.AxisName] = None) -> Any:
     """Average (or sum) across ranks (``torch/mpi_ops.py:110-160``)."""
     if axis_name is not None:
+        if getattr(compression, "quantized", False):
+            # block-quantized wire: the codec owns the whole collective
+            # (quantize -> int8/fp8 reduce -> dequantize), see spmd
+            return spmd.quantized_allreduce(tensor, axis_name,
+                                            average=average,
+                                            codec=compression)
         compressed, ctx = compression.compress(tensor)
         reduced = spmd.allreduce(compressed, axis_name, average=average)
         return compression.decompress(reduced, ctx)

@@ -8,6 +8,19 @@ native 16-bit format of the MXU — on TPU it is both faster and safer
 (fp32-range exponent) than fp16, and XLA reduces it natively, so the
 software fp16-sum shim of the reference (``half.cc:43-75``) has no analog
 here.
+
+Beyond the cast codecs, ``Compression.int8`` / ``Compression.fp8`` are
+*quantized wire* codecs (EQuARX, arxiv 2506.17615): block-wise scaled
+int8 (or fp8-e4m3) payloads on the collective wire, ~4x fewer bytes than
+f32. Unlike the cast codecs these cannot quantize locally before a
+generic collective — the per-block scales must be agreed across ranks
+(a tiny ``pmax`` pre-pass) so the reduced payload dequantizes
+consistently — so their ``compress``/``decompress`` hooks are identity
+and the collective itself routes through the quantized data plane
+(``ops.spmd.quantized_allreduce``, ``parallel.hierarchical``, the eager
+``ops.xla_plane`` fused-buffer program). ``codec_name`` is the
+negotiation tag the eager control plane carries so every rank picks the
+same wire. See docs/compression.md for the codec table and error bound.
 """
 
 from __future__ import annotations
@@ -18,6 +31,12 @@ import jax.numpy as jnp
 class Compressor:
     """Interface for compressing and decompressing a tensor
     (``compression.py:20-33`` in the reference)."""
+
+    # Negotiation tag + routing flags, uniform across every codec so the
+    # ops layer can duck-type (the TF front-end mirrors these on its own
+    # Compression classes without importing jax).
+    codec_name = "none"
+    quantized = False
 
     @staticmethod
     def compress(tensor):
@@ -62,16 +81,124 @@ class _CastCompressor(Compressor):
 
 class FP16Compressor(_CastCompressor):
     WIRE_DTYPE = jnp.float16
+    codec_name = "fp16"
 
 
 class BF16Compressor(_CastCompressor):
     WIRE_DTYPE = jnp.bfloat16
+    codec_name = "bf16"
+
+
+class _BlockQuantCompressor(Compressor):
+    """Block-wise scaled quantized wire (EQuARX design): the flat payload
+    is split into ``BLOCK``-element blocks, each carrying one shared scale
+    ``s = pmax(absmax(block)) / QMAX`` so every rank quantizes with the
+    SAME step and the wire integers sum exactly in a widened int32
+    accumulator (no overflow up to world sizes of QMAX * size < 2^31,
+    i.e. ~16M ranks at int8).
+
+    ``compress``/``decompress`` are identity: the quantize → reduce →
+    dequantize cycle lives inside the collective (see module docstring).
+    Per-element error bound after one quantized allreduce:
+
+        |quantized_mean - exact_mean| <= block_absmax * ERROR_BOUND
+
+    where ``block_absmax`` is the across-ranks absolute max of the
+    element's block (int8: one 1/2-step from quantization + one 1/2-step
+    from re-quantizing the averaged sum → 1/127 of the block max).
+    """
+
+    quantized = True
+    BLOCK = 512  # elements per scale; small leaves shrink it (see spmd)
+
+    # subclasses pin the wire format
+    WIRE_DTYPE: jnp.dtype
+    QMAX: float
+    SCALE_DTYPE: jnp.dtype
+    ERROR_BOUND: float
+
+    @classmethod
+    def wire_dtype(cls):
+        """The collective operand dtype; an accessor (not the bare class
+        attribute) so codecs whose dtype may be missing on old stacks can
+        resolve it lazily (see FP8Compressor)."""
+        return cls.WIRE_DTYPE
+
+    @classmethod
+    def block_layout(cls, n_elems: int, size: int):
+        """(block, padded): the scale-block geometry for an ``n_elems``
+        bucket reduced over ``size`` ranks. THE single definition — the
+        collective (``ops.spmd``), the error-bound checks in the tests,
+        and the benchmark auditor all derive from it. Buckets small
+        enough to fit one block per scatter chunk shrink the block to the
+        chunk itself instead of paying up to ``size*BLOCK-1`` elements of
+        padding; larger buckets pad to whole (size x BLOCK) tiles."""
+        block = int(cls.BLOCK)
+        if n_elems <= size * block:
+            padded = -(-n_elems // size) * size
+            block = max(1, padded // size)
+        else:
+            padded = -(-n_elems // (size * block)) * (size * block)
+        return block, padded
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class Int8Compressor(_BlockQuantCompressor):
+    """Symmetric int8: values in [-127, 127], exact int32 summation."""
+
+    codec_name = "int8"
+    WIRE_DTYPE = jnp.int8
+    QMAX = 127.0
+    SCALE_DTYPE = jnp.float32
+    ERROR_BOUND = 1.0 / 127.0
+
+
+class FP8Compressor(_BlockQuantCompressor):
+    """fp8-e4m3 wire with bf16 scales: coarser than int8 near the block
+    max (3 mantissa bits → ulp(448) = 32) but wider dynamic range within
+    a block. Accumulates in f32 after widening. Backend support is
+    probed at trace time; unsupported backends raise at compile."""
+
+    codec_name = "fp8"
+    QMAX = 448.0
+    SCALE_DTYPE = jnp.bfloat16
+    # one e4m3 rounding (<= 2^-4 relative, <= QMAX/16 absolute at the
+    # block max... conservatively ulp(448)/448 = 1/14) per leg, double it
+    ERROR_BOUND = 1.0 / 7.0
+
+    # resolved lazily: jnp may lack float8 types on old stacks, and a
+    # class attribute would make `import horovod_tpu` itself fail there
+    @classmethod
+    def wire_dtype(cls):
+        return jnp.float8_e4m3fn
 
 
 class Compression:
     """Optional gradient compression algorithm used during allreduce
-    (``compression.py:67-74``)."""
+    (``compression.py:67-74``; ``int8``/``fp8`` extend the reference
+    surface with the EQuARX quantized wire)."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor
+    fp8 = FP8Compressor
+
+    @staticmethod
+    def lookup(name):
+        """Codec by negotiation tag (the ``HOROVOD_COMPRESSION`` values):
+        none / fp16 / bf16 / int8 / fp8."""
+        codec = getattr(Compression, (name or "none").strip().lower(), None)
+        if codec is None or not (isinstance(codec, type)
+                                 and issubclass(codec, Compressor)):
+            raise ValueError(
+                f"unknown compression codec {name!r}; expected one of "
+                f"none, fp16, bf16, int8, fp8")
+        return codec

@@ -145,6 +145,7 @@ class Negotiator:
                 resp = self._construct_response(name, entry)
                 first = entry.requests[min(entry.requests)]
                 resp.tensor_dtype = first.tensor_type
+                resp.tensor_codec = getattr(first, "codec", "none")
                 resp.payload_bytes = _nbytes(first)
                 responses.append(resp)
             self._maybe_check_stalls()
@@ -178,6 +179,16 @@ class Negotiator:
                     f"Mismatched data types: rank {first.request_rank} sent "
                     f"{first.tensor_type.name}, but rank {req.request_rank} "
                     f"sent {req.tensor_type.name} for tensor {name}.")
+            if getattr(req, "codec", "none") != \
+                    getattr(first, "codec", "none"):
+                # a quantized wire changes the collective program itself;
+                # divergent codecs would desynchronize XLA launch order
+                return error(
+                    f"Mismatched compression codecs: rank "
+                    f"{first.request_rank} sent "
+                    f"{getattr(first, 'codec', 'none')!r}, but rank "
+                    f"{req.request_rank} sent "
+                    f"{getattr(req, 'codec', 'none')!r} for tensor {name}.")
 
         op = first.request_type
         if op == RequestType.ALLREDUCE:
@@ -253,14 +264,16 @@ class Negotiator:
             batch = Response(ResponseType.ALLREDUCE,
                              tensor_names=list(resp.tensor_names),
                              tensor_dtype=resp.tensor_dtype,
-                             payload_bytes=resp.payload_bytes)
+                             payload_bytes=resp.payload_bytes,
+                             tensor_codec=resp.tensor_codec)
             dtype = resp.tensor_dtype
             total = resp.payload_bytes
             j = i + 1
             while j < len(responses):
                 nxt = responses[j]
                 if nxt.response_type != ResponseType.ALLREDUCE or \
-                        nxt.tensor_dtype != dtype:
+                        nxt.tensor_dtype != dtype or \
+                        nxt.tensor_codec != resp.tensor_codec:
                     break
                 if total + nxt.payload_bytes > self._fusion_threshold:
                     break

@@ -69,6 +69,7 @@ class TensorTableEntry:
     array: Any  # np.ndarray | jax.Array, per the docstring contract
     handle: int
     root_rank: int = -1
+    codec: str = "none"  # negotiated wire-compression tag (messages.Request)
 
 
 def _is_jax_array(a) -> bool:
@@ -251,6 +252,7 @@ class Engine:
         self._service: Optional[ControllerService] = None
         self._client: Optional[ControllerClient] = None
         self._negotiator = None
+        self._native_controller = False  # set with use_native below
         self._autotuner: Optional[Autotuner] = None
         # The autotuner lives with the controller service — launcher
         # world-rank 0 (when a member; a non-member service host builds its
@@ -320,6 +322,7 @@ class Engine:
             # library availability, identical on every rank (the two speak
             # different wires).
             use_native = native_controller_enabled(cfg)
+            self._native_controller = use_native
             from .controller import world_id_of
 
             world_id = world_id_of(topo.members, self._size)
@@ -504,11 +507,25 @@ class Engine:
     # -- submission (API threads) --------------------------------------------
 
     def enqueue(self, op: RequestType, array: np.ndarray, name: str,
-                root_rank: int = -1) -> int:
+                root_rank: int = -1, codec: str = "none") -> int:
         """EnqueueTensor* (``operations.cc:2472-2591``): duplicate names are
         rejected while the previous submission is still in flight, as the
         reference's tensor_table emplace does."""
         dtype_of(array)  # validate wire dtype early
+        if codec != "none" and self._native_controller:
+            # The native controller's fixed binary wire has no codec slot,
+            # so quantized negotiation metadata cannot reach the
+            # coordinator. Deterministic on every rank (the native
+            # decision is config-driven and rank-identical): fall back to
+            # the full-precision wire rather than risk divergent batches.
+            if codec not in self._host_fallback_warned:
+                self._host_fallback_warned.add(codec)
+                LOG.warning(
+                    "quantized allreduce (%s) is not carried by the native "
+                    "controller wire; reducing at full precision. Set "
+                    "HOROVOD_NATIVE_CONTROLLER=0 to use the quantized "
+                    "eager data plane.", codec)
+            codec = "none"
         with self._lock:
             if self._stop_requested:
                 raise RuntimeError(SHUT_DOWN_ERROR)
@@ -521,7 +538,8 @@ class Engine:
                     f"first or pass a unique name.")
             handle = self.handles.allocate()
             entry = TensorTableEntry(name=name, op=op, array=array,
-                                     handle=handle, root_rank=root_rank)
+                                     handle=handle, root_rank=root_rank,
+                                     codec=codec)
             self._submissions.append(entry)
         self.timeline.negotiate_start(name, _OP_NAMES[op])
         # No wake: submissions ride the next cycle tick, preserving the
@@ -635,6 +653,7 @@ class Engine:
             tensor_type=dtype_of(entry.array),
             tensor_shape=tuple(entry.array.shape),
             root_rank=entry.root_rank,
+            codec=entry.codec,
         )
 
     def _flush_outstanding(self, status: Status) -> None:
@@ -669,7 +688,8 @@ class Engine:
             tl.start(entry.name, op_name)
         try:
             if resp.response_type == ResponseType.ALLREDUCE:
-                results = self._run_allreduce(idx, entries)
+                results = self._run_allreduce(
+                    idx, entries, getattr(resp, "tensor_codec", "none"))
             elif resp.response_type == ResponseType.ALLGATHER:
                 results = self._run_allgather(idx, entries[0], resp)
             else:
@@ -699,10 +719,27 @@ class Engine:
                 self.handles.mark_done(
                     entry.handle, Status.unknown_error(reason), None)
 
-    def _run_allreduce(self, idx: int,
-                       entries: List[TensorTableEntry]) -> List[np.ndarray]:
+    def _run_allreduce(self, idx: int, entries: List[TensorTableEntry],
+                       codec: str = "none") -> List[np.ndarray]:
         fused = len(entries) > 1
         tl = self.timeline
+        # Quantized wire eligibility is decided from NEGOTIATED batch
+        # metadata (codec + dtype), identical on every rank, so the
+        # compiled collective programs stay launch-order compatible.
+        # Ineligible dtypes and plane-less (host TCP) worlds deterministically
+        # ride the full-precision wire.
+        if codec != "none":
+            if self._plane is None or not self._plane.supports_quantized(
+                    dtype_of(entries[0].array)):
+                if self._plane is None and \
+                        ("codec", codec) not in self._host_fallback_warned:
+                    self._host_fallback_warned.add(("codec", codec))
+                    LOG.warning(
+                        "quantized allreduce (%s) requested but the host "
+                        "TCP data plane is active; reducing at full "
+                        "precision (set HOROVOD_DATA_PLANE=xla for the "
+                        "quantized device wire).", codec)
+                codec = "none"
         device_in = all(_is_jax_array(e.array) for e in entries)
         if device_in and self._client is None:
             # World of one, device tensors: sum over a single rank without
@@ -723,7 +760,7 @@ class Engine:
             for e in entries:
                 tl.activity_start(e.name, "EXECUTE")
             results = self._device_call(self._plane.allreduce_onchip,
-                                        [e.array for e in entries])
+                                        [e.array for e in entries], codec)
             for e in entries:
                 tl.activity_end(e.name)
             return results
@@ -745,7 +782,7 @@ class Engine:
             # explicit size-1 plane, where the single-rank psum is how the
             # eager path's bytes actually traverse the chip.
             out = self._device_call(self._plane.allreduce,
-                                    np.ascontiguousarray(buf))
+                                    np.ascontiguousarray(buf), codec)
         elif self._client is None:
             # world of one: sum over a single rank. Copy so results never
             # alias the caller's input array.

@@ -84,6 +84,12 @@ class Request:
     # (``common.h:109``: CPU_DEVICE_ID=-1); on TPU all eager tensors live on
     # the process's device set, so this only distinguishes cpu/tpu paths.
     device: str = "cpu"
+    # Wire-compression codec tag ("none"/"int8"/"fp8"): quantized codecs
+    # change the collective PROGRAM every rank must issue, so the codec is
+    # negotiated like the dtype — mismatches become coordinator errors,
+    # and fusion only batches same-codec tensors. Cast codecs (fp16/bf16)
+    # stay "none" here: they already changed tensor_type itself.
+    codec: str = "none"
 
 
 @dataclass
@@ -112,6 +118,8 @@ class Response:
     tensor_sizes: List[int] = field(default_factory=list)
     tensor_dtype: Optional[DataType] = None
     payload_bytes: int = 0
+    # negotiated wire-compression codec for the batch (see Request.codec)
+    tensor_codec: str = "none"
 
 
 @dataclass

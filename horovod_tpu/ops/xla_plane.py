@@ -114,6 +114,16 @@ class XlaDataPlane:
             return False
         return not (dt == DataType.FLOAT64 and self._platform != "cpu")
 
+    def supports_quantized(self, dt: DataType) -> bool:
+        """Deterministic eligibility for the block-quantized (EQuARX)
+        reduction wire, mirroring ``supports()``: decided from the
+        NEGOTIATED dtype so every rank picks the same compiled program
+        and launch order stays identical. Float reductions only — the
+        quantized codec is a lossy float transform; integer/bool payloads
+        must reduce exactly, so they keep the full-precision wire."""
+        return self.supports(dt) and dt in (
+            DataType.FLOAT32, DataType.FLOAT16, DataType.BFLOAT16)
+
     def _wire_parts(self, dtype) -> Tuple[object, object]:
         """(wire dtype, result dtype). CPU gloo lacks 16-bit float reductions,
         so f16/bf16 upcast to f32 on the wire — numerically strictly better
@@ -136,6 +146,21 @@ class XlaDataPlane:
             P = self._P
             if kind == "psum":
                 body = lambda x: lax.psum(x, "hvd")  # noqa: E731
+            elif kind == "qpsum":
+                # Block-quantized fused allreduce (key = (codec,)): the
+                # SAME wire math as the jit/SPMD path — shared pmax
+                # scales, int8/fp8 all_to_all + all_gather, widened
+                # accumulator — over the eager process mesh. The
+                # per-bucket scale tensors ride inside the program as the
+                # pmax wire; the fused buffer layout (bucket size, pack/
+                # unpack) is identical to the psum path, so eligibility
+                # (supports_quantized) is the only negotiation delta.
+                from .compression import Compression
+                from .spmd import quantized_allreduce
+
+                q_codec = Compression.lookup(key[0])
+                body = lambda x: quantized_allreduce(  # noqa: E731
+                    x, "hvd", average=False, codec=q_codec)
             elif kind == "gather":
                 body = lambda x: lax.all_gather(  # noqa: E731
                     x, "hvd", axis=0, tiled=True)
@@ -189,7 +214,16 @@ class XlaDataPlane:
 
     # -- collectives ----------------------------------------------------------
 
-    def allreduce_onchip(self, arrays: Sequence) -> List:
+    def _reduce_fn(self, codec: str = "none"):
+        """The bucketed fused-reduction program: full-precision psum, or
+        the block-quantized variant when the negotiated codec asks for it
+        (callers already checked ``supports_quantized``)."""
+        if codec != "none":
+            return self._fn("qpsum", codec)
+        return self._fn("psum")
+
+    def allreduce_onchip(self, arrays: Sequence,
+                         codec: str = "none") -> List:
         """Fused allreduce of device-resident ``jax.Array``s with ZERO host
         transfers: pack (local jit: cast+concat+pad to the bucket) → the
         SAME bucketed psum program the host-fed path issues → unpack
@@ -228,7 +262,7 @@ class XlaDataPlane:
         for a, n in zip(arrays, sizes):
             buf = write(buf, a, off)
             off += n
-        result = self._fn("psum")(self._global_put(buf))
+        result = self._reduce_fn(codec)(self._global_put(buf))
         # out_specs=P(): replicated, so this process's single shard holds
         # the full reduced value, already on the lead device
         local = result.addressable_shards[0].data
@@ -358,13 +392,13 @@ class XlaDataPlane:
             ("trimrows", shape[1:], str(dt), rows, sizes), _build_trim)
         return trim(local)
 
-    def allreduce(self, buf: np.ndarray) -> np.ndarray:
+    def allreduce(self, buf: np.ndarray, codec: str = "none") -> np.ndarray:
         """Sum a flat (possibly fused) buffer across all ranks."""
         wire_dt, out_dt = self._wire_parts(buf.dtype)
         n = buf.size
         padded = np.zeros((_next_bucket(n),), dtype=wire_dt)
         padded[:n] = buf
-        result = self._fn("psum")(self._global_put(padded))
+        result = self._reduce_fn(codec)(self._global_put(padded))
         # always copy: np.asarray of a jax Array is a read-only view of its
         # host cache, and callers (torch front-end in-place grads) need a
         # writable result — the host plane copies for the same reason

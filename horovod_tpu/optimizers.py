@@ -34,11 +34,12 @@ from . import basics, ops
 from .core.logging import LOG
 from .ops.compression import Compression
 
-# Build-time hierarchical resolutions made BEFORE hvd.init() (env reads).
+# Build-time knob resolutions made BEFORE hvd.init() (env reads).
 # init() audits these against the pinned config: a step traced before init
-# keeps its build-time routing forever, so a divergence would otherwise be
-# silent (see check_build_time_resolutions).
+# keeps its build-time routing/codec forever, so a divergence would
+# otherwise be silent (see check_build_time_resolutions).
 _prebuild_hierarchical_resolutions: list = []
+_prebuild_compression_resolutions: list = []
 
 
 def _use_hierarchical(axis_name, hierarchical) -> bool:
@@ -62,6 +63,30 @@ def _use_hierarchical(axis_name, hierarchical) -> bool:
     return resolved
 
 
+def _resolve_compression(compression, record: bool = False):
+    """``compression=None`` means "follow the HOROVOD_COMPRESSION knob"
+    (``core.config``): initialized worlds use the pinned config; before
+    ``hvd.init()`` the env is read directly — same build-time semantics
+    as the hierarchical knob (a step traced before init keeps its
+    build-time codec). An explicit ``Compression.*`` argument always
+    wins. ``record=True`` registers a pre-init resolution for the
+    ``check_build_time_resolutions`` audit — set only by the reduction
+    sites that actually bake the codec into a traced step, so ad-hoc
+    resolutions (tests, introspection) cannot trigger spurious
+    stale-codec warnings at the next init."""
+    if compression is not None:
+        return compression
+    if basics.is_initialized():
+        name = basics.config().compression
+    else:
+        from .core.config import Config
+
+        name = Config.from_env().compression
+        if record:
+            _prebuild_compression_resolutions.append(name)
+    return Compression.lookup(name)
+
+
 def check_build_time_resolutions(cfg) -> None:
     """Called by ``hvd.init()``: warn when a step traced before init
     resolved the hierarchical knob differently from the now-pinned config
@@ -71,10 +96,13 @@ def check_build_time_resolutions(cfg) -> None:
     to rebuild the step or align the config."""
     stale = {v for v in _prebuild_hierarchical_resolutions
              if v != cfg.hierarchical_allreduce}
+    stale_codecs = {v for v in _prebuild_compression_resolutions
+                    if v != cfg.compression}
     # Consume the audited entries: a later shutdown/re-init must only audit
     # steps built since THIS init, not re-warn about ones already reported
     # (which may have been rebuilt by then).
     _prebuild_hierarchical_resolutions.clear()
+    _prebuild_compression_resolutions.clear()
     if stale:
         built = "ON" if True in stale else "off"
         pinned = "ON" if cfg.hierarchical_allreduce else "off"
@@ -85,10 +113,18 @@ def check_build_time_resolutions(cfg) -> None:
             "rebuild them after init (or align "
             "HOROVOD_HIERARCHICAL_ALLREDUCE / init(config=...)) so the "
             "routing matches the pinned config.", built, pinned)
+    if stale_codecs:
+        LOG.warning(
+            "a train step was built before hvd.init() with compression "
+            "codec %s (HOROVOD_COMPRESSION), but the initialized world "
+            "pins %r. Steps traced before init keep their build-time "
+            "wire codec; rebuild them after init (or align the env / "
+            "init(config=...)) so the wire matches the pinned config.",
+            "/".join(sorted(stale_codecs)), cfg.compression)
 
 
 def allreduce_gradients(grads: Any, axis_name=None, average: bool = True,
-                        compression=Compression.none,
+                        compression=None,
                         hierarchical: Optional[bool] = None) -> Any:
     """Average a gradient pytree across the world.
 
@@ -97,7 +133,13 @@ def allreduce_gradients(grads: Any, axis_name=None, average: bool = True,
     feeding an optimizer. With a two-axis ``axis_name`` (dcn, ici) and
     ``hierarchical`` (or ``HOROVOD_HIERARCHICAL_ALLREDUCE``), varying
     gradients take the factored reduce_scatter/allreduce/all_gather route
-    of ``parallel.hierarchical``."""
+    of ``parallel.hierarchical``. ``compression=None`` follows the
+    ``HOROVOD_COMPRESSION`` knob; a quantized codec (``Compression.int8``
+    / ``.fp8``) moves the collective bytes as block-quantized wire — on
+    the hierarchical route only the DCN hop is quantized (the EQuARX
+    design point)."""
+    compression = _resolve_compression(compression, record=True)
+    quantized = bool(getattr(compression, "quantized", False))
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if axis_name is not None:
         if _use_hierarchical(axis_name, hierarchical):
@@ -120,7 +162,8 @@ def allreduce_gradients(grads: Any, axis_name=None, average: bool = True,
                 if legacy or _varies_over(comp, axis_name):
                     factored_leaves += 1
                     red = hierarchical_grad_allreduce(
-                        comp, dcn_axis, ici_axis, average=average)
+                        comp, dcn_axis, ici_axis, average=average,
+                        codec=compression if quantized else None)
                 else:
                     # pre-summed cotangent (see ops.spmd.allreduce)
                     red = ops.spmd.allreduce(comp, axis_name, average=average)
@@ -169,7 +212,7 @@ class DistributedOptState(NamedTuple):
 def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          *,
                          axis_name=None,
-                         compression=Compression.none,
+                         compression=None,
                          average: bool = True,
                          backward_passes_per_step: int = 1,
                          hierarchical: Optional[bool] = None,
@@ -177,7 +220,11 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     """Wrap an optax optimizer so updates are computed from world-averaged
     gradients. ``backward_passes_per_step`` accumulates N passes locally
     before one allreduce + one inner update, exactly the delay-counter
-    semantics of ``torch/__init__.py:71-73,114-130``."""
+    semantics of ``torch/__init__.py:71-73,114-130``.
+    ``compression=None`` follows ``HOROVOD_COMPRESSION`` (resolved per
+    reduction, so a step traced after ``hvd.init()`` sees the pinned
+    config); pass ``hvd.Compression.int8`` (or fp16/bf16/fp8) to pin a
+    codec explicitly."""
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
     n_acc = backward_passes_per_step

@@ -47,11 +47,38 @@ def hierarchical_allgather(x: jax.Array, dcn_axis: str = "dcn",
     return lax.all_gather(local, dcn_axis, axis=0, tiled=True)
 
 
+def hierarchical_quantized_allreduce(x: jax.Array, dcn_axis: str = "dcn",
+                                     ici_axis: str = "ici",
+                                     average: bool = True,
+                                     codec=None) -> jax.Array:
+    """The EQuARX design point: compress exactly the bandwidth-bound link.
+
+    Same factoring as :func:`hierarchical_allreduce`, but the cross-slice
+    ``psum`` — the slow DCN hop carrying 1/|ici| of the bytes — is
+    replaced by :func:`ops.spmd.quantized_allreduce` (int8/fp8 wire,
+    shared block scales). The ICI legs (reduce-scatter / all-gather) stay
+    FULL precision: ICI bandwidth is not the bottleneck the hierarchy
+    exists to protect, and keeping them exact halves the quantization
+    error relative to quantizing the whole reduction."""
+    from ..ops.spmd import quantized_allreduce
+
+    shard = lax.psum_scatter(x, ici_axis, scatter_dimension=0, tiled=True)
+    shard = quantized_allreduce(shard, dcn_axis, average=False, codec=codec)
+    out = lax.all_gather(shard, ici_axis, axis=0, tiled=True)
+    if average:
+        out = out / (lax.axis_size(ici_axis) * lax.axis_size(dcn_axis))
+    return out
+
+
 def hierarchical_grad_allreduce(grads, dcn_axis: str = "dcn",
                                 ici_axis: str = "ici",
-                                average: bool = True):
+                                average: bool = True,
+                                codec=None):
     """Apply hierarchical_allreduce leaf-wise to a gradient pytree, padding
-    each flattened leaf to a multiple of the ici axis size."""
+    each flattened leaf to a multiple of the ici axis size. A quantized
+    ``codec`` (``Compression.int8`` / ``.fp8``) routes the DCN hop through
+    :func:`hierarchical_quantized_allreduce`; float leaves only — integer
+    leaves keep the exact full-precision route on both hops."""
     import jax.numpy as jnp
 
     def reduce_leaf(g):
@@ -60,7 +87,13 @@ def hierarchical_grad_allreduce(grads, dcn_axis: str = "dcn",
         pad = (-flat.shape[0]) % ici
         if pad:
             flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-        reduced = hierarchical_allreduce(flat, dcn_axis, ici_axis, average)
+        if codec is not None and getattr(codec, "quantized", False) and \
+                jnp.issubdtype(flat.dtype, jnp.floating):
+            reduced = hierarchical_quantized_allreduce(
+                flat, dcn_axis, ici_axis, average, codec=codec)
+        else:
+            reduced = hierarchical_allreduce(flat, dcn_axis, ici_axis,
+                                             average)
         if pad:
             reduced = reduced[:-pad]
         return reduced.reshape(g.shape)

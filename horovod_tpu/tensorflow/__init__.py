@@ -169,14 +169,20 @@ def _allreduce_dense(tensor, average: bool, name: Optional[str],
 
     name = name or _auto_name("allreduce")
     compressed, ctx = compression.compress(tf.convert_to_tensor(tensor))
+    # Cast codecs already narrowed the tensor above; quantized codecs
+    # compress inside the engine's collective, so their tag must ride the
+    # submission (ops._submit reads codec_name off the object).
+    kw = {"compression": compression} \
+        if getattr(compression, "quantized", False) else {}
     if tf.executing_eagerly():
         out = _eager_roundtrip(
-            lambda a: _ops.allreduce_async(a, average=average, name=name),
+            lambda a: _ops.allreduce_async(a, average=average, name=name,
+                                           **kw),
             compressed)
     else:
         def _run(t):
             arr, narrow = _to_numpy(t)
-            h = _ops.allreduce_async(arr, average=average, name=name)
+            h = _ops.allreduce_async(arr, average=average, name=name, **kw)
             res = np.asarray(_ops.synchronize(h)).reshape(arr.shape)
             return _from_numpy(res, narrow)
 

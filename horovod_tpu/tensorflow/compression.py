@@ -12,6 +12,9 @@ from __future__ import annotations
 class NoneCompressor:
     """Default: no-op (``compression.py:20-33``)."""
 
+    codec_name = "none"
+    quantized = False
+
     @staticmethod
     def compress(tensor):
         return tensor, None
@@ -25,6 +28,8 @@ class FP16Compressor:
     """Cast float tensors to fp16 for the wire (``compression.py:36-64``)."""
 
     _wire_dtype = "float16"
+    codec_name = "fp16"
+    quantized = False
 
     @classmethod
     def compress(cls, tensor):
@@ -49,11 +54,33 @@ class BF16Compressor(FP16Compressor):
     (extension beyond the reference's fp16)."""
 
     _wire_dtype = "bfloat16"
+    codec_name = "bf16"
+
+
+class Int8Compressor(NoneCompressor):
+    """Block-quantized int8 wire (EQuARX): compression happens INSIDE the
+    engine's fused collective — shared per-block scales need a cross-rank
+    max exchange, impossible as a local pre-cast — so the TF-side hooks
+    are identity and this class is the negotiation tag the ops layer
+    forwards (``ops._submit`` reads ``codec_name``/``quantized``). The
+    reduced result comes back in the original float dtype."""
+
+    codec_name = "int8"
+    quantized = True
+
+
+class FP8Compressor(Int8Compressor):
+    """fp8-e4m3 wire variant of the quantized codec (backend-gated)."""
+
+    codec_name = "fp8"
 
 
 class Compression:
-    """Namespace matching the reference surface (``compression.py:67-74``)."""
+    """Namespace matching the reference surface (``compression.py:67-74``;
+    ``int8``/``fp8`` extend it with the EQuARX quantized wire)."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor
+    fp8 = FP8Compressor

@@ -28,9 +28,18 @@ log() { echo "[deadline $(date +%H:%M:%S)] $*" >> "$OUT/watch.log"; }
 # alive: the group kill below only covers the watcher THIS script spawns,
 # so strays from an earlier instance (e.g. a `pkill -f chip_watch5` that
 # killed the watcher bash but not its bench child) would survive the
-# deadline.  Patterns are anchored so they can't match this script or
-# unrelated processes whose argv merely mentions the file names.
-if pgrep -f 'chip_watch5\.sh' >/dev/null || pgrep -f '^python bench\.py' >/dev/null; then
+# deadline.  Match every process shape the watcher tree can leave
+# behind: the relative-path supervisor itself (`^python bench\.py`, how
+# chip_watch5 spawns it), the supervisor's measure child
+# (`<python> /abs/path/bench.py --_measure` — the anchored pattern never
+# matches an absolute interpreter or script path), and the python
+# invocations of lm_bench / onchip_path / the torch synthetic benchmark
+# — anchored on `python... <path>.py` so an editor or `tail -f` whose
+# argv merely mentions a file name cannot match.  The patterns contain
+# tokens absent from this script's own argv
+# (chip_watch_deadline.sh <epoch>), so the guard cannot match itself.
+orphan_pat='^python bench\.py|bench\.py --_measure|python[0-9.]* [^ ]*(lm_bench|onchip_path_bench|pytorch_synthetic_benchmark)\.py'
+if pgrep -f 'chip_watch5\.sh' >/dev/null || pgrep -f "$orphan_pat" >/dev/null; then
     echo "a chip_watch5/bench process is already running; kill it first" >&2
     exit 2
 fi

@@ -65,7 +65,7 @@ if _platform:
     del _jax, _live
 del _os, _platform
 
-from . import callbacks, checkpoint, parallel, runner
+from . import callbacks, checkpoint, elastic, parallel, runner
 from .basics import (
     cross_rank,
     cross_size,
@@ -80,7 +80,11 @@ from .basics import (
     shutdown,
     size,
 )
-from .core.status import HorovodInternalError, NotInitializedError
+from .core.status import (
+    HorovodInternalError,
+    NotInitializedError,
+    RanksAbortedError,
+)
 from .ops import (
     Compression,
     allgather,
@@ -125,9 +129,10 @@ __all__ = [
     "allreduce", "allreduce_async", "allgather", "allgather_async",
     "broadcast", "broadcast_async", "poll", "synchronize", "release",
     "Compression", "spmd", "parallel", "callbacks", "checkpoint",
+    "elastic",
     "IndexedSlices", "allreduce_sparse", "flash_attention",
     "DistributedOptimizer", "allreduce_gradients",
     "broadcast_parameters", "broadcast_optimizer_state",
     "broadcast_global_variables", "broadcast_object",
-    "HorovodInternalError", "NotInitializedError",
+    "HorovodInternalError", "NotInitializedError", "RanksAbortedError",
 ]

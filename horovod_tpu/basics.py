@@ -130,6 +130,15 @@ def init(ranks=None, comm=None) -> None:
             from .ops.engine import start_subset_service
 
             start_subset_service(list(ranks))
+        epoch = world_epoch()
+        if epoch > 0:
+            # An elastic relaunch: say so at default verbosity — operators
+            # reading a worker log must be able to tell attempt N from a
+            # fresh start (the rank numbering may have changed).
+            LOG.warning(
+                "horovod_tpu initialized on elastic world epoch %d "
+                "(relaunched world; ranks renumbered over surviving "
+                "slots)", epoch)
         LOG.debug(
             "horovod_tpu initialized: rank=%d size=%d local_rank=%d "
             "local_size=%d devices=%d/%d",
@@ -232,3 +241,15 @@ def mpi_threads_supported() -> bool:
     if not _global.initialized:
         raise NotInitializedError()
     return False
+
+
+def world_epoch() -> int:
+    """Elastic world epoch: 0 for a first launch, bumped by
+    ``runner.run_elastic`` on every relaunch (``HOROVOD_ELASTIC_EPOCH``).
+    Readable before ``init()`` — the launcher env defines it, not the
+    topology."""
+    import os
+
+    from .core import config as _config
+
+    return int(os.environ.get(_config.HOROVOD_ELASTIC_EPOCH, "0"))

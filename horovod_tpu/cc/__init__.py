@@ -170,6 +170,11 @@ class NativeNegotiator:
         self._lib.htpu_negotiator_set_fusion_threshold(
             self._handle, int(threshold_bytes))
 
+    def request_shutdown(self) -> None:
+        """Force shutdown on subsequent response lists (stall-escalation
+        path; same contract as ``Negotiator.request_shutdown``)."""
+        self._lib.htpu_negotiator_shutdown(self._handle)
+
     def add_request_list(self, rl) -> None:
         if rl.shutdown:
             self._lib.htpu_negotiator_shutdown(self._handle)

@@ -18,5 +18,11 @@ def parse_response_json(doc: dict) -> ResponseList:
             # the native wire predates quantized codecs; absent == none
             tensor_codec=str(item.get("codec", "none")),
         ))
+    stalls = list(doc.get("stall_warnings", []))
     return ResponseList(responses=responses,
-                        shutdown=bool(doc.get("shutdown", 0)))
+                        shutdown=bool(doc.get("shutdown", 0)),
+                        stall_warnings=stalls,
+                        # the native wire cannot distinguish "check ran,
+                        # nothing stalled" from "no check this cycle";
+                        # only a non-empty batch is authoritative
+                        stall_check=bool(stalls))

@@ -11,6 +11,7 @@ from .logging import LOG
 from .status import (
     HorovodInternalError,
     NotInitializedError,
+    RanksAbortedError,
     SHUT_DOWN_ERROR,
     Status,
     StatusType,
@@ -22,6 +23,7 @@ __all__ = [
     "LOG",
     "HorovodInternalError",
     "NotInitializedError",
+    "RanksAbortedError",
     "SHUT_DOWN_ERROR",
     "Status",
     "StatusType",

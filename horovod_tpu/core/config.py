@@ -28,6 +28,13 @@ HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
 # Extension: the reference hardcodes 60s (STALL_WARNING_TIME,
 # operations.cc:258); configurable here, same default.
 HOROVOD_STALL_WARNING_TIME = "HOROVOD_STALL_WARNING_TIME"
+# Fault-tolerance escalation (horovod_tpu.elastic): a stall that outlives
+# this many seconds is converted from a warning into a structured world
+# abort — every healthy rank raises RanksAbortedError naming the missing
+# ranks instead of blocking forever. 0 (default) keeps the reference's
+# warn-and-wait behavior; upstream Horovod later grew the same knob as
+# HOROVOD_STALL_SHUTDOWN_TIME_SECONDS.
+HOROVOD_STALL_SHUTDOWN_TIME = "HOROVOD_STALL_SHUTDOWN_TIME_S"
 HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
 HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
 # Default gradient-compression codec for DistributedOptimizer /
@@ -51,6 +58,11 @@ HOROVOD_CROSS_RANK = "HOROVOD_CROSS_RANK"
 HOROVOD_CROSS_SIZE = "HOROVOD_CROSS_SIZE"
 HOROVOD_CONTROLLER_ADDR = "HOROVOD_CONTROLLER_ADDR"
 HOROVOD_CONTROLLER_PORT = "HOROVOD_CONTROLLER_PORT"
+# Single-host launches: the launcher binds the controller listener itself
+# (port 0) and rank 0 inherits the LIVE socket via this fd — closing the
+# probe-then-rebind TOCTOU window where another process could steal the
+# advertised port between the launcher's probe and rank 0's bind.
+HOROVOD_CONTROLLER_FD = "HOROVOD_CONTROLLER_FD"
 HOROVOD_SECRET_KEY = "HOROVOD_SECRET_KEY"
 HOROVOD_START_TIMEOUT = "HOROVOD_START_TIMEOUT"
 # Force the JAX platform ("cpu", "tpu", ...) before any backend starts.
@@ -69,6 +81,24 @@ HOROVOD_LAUNCHER_PIN_DEVICES = "HOROVOD_LAUNCHER_PIN_DEVICES"
 #   "xla"  — force device collectives.
 #   "host" — force host (numpy-over-TCP) reduction; used by CPU launcher tests.
 HOROVOD_DATA_PLANE = "HOROVOD_DATA_PLANE"
+
+# --- elastic fault-tolerance plane (horovod_tpu.elastic; ours) ---------------
+# World epoch: 0 for the first launch, bumped by the elastic driver on every
+# relaunch so workers (and elastic.State) can tell a restart from a fresh
+# start.
+HOROVOD_ELASTIC_EPOCH = "HOROVOD_ELASTIC_EPOCH"
+# Address/port of the elastic driver's health-and-state service (heartbeats
+# from every rank; committed-state store for elastic.State). Exported by
+# runner.run_elastic; absent for non-elastic jobs.
+HOROVOD_ELASTIC_ADDR = "HOROVOD_ELASTIC_ADDR"
+HOROVOD_ELASTIC_PORT = "HOROVOD_ELASTIC_PORT"
+# Seconds between worker heartbeats to the elastic driver.
+HOROVOD_HEARTBEAT_INTERVAL = "HOROVOD_HEARTBEAT_INTERVAL"
+# Fault-injection hook for recovery tests: "rank:commit[:epoch]" kills that
+# rank with os._exit right before it persists its Nth commit (epoch
+# defaults to 0 so the fault does not re-fire after the relaunch). See
+# docs/elastic.md.
+HOROVOD_ELASTIC_FAULT = "HOROVOD_ELASTIC_FAULT"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:1838
 DEFAULT_CYCLE_TIME_MS = 5.0  # operations.cc:1846
@@ -115,6 +145,8 @@ class Config:
     jax_profile_dir: str = ""
     stall_check_disable: bool = False
     stall_warning_time_s: float = STALL_WARNING_TIME_S
+    stall_shutdown_time_s: float = 0.0  # 0 = warn forever, never abort
+    heartbeat_interval_s: float = 1.0
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
     compression: str = "none"
@@ -142,6 +174,9 @@ class Config:
             stall_check_disable=_env_bool(HOROVOD_STALL_CHECK_DISABLE),
             stall_warning_time_s=_env_float(HOROVOD_STALL_WARNING_TIME,
                                             STALL_WARNING_TIME_S),
+            stall_shutdown_time_s=_env_float(HOROVOD_STALL_SHUTDOWN_TIME,
+                                             0.0),
+            heartbeat_interval_s=_env_float(HOROVOD_HEARTBEAT_INTERVAL, 1.0),
             hierarchical_allreduce=_env_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
             hierarchical_allgather=_env_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
             compression=(os.environ.get(HOROVOD_COMPRESSION, "none")

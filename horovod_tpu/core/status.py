@@ -10,7 +10,9 @@ error-response construction can report identical failure classes.
 from __future__ import annotations
 
 import enum
+import re
 from dataclasses import dataclass, field
+from typing import List, Optional
 
 
 class StatusType(enum.IntEnum):
@@ -61,7 +63,11 @@ class Status:
     def raise_if_error(self) -> None:
         if self.type in (StatusType.OK, StatusType.IN_PROGRESS):
             return
-        raise HorovodInternalError(self.reason or self.type.name)
+        reason = self.reason or self.type.name
+        ranks = parse_aborted_ranks(reason)
+        if ranks is not None:
+            raise RanksAbortedError(ranks, reason)
+        raise HorovodInternalError(reason)
 
 
 # The message every outstanding callback receives when the background
@@ -104,6 +110,64 @@ class HorovodInternalError(RuntimeError):
     The reference surfaces these as framework-specific exceptions from the
     synchronize/wait path (e.g. ``torch/mpi_ops_v2.cc:228-234``).
     """
+
+
+class RanksAbortedError(HorovodInternalError):
+    """A collective was aborted because specific peer ranks are gone.
+
+    The structured form of the reference's blanket SHUT_DOWN_ERROR: when
+    the coordinator can attribute the failure — a rank's connection
+    dropped mid-job, or a stall outlived the
+    ``HOROVOD_STALL_SHUTDOWN_TIME_S`` deadline — the abort names the
+    missing ranks so an elastic driver (``horovod_tpu.elastic``) can
+    blacklist the right slots on relaunch. Subclasses
+    ``HorovodInternalError`` so existing handlers keep working.
+    """
+
+    def __init__(self, ranks: List[int], message: str) -> None:
+        super().__init__(message)
+        self.ranks = sorted(set(ranks))
+
+
+# Machine-parseable tag embedded in abort reasons so every layer the
+# message travels through (status flush, watch-channel push, engine-loop
+# rewrap) preserves attribution. format/parse are the single source of
+# truth for the wire text.
+_ABORTED_TAG_RE = re.compile(r"\[aborted ranks: ([0-9][0-9,\s]*)\]")
+# Fallbacks: abort reasons composed before this tag existed (the native
+# C++ service's disconnect message, the stall warning's rank list).
+_EXITED_RE = re.compile(r"rank (\d+) (?:exited mid-job|disconnected)")
+_MISSING_RE = re.compile(r"missing ranks: ([0-9][0-9,\s]*)")
+
+
+def format_aborted_ranks(ranks) -> str:
+    """Render the structured tag appended to abort reasons."""
+    return "[aborted ranks: " + ", ".join(
+        str(r) for r in sorted(set(ranks))) + "]"
+
+
+def parse_aborted_ranks(message: str,
+                        strict: bool = False) -> Optional[List[int]]:
+    """Extract the missing-rank list from an abort reason, if one is
+    attributable; None for unattributed shutdowns.
+
+    ``strict=True`` accepts only the explicit ``[aborted ranks: …]`` tag —
+    required when scanning LOG output (e.g. a dead rank's stderr tail),
+    where the fallback patterns would match the coordinator's routine
+    stall warnings. The default full parse is for exception messages,
+    which only ever contain genuine abort reasons."""
+    m = _ABORTED_TAG_RE.search(message)
+    if m is None and not strict:
+        m = _MISSING_RE.search(message)
+    if m is not None:
+        ranks = [int(tok) for tok in m.group(1).replace(",", " ").split()]
+        return sorted(set(ranks)) if ranks else None
+    if strict:
+        return None
+    m = _EXITED_RE.search(message)
+    if m is not None:
+        return [int(m.group(1))]
+    return None
 
 
 class NotInitializedError(ValueError):

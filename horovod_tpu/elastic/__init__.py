@@ -1,0 +1,53 @@
+"""Elastic fault tolerance: heartbeats, abort-instead-of-hang, relaunch.
+
+The subsystem the 0.16 reference lacked (its answer to a dead worker was
+an infinite hang behind a stall warning; upstream Horovod's next era was
+elastic mode). Four pieces, built entirely on this repo's existing
+primitives (docs/elastic.md):
+
+* **health plane** (:mod:`.health`): every rank heartbeats the elastic
+  driver over the HMAC-framed TCP wire; the driver declares ranks dead
+  when beats stop.
+* **abort-instead-of-hang** (``HOROVOD_STALL_SHUTDOWN_TIME_S``): the
+  coordinator escalates an expired stall deadline into a structured
+  shutdown, so healthy ranks raise :class:`RanksAbortedError` (naming the
+  missing ranks) out of ``allreduce``/``synchronize`` instead of blocking
+  forever (``ops/controller.py`` + the native wrapper).
+* **elastic driver** (:func:`run_elastic`): detect → abort → relaunch →
+  restore, with slot blacklisting, ``min_np``, restart budget, and
+  exponential backoff.
+* **state** (:class:`State`): commit/restore/sync over arbitrary pytrees
+  (params + optimizer state + step), persisted in the driver's store so a
+  relaunched world resumes from the last commit.
+
+``State`` imports lazily: the worker entry hooks the health plane without
+paying the jax import.
+"""
+
+from __future__ import annotations
+
+from ..core.status import RanksAbortedError
+from .driver import ElasticExhaustedError, WorkerDeadError, run_elastic
+from .health import ElasticService, HeartbeatReporter
+
+__all__ = [
+    "ElasticExhaustedError",
+    "ElasticService",
+    "HeartbeatReporter",
+    "RanksAbortedError",
+    "State",
+    "WorkerDeadError",
+    "run_elastic",
+    "world_epoch",
+]
+
+
+def __getattr__(name):
+    # State (and world_epoch) live with the jax-facing code; loading them
+    # lazily keeps `elastic.health` importable from the worker entry
+    # before the platform pin.
+    if name in ("State", "world_epoch"):
+        from . import state as _state
+
+        return getattr(_state, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

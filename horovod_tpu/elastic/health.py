@@ -1,0 +1,199 @@
+"""Health plane: worker heartbeats + the driver's health-and-state service.
+
+The reference has no liveness story beyond the coordinator's stall warning
+(``CheckForStalledTensors``): a wedged or dead rank hangs the world until an
+operator intervenes. This module is the driver-side half of the elastic
+subsystem's detect step: every rank heartbeats the elastic driver over the
+same HMAC-framed TCP wire the launcher and controller already use
+(``runner.network``), and the driver declares a rank dead when its beats
+stop — catching the one failure mode neither process-exit watching (the
+launcher's ``_wait_all``) nor the coordinator's stall escalation can see: a
+process that is alive but wedged before it ever reaches a collective.
+
+The same service doubles as the committed-state store for
+``elastic.State``: rank 0 pushes its last commit here (the driver process
+outlives every worker world), and the first sync of a relaunched world
+fetches it back. One port, one secret, one service.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core import config as _config
+from ..core.logging import LOG
+from ..runner.network import BasicClient, BasicService, default_secret
+
+
+class ElasticService:
+    """Driver-side heartbeat monitor + committed-state store.
+
+    Requests on the wire:
+      ("beat", epoch, rank)            -> ("ok",)
+      ("goodbye", epoch, rank)         -> ("ok",)   # clean exit: stop watching
+      ("commit", epoch, meta, payload) -> ("ok",)   # rank 0's state push
+      ("fetch",)                       -> ("commit", meta, payload | None)
+
+    Beats are tagged with the world epoch so a straggler from a torn-down
+    attempt cannot resurrect itself into the successor world's liveness
+    table. A rank is dead when its beats STOPPED: ranks that never beat at
+    all are the registration timeout's problem (they may still be
+    importing jax), not this monitor's.
+    """
+
+    def __init__(self, secret: bytes,
+                 heartbeat_interval_s: float = 1.0,
+                 miss_limit: int = 5) -> None:
+        self._interval_s = heartbeat_interval_s
+        self._miss_limit = miss_limit
+        self._lock = threading.Lock()
+        self._epoch = 0
+        self._last_beat: Dict[int, float] = {}
+        self._departed: set = set()
+        self._commit: Optional[bytes] = None
+        self._commit_meta: Optional[dict] = None
+        self._service = BasicService("horovod-elastic", self._handle,
+                                     secret=secret)
+        self.port = self._service.port
+
+    def _handle(self, req: Any, _sock) -> Any:
+        kind = req[0]
+        if kind == "beat":
+            _, epoch, rank = req
+            with self._lock:
+                if epoch == self._epoch:
+                    self._last_beat[rank] = time.monotonic()
+            return ("ok",)
+        if kind == "goodbye":
+            _, epoch, rank = req
+            with self._lock:
+                if epoch == self._epoch:
+                    self._departed.add(rank)
+                    self._last_beat.pop(rank, None)
+            return ("ok",)
+        if kind == "commit":
+            _, epoch, meta, payload = req
+            with self._lock:
+                # Epoch fence, like beats: a torn-down world's straggling
+                # commit must not overwrite the successor's newer state
+                # (the next relaunch would silently replay steps).
+                if epoch == self._epoch:
+                    self._commit = payload
+                    self._commit_meta = dict(meta, epoch=epoch)
+            return ("ok",)
+        if kind == "fetch":
+            with self._lock:
+                return ("commit", self._commit_meta, self._commit)
+        raise ValueError(f"unknown elastic request {kind!r}")
+
+    def begin_epoch(self, epoch: int) -> None:
+        """Reset the liveness table for a (re)launched world attempt."""
+        with self._lock:
+            self._epoch = epoch
+            self._last_beat = {}
+            self._departed = set()
+
+    def dead_ranks(self) -> List[int]:
+        """Ranks whose heartbeats stopped for > miss_limit intervals."""
+        deadline = self._interval_s * self._miss_limit
+        now = time.monotonic()
+        with self._lock:
+            return sorted(r for r, t in self._last_beat.items()
+                          if now - t > deadline and r not in self._departed)
+
+    @property
+    def last_commit_meta(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._commit_meta) if self._commit_meta else None
+
+    def shutdown(self) -> None:
+        self._service.shutdown()
+
+
+class HeartbeatReporter:
+    """Worker-side daemon: one beat per interval to the elastic driver.
+
+    Transport losses are retried quietly — a missing driver is not a
+    worker failure (the parent-death watchdog owns that direction); after
+    repeated reconnect failures the reporter just stops (the driver being
+    gone means the whole job is ending anyway)."""
+
+    def __init__(self, addr: Tuple[str, int], rank: int, epoch: int,
+                 secret: Optional[bytes] = None,
+                 interval_s: float = 1.0) -> None:
+        self._addr = addr
+        self._rank = rank
+        self._epoch = epoch
+        self._secret = secret
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="horovod-heartbeat", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        client = None
+        failures = 0
+        while not self._stop.wait(self._interval_s):
+            try:
+                if client is None:
+                    client = BasicClient(self._addr, secret=self._secret,
+                                         attempts=3, timeout_s=5.0)
+                client.request(("beat", self._epoch, self._rank))
+                failures = 0
+            except Exception:  # noqa: BLE001 - reconnect next tick
+                if client is not None:
+                    try:
+                        client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    client = None
+                failures += 1
+                if failures == 5:
+                    # NEVER give up while the process lives: a reporter
+                    # that stops beating reads as a DEATH to the driver,
+                    # and a transiently-busy driver (GIL-bound unpickling
+                    # a large commit) must not get a healthy world torn
+                    # down. If the driver is really gone, the parent
+                    # watchdog ends this process anyway.
+                    LOG.warning("elastic heartbeat channel flapping "
+                                "(%d consecutive failures); retrying "
+                                "until the driver answers", failures)
+        # Clean exit: tell the driver this rank LEFT, so the in-flight
+        # teardown is not misread as a death by the liveness monitor.
+        try:
+            if client is None:
+                client = BasicClient(self._addr, secret=self._secret,
+                                     attempts=1, timeout_s=2.0)
+            client.request(("goodbye", self._epoch, self._rank))
+        except Exception:  # noqa: BLE001 - driver may already be gone
+            pass
+        finally:
+            if client is not None:
+                try:
+                    client.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+def reporter_from_env() -> Optional[HeartbeatReporter]:
+    """Start a heartbeat reporter from the elastic driver's env block
+    (``HOROVOD_ELASTIC_ADDR``/``PORT``/``EPOCH``); None for non-elastic
+    jobs. Called by the worker entry (``runner._exec_fn``)."""
+    port = os.environ.get(_config.HOROVOD_ELASTIC_PORT)
+    if not port:
+        return None
+    addr = os.environ.get(_config.HOROVOD_ELASTIC_ADDR, "127.0.0.1")
+    rank = int(os.environ.get(_config.HOROVOD_RANK, "0"))
+    epoch = int(os.environ.get(_config.HOROVOD_ELASTIC_EPOCH, "0"))
+    interval = float(
+        os.environ.get(_config.HOROVOD_HEARTBEAT_INTERVAL, "") or 1.0)
+    return HeartbeatReporter((addr, int(port)), rank, epoch,
+                             secret=default_secret(), interval_s=interval)

@@ -1,0 +1,281 @@
+"""Elastic training state: commit / restore / sync over arbitrary pytrees.
+
+The restore half of the elastic loop. Upstream Horovod grew
+``hvd.elastic.State`` one subsystem era after the 0.16 reference; this is
+that shape rebuilt on this repo's own primitives: per-leaf broadcast rides
+``state_bcast.broadcast_parameters`` (fused, device-plane aware) and
+non-array leaves ride ``state_bcast.broadcast_object`` (pickle wire), so a
+relaunched world resumes bit-exact from the last committed step.
+
+A relaunch replaces every worker PROCESS, so in-memory copies alone cannot
+survive it: ``commit()`` also pushes rank 0's committed tree to the elastic
+driver's state store (``health.ElasticService`` — the driver process
+outlives every world attempt), and the first ``sync()`` of a relaunched
+world fetches it back before broadcasting. Worlds launched outside
+``run_elastic`` (no store in the env) degrade gracefully to in-process
+commit/restore — the upstream semantics for in-place recovery.
+
+Fault injection (``HOROVOD_ELASTIC_FAULT=rank:commit[:epoch]``): the named
+rank dies with ``os._exit`` right BEFORE persisting its Nth commit of that
+epoch — the hook the recovery tests (and chaos drills) use to kill a worker
+mid-training deterministically.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import basics, state_bcast
+from ..basics import world_epoch
+from ..core import config as _config
+from ..core.logging import LOG
+from ..runner.network import BasicClient, default_secret
+
+
+def parse_fault_spec(spec: str) -> Optional[Tuple[int, int, int]]:
+    """``rank:commit[:epoch]`` -> (rank, commit_no, epoch); None if unset
+    or malformed (a malformed spec must not take down production jobs)."""
+    if not spec:
+        return None
+    parts = spec.split(":")
+    if len(parts) not in (2, 3):
+        return None
+    try:
+        rank, commit_no = int(parts[0]), int(parts[1])
+        epoch = int(parts[2]) if len(parts) == 3 else 0
+    except ValueError:
+        return None
+    return rank, commit_no, epoch
+
+
+def _maybe_inject_fault(commit_no: int) -> None:
+    fault = parse_fault_spec(
+        os.environ.get(_config.HOROVOD_ELASTIC_FAULT, ""))
+    if fault is None:
+        return
+    rank, at_commit, at_epoch = fault
+    if (basics.rank() == rank and commit_no == at_commit
+            and world_epoch() == at_epoch):
+        LOG.warning("HOROVOD_ELASTIC_FAULT firing: rank %d dying before "
+                    "commit %d (epoch %d)", rank, at_commit, at_epoch)
+        os._exit(13)
+
+
+def _is_array(leaf: Any) -> bool:
+    return hasattr(leaf, "shape") and hasattr(leaf, "dtype") \
+        and not np.isscalar(leaf)
+
+
+def _host_copy(leaf: Any) -> Any:
+    """Private host-side snapshot of a leaf (D2H for jax arrays — the
+    committed copy must survive donation/deletion of the live buffers)."""
+    if _is_array(leaf):
+        return np.array(np.asarray(leaf), copy=True)
+    return leaf
+
+
+class State:
+    """Commit/restore wrapper over named pytrees (params, optimizer state,
+    step counters, ...).
+
+    ::
+
+        state = elastic.State(params=params, opt_state=opt_state, step=0)
+
+        def train(state):
+            while state.step < total_steps:
+                ... one step using state.params / state.opt_state ...
+                state.step += 1
+                state.commit()
+
+        state.run(train)
+
+    ``run`` syncs first — after a relaunch that pulls the last committed
+    state from the elastic driver and broadcasts rank 0's copy to every
+    rank — then calls the function. ``commit`` snapshots the current
+    values (and persists them to the driver from rank 0); ``restore``
+    rewinds to the last snapshot without any communication.
+    """
+
+    def __init__(self, **values: Any) -> None:
+        if not values:
+            raise ValueError("State needs at least one named value, e.g. "
+                             "State(params=..., step=0)")
+        for key in values:
+            if key.startswith("_") or hasattr(type(self), key):
+                raise ValueError(f"invalid state name {key!r}")
+        self._keys = sorted(values)
+        for key, value in values.items():
+            setattr(self, key, value)
+        self._commit_no = 0
+        self._sync_no = 0
+        self._synced = False
+        self._store: Optional[BasicClient] = None
+        self._committed = self._snapshot()
+
+    # -- snapshots ------------------------------------------------------------
+
+    def _tree(self) -> Dict[str, Any]:
+        return {key: getattr(self, key) for key in self._keys}
+
+    def _snapshot(self) -> Dict[str, Any]:
+        import jax
+
+        return jax.tree_util.tree_map(_host_copy, self._tree())
+
+    def commit(self) -> None:
+        """Snapshot the current values as the recovery point; rank 0 also
+        persists the snapshot to the elastic driver's store (when this
+        world was launched by ``run_elastic``). The fault-injection hook
+        fires before anything is saved, so an injected death always rolls
+        back to the PREVIOUS commit — a real mid-step crash."""
+        self._commit_no += 1
+        _maybe_inject_fault(self._commit_no)
+        self._committed = self._snapshot()
+        if basics.rank() == 0:
+            self._push_commit()
+
+    def restore(self) -> None:
+        """Rewind the live attributes to the last committed snapshot."""
+        import jax
+
+        for key in self._keys:
+            setattr(self, key, jax.tree_util.tree_map(
+                _host_copy, self._committed[key]))
+
+    # -- driver store ---------------------------------------------------------
+
+    def _store_client(self) -> Optional[BasicClient]:
+        port = os.environ.get(_config.HOROVOD_ELASTIC_PORT)
+        if not port:
+            return None
+        if self._store is None:
+            addr = os.environ.get(_config.HOROVOD_ELASTIC_ADDR, "127.0.0.1")
+            # generous timeout: one commit can carry the whole model
+            self._store = BasicClient((addr, int(port)),
+                                      secret=default_secret(),
+                                      attempts=3, timeout_s=60.0)
+        return self._store
+
+    def _drop_store_client(self) -> None:
+        """A failed request may leave a partial frame on the connection;
+        reconnect next time rather than poisoning every later commit."""
+        if self._store is not None:
+            try:
+                self._store.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._store = None
+
+    def _push_commit(self) -> None:
+        client = self._store_client()
+        if client is None:
+            return
+        meta = {"commit_no": self._commit_no}
+        try:
+            client.request(("commit", world_epoch(), meta,
+                            pickle.dumps(self._committed,
+                                         protocol=pickle.HIGHEST_PROTOCOL)))
+        except Exception as exc:  # noqa: BLE001 - commits are best-effort
+            self._drop_store_client()
+            LOG.warning("elastic commit push failed: %s (recovery will "
+                        "fall back to an older commit)", exc)
+
+    def _fetch_commit(self) -> Optional[Dict[str, Any]]:
+        client = self._store_client()
+        if client is None:
+            return None
+        try:
+            resp = client.request(("fetch",))
+        except Exception as exc:  # noqa: BLE001
+            self._drop_store_client()
+            LOG.warning("elastic commit fetch failed: %s (starting from "
+                        "the constructor state)", exc)
+            return None
+        _, meta, payload = resp
+        if payload is None:
+            return None
+        committed = pickle.loads(payload)
+        if sorted(committed) != self._keys:
+            LOG.warning("stored elastic commit has keys %s but this State "
+                        "has %s; ignoring the stored commit",
+                        sorted(committed), self._keys)
+            return None
+        LOG.info("elastic restore: adopting driver commit %s",
+                 (meta or {}).get("commit_no"))
+        return committed
+
+    # -- sync -----------------------------------------------------------------
+
+    def sync(self, root_rank: int = 0) -> None:
+        """Make every rank's state identical to root's.
+
+        On the FIRST sync of a process, rank ``root_rank`` first adopts
+        the elastic driver's stored commit (present only after a
+        relaunch), so the broadcast seeds the new world from the last
+        recovery point. Array leaves broadcast fused via
+        ``broadcast_parameters``; everything else rides one
+        ``broadcast_object``."""
+        import jax
+
+        if not self._synced and basics.rank() == root_rank:
+            stored = self._fetch_commit()
+            if stored is not None:
+                self._committed = stored
+                self.restore()
+        self._synced = True
+        self._sync_no += 1
+        leaves, treedef = jax.tree_util.tree_flatten(self._tree())
+        arr_mask = [_is_array(leaf) for leaf in leaves]
+        # Array leaves: placeholder-None the rest so the engine can fuse
+        # the real tensors (None leaves vanish from the flatten and
+        # reappear on unflatten).
+        arrays = [leaf if m else None for leaf, m in zip(leaves, arr_mask)]
+        arrays = state_bcast.broadcast_parameters(
+            arrays, root_rank,
+            name_prefix=f"elastic.sync.{world_epoch()}.{self._sync_no}")
+        others = [None if m else leaf for leaf, m in zip(leaves, arr_mask)]
+        others = state_bcast.broadcast_object(
+            others, root_rank,
+            name=f"elastic.sync.obj.{world_epoch()}.{self._sync_no}")
+        merged = [a if m else o
+                  for a, o, m in zip(arrays, others, arr_mask)]
+        # Preserve each rank's local leaf flavor: root may have adopted
+        # numpy snapshots from the store while this rank built jax arrays.
+        merged = [_match_flavor(new, old)
+                  for new, old in zip(merged, leaves)]
+        tree = jax.tree_util.tree_unflatten(treedef, merged)
+        for key in self._keys:
+            setattr(self, key, tree[key])
+        # The synced state is the recovery point (local snapshot only: a
+        # push here would overwrite the driver's commit with itself).
+        self._committed = self._snapshot()
+
+    def run(self, fn, *args: Any, **kwargs: Any) -> Any:
+        """``sync()`` then ``fn(self, *args, **kwargs)`` — user training
+        loops written this way resume from the last commit after an
+        elastic relaunch with no extra code."""
+        self.sync()
+        return fn(self, *args, **kwargs)
+
+
+def _match_flavor(new: Any, old: Any) -> Any:
+    """Return ``new`` converted to ``old``'s array flavor (jax vs numpy)
+    so a sync never silently changes the types user code steps with."""
+    if not _is_array(old) or not _is_array(new):
+        return new
+    if isinstance(old, np.ndarray):
+        return np.asarray(new)
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        if isinstance(old, jax.Array) and not isinstance(new, jax.Array):
+            return jnp.asarray(new)
+    except Exception:  # noqa: BLE001 - no jax: numpy passthrough
+        pass
+    return new

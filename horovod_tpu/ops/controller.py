@@ -19,6 +19,7 @@ Rebuild of the rank-0 "coordinator" half of ``horovod/common/operations.cc``:
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from dataclasses import dataclass, field
@@ -31,6 +32,7 @@ from ..core.status import (
     CONTROLLER_RESTARTING,
     SHUT_DOWN_ERROR,
     WORLD_MISMATCH,
+    format_aborted_ranks,
 )
 from ..runner.network import (
     BasicClient,
@@ -148,14 +150,23 @@ class Negotiator:
                 resp.tensor_codec = getattr(first, "codec", "none")
                 resp.payload_bytes = _nbytes(first)
                 responses.append(resp)
-            self._maybe_check_stalls()
+            warnings = self._maybe_check_stalls()
             out = ResponseList(responses=self._fuse(responses),
-                               shutdown=self._shutdown)
+                               shutdown=self._shutdown,
+                               stall_warnings=warnings or [],
+                               stall_check=warnings is not None)
             return out
 
     @property
     def shutdown_requested(self) -> bool:
         return self._shutdown
+
+    def request_shutdown(self) -> None:
+        """Force shutdown=True on every subsequent response list (the
+        stall-escalation path; a negotiated shutdown arrives via
+        ``RequestList.shutdown`` instead)."""
+        with self._lock:
+            self._shutdown = True
 
     # -- response construction -----------------------------------------------
 
@@ -287,31 +298,40 @@ class Negotiator:
 
     # -- stall detection ------------------------------------------------------
 
-    def _maybe_check_stalls(self) -> None:
+    def _maybe_check_stalls(self) -> Optional[List[str]]:
         """WARN about tensors some ranks submitted >stall_warning_s ago
         that other ranks never did (``CheckForStalledTensors``,
-        ``operations.cc:1625-1672``)."""
+        ``operations.cc:1625-1672``). Returns the warning strings so the
+        controller can ship them to every rank on the response list —
+        the input the stall-shutdown escalation watches. ``None`` means
+        the interval-gated check did NOT run this cycle; an empty list
+        means it ran and found nothing stalled (authoritative recovery
+        signal for the escalation tracker)."""
         if self._stall_check_disable:
-            return
+            return None
         now = time.monotonic()
         if now - self._last_stall_check < self._stall_warning_s:
-            return
+            return None
         self._last_stall_check = now
+        warnings: List[str] = []
         for name, entry in self._table.items():
             if now - entry.first_seen <= self._stall_warning_s:
                 continue
             missing = sorted(set(range(self._size)) - set(entry.requests))
             ready = sorted(entry.requests)
-            LOG.warning(
+            warning = (
                 "One or more tensors were submitted to be reduced, gathered "
                 "or broadcasted by subset of ranks and are waiting for "
-                "remainder of ranks for more than %d seconds. This may "
-                "indicate that different ranks are trying to submit "
-                "different tensors or that only subset of ranks is "
-                "submitting tensors, which will cause deadlock. Stalled ops: "
-                "%s [missing ranks: %s] [ready ranks: %s]",
-                int(self._stall_warning_s), name,
-                ", ".join(map(str, missing)), ", ".join(map(str, ready)))
+                "remainder of ranks for more than "
+                f"{int(self._stall_warning_s)} seconds. This may indicate "
+                "that different ranks are trying to submit different tensors "
+                "or that only subset of ranks is submitting tensors, which "
+                "will cause deadlock. Stalled ops: "
+                f"{name} [missing ranks: {', '.join(map(str, missing))}] "
+                f"[ready ranks: {', '.join(map(str, ready))}]")
+            LOG.warning("%s", warning)
+            warnings.append(warning)
+        return warnings
 
 
 def numpy_dtype(dt: DataType):
@@ -406,6 +426,91 @@ def world_mismatch_error(service_id: str, caller_id: str) -> str:
             f"retry against this port's successor service")
 
 
+class StallEscalation:
+    """Escalate persistent stalls into a structured world abort.
+
+    The reference answers a permanently-missing rank with an infinite
+    hang behind a periodic warning (``CheckForStalledTensors``). With
+    ``HOROVOD_STALL_SHUTDOWN_TIME_S`` set, this tracker watches the
+    warning stream: once a stalled op has kept warning for ``deadline_s``
+    beyond its FIRST warning (i.e. ~``stall_warning + deadline`` after
+    the stall began), it produces the abort — ERROR responses for the
+    stalled tensors plus a shutdown reason naming the missing ranks, so
+    healthy ranks raise :class:`core.status.RanksAbortedError` instead of
+    blocking forever.
+
+    One implementation serves every controller configuration: the Python
+    ``ControllerService`` applies it coordinator-side over either
+    negotiation core's warnings; the native C++ service's clients apply
+    it client-side over the warnings the binary wire already carries
+    (identical on every rank, so every client reaches the same verdict).
+    """
+
+    _WARNING_RE = re.compile(
+        r"Stalled ops: (.*?) \[missing ranks: ([0-9, ]*)\]")
+
+    def __init__(self, deadline_s: float,
+                 warning_interval_s: float = 60.0) -> None:
+        self._deadline_s = deadline_s
+        # A still-stalled op re-warns every warning interval; an entry
+        # whose warnings stopped for well over that recovered, and its
+        # clock must not leak into the name's NEXT stall episode (fixed
+        # user names like "grad" recur every step). The window tracks
+        # the warning CADENCE only — mixing the (possibly much longer)
+        # deadline in would keep resolved episodes alive long enough to
+        # abort the next one prematurely.
+        self._stale_after_s = 2.5 * max(warning_interval_s, 0.1)
+        self._warned: Dict[str, Tuple[float, float]] = {}  # first, last
+
+    def check(self, warnings: List[str], check_ran: bool = False
+              ) -> Optional[Tuple[List[str], List[int], str]]:
+        """Feed one cycle's warning batch (possibly empty); returns
+        ``(stalled_names, missing_ranks, reason)`` when the deadline
+        expired, else None. ``check_ran=True`` marks an empty batch as an
+        authoritative all-clear (the coordinator's interval-gated check
+        ran and found nothing) — resolved episodes retire immediately
+        instead of waiting out the cadence window."""
+        if self._deadline_s <= 0:
+            return None
+        now = time.monotonic()
+        for name in list(self._warned):
+            if now - self._warned[name][1] > self._stale_after_s:
+                del self._warned[name]
+        if not warnings:
+            if check_ran:
+                self._warned.clear()
+            return None
+        expired: List[str] = []
+        missing: set = set()
+        seen_now: set = set()
+        for warning in warnings:
+            m = self._WARNING_RE.search(warning)
+            if m is None:
+                continue
+            name, ranks_s = m.group(1), m.group(2)
+            seen_now.add(name)
+            first, _last = self._warned.get(name, (now, now))
+            self._warned[name] = (first, now)
+            if now - first >= self._deadline_s:
+                expired.append(name)
+                missing.update(int(tok) for tok in
+                               ranks_s.replace(",", " ").split())
+        # A non-empty batch is a complete snapshot of the still-stalled
+        # table: entries that completed since the last check stop warning
+        # and must stop aging toward the deadline.
+        for name in list(self._warned):
+            if name not in seen_now:
+                del self._warned[name]
+        if not expired:
+            return None
+        reason = (
+            f"collective(s) {', '.join(sorted(expired))} stalled past the "
+            f"{self._deadline_s:.0f}s HOROVOD_STALL_SHUTDOWN_TIME_S "
+            f"deadline; aborting the world instead of hanging. "
+            f"{SHUT_DOWN_ERROR} {format_aborted_ranks(missing)}")
+        return sorted(expired), sorted(missing), reason
+
+
 class ControllerService:
     """Rank-0 TCP controller: cycle negotiation + host-mode payload exchange.
 
@@ -420,9 +525,14 @@ class ControllerService:
     def __init__(self, size: int, negotiator: Negotiator,
                  secret: Optional[bytes] = None, port: int = 0,
                  bind_host: str = "127.0.0.1",
-                 autotuner=None, world_id: str = "") -> None:
+                 autotuner=None, world_id: str = "",
+                 stall_shutdown_s: float = 0.0,
+                 stall_warning_s: float = 60.0,
+                 listen_fd: Optional[int] = None) -> None:
         self._negotiator = negotiator
         self._world_id = world_id
+        self._stall_escalation = StallEscalation(
+            stall_shutdown_s, warning_interval_s=stall_warning_s)
         self._cycles = _Rendezvous(size)
         self._payloads = _Rendezvous(size)
         self._cycle_no = 0
@@ -448,7 +558,8 @@ class ControllerService:
         self._watch_reason: Optional[str] = None
         self._service = BasicService(
             "horovod-controller", self._handle, secret=secret, port=port,
-            bind_host=bind_host, on_disconnect=self._on_disconnect)
+            bind_host=bind_host, on_disconnect=self._on_disconnect,
+            listen_fd=listen_fd)
         self.port = self._service.port
 
     def _deregister(self, sock: Any) -> Optional[int]:
@@ -473,7 +584,11 @@ class ControllerService:
             # Cascade: survivors tear down after the first abort; their
             # disconnects are a consequence, not the cause.
             LOG.debug("rank %d disconnected during abort teardown", rank)
-        exc = RuntimeError(f"rank {rank} exited mid-job. {SHUT_DOWN_ERROR}")
+        # The explicit tag makes the attribution machine-parseable even
+        # from a survivor's stderr tail (strict parsing ignores the
+        # bare "rank N exited" phrasing there — log text is noisy).
+        exc = RuntimeError(f"rank {rank} exited mid-job. {SHUT_DOWN_ERROR} "
+                           f"{format_aborted_ranks([rank])}")
         self._cycles.abort(exc)  # first abort wins inside the rendezvous
         self._payloads.abort(exc)
         with self._lock:
@@ -603,6 +718,29 @@ class ControllerService:
         for rank in sorted(slot):
             self._negotiator.add_request_list(slot[rank])
         response_list = self._negotiator.construct_response_list()
+        escalation = self._stall_escalation.check(
+            response_list.stall_warnings,
+            check_ran=getattr(response_list, "stall_check", False))
+        if escalation is not None:
+            # Abort-instead-of-hang: stalled tensors become ERROR responses
+            # (their submitters' handles fail with the structured reason),
+            # and the shutdown+abort_reason pair tells EVERY engine —
+            # including the ranks that never submitted them — to fail its
+            # outstanding work naming the missing ranks.
+            names, _missing, reason = escalation
+            LOG.error("%s", reason)
+            response_list.responses = list(response_list.responses) + [
+                Response(ResponseType.ERROR, tensor_names=[name],
+                         error_message=reason) for name in names]
+            response_list.shutdown = True
+            response_list.abort_reason = reason
+            self._negotiator.request_shutdown()
+            with self._lock:
+                if self._watch_reason is None:
+                    self._watch_reason = reason
+            # Unpark watch channels too: a rank blocked inside a compiled
+            # device collective cannot read this cycle response.
+            self._watch_event.set()
         if response_list.shutdown:
             # Clean coordinated shutdown: connection drops after this cycle
             # are expected teardown, not rank deaths.

@@ -91,6 +91,29 @@ def _jax_multiprocess() -> bool:
         return False
 
 
+def _adopt_controller_fd(use_native: bool) -> Optional[int]:
+    """Claim the launcher-inherited controller listener, if any.
+
+    The launcher binds the controller socket itself and rank 0 inherits
+    it (launcher._free_port TOCTOU fix) — consume the env marker so a
+    re-init on the same process (``shutdown(); init()``) binds the port
+    normally instead of adopting an fd the first service already closed.
+    The native (C++) service binds its own socket, so there the inherited
+    fd is closed to free the port for it — the backlogged early
+    connections reset and the clients' connect retries re-dial."""
+    fd_env = os.environ.pop(_config.HOROVOD_CONTROLLER_FD, None)
+    if not fd_env:
+        return None
+    fd = int(fd_env)
+    if use_native:
+        try:
+            os.close(fd)
+        except OSError:
+            pass
+        return None
+    return fd
+
+
 # Handle ids are unique across engine generations (an engine can be torn
 # down by shutdown and a fresh one started by re-init); ids must never
 # collide in the API layer's handle→context map.
@@ -331,6 +354,7 @@ class Engine:
                 # (world rank 0), not the subset rank numbering.
                 bind_host = os.environ.get(
                     "HOROVOD_CONTROLLER_BIND", "127.0.0.1")
+                listen_fd = _adopt_controller_fd(use_native)
                 if use_native:
                     self._service = NativeControllerService(
                         self._size, cfg, secret=secret, port=port,
@@ -341,7 +365,10 @@ class Engine:
                     self._service = ControllerService(
                         self._size, negotiator, secret=secret, port=port,
                         bind_host=bind_host, autotuner=self._autotuner,
-                        world_id=world_id)
+                        world_id=world_id,
+                        stall_shutdown_s=cfg.stall_shutdown_time_s,
+                        stall_warning_s=cfg.stall_warning_time_s,
+                        listen_fd=listen_fd)
                 port = self._service.port
             # The launcher may advertise several controller addresses
             # (comma-separated: every NIC of the controller host); the
@@ -356,7 +383,10 @@ class Engine:
             self._client = client_cls(
                 {a: (a, port) for a in addr_list}, secret=secret,
                 timeout_s=None, rank=self._rank, world_id=world_id,
-                **({"log_stalls": self._rank == 0} if use_native else {}))
+                **({"log_stalls": self._rank == 0,
+                    "stall_shutdown_s": cfg.stall_shutdown_time_s,
+                    "stall_warning_s": cfg.stall_warning_time_s}
+                   if use_native else {}))
 
         self._host_fallback_warned = set()
 
@@ -369,6 +399,7 @@ class Engine:
         self._device_worker: Optional[_DevicePlaneWorker] = None
         self._finalizer_q = None
         self._crashed = False
+        self._shutdown_reason: Optional[str] = None
         if self._plane is not None and self._client is not None:
             import queue
 
@@ -586,6 +617,11 @@ class Engine:
                 elif response_list.tuned_cycle_ms is not None:
                     cycle_s = max(response_list.tuned_cycle_ms, 0.1) / 1000.0
                 if response_list.shutdown:
+                    if response_list.abort_reason:
+                        # Escalated shutdown (stall deadline): flush with
+                        # the structured reason so waiters raise
+                        # RanksAbortedError naming the missing ranks.
+                        self._shutdown_reason = response_list.abort_reason
                     break
         except Exception as exc:  # noqa: BLE001 - propagate to handles
             LOG.error("background loop failed: %s", exc)
@@ -602,7 +638,8 @@ class Engine:
             self._flush_outstanding(Status.unknown_error(reason))
         finally:
             self._stop_requested = True
-            self._flush_outstanding(Status.unknown_error(SHUT_DOWN_ERROR))
+            self._flush_outstanding(Status.unknown_error(
+                self._shutdown_reason or SHUT_DOWN_ERROR))
             crashed = getattr(self, "_crashed", False)
             if not crashed and self._finalizer_q is not None:
                 # Clean shutdown: drain still-completing device batches
@@ -672,7 +709,21 @@ class Engine:
         """PerformOperation (``operations.cc:768-1621``) for one response,
         possibly a fused allreduce batch."""
         with self._lock:
-            entries = [self._pending.pop(n) for n in resp.tensor_names]
+            if resp.response_type == ResponseType.ERROR:
+                # An escalated stall ERROR targets a tensor only SOME
+                # ranks submitted (that is what a stall is); ranks
+                # without a pending entry for it have nothing to mark.
+                entries = [e for e in (self._pending.pop(n, None)
+                                       for n in resp.tensor_names)
+                           if e is not None]
+            else:
+                # Data responses keep the strict invariant: a batch
+                # naming a tensor this rank never submitted is a
+                # coordinator bug and must fail loudly here, not as a
+                # short-handed payload rendezvous later.
+                entries = [self._pending.pop(n) for n in resp.tensor_names]
+        if not entries:
+            return
         tl = self.timeline
         for entry in entries:
             tl.negotiate_end(entry.name)
@@ -894,7 +945,9 @@ def start_subset_service(subset_ranks) -> None:
     port = int(os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "0"))
     bind_host = os.environ.get("HOROVOD_CONTROLLER_BIND", "127.0.0.1")
     autotuner = Autotuner(cfg) if cfg.autotune else None
-    if native_controller_enabled(cfg):  # same decision the members make
+    use_native = native_controller_enabled(cfg)
+    listen_fd = _adopt_controller_fd(use_native)
+    if use_native:  # same decision the members make
         service = NativeControllerService(
             subset_size, cfg, secret=default_secret(), port=port,
             bind_host=bind_host, autotuner=autotuner, world_id=world_id)
@@ -902,7 +955,10 @@ def start_subset_service(subset_ranks) -> None:
         service = ControllerService(
             subset_size, make_negotiator(subset_size, cfg),
             secret=default_secret(), port=port, bind_host=bind_host,
-            autotuner=autotuner, world_id=world_id)
+            autotuner=autotuner, world_id=world_id,
+            stall_shutdown_s=cfg.stall_shutdown_time_s,
+            stall_warning_s=cfg.stall_warning_time_s,
+            listen_fd=listen_fd)
 
     def _teardown() -> None:
         # Grace period: the host's own shutdown (often atexit) must not

@@ -128,8 +128,26 @@ class ResponseList:
     rank — the property that makes SPMD data-plane execution legal
     (``message.h:186-214``). ``tuned_cycle_ms`` piggybacks autotuner
     decisions to every rank, the role the coordinator's Params broadcast
-    plays in the reference (``parameter_manager.cc:213`` SyncParams)."""
+    plays in the reference (``parameter_manager.cc:213`` SyncParams).
+
+    ``stall_warnings`` carries the coordinator's CheckForStalledTensors
+    output to every rank (the native wire already shipped these strings;
+    the Python wire now does too) — the input the stall-shutdown
+    escalation tracks. ``abort_reason`` is set alongside ``shutdown=True``
+    when the shutdown is an ABORT rather than a negotiated drain: engines
+    fail outstanding handles with this structured reason (which names the
+    missing ranks, see ``core.status.RanksAbortedError``) instead of the
+    generic SHUT_DOWN_ERROR."""
 
     responses: List[Response] = field(default_factory=list)
     shutdown: bool = False
     tuned_cycle_ms: Optional[float] = None
+    stall_warnings: List[str] = field(default_factory=list)
+    # True when the coordinator actually RAN its stall check this cycle
+    # (the check is interval-gated): an empty warning list is then an
+    # authoritative "nothing is stalled", letting the escalation tracker
+    # retire resolved episodes exactly. The native wire cannot express
+    # this (empty is ambiguous there), so it stays False and the tracker
+    # falls back to warning-cadence pruning.
+    stall_check: bool = False
+    abort_reason: Optional[str] = None

@@ -136,12 +136,15 @@ def decode_cycle_response(body: bytes,
             error_message=err, tensor_sizes=sizes,
             tensor_dtype=DataType(dtype), payload_bytes=payload_bytes))
     nstalls = r.unpack("<I")
+    stalls = []
     for _ in range(nstalls):
         warning = r.take(r.unpack("<I")).decode("utf-8", "replace")
+        stalls.append(warning)
         if log_stalls:
             LOG.warning("%s", warning)
     return ResponseList(responses=responses, shutdown=shutdown,
-                        tuned_cycle_ms=tuned_ms if has_tuned else None)
+                        tuned_cycle_ms=tuned_ms if has_tuned else None,
+                        stall_warnings=stalls)
 
 
 def decode_payload_response(body: bytes) -> bytes:
@@ -163,8 +166,11 @@ class NativeControllerClient:
                  timeout_s: Optional[float] = None,
                  connect_attempts: int = 100,
                  rank: Optional[int] = None,
-                 log_stalls: bool = False, world_id: str = "") -> None:
+                 log_stalls: bool = False, world_id: str = "",
+                 stall_shutdown_s: float = 0.0,
+                 stall_warning_s: float = 60.0) -> None:
         from ..runner.network import BasicClient
+        from .controller import StallEscalation
 
         self._addr = addr
         self._secret = secret
@@ -173,6 +179,12 @@ class NativeControllerClient:
         self._log_stalls = log_stalls
         self._cycle_no = 0
         self._last_cycle = 0
+        # The C++ service's cycle wire carries the coordinator's stall
+        # warnings to every rank; escalation runs CLIENT-side (the server
+        # predates the knob) — identical warning stream on every rank, so
+        # every client reaches the same abort verdict.
+        self._escalation = StallEscalation(
+            stall_shutdown_s, warning_interval_s=stall_warning_s)
         if rank is None:
             self._client = BasicClient(addr, secret=secret,
                                        attempts=connect_attempts,
@@ -193,6 +205,16 @@ class NativeControllerClient:
         out = decode_cycle_response(
             self._client.request_raw(encode_cycle(rank, request_list)),
             log_stalls=self._log_stalls)
+        escalation = self._escalation.check(out.stall_warnings)
+        if escalation is not None:
+            # Abort-instead-of-hang (HOROVOD_STALL_SHUTDOWN_TIME_S): fail
+            # this engine's loop with the structured reason; the engine
+            # flushes every outstanding handle with it (raising
+            # RanksAbortedError from wait/synchronize) and its
+            # non-detached close tells the C++ coordinator to abort the
+            # remaining world.
+            _names, _missing, reason = escalation
+            raise RuntimeError(reason)
         self._last_cycle = self._cycle_no
         self._cycle_no += 1
         return out

@@ -2,11 +2,25 @@
 
 * ``horovodrun`` CLI: ``python -m horovod_tpu.runner -np N <cmd>``
 * ``run(fn, np=N)``: ship a function to N ranks, collect per-rank results
-* ``network``: HMAC-authenticated TCP wire shared by the launcher and the
-  eager collective controller
+* ``run_elastic(fn, np=N, min_np=M)``: the fault-tolerant variant —
+  heartbeat monitoring, relaunch-on-death, slot blacklisting
+  (``horovod_tpu.elastic``, docs/elastic.md)
+* ``network``: HMAC-authenticated TCP wire shared by the launcher, the
+  eager collective controller, and the elastic health plane
 """
 
 from .launcher import LaunchError, launch, main
-from .run_api import run
+from .run_api import WorkerFailedError, WorkerLostError, run
 
-__all__ = ["LaunchError", "launch", "main", "run"]
+__all__ = ["LaunchError", "WorkerFailedError", "WorkerLostError",
+           "launch", "main", "run", "run_elastic"]
+
+
+def __getattr__(name):
+    # Lazy: elastic.driver builds ON this package (run_api), so a
+    # module-level import here would be circular.
+    if name == "run_elastic":
+        from ..elastic.driver import run_elastic
+
+        return run_elastic
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -39,6 +39,11 @@ def main() -> int:
     _parent_death_watchdog()
     rank = int(os.environ["HOROVOD_RANK"])
     port = int(os.environ[_DRIVER_PORT_ENV])
+    # Elastic jobs: heartbeat the driver's health plane for the whole
+    # lifetime of this worker (no-op when HOROVOD_ELASTIC_PORT is absent).
+    from ..elastic.health import reporter_from_env
+
+    reporter = reporter_from_env()
     client = BasicClient(("127.0.0.1", port), secret=default_secret())
     client.request(("register", rank))
     _, payload = client.request(("fn",))
@@ -52,6 +57,10 @@ def main() -> int:
                         pickle.dumps(traceback.format_exc())))
         return 1
     finally:
+        if reporter is not None:
+            # goodbye beat: a clean exit must not read as a death while
+            # the driver is still collecting the other ranks' results
+            reporter.stop()
         client.close()
 
 

@@ -24,16 +24,38 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 from ..core import config as _config
 from .network import local_addresses, make_secret
 
 
 def _free_port(bind_addr: str = "127.0.0.1") -> int:
+    """Probe a free port by bind-and-release. Inherently TOCTOU-racy —
+    the port can be lost to another process before its real user binds
+    it — so this survives only where the bind happens on ANOTHER host
+    (``launch_hosts`` with a remote hosts[0], where a collision surfaces
+    as rank 0's prompt "Address already in use" LaunchError). Single-host
+    launches use ``_bind_controller_listener`` instead: the launcher
+    binds the live socket itself and rank 0 inherits it."""
     with socket.socket() as s:
         s.bind((bind_addr, 0))
         return s.getsockname()[1]
+
+
+def _bind_controller_listener(bind_addr: str = "127.0.0.1"
+                              ) -> socket.socket:
+    """Bind AND LISTEN the controller socket in the launcher (port 0 — the
+    kernel picks a genuinely free port) so the advertised port can never
+    be lost before rank 0 starts serving: rank 0 inherits this exact
+    socket (``HOROVOD_CONTROLLER_FD``), and peers that dial early wait in
+    its kernel backlog instead of bouncing off a connection refused."""
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((bind_addr, 0))
+    # match BasicService's backlog: every rank connects at t0
+    s.listen(128)
+    return s
 
 
 def build_rank_env(rank: int, size: int, port: int, secret: str,
@@ -238,12 +260,21 @@ def launch_hosts(command: Sequence[str], hosts: List[tuple],
 
 
 class LaunchError(RuntimeError):
-    def __init__(self, rank: int, returncode: int) -> None:
-        super().__init__(
-            f"rank {rank} exited with code {returncode}; terminated "
-            f"remaining ranks.")
+    """A rank died: names the rank, its exit code, and (when the launcher
+    captured it) the tail of that rank's stderr — so a worker crash reads
+    as its own traceback, not an opaque result-wait timeout."""
+
+    def __init__(self, rank: int, returncode: int,
+                 stderr_tail: str = "") -> None:
+        msg = (f"rank {rank} exited with code {returncode}; terminated "
+               f"remaining ranks.")
+        if stderr_tail:
+            msg += (f"\n--- last stderr of rank {rank} ---\n"
+                    f"{stderr_tail.rstrip()}")
+        super().__init__(msg)
         self.rank = rank
         self.returncode = returncode
+        self.stderr_tail = stderr_tail
 
 
 class LaunchCancelled(RuntimeError):
@@ -254,37 +285,112 @@ def launch(command: Sequence[str], np: int,
            env_extra: Optional[Dict[str, str]] = None,
            host_data_plane: bool = False,
            job_timeout_s: Optional[float] = None,
-           cancel_event: Optional["threading.Event"] = None) -> int:
+           cancel_event: Optional["threading.Event"] = None,
+           capture_stderr: bool = False,
+           exit_codes: Optional[Dict[int, int]] = None) -> int:
     """Run ``command`` as ``np`` ranks; return 0 or raise LaunchError.
 
     ``job_timeout_s`` bounds the WHOLE job (leave None for training runs);
     ``cancel_event`` lets an owner (e.g. ``run()``'s driver) tear the world
-    down early. Failure semantics follow the reference launcher stack: when
-    any rank dies, the rest are terminated (mpirun behavior; also the Spark
+    down early. ``capture_stderr`` redirects each rank's stderr to a temp
+    file so a failure's LaunchError can carry the dead rank's last output
+    (``runner.run`` enables this; the CLI keeps the passthrough).
+    ``exit_codes``, if given, is filled with every observed rank exit code
+    (the owner can tell a silent exit-0 from a still-running rank).
+    Failure semantics follow the reference launcher stack: when any rank
+    dies, the rest are terminated (mpirun behavior; also the Spark
     driver's job-group cancel, ``spark/__init__.py:181-188``), and children
     die with the launcher via process-group kill
     (``spark/util/safe_shell_exec.py``)."""
+    import tempfile
+
     if np < 1:
         raise ValueError("np must be >= 1")
-    port = _free_port()
+    # TOCTOU fix: bind + listen the controller socket HERE and hand the
+    # live socket to rank 0 (HOROVOD_CONTROLLER_FD) — the port cannot be
+    # lost to another process between probe and bind, and early worker
+    # connects park in the backlog instead of bouncing.
+    listener = _bind_controller_listener()
+    port = listener.getsockname()[1]
     secret = make_secret()
     procs: List[subprocess.Popen] = []
+    stderr_files: Dict[int, Any] = {}
     try:
         for rank in range(np):
             env = build_rank_env(rank, np, port, secret,
                                  host_data_plane=host_data_plane,
                                  env_extra=env_extra)
+            popen_kwargs: Dict[str, Any] = {}
+            if rank == 0:
+                env[_config.HOROVOD_CONTROLLER_FD] = str(listener.fileno())
+                popen_kwargs["pass_fds"] = (listener.fileno(),)
+            if capture_stderr:
+                stderr_files[rank] = tempfile.TemporaryFile()
+                popen_kwargs["stderr"] = stderr_files[rank]
             procs.append(subprocess.Popen(
                 list(command), env=env,
-                start_new_session=True))  # own process group for clean kill
-        return _wait_all(procs, job_timeout_s, cancel_event)
+                start_new_session=True,  # own process group for clean kill
+                **popen_kwargs))
+        # rank 0 inherited the listening socket; drop the launcher's copy
+        # so service shutdown in the worker actually releases the port
+        listener.close()
+        return _wait_all(procs, job_timeout_s, cancel_event,
+                         stderr_files=stderr_files, exit_codes=exit_codes)
     finally:
+        try:
+            listener.close()
+        except OSError:
+            pass
         _terminate_all(procs)
+        _replay_stderr(stderr_files)
+        for fh in stderr_files.values():
+            try:
+                fh.close()
+            except OSError:
+                pass
+
+
+def _replay_stderr(stderr_files: Dict[int, Any],
+                   max_bytes: int = 1 << 16) -> None:
+    """Dump each rank's captured stderr to this process's stderr once the
+    world is down. Capture exists so failures can carry the dead rank's
+    output; replaying at teardown means callers lose only LIVENESS, not
+    content (worker logs, warnings, user prints). Bounded per rank so a
+    log-spamming job cannot flood the launcher."""
+    for rank in sorted(stderr_files):
+        fh = stderr_files[rank]
+        try:
+            fh.flush()
+            size = fh.seek(0, 2)
+            if size == 0:
+                continue
+            fh.seek(max(0, size - max_bytes))
+            content = fh.read().decode("utf-8", "replace")
+        except (OSError, ValueError):
+            continue
+        trunc = " (truncated)" if size > max_bytes else ""
+        print(f"--- captured stderr, rank {rank}{trunc} ---\n"
+              f"{content.rstrip()}", file=sys.stderr, flush=True)
+
+
+def _stderr_tail(fh, max_bytes: int = 4096) -> str:
+    """Read the trailing bytes of a captured stderr temp file. Only safe
+    once the owning rank exited (the file description's offset is shared
+    with the child)."""
+    try:
+        fh.flush()
+        size = fh.seek(0, 2)
+        fh.seek(max(0, size - max_bytes))
+        return fh.read().decode("utf-8", "replace")
+    except (OSError, ValueError):
+        return ""
 
 
 def _wait_all(procs: List[subprocess.Popen],
               timeout_s: Optional[float],
-              cancel_event: Optional["threading.Event"] = None) -> int:
+              cancel_event: Optional["threading.Event"] = None,
+              stderr_files: Optional[Dict[int, Any]] = None,
+              exit_codes: Optional[Dict[int, int]] = None) -> int:
     deadline = time.monotonic() + timeout_s if timeout_s else None
     remaining = {rank: p for rank, p in enumerate(procs)}
     while remaining:
@@ -293,8 +399,13 @@ def _wait_all(procs: List[subprocess.Popen],
             if code is None:
                 continue
             del remaining[rank]
+            if exit_codes is not None:
+                exit_codes[rank] = code
             if code != 0:
-                raise LaunchError(rank, code)
+                tail = ""
+                if stderr_files and rank in stderr_files:
+                    tail = _stderr_tail(stderr_files[rank])
+                raise LaunchError(rank, code, stderr_tail=tail)
         if cancel_event is not None and cancel_event.is_set():
             raise LaunchCancelled("job cancelled by owner")
         if deadline and time.monotonic() > deadline:

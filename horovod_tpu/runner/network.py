@@ -235,7 +235,14 @@ class BasicService:
                  port: int = 0,
                  bind_host: str = "127.0.0.1",
                  on_disconnect: Optional[Callable[[socket.socket], None]]
-                 = None) -> None:
+                 = None,
+                 listen_fd: Optional[int] = None) -> None:
+        """``listen_fd``: adopt an ALREADY-LISTENING socket inherited from
+        the launcher instead of binding ``port`` — the fix for the
+        launcher's probe-then-rebind TOCTOU race (the port cannot be lost
+        between probe and bind because it is never released; peers that
+        dialed before this service started sit in the kernel backlog).
+        The service owns the fd from here on (server_close closes it)."""
         self.name = name
         # The wire deserializes pickle: loopback-only by default, and a
         # non-loopback bind demands a real per-job secret — the hardcoded
@@ -286,7 +293,16 @@ class BasicService:
             # stalls to world start and the first cycle.
             request_queue_size = 128
 
-        self._server = _Server((bind_host, port), _Handler)
+        if listen_fd is not None:
+            # bind_and_activate=False: the server must not bind a fresh
+            # socket — it adopts the inherited, already-listening one.
+            self._server = _Server((bind_host, port), _Handler,
+                                   bind_and_activate=False)
+            self._server.socket.close()
+            self._server.socket = socket.socket(fileno=listen_fd)
+            self._server.server_address = self._server.socket.getsockname()
+        else:
+            self._server = _Server((bind_host, port), _Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, name=f"{name}-service",

@@ -8,14 +8,17 @@ exactly like reference user fns), and per-rank return values are collected
 back. The driver/task split mirrors ``driver_service.py``/``task_service.py``:
 registration handshake, code distribution, result registration, and
 timeouts with actionable messages (``util/timeout.py``).
+
+``_execute_world`` is the reusable single-attempt core: ``run`` is one
+attempt; the elastic driver (``horovod_tpu.elastic.run_elastic``) wraps it
+in a detect → abort → relaunch → restore loop.
 """
 
 from __future__ import annotations
 
-import os
 import pickle
 import threading
-from typing import Any, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import cloudpickle
 
@@ -23,6 +26,33 @@ from .launcher import LaunchCancelled, LaunchError, launch
 from .network import BasicService, make_secret
 
 _DRIVER_PORT_ENV = "HOROVOD_DRIVER_PORT"
+
+
+class WorkerLostError(RuntimeError):
+    """Workers exited without reporting results (e.g. ``os._exit(0)`` in
+    user code): a world-level fault an elastic driver may retry, unlike
+    an arbitrary RuntimeError (which should fail fast)."""
+
+    def __init__(self, ranks: List[int], codes: List[Optional[int]]) -> None:
+        super().__init__(
+            f"ranks {ranks} exited (codes {codes}) without reporting a "
+            f"result to the driver.")
+        self.ranks = list(ranks)
+
+
+class WorkerFailedError(RuntimeError):
+    """The job function raised on one or more ranks; carries the rank list
+    so an elastic driver can attribute the failure to slots."""
+
+    def __init__(self, failures: List[Tuple[int, str]]) -> None:
+        rank, detail = failures[0]
+        msg = f"run(fn) failed on rank {rank}: {detail}"
+        if len(failures) > 1:
+            msg += (f" (and on {len(failures) - 1} more rank(s): "
+                    f"{sorted(r for r, _ in failures[1:])})")
+        super().__init__(msg)
+        self.ranks = sorted(r for r, _ in failures)
+        self.failures = failures
 
 
 def _dumps_by_value(fn, args: Tuple, kwargs: dict) -> bytes:
@@ -117,64 +147,96 @@ class _Driver:
                         f"stall warning).")
                 self._cond.wait(timeout=0.2)
         out = []
+        failures: List[Tuple[int, str]] = []
         for rank in range(self._np):
             ok, payload = self._results[rank]
             value = pickle.loads(payload)
             if not ok:
-                raise RuntimeError(
-                    f"run(fn) failed on rank {rank}: {value}")
+                failures.append((rank, str(value)))
             out.append(value)
+        if failures:
+            raise WorkerFailedError(failures)
         return out
+
+    def missing_results(self) -> List[int]:
+        with self._cond:
+            return sorted(set(range(self._np)) - set(self._results))
 
     def shutdown(self) -> None:
         self._service.shutdown()
 
 
-def run(fn, args: Tuple = (), kwargs: Optional[dict] = None, np: int = 1,
-        timeout_s: float = 300.0, start_timeout_s: float = 60.0,
-        use_host_data_plane: bool = True) -> List[Any]:
-    """Execute ``fn(*args, **kwargs)`` on ``np`` ranks; return results in
-    rank order (the reference returns the same, ``spark/__init__.py:192-196``).
+def _execute_world(fn, args: Tuple, kwargs: dict, np: int,
+                   timeout_s: float, start_timeout_s: float,
+                   use_host_data_plane: bool,
+                   env_extra: Optional[Dict[str, str]] = None,
+                   extra_abort_check: Optional[Callable[[], None]] = None,
+                   secret: Optional[str] = None,
+                   capture_stderr: bool = True) -> List[Any]:
+    """One world attempt: spawn ``np`` ranks, ship ``fn``, collect results.
 
-    ``start_timeout_s`` bounds worker registration (reference
-    HOROVOD_SPARK_START_TIMEOUT semantics); ``timeout_s`` bounds the whole
-    job. On either timeout the workers are torn down, not orphaned."""
+    The building block shared by ``run`` (exactly one attempt) and
+    ``elastic.run_elastic`` (retry loop). ``extra_abort_check`` runs on
+    every wait tick — the elastic driver's heartbeat monitor raises there
+    when a rank's beats stop. ``secret`` lets an owner with its own
+    long-lived services (the elastic driver's health/state store) put the
+    whole job on one HMAC key. Worker stderr is captured so a dead rank's
+    LaunchError carries its last output instead of surfacing as an opaque
+    result-wait timeout."""
     import sys
 
     kwargs = kwargs or {}
-    secret = make_secret()
+    secret = secret or make_secret()
     driver = _Driver(np, fn, args, kwargs, bytes.fromhex(secret))
     cancel = threading.Event()
     thread = None
     try:
         worker_cmd = [sys.executable, "-m", "horovod_tpu.runner._exec_fn"]
-        env_extra = {_DRIVER_PORT_ENV: str(driver.port),
-                     "HOROVOD_SECRET_KEY": secret}
+        merged_env = {_DRIVER_PORT_ENV: str(driver.port),
+                      "HOROVOD_SECRET_KEY": secret}
+        if env_extra:
+            merged_env.update(env_extra)
         launch_err: List[BaseException] = []
+        exit_codes: Dict[int, int] = {}
+        launch_done = threading.Event()
 
         def _launch() -> None:
             try:
-                launch(worker_cmd, np, env_extra=env_extra,
+                launch(worker_cmd, np, env_extra=merged_env,
                        host_data_plane=use_host_data_plane,
-                       cancel_event=cancel)
+                       cancel_event=cancel, capture_stderr=capture_stderr,
+                       exit_codes=exit_codes)
             except LaunchCancelled:
                 pass
             except BaseException as exc:  # noqa: BLE001
                 launch_err.append(exc)
+            finally:
+                launch_done.set()
 
         thread = threading.Thread(target=_launch, daemon=True)
         thread.start()
 
-        def _abort_on_launch_failure() -> None:
+        def _abort_check() -> None:
             # A dead rank means results will never arrive; surface the
             # launcher's error instead of waiting out the timeout (the
             # reference cancels the Spark job group the same way,
             # ``spark/__init__.py:181-188``).
             if launch_err:
                 raise launch_err[0]
+            if launch_done.is_set() and not cancel.is_set():
+                # Every worker exited cleanly (code 0) yet results are
+                # still missing: a rank died without reporting (e.g.
+                # os._exit(0) in user code). Waiting out the timeout
+                # would be the old opaque failure mode — name the ranks.
+                missing = driver.missing_results()
+                if missing:
+                    raise WorkerLostError(
+                        missing, [exit_codes.get(r) for r in missing])
+            if extra_abort_check is not None:
+                extra_abort_check()
 
-        driver.wait_registered(start_timeout_s, _abort_on_launch_failure)
-        results = driver.wait_results(timeout_s, _abort_on_launch_failure)
+        driver.wait_registered(start_timeout_s, _abort_check)
+        results = driver.wait_results(timeout_s, _abort_check)
         thread.join(timeout=30.0)
         if launch_err:
             raise launch_err[0]
@@ -186,3 +248,23 @@ def run(fn, args: Tuple = (), kwargs: Optional[dict] = None, np: int = 1,
         if thread is not None:
             thread.join(timeout=30.0)
         driver.shutdown()
+
+
+def run(fn, args: Tuple = (), kwargs: Optional[dict] = None, np: int = 1,
+        timeout_s: float = 300.0, start_timeout_s: float = 60.0,
+        use_host_data_plane: bool = True,
+        capture_stderr: bool = True) -> List[Any]:
+    """Execute ``fn(*args, **kwargs)`` on ``np`` ranks; return results in
+    rank order (the reference returns the same, ``spark/__init__.py:192-196``).
+
+    ``start_timeout_s`` bounds worker registration (reference
+    HOROVOD_SPARK_START_TIMEOUT semantics); ``timeout_s`` bounds the whole
+    job. On either timeout the workers are torn down, not orphaned.
+    ``capture_stderr`` (default) buffers each rank's stderr so a dead
+    rank's error carries its last output; pass False to stream worker
+    stderr to this process's console instead (failures then lack the
+    tail). For the fault-tolerant variant that relaunches on worker
+    death, see ``horovod_tpu.elastic.run_elastic``."""
+    return _execute_world(fn, args, kwargs or {}, np, timeout_s,
+                          start_timeout_s, use_host_data_plane,
+                          capture_stderr=capture_stderr)

@@ -375,6 +375,67 @@ def main() -> None:
         for r in range(size):
             np.testing.assert_array_equal(gathered[r], gathered[0])
 
+    elif scenario == "stall_abort":
+        # Abort-instead-of-hang (HOROVOD_STALL_SHUTDOWN_TIME_S): rank 0
+        # submits a tensor the other rank NEVER submits. The reference
+        # behavior is an infinite hang behind a stall warning; with the
+        # shutdown deadline set (parent env: warning 1s, shutdown 2s) the
+        # coordinator escalates into a structured world abort and rank 0
+        # raises RanksAbortedError naming the missing rank — well before
+        # the parent's harness timeout.
+        import time
+
+        from horovod_tpu.ops.engine import get_engine
+
+        engine = get_engine()
+        if rank == 0:
+            t0 = time.monotonic()
+            try:
+                hvd.allreduce(np.ones((4,), np.float32), average=False,
+                              name="sa.trap")
+            except hvd.RanksAbortedError as exc:
+                assert exc.ranks == [1], exc.ranks
+                assert "shut down" in str(exc), exc
+            else:
+                raise AssertionError(
+                    "expected RanksAbortedError from the stall deadline")
+            assert time.monotonic() - t0 < 20.0
+        else:
+            # the permanently-absent rank: keep cycling (the engine loop
+            # does) but never submit sa.trap; the escalated shutdown must
+            # stop this engine too instead of leaving it parked
+            assert engine._stopped.wait(25.0), \
+                "absent rank's engine not stopped by the escalation"
+
+    elif scenario == "object_edge":
+        # broadcast_object edge cases: None payload, empty bytes, a blob
+        # far above the (parent-shrunk) fusion threshold, and an exact
+        # pickle round-trip on non-root ranks.
+        import pickle
+
+        out = hvd.broadcast_object(None if rank == 0 else "junk",
+                                   root_rank=0, name="oe.none")
+        assert out is None, out
+        out = hvd.broadcast_object(b"" if rank == 0 else None,
+                                   root_rank=0, name="oe.empty")
+        assert out == b"", out
+        out = hvd.broadcast_object([] if rank == 0 else None,
+                                   root_rank=0, name="oe.emptylist")
+        assert out == [], out
+        blob = bytes(range(256)) * 4096  # 1 MiB >> threshold
+        out = hvd.broadcast_object({"blob": blob} if rank == 0 else None,
+                                   root_rank=0, name="oe.big")
+        assert out["blob"] == blob
+        obj = {"a": [1, 2, {"b": (3.5, "s")}], "t": ("x", None),
+               "arr": np.arange(7, dtype=np.int16)}
+        out = hvd.broadcast_object(obj if rank == 0 else None,
+                                   root_rank=0, name="oe.exact")
+        # non-root ranks must see a payload that round-trips pickle
+        # exactly (same bytes as root's serialization)
+        ref = {**obj, "arr": obj["arr"]}
+        assert pickle.dumps(out) == pickle.dumps(ref)
+        np.testing.assert_array_equal(out["arr"], obj["arr"])
+
     elif scenario == "stall":
         # rank 0 submits immediately; rank 1 delays past the stall window so
         # the coordinator must print the stall warning naming the missing
@@ -419,8 +480,12 @@ def main() -> None:
         hvd.allreduce(np.ones((4,), np.float32), average=False,
                       name="pd.barrier")
         if rank == victim:
+            # Same shapes as the survivors: under heavy CPU load the
+            # victim's cycle can ship these before the _exit lands, and a
+            # shape mismatch would then surface as a coordinator ERROR
+            # instead of the death-abort this scenario pins.
             for i in range(3):
-                hvd.allreduce_async(np.ones((64,), np.float32),
+                hvd.allreduce_async(np.ones((256,), np.float32),
                                     average=False, name=f"pd.{i}")
             os._exit(3)  # no shutdown message, no atexit — a real crash
         handles = [hvd.allreduce_async(np.full((256,), float(rank),

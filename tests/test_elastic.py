@@ -1,0 +1,486 @@
+"""Elastic fault-tolerance plane: heartbeats, abort-instead-of-hang,
+relaunch with state restore (docs/elastic.md).
+
+The reference (Horovod 0.16) answers a dead worker with an infinite hang;
+upstream Horovod's next subsystem era was elastic mode. These tests pin
+the rebuilt contract: the deterministic kill-one-worker recovery and the
+stall-deadline abort run in the tier-1 subset; the multi-restart soaks are
+marked ``slow``.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "_mp_worker.py")
+
+
+# -- unit: structured abort parsing ------------------------------------------
+
+def test_parse_aborted_ranks_forms():
+    from horovod_tpu.core.status import (
+        format_aborted_ranks,
+        parse_aborted_ranks,
+    )
+
+    assert parse_aborted_ranks(format_aborted_ranks([3, 1, 3])) == [1, 3]
+    assert parse_aborted_ranks("rank 7 exited mid-job. blah") == [7]
+    assert parse_aborted_ranks(
+        "Stalled ops: t [missing ranks: 0, 2] [ready ranks: 1]") == [0, 2]
+    assert parse_aborted_ranks("nothing attributable here") is None
+    # strict mode (for LOG text like stderr tails): only the explicit tag
+    # counts — routine stall warnings and incidental phrasing are noise
+    assert parse_aborted_ranks("x [aborted ranks: 4]", strict=True) == [4]
+    assert parse_aborted_ranks("rank 7 exited mid-job.",
+                               strict=True) is None
+    assert parse_aborted_ranks(
+        "Stalled ops: t [missing ranks: 0, 2] [ready ranks: 1]",
+        strict=True) is None
+
+
+def test_ranks_aborted_error_from_status():
+    from horovod_tpu.core.status import (
+        HorovodInternalError,
+        RanksAbortedError,
+        Status,
+    )
+
+    status = Status.unknown_error(
+        "x stalled. shut down [aborted ranks: 2]")
+    with pytest.raises(RanksAbortedError) as excinfo:
+        status.raise_if_error()
+    assert excinfo.value.ranks == [2]
+    assert isinstance(excinfo.value, HorovodInternalError)
+    # unattributed shutdowns keep the plain error class
+    with pytest.raises(HorovodInternalError) as excinfo:
+        Status.unknown_error("shut down, no details").raise_if_error()
+    assert not isinstance(excinfo.value, RanksAbortedError)
+
+
+def test_stall_escalation_tracker():
+    from horovod_tpu.ops.controller import StallEscalation
+
+    warning = ("... Stalled ops: grad.3 [missing ranks: 1, 2] "
+               "[ready ranks: 0]")
+    esc = StallEscalation(deadline_s=0.2)
+    assert esc.check([warning]) is None  # first sighting starts the clock
+    time.sleep(0.25)
+    result = esc.check([warning])
+    assert result is not None
+    names, missing, reason = result
+    assert names == ["grad.3"] and missing == [1, 2]
+    assert "HOROVOD_STALL_SHUTDOWN_TIME_S" in reason
+    assert "[aborted ranks: 1, 2]" in reason
+    # a resolved stall (no longer warned about) must stop aging
+    esc2 = StallEscalation(deadline_s=0.2)
+    assert esc2.check([warning]) is None
+    assert esc2.check(["... Stalled ops: other [missing ranks: 1] "
+                       "[ready ranks: 0]"]) is None
+    time.sleep(0.25)
+    assert esc2.check([warning]) is None  # clock restarted
+    # disabled tracker never escalates
+    assert StallEscalation(0.0).check([warning]) is None
+    # an authoritative all-clear (the coordinator's check ran and found
+    # nothing) retires the episode immediately — no cadence wait
+    esc25 = StallEscalation(deadline_s=0.2, warning_interval_s=60.0)
+    assert esc25.check([warning]) is None
+    assert esc25.check([], check_ran=True) is None
+    time.sleep(0.25)
+    assert esc25.check([warning]) is None  # fresh episode, clock restarted
+    # a recovered stall followed by EMPTY batches (nothing else stalled,
+    # so no non-empty snapshot ever prunes it) must not leak its clock
+    # into the name's next stall episode: after the warning cadence says
+    # the episode ended, a fresh warning restarts the deadline
+    esc3 = StallEscalation(deadline_s=0.2, warning_interval_s=0.05)
+    assert esc3.check([warning]) is None
+    time.sleep(0.3)  # > 2.5x interval with no re-warning: episode over
+    assert esc3.check([warning]) is None  # new episode, clock restarted
+    # a CONTINUOUSLY warned stall keeps its original clock and expires
+    esc4 = StallEscalation(deadline_s=0.3, warning_interval_s=0.05)
+    deadline = time.monotonic() + 5.0
+    fired = None
+    while fired is None and time.monotonic() < deadline:
+        fired = esc4.check([warning])
+        time.sleep(0.05)
+    assert fired is not None and fired[0] == ["grad.3"]
+
+
+def test_fault_spec_parse():
+    from horovod_tpu.elastic.state import parse_fault_spec
+
+    assert parse_fault_spec("2:5") == (2, 5, 0)
+    assert parse_fault_spec("0:3:1") == (0, 3, 1)
+    assert parse_fault_spec("") is None
+    assert parse_fault_spec("nope") is None
+    assert parse_fault_spec("1:2:3:4") is None
+
+
+def test_format_aborted_ranks_dedupes_and_sorts():
+    from horovod_tpu.core.status import format_aborted_ranks
+
+    assert format_aborted_ranks([5, 1, 5, 3]) == "[aborted ranks: 1, 3, 5]"
+    assert format_aborted_ranks({0}) == "[aborted ranks: 0]"
+
+
+def test_parse_aborted_ranks_prefers_explicit_tag():
+    from horovod_tpu.core.status import parse_aborted_ranks
+
+    # explicit tag wins over incidental rank mentions elsewhere
+    msg = "rank 0 saw trouble [aborted ranks: 3] rank 9 exited mid-job"
+    assert parse_aborted_ranks(msg) == [3]
+    # survives the engine loop's SHUT_DOWN_ERROR rewrap
+    wrapped = ("Horovod has been shut down. (cause: collective aborted "
+               "[aborted ranks: 1, 2])")
+    assert parse_aborted_ranks(wrapped) == [1, 2]
+
+
+def test_stall_escalation_ignores_unparseable_warnings():
+    from horovod_tpu.ops.controller import StallEscalation
+
+    esc = StallEscalation(deadline_s=0.01)
+    assert esc.check(["free-form warning with no stalled ops"]) is None
+    assert esc.check([]) is None
+
+
+def test_world_epoch_reads_env(monkeypatch):
+    from horovod_tpu.basics import world_epoch
+    from horovod_tpu.core import config as _config
+
+    monkeypatch.delenv(_config.HOROVOD_ELASTIC_EPOCH, raising=False)
+    assert world_epoch() == 0
+    monkeypatch.setenv(_config.HOROVOD_ELASTIC_EPOCH, "4")
+    assert world_epoch() == 4
+
+
+def test_worker_failed_error_names_all_ranks():
+    from horovod_tpu.runner.run_api import WorkerFailedError
+
+    err = WorkerFailedError([(1, "boom"), (3, "bang")])
+    assert err.ranks == [1, 3]
+    assert "rank 1" in str(err) and "boom" in str(err)
+    assert "[3]" in str(err)
+
+
+def test_launch_error_message_with_and_without_tail():
+    from horovod_tpu.runner.launcher import LaunchError
+
+    plain = LaunchError(2, 9)
+    assert "rank 2" in str(plain) and "code 9" in str(plain)
+    assert plain.stderr_tail == ""
+    tailed = LaunchError(0, 1, stderr_tail="Traceback: kaput\n")
+    assert "kaput" in str(tailed) and tailed.stderr_tail
+
+
+def test_driver_failed_rank_attribution():
+    from horovod_tpu.elastic.driver import WorkerDeadError, _failed_ranks
+    from horovod_tpu.runner.launcher import LaunchError
+    from horovod_tpu.runner.run_api import WorkerFailedError
+
+    # plain exit: blame the exiting rank
+    assert _failed_ranks(LaunchError(2, 13)) == [2]
+    # a healthy victim's stderr names the real culprit: prefer it
+    victim = LaunchError(0, 1, stderr_tail="RanksAbortedError: stalled "
+                                           "[aborted ranks: 3]")
+    assert _failed_ranks(victim) == [3]
+    # ...but a ROUTINE stall warning in the coordinator's stderr (a
+    # transient, already-recovered stall) must NOT redirect the blame
+    noisy = LaunchError(0, 1, stderr_tail=(
+        "[WARNING] ... Stalled ops: g [missing ranks: 3] [ready ranks: "
+        "0]\nTraceback: unrelated crash"))
+    assert _failed_ranks(noisy) == [0]
+    assert _failed_ranks(WorkerDeadError([1, 2], 1.0, 5)) == [1, 2]
+    from horovod_tpu.runner.run_api import WorkerLostError
+
+    assert _failed_ranks(WorkerLostError([2], [0])) == [2]
+    # arbitrary runtime errors are not retried, hence not attributed
+    assert _failed_ranks(RuntimeError("internal bug")) == []
+    # worker exceptions: abort-tagged detail wins over the reporter list
+    wf = WorkerFailedError([(0, "shut down [aborted ranks: 2]")])
+    assert _failed_ranks(wf) == [2]
+    assert _failed_ranks(WorkerFailedError([(1, "user bug")])) == [1]
+    assert _failed_ranks(TimeoutError("nothing attributable")) == []
+
+
+# -- unit: health plane -------------------------------------------------------
+
+def test_elastic_service_heartbeats_and_death():
+    from horovod_tpu.elastic.health import ElasticService, HeartbeatReporter
+
+    secret = os.urandom(32)
+    service = ElasticService(secret, heartbeat_interval_s=0.05,
+                             miss_limit=3)
+    try:
+        service.begin_epoch(0)
+        reporter = HeartbeatReporter(("127.0.0.1", service.port), rank=1,
+                                     epoch=0, secret=secret,
+                                     interval_s=0.05)
+        deadline = time.monotonic() + 5.0
+        while not service._last_beat and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert 1 in service._last_beat, "no heartbeat arrived"
+        assert service.dead_ranks() == []
+        # a clean stop sends goodbye: never flagged dead
+        reporter.stop()
+        time.sleep(0.4)
+        assert service.dead_ranks() == []
+        # an abrupt stop (no goodbye) IS flagged dead after the miss limit
+        service.begin_epoch(1)
+        reporter2 = HeartbeatReporter(("127.0.0.1", service.port), rank=2,
+                                      epoch=1, secret=secret,
+                                      interval_s=0.05)
+        deadline = time.monotonic() + 5.0
+        while not service._last_beat and time.monotonic() < deadline:
+            time.sleep(0.02)
+        reporter2._stop.set()  # kill the loop without the goodbye path
+        reporter2._thread.join(timeout=5.0)
+        # undo the goodbye the stopped loop may still have sent: simulate
+        # the hard-death case by re-beating then silencing
+        service.begin_epoch(2)
+        service._handle(("beat", 2, 2), None)
+        time.sleep(0.3)
+        assert service.dead_ranks() == [2]
+    finally:
+        service.shutdown()
+
+
+def test_elastic_service_epoch_fencing_and_store():
+    from horovod_tpu.elastic.health import ElasticService
+
+    service = ElasticService(os.urandom(32), heartbeat_interval_s=0.05,
+                             miss_limit=2)
+    try:
+        service.begin_epoch(3)
+        # a straggler beat from a previous epoch must be ignored
+        service._handle(("beat", 2, 0), None)
+        assert service._last_beat == {}
+        service._handle(("beat", 3, 0), None)
+        assert 0 in service._last_beat
+        # commit store: latest payload wins; fetch round-trips
+        assert service._handle(("fetch",), None) == ("commit", None, None)
+        service._handle(("commit", 3, {"commit_no": 1}, b"one"), None)
+        service._handle(("commit", 3, {"commit_no": 2}, b"two"), None)
+        kind, meta, payload = service._handle(("fetch",), None)
+        assert (kind, payload) == ("commit", b"two")
+        assert meta["commit_no"] == 2 and meta["epoch"] == 3
+    finally:
+        service.shutdown()
+
+
+# -- unit: state commit/restore (single-process world) ------------------------
+
+def test_state_commit_restore_roundtrip(hvd):
+    from horovod_tpu.elastic import State
+
+    state = State(w=np.zeros(3, np.float32), step=0,
+                  extra={"lr": 0.5})
+    state.w = state.w + 1.0
+    state.step = 4
+    state.commit()
+    state.w = state.w + 99.0
+    state.step = 9
+    state.extra = {"lr": 0.1}
+    state.restore()
+    assert state.step == 4
+    np.testing.assert_array_equal(state.w, 1.0)
+    assert state.extra == {"lr": 0.5}
+    # sync in a world of one is the identity (and re-commits)
+    out = state.run(lambda s: (s.step, float(s.w[0])))
+    assert out == (4, 1.0)
+
+
+def test_state_rejects_reserved_names(hvd):
+    from horovod_tpu.elastic import State
+
+    with pytest.raises(ValueError):
+        State()
+    with pytest.raises(ValueError):
+        State(commit=1)
+    with pytest.raises(ValueError):
+        State(_hidden=2)
+
+
+# -- tier-1 acceptance: kill a worker mid-step, relaunch, restore -------------
+
+_TOTAL_STEPS = 5
+
+
+def _elastic_train_fn(total_steps):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.basics import world_epoch
+    from horovod_tpu.elastic import State
+
+    hvd.init()
+    state = State(w=np.zeros(2, np.float32), step=0)
+
+    def train(state):
+        while state.step < total_steps:
+            try:
+                grad = hvd.allreduce(
+                    np.full(2, float(state.step + 1), np.float32),
+                    average=False, name=f"el.grad.{state.step}")
+            except hvd.RanksAbortedError as exc:
+                # the acceptance contract: a healthy rank must see the
+                # STRUCTURED abort naming the dead rank — never a hang,
+                # never an anonymous shutdown
+                assert 2 in exc.ranks, exc.ranks
+                raise
+            state.w = state.w + np.asarray(grad)
+            state.step += 1
+            state.commit()
+        return {"rank": hvd.rank(), "size": hvd.size(),
+                "epoch": world_epoch(), "step": state.step,
+                "w0": float(state.w[0])}
+
+    out = state.run(train)
+    hvd.shutdown()
+    return out
+
+
+def test_run_elastic_kill_mid_step_restores_and_finishes():
+    """THE elastic contract: a 4-rank job whose rank 2 is killed
+    mid-step (fault hook fires before its 3rd commit persists) aborts
+    cleanly — no hang — relaunches, restores from the last commit
+    (step 2), and finishes with the correct final step count and a loss
+    trajectory identical to an unfailed run."""
+    from horovod_tpu.runner import run_elastic
+
+    results = run_elastic(
+        _elastic_train_fn, args=(_TOTAL_STEPS,), np=4, min_np=2,
+        max_restarts=2, backoff_s=0.2, timeout_s=180.0,
+        start_timeout_s=120.0,
+        heartbeat_interval_s=0.5, heartbeat_miss_limit=6,
+        env_extra={"HOROVOD_ELASTIC_FAULT": "2:3",
+                   "HOROVOD_CYCLE_TIME": "2"})
+    assert len(results) == 4
+    # w accumulates sum_k size*k over steps 1..total — bit-exact resume
+    expected_w = 4.0 * sum(range(1, _TOTAL_STEPS + 1))
+    for result in results:
+        assert result["step"] == _TOTAL_STEPS, result
+        assert result["w0"] == expected_w, (result, expected_w)
+        assert result["size"] == 4, result
+        assert result["epoch"] == 1, result  # exactly one relaunch
+
+
+def test_stall_deadline_aborts_instead_of_hanging():
+    """Companion acceptance test: a permanently-absent rank converts into
+    RanksAbortedError on the healthy rank within the stall deadline —
+    never the reference's infinite hang. (Python controller pinned here;
+    the native wrapper's client-side escalation runs in
+    test_multiprocess.py's CONTROLLERS battery.)"""
+    from horovod_tpu.runner.launcher import launch
+
+    rc = launch(
+        [sys.executable, _WORKER, "stall_abort"], np=2,
+        host_data_plane=True, job_timeout_s=90.0,
+        env_extra={"HOROVOD_STALL_WARNING_TIME": "1",
+                   "HOROVOD_STALL_SHUTDOWN_TIME_S": "2",
+                   "HOROVOD_CYCLE_TIME": "2",
+                   "HOROVOD_NATIVE_CONTROLLER": "0"})
+    assert rc == 0
+
+
+# -- slow tier: multi-restart soak + exhaustion ------------------------------
+
+def _flaky_until_epoch_fn(heal_epoch):
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import horovod_tpu as hvd
+    from horovod_tpu.basics import world_epoch
+
+    hvd.init()
+    if world_epoch() < heal_epoch and hvd.rank() == 1:
+        os._exit(11)  # a crashing worker, not a user exception
+    out = {"rank": hvd.rank(), "epoch": world_epoch()}
+    hvd.shutdown()
+    return out
+
+
+@pytest.mark.slow
+def test_run_elastic_multi_restart_soak():
+    """Rank 1 crashes on epochs 0 and 1, heals on epoch 2: two relaunches
+    with backoff, no blacklisting at slot_fail_limit=3."""
+    from horovod_tpu.runner import run_elastic
+
+    results = run_elastic(
+        _flaky_until_epoch_fn, args=(2,), np=3, min_np=2,
+        max_restarts=3, backoff_s=0.1, timeout_s=120.0,
+        start_timeout_s=120.0, slot_fail_limit=3)
+    assert [r["rank"] for r in results] == [0, 1, 2]
+    assert all(r["epoch"] == 2 for r in results)
+
+
+def _always_crashing_fn():
+    import os
+    import sys
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    if hvd.rank() == 0:
+        print("permanent failure on this slot", file=sys.stderr,
+              flush=True)
+        os._exit(7)
+    hvd.shutdown()
+    return "ok"
+
+
+@pytest.mark.slow
+def test_run_elastic_exhausts_restart_budget():
+    from horovod_tpu.elastic import ElasticExhaustedError
+    from horovod_tpu.runner import run_elastic
+
+    with pytest.raises(ElasticExhaustedError) as excinfo:
+        run_elastic(_always_crashing_fn, np=2, min_np=1, max_restarts=1,
+                    backoff_s=0.1, timeout_s=120.0, start_timeout_s=120.0)
+    # the exhaustion error surfaces the dead rank's captured stderr
+    assert "permanent failure on this slot" in str(excinfo.value)
+
+
+def _user_bug_fn():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    failing = hvd.rank() == 0
+    hvd.shutdown()
+    if failing:
+        raise KeyError("deterministic application bug")
+    return "ok"
+
+
+@pytest.mark.slow
+def test_run_elastic_fails_fast_on_user_exception():
+    """A user-code exception is NOT a world fault: no retries, no
+    blacklisting — it propagates on the first attempt."""
+    import time
+
+    from horovod_tpu.runner import run_elastic
+    from horovod_tpu.runner.run_api import WorkerFailedError
+
+    t0 = time.monotonic()
+    with pytest.raises(WorkerFailedError) as excinfo:
+        run_elastic(_user_bug_fn, np=2, min_np=1, max_restarts=3,
+                    backoff_s=5.0, timeout_s=120.0, start_timeout_s=120.0)
+    assert "deterministic application bug" in str(excinfo.value)
+    # fail-fast: nowhere near max_restarts x (attempt + backoff)
+    assert time.monotonic() - t0 < 60.0

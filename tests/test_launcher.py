@@ -33,6 +33,79 @@ def test_launch_missing_binary_fails_fast():
         launch(["definitely-not-a-real-binary-xyz"], np=2)
 
 
+def test_launch_error_names_rank_code_and_stderr_tail():
+    """A dead worker's LaunchError must carry the failed rank, its exit
+    code, and the tail of its captured stderr — not surface later as an
+    opaque result-wait timeout."""
+    with pytest.raises(LaunchError) as excinfo:
+        launch([sys.executable, "-c",
+                "import os, sys\n"
+                "if os.environ['HOROVOD_RANK'] == '1':\n"
+                "    print('boom: synthetic worker crash', file=sys.stderr)\n"
+                "    sys.exit(7)\n"
+                "import time; time.sleep(30)\n"],
+               np=2, capture_stderr=True, job_timeout_s=60.0)
+    err = excinfo.value
+    assert err.rank == 1 and err.returncode == 7
+    assert "boom: synthetic worker crash" in str(err)
+    assert "code 7" in str(err)
+
+
+def test_launch_controller_listener_is_prebound():
+    """TOCTOU fix: rank 0 receives the launcher's LIVE listening socket
+    (HOROVOD_CONTROLLER_FD) on the advertised controller port."""
+    probe = (
+        "import os, socket\n"
+        "fd = int(os.environ['HOROVOD_CONTROLLER_FD'])\n"
+        "s = socket.socket(fileno=fd)\n"
+        "port = s.getsockname()[1]\n"
+        "assert port == int(os.environ['HOROVOD_CONTROLLER_PORT']), port\n"
+        "s.listen(128)\n"  # already listening: re-listen is a no-op\n
+        "s.close()\n"
+    )
+    rc = launch([sys.executable, "-c", probe], np=1, job_timeout_s=60.0)
+    assert rc == 0
+
+
+def test_launch_allreduce_world_python_controller_adopts_fd():
+    """End to end on the Python controller service: rank 0's
+    ControllerService must adopt the inherited listener (no rebind) and
+    the world must still negotiate and reduce correctly."""
+    rc = launch([sys.executable, _WORKER, "allreduce"], np=2,
+                host_data_plane=True, job_timeout_s=120.0,
+                env_extra={"HOROVOD_NATIVE_CONTROLLER": "0"})
+    assert rc == 0
+
+
+def _silent_exit_fn():
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+
+    hvd.init()
+    if hvd.rank() == 1:
+        os._exit(0)  # dies without reporting a result, exit code 0
+    hvd.shutdown()
+    return "ok"
+
+
+def test_run_fn_names_silent_exit_instead_of_timing_out():
+    """A worker that exits 0 WITHOUT registering a result used to eat the
+    whole result timeout; now the driver names the silent ranks as soon
+    as the launcher observes every process gone."""
+    import time
+
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError) as excinfo:
+        run(_silent_exit_fn, np=2, timeout_s=300.0)
+    assert "without reporting a result" in str(excinfo.value)
+    assert "[1]" in str(excinfo.value)
+    assert time.monotonic() - t0 < 120.0
+
+
 def _worker_fn(scale):
     import jax
 

@@ -145,6 +145,26 @@ def test_mp_broadcast_object():
     _run_world("object", 2)
 
 
+def test_mp_broadcast_object_edge_cases():
+    """broadcast_object edges: None / empty payloads, a blob far above
+    the (shrunk) fusion threshold, and exact pickle round-trips on
+    non-root ranks."""
+    _run_world("object_edge", 3,
+               extra_env={"HOROVOD_FUSION_THRESHOLD": "65536"})
+
+
+@CONTROLLERS
+def test_mp_stall_shutdown_deadline_aborts(controller):
+    """HOROVOD_STALL_SHUTDOWN_TIME_S on both controller implementations:
+    a permanently-absent rank becomes RanksAbortedError on the healthy
+    rank (python: coordinator-side escalation; native: the wrapper's
+    client-side escalation over the wire's stall warnings)."""
+    _run_world("stall_abort", 2, timeout=120.0,
+               extra_env={"HOROVOD_STALL_WARNING_TIME": "1",
+                          "HOROVOD_STALL_SHUTDOWN_TIME_S": "2",
+                          **_ctrl_env(controller)})
+
+
 def _run_world_xla(scenario: str, size: int, **kw):
     """Same scenarios over the eager XLA data plane: workers form a real
     multi-process JAX world (gloo CPU collectives) and bytes move as

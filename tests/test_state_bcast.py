@@ -42,3 +42,23 @@ def test_broadcast_optimizer_state_with_scalars(hvd):
 def test_broadcast_object(hvd):
     obj = {"config": [1, 2, 3], "name": "resnet50"}
     assert hvd.broadcast_object(obj, root_rank=0) == obj
+
+
+def test_broadcast_object_none_and_empty(hvd):
+    # None is a legal payload, not an absence marker
+    assert hvd.broadcast_object(None, root_rank=0) is None
+    assert hvd.broadcast_object(b"", root_rank=0) == b""
+    assert hvd.broadcast_object([], root_rank=0) == []
+    assert hvd.broadcast_object({}, root_rank=0) == {}
+
+
+def test_broadcast_object_large_payload_roundtrips_exactly(hvd):
+    # bigger than any fusion window the engine would pick for the wire
+    import pickle
+
+    blob = {"blob": bytes(range(256)) * 4096,
+            "arr": np.arange(513, dtype=np.float64)}
+    out = hvd.broadcast_object(blob, root_rank=0)
+    assert pickle.dumps(out) == pickle.dumps(blob)
+    # multi-rank versions of these edges run in
+    # test_multiprocess.py::test_mp_broadcast_object_edge_cases

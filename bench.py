@@ -256,7 +256,20 @@ def _preflight_backend(attempts: Optional[int] = None,
                 f"(> {probe_timeout_s:.0f}s) — the accelerator plugin is "
                 f"wedged, likely a stale process holding the chip.")
             _print_chip_diagnostics(log)
-            continue
+            # A HUNG probe is not a transient failure: a wedged plugin
+            # stays wedged across back-to-back probes, and each identical
+            # retry costs the full probe timeout (round 5 burned ~8 min on
+            # 4 x 120 s hangs before reaching the fallback line). Fail
+            # fast so the caller's fallback/diagnosis runs while the job
+            # budget still has room; transient NON-ZERO exits below keep
+            # their full retry budget (those do recover within seconds).
+            if attempt < attempts:
+                log(f"[preflight] skipping the remaining "
+                    f"{attempts - attempt} attempt(s): identical hangs "
+                    f"would burn "
+                    f"{(attempts - attempt) * probe_timeout_s:.0f}s "
+                    f"without new information")
+            break
         if out.returncode == 0 and out.stdout.strip():
             # The probe's own print is a 2-token line; scan from the end so
             # plugin banners on stdout cannot break the parse.

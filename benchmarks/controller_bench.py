@@ -40,14 +40,18 @@ from horovod_tpu.core.config import Config
 from horovod_tpu.ops.controller import (
     ControllerClient,
     ControllerService,
+    Negotiator,
     make_negotiator,
 )
 from horovod_tpu.ops.messages import (
+    CacheHitAck,
+    CacheRequest,
     DataType,
     Request,
     RequestList,
     RequestType,
 )
+from horovod_tpu.ops.response_cache import ResponseCache, bits_of
 
 SECRET = b"s" * 32
 
@@ -206,6 +210,112 @@ def _measure(impl: str, size: int, n_cycles: int, tensors_per_cycle: int,
             max(s_timed) if s_timed else float("nan"))
 
 
+def _make_core(core: str, size: int, cfg):
+    """A negotiation core by explicit choice (the steady-state table
+    compares BOTH cores under one Python controller service; the response
+    cache wraps whichever core runs — docs/response-cache.md)."""
+    if core == "native":
+        from horovod_tpu import cc
+
+        return cc.NativeNegotiator(size, cfg.fusion_threshold_bytes,
+                                   stall_warning_s=cfg.stall_warning_time_s)
+    return Negotiator(size, cfg.fusion_threshold_bytes,
+                      stall_warning_s=cfg.stall_warning_time_s)
+
+
+def _steady_measure(core: str, size: int, n_cycles: int,
+                    tensors_per_cycle: int, cache_capacity: int):
+    """Steady-state training shape: every rank submits the SAME tensor set
+    every cycle (the pattern the response cache exists for). Returns
+    (cycles_per_s, neg_bytes_per_cycle) over the warm portion (first two
+    cycles dropped: connect/auth + the populating miss)."""
+    cfg = Config.from_env()
+    service = ControllerService(
+        size, _make_core(core, size, cfg), secret=SECRET, port=0,
+        cache_capacity=cache_capacity,
+        fusion_threshold_bytes=cfg.fusion_threshold_bytes)
+    latencies: list[float] = []
+    nbytes: list[int] = []
+    errors: list[BaseException] = []
+    barrier = threading.Barrier(size)
+
+    def worker(rank: int) -> None:
+        try:
+            client = ControllerClient(("127.0.0.1", service.port),
+                                      secret=SECRET, rank=rank)
+            cache = ResponseCache(cache_capacity)
+            requests = [_request(rank, f"steady_{i}")
+                        for i in range(tensors_per_cycle)]
+            by_name = {r.tensor_name: r for r in requests}
+            for _ in range(n_cycles):
+                positions = cache.plan_cycle(requests) \
+                    if cache_capacity > 0 else None
+                barrier.wait(timeout=120)
+                t0 = time.perf_counter()
+                if positions is not None:
+                    out = client.cycle(rank, CacheRequest(
+                        rank=rank,
+                        bits=bits_of(positions, cache.capacity),
+                        generation=cache.generation))
+                else:
+                    out = client.cycle(rank, RequestList(
+                        rank=rank, requests=list(requests)))
+                dt = time.perf_counter() - t0
+                if isinstance(out, CacheHitAck):
+                    replayed = cache.accept_ack(out)
+                    assert len(replayed) >= 1
+                else:
+                    cache.accept_response_list(out, by_name)
+                if rank == 0:
+                    latencies.append(dt)
+                    nbytes.append(client.last_cycle_tx_bytes
+                                  + client.last_cycle_rx_bytes)
+            client.close()
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+            barrier.abort()
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    service.shutdown()
+    if errors:
+        raise RuntimeError(f"steady {core} clients failed: {errors[:3]}")
+    if any(t.is_alive() for t in threads):
+        raise RuntimeError(f"steady {core}: client hung; no measurement")
+    warm_lat, warm_bytes = latencies[2:], nbytes[2:]
+    return (1.0 / statistics.median(warm_lat),
+            statistics.median(warm_bytes))
+
+
+def steady_state_table(cores, sizes, n_cycles: int,
+                       tensors_per_cycle: int) -> None:
+    """The acceptance table: warm-cache steady state must send strictly
+    fewer negotiation bytes/cycle than cold (bitvector + ack vs. full
+    RequestList/ResponseList) and turn that into a cycles/sec speedup, on
+    both negotiation cores."""
+    print(f"\n# steady-state negotiation bypass (HOROVOD_CACHE_CAPACITY), "
+          f"{tensors_per_cycle} tensors/cycle, {n_cycles} cycles, "
+          f"Python controller service, threaded clients")
+    # "-core" suffix: these rows compare NEGOTIATION CORES under the one
+    # Python service, and must not parse as the main table's impl rows
+    # (test_controller_scale greps those by leading "python "/"native ")
+    print(f"{'core':<12} {'ranks':>6} {'cold cyc/s':>11} {'warm cyc/s':>11} "
+          f"{'speedup':>8} {'cold B/cyc':>11} {'warm B/cyc':>11}")
+    for core in cores:
+        for size in sizes:
+            cold_cps, cold_b = _steady_measure(core, size, n_cycles,
+                                               tensors_per_cycle, 0)
+            warm_cps, warm_b = _steady_measure(core, size, n_cycles,
+                                               tensors_per_cycle, 1024)
+            print(f"{core + '-core':<12} {size:>6} {cold_cps:>11.0f} "
+                  f"{warm_cps:>11.0f} {warm_cps / cold_cps:>7.2f}x "
+                  f"{cold_b:>11.0f} {warm_b:>11.0f}", flush=True)
+
+
 def _worker_main(args) -> None:
     ranks = range(args.base_rank, args.base_rank + args.n_ranks)
     # Free-running (no cross-process barrier): the controller's own
@@ -228,6 +338,12 @@ def main() -> None:
     parser.add_argument("--procs", type=int, default=0,
                         help="spread clients over this many worker "
                              "PROCESSES (0 = threads in-process)")
+    parser.add_argument("--steady-sizes", default="8",
+                        help="world sizes for the steady-state cache table "
+                             "(empty string skips it; keep the default "
+                             "small — the main-table scale tests budget "
+                             "their subprocess timeout around it)")
+    parser.add_argument("--steady-cycles", type=int, default=30)
     # internal worker mode
     parser.add_argument("--_worker", action="store_true",
                         help=argparse.SUPPRESS)
@@ -261,6 +377,16 @@ def main() -> None:
                                       procs=args.procs)
             print(f"{impl:<8} {size:>6} {cm * 1e3:>14.1f} {cw * 1e3:>13.1f} "
                   f"{sm * 1e3:>14.2f} {sw * 1e3:>13.2f}", flush=True)
+
+    if args.steady_sizes.strip():
+        from horovod_tpu import cc
+
+        cores = ["python"] + (["native"] if cc.available() else [])
+        if len(cores) == 1:
+            print(f"steady: native core skipped: {cc.load_error()}")
+        steady_state_table(cores,
+                           [int(s) for s in args.steady_sizes.split(",")],
+                           args.steady_cycles, args.tensors_per_cycle)
 
 
 if __name__ == "__main__":

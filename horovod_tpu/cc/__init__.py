@@ -21,6 +21,7 @@ import json
 import os
 import subprocess
 import threading
+import time
 from typing import List, Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
@@ -162,6 +163,18 @@ class NativeNegotiator:
         self._lib = lib
         self._codecs: dict = {}  # in-flight tensor name -> codec tag
         self._mismatched: dict = {}  # name -> (codec_a, codec_b)
+        # Idle/hit-cycle bookkeeping for the response-cache bypass
+        # (docs/response-cache.md): an all-ranks cache hit adds no
+        # requests, so the only reason to cross the FFI boundary is the
+        # interval-gated stall check (or a latched shutdown). Track both
+        # in Python — same pattern PR 1 uses for codec bookkeeping the
+        # C++ wire predates — so steady-state hit cycles skip the
+        # construct FFI + JSON parse entirely between stall intervals.
+        self._dirty = False
+        self._shutdown_latched = False
+        self._stall_warning_s = stall_warning_s
+        self._stall_check_disable = stall_check_disable
+        self._last_ffi_pass = time.monotonic()
         self._handle = lib.htpu_negotiator_new(
             size, fusion_threshold_bytes, stall_warning_s,
             1 if stall_check_disable else 0)
@@ -173,11 +186,15 @@ class NativeNegotiator:
     def request_shutdown(self) -> None:
         """Force shutdown on subsequent response lists (stall-escalation
         path; same contract as ``Negotiator.request_shutdown``)."""
+        self._shutdown_latched = True
         self._lib.htpu_negotiator_shutdown(self._handle)
 
     def add_request_list(self, rl) -> None:
         if rl.shutdown:
+            self._shutdown_latched = True
             self._lib.htpu_negotiator_shutdown(self._handle)
+        if rl.requests:
+            self._dirty = True
         for req in rl.requests:
             codec = getattr(req, "codec", "none")
             prev = self._codecs.setdefault(req.tensor_name, codec)
@@ -245,8 +262,21 @@ class NativeNegotiator:
 
     def construct_response_list(self):
         from ..core.logging import LOG
+        from ..ops.messages import ResponseList
         from .messages_adapter import parse_response_json
 
+        if not self._dirty and not self._shutdown_latched and (
+                self._stall_check_disable or
+                time.monotonic() - self._last_ffi_pass
+                < self._stall_warning_s):
+            # Nothing added since the last construct and the stall-check
+            # interval has not elapsed: the FFI call could only return an
+            # empty list. stall_check=False is accurate — the check did
+            # not run this cycle (the C++ core's own interval gate would
+            # have declined it too).
+            return ResponseList()
+        self._dirty = False
+        self._last_ffi_pass = time.monotonic()
         ptr = self._lib.htpu_negotiator_construct(self._handle)
         try:
             raw = ctypes.string_at(ptr).decode("utf-8")

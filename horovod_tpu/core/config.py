@@ -44,6 +44,13 @@ HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
 # quantized wire (EQuARX int8/fp8) is an operational knob one wants to
 # flip fleet-wide without touching training code. docs/compression.md.
 HOROVOD_COMPRESSION = "HOROVOD_COMPRESSION"
+# Steady-state negotiation bypass (docs/response-cache.md): max cached
+# fused responses per rank/coordinator; 0 disables the cache-bit fast
+# path. Upstream Horovod later grew the same knob as HOROVOD_CACHE_CAPACITY.
+# Must resolve identically on every rank (the launcher's env export does
+# this): cache coherence is deterministic replay of identical transitions,
+# and capacity participates in eviction choices.
+HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
 HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
 HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
 HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
@@ -101,6 +108,7 @@ HOROVOD_HEARTBEAT_INTERVAL = "HOROVOD_HEARTBEAT_INTERVAL"
 HOROVOD_ELASTIC_FAULT = "HOROVOD_ELASTIC_FAULT"
 
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:1838
+DEFAULT_CACHE_CAPACITY = 1024  # upstream response_cache.cc default
 DEFAULT_CYCLE_TIME_MS = 5.0  # operations.cc:1846
 DEFAULT_START_TIMEOUT_S = 30.0
 STALL_WARNING_TIME_S = 60.0  # operations.cc:258
@@ -150,6 +158,7 @@ class Config:
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
     compression: str = "none"
+    cache_capacity: int = DEFAULT_CACHE_CAPACITY
     autotune: bool = False
     autotune_log: str = ""
     start_timeout_s: float = DEFAULT_START_TIMEOUT_S
@@ -181,6 +190,8 @@ class Config:
             hierarchical_allgather=_env_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
             compression=(os.environ.get(HOROVOD_COMPRESSION, "none")
                          .strip().lower() or "none"),
+            cache_capacity=max(_env_int(HOROVOD_CACHE_CAPACITY,
+                                        DEFAULT_CACHE_CAPACITY), 0),
             autotune=_env_bool(HOROVOD_AUTOTUNE),
             autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG, ""),
             start_timeout_s=_env_float(

@@ -12,7 +12,13 @@ Placement differs from the reference by design: the reference tunes on the
 coordinator and broadcasts a Params struct over MPI; here the tuner lives
 wherever the negotiator lives — in-process for size-1 worlds, on the rank-0
 controller service for multi-process worlds, which piggybacks the tuned
-cycle time on the ResponseList (``messages.ResponseList.tuned_cycle_ms``).
+cycle time on the ResponseList (``messages.ResponseList.tuned_cycle_ms``)
+AND on the response-cache bypass ack (``messages.CacheHitAck``), so a warm
+steady state keeps receiving retunes. A retuned FUSION THRESHOLD is applied
+through ``ControllerService.set_fusion_threshold``, which bumps the
+response-cache generation: repacking stales every cached fused layout, and
+without the bump a warm cache would replay the old packing forever
+(docs/response-cache.md).
 """
 
 from __future__ import annotations

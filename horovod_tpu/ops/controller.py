@@ -42,6 +42,8 @@ from ..runner.network import (
     WireError,
 )
 from .messages import (
+    CacheHitAck,
+    CacheRequest,
     DataType,
     Request,
     RequestList,
@@ -528,9 +530,37 @@ class ControllerService:
                  autotuner=None, world_id: str = "",
                  stall_shutdown_s: float = 0.0,
                  stall_warning_s: float = 60.0,
-                 listen_fd: Optional[int] = None) -> None:
+                 listen_fd: Optional[int] = None,
+                 cache_capacity: int = 0,
+                 fusion_threshold_bytes: Optional[int] = None) -> None:
         self._negotiator = negotiator
         self._world_id = world_id
+        # Steady-state negotiation bypass (docs/response-cache.md): the
+        # coordinator's mirror of every rank's ResponseCache. None when
+        # disabled — a cache-bit cycle arriving anyway is a configuration
+        # desync and fails loudly in _expand_cache_cycle.
+        from .response_cache import ResponseCache
+
+        self._cache = ResponseCache(cache_capacity) \
+            if cache_capacity > 0 else None
+        # Invalidations are DEFERRED to the next cycle's bookkeeping point:
+        # in-flight cache-bit requests were planned against the current
+        # mirror, and clearing it mid-flight would make their positions
+        # unresolvable. The flag is consumed inside _run_cycle, after
+        # expansion and autotune, so any caller timing is safe.
+        self._cache_bump_pending = False
+        # Fusion repacking stales cached fused layouts: track the live
+        # threshold so set_fusion_threshold only bumps the cache generation
+        # on a REAL change (the autotuner re-proposes unchanged thresholds
+        # whenever only the cycle time moved). Callers that skip the
+        # parameter get the Python negotiator's configured threshold; an
+        # opaque (native) negotiator leaves it None, and the first retune
+        # then bumps conservatively — a spurious one-miss invalidation
+        # beats replaying a stale layout.
+        if fusion_threshold_bytes is None:
+            fusion_threshold_bytes = getattr(
+                negotiator, "_fusion_threshold", None)
+        self._fusion_threshold = fusion_threshold_bytes
         self._stall_escalation = StallEscalation(
             stall_shutdown_s, warning_interval_s=stall_warning_s)
         self._cycles = _Rendezvous(size)
@@ -713,11 +743,94 @@ class ControllerService:
             counters[rank] = n + 1
             return n
 
-    def _run_cycle(self, slot: Dict[int, RequestList],
-                   key: Any = None) -> Preserialized:
+    def _expand_cache_cycle(self, slot: Dict[int, Any]):
+        """Classify one cycle's submissions (docs/response-cache.md).
+
+        Returns ``(expanded_slot, hit_positions)``: when EVERY rank sent
+        the SAME cache-bit set, ``expanded_slot`` is None and
+        ``hit_positions`` the sorted common positions (the bypass fires);
+        otherwise any ``CacheRequest`` is expanded back into the full
+        ``RequestList`` it stands for and normal negotiation runs."""
+        from .response_cache import positions_of
+
+        cache_sets: Dict[int, frozenset] = {}
+        for rank, rl in slot.items():
+            if not isinstance(rl, CacheRequest):
+                continue
+            if self._cache is None:
+                raise RuntimeError(
+                    f"rank {rank} sent a cache-bit cycle but the "
+                    f"coordinator's response cache is disabled; "
+                    f"HOROVOD_CACHE_CAPACITY must resolve identically on "
+                    f"every rank")
+            if rl.generation != self._cache.generation:
+                raise RuntimeError(
+                    f"response cache generation desync: rank {rank} sent "
+                    f"generation {rl.generation}, coordinator holds "
+                    f"{self._cache.generation}")
+            expected_bits = (self._cache.capacity + 7) // 8
+            if len(rl.bits) != expected_bits:
+                # The bitvector length IS the capacity: divergent
+                # HOROVOD_CACHE_CAPACITY values diverge eviction choices,
+                # and an all-hit cycle would then misreplay silently —
+                # refuse here, not only on the expand path.
+                raise RuntimeError(
+                    f"response cache capacity desync: rank {rank} sent a "
+                    f"{len(rl.bits)}-byte bitvector, coordinator expects "
+                    f"{expected_bits}; HOROVOD_CACHE_CAPACITY must resolve "
+                    f"identically on every rank")
+            cache_sets[rank] = frozenset(positions_of(rl.bits))
+        if len(cache_sets) == len(slot) and \
+                len(set(cache_sets.values())) == 1:
+            return None, sorted(next(iter(cache_sets.values())))
+        expanded = {
+            rank: (self._cache.expand(rank, sorted(cache_sets[rank]))
+                   if rank in cache_sets else rl)
+            for rank, rl in slot.items()}
+        return expanded, None
+
+    @staticmethod
+    def _requests_by_name(slot: Dict[int, RequestList]) -> Dict[str, Request]:
+        """Identity source for cache insertion: the union of the cycle's
+        requests, first-seen by rank order. Every tensor completing this
+        cycle has its size-th arrival IN this cycle, so its name is
+        present; for allreduce the identity is rank-invariant (negotiation
+        errors on divergence), so any rank's request serves."""
+        out: Dict[str, Any] = {}
         for rank in sorted(slot):
-            self._negotiator.add_request_list(slot[rank])
-        response_list = self._negotiator.construct_response_list()
+            for req in slot[rank].requests:
+                out.setdefault(req.tensor_name, req)
+        return out
+
+    def _run_cycle(self, slot: Dict[int, Any],
+                   key: Any = None) -> Preserialized:
+        slot, hit_positions = self._expand_cache_cycle(slot)
+        if hit_positions is not None:
+            # All-ranks cache hit: replay the cached fused responses —
+            # no table insertion, no response construction, no fusion
+            # planning. The negotiator is still cycled once with nothing
+            # added: it drains nothing and only runs its interval-gated
+            # stall check over the still-incomplete table (+ reports a
+            # latched shutdown) — a cache hit must never mask a dead rank.
+            response_list = ResponseList(
+                responses=[self._cache.response_at(p)
+                           for p in hit_positions])
+            tail = self._negotiator.construct_response_list()
+            if tail.responses:
+                # nothing was added this cycle, so nothing can have become
+                # ready; anything else means the mirror diverged — poison
+                # the rendezvous loudly rather than hang ranks on
+                # responses an ack cannot reference
+                raise RuntimeError(
+                    "response cache desync: negotiator produced responses "
+                    "on an all-hit cycle")
+            response_list.shutdown = tail.shutdown
+            response_list.stall_warnings = tail.stall_warnings
+            response_list.stall_check = getattr(tail, "stall_check", False)
+        else:
+            for rank in sorted(slot):
+                self._negotiator.add_request_list(slot[rank])
+            response_list = self._negotiator.construct_response_list()
         escalation = self._stall_escalation.check(
             response_list.stall_warnings,
             check_ran=getattr(response_list, "stall_check", False))
@@ -750,6 +863,33 @@ class ControllerService:
             t0 = self._cycle_t0.pop(key, None)
         active_us = (time.monotonic() - t0) * 1e6 if t0 is not None else None
         self._maybe_autotune(response_list, active_us)
+        ack = None
+        if self._cache is not None:
+            # Cache bookkeeping AFTER autotune: a threshold retune queues a
+            # generation bump, and responses fusion-planned before the bump
+            # must not be cached (ranks apply the same rule off the stamped
+            # generation, keeping the mirrors in lockstep).
+            unchanged = not self._cache_bump_pending
+            if self._cache_bump_pending:
+                self._cache_bump_pending = False
+                self._cache.bump()
+            if hit_positions is not None:
+                if escalation is None and not response_list.shutdown:
+                    if unchanged:
+                        self._cache.touch(hit_positions)
+                    ack = CacheHitAck(
+                        positions=hit_positions,
+                        generation=self._cache.generation,
+                        tuned_cycle_ms=response_list.tuned_cycle_ms,
+                        stall_warnings=response_list.stall_warnings,
+                        stall_check=response_list.stall_check)
+                # degraded hit (escalation / latched shutdown): ranks get
+                # the full materialized list; no insert — the batches are
+                # already cached and the world is ending
+            elif unchanged and not response_list.shutdown:
+                self._cache.insert_cycle(self._requests_by_name(slot),
+                                         response_list.responses)
+            response_list.cache_generation = self._cache.generation
         with self._lock:
             self._history[self._cycle_no] = response_list
             # History only needs to survive until the payload exchanges of
@@ -758,9 +898,10 @@ class ControllerService:
             if stale in self._history:
                 del self._history[stale]
             self._cycle_no += 1
-        # One frame serves every rank (identical ResponseList by
+        # One frame serves every rank (identical ResponseList / ack by
         # construction — the property that makes lockstep execution legal).
-        return Preserialized(self._service.wire.frame(response_list))
+        return Preserialized(self._service.wire.frame(
+            ack if ack is not None else response_list))
 
     def _maybe_autotune(self, response_list: ResponseList,
                         active_us: Optional[float] = None) -> None:
@@ -773,9 +914,26 @@ class ControllerService:
                                               active_us=active_us)
         if tuned is not None:
             threshold, cycle_ms = tuned
-            self._negotiator.set_fusion_threshold(threshold)
+            self.set_fusion_threshold(threshold)
             self._tuned_cycle_ms = cycle_ms
         response_list.tuned_cycle_ms = self._tuned_cycle_ms
+
+    def set_fusion_threshold(self, threshold_bytes: int) -> None:
+        """Apply a (re)tuned fusion threshold. Repacking changes which
+        fused batches exist, so every cached fused layout is stale: a REAL
+        change bumps the response-cache generation, which the next cycle
+        response (list or ack) carries to every rank — they clear, miss
+        once, and renegotiate under the new packing. Without the bump a
+        warm cache would replay the old layout forever and the knob change
+        would silently never take effect (docs/response-cache.md). The
+        bump is deferred to the next cycle's bookkeeping point (see
+        ``_cache_bump_pending``); the new threshold itself applies to the
+        negotiator immediately."""
+        self._negotiator.set_fusion_threshold(threshold_bytes)
+        if self._cache is not None and \
+                self._fusion_threshold != threshold_bytes:
+            self._cache_bump_pending = True
+        self._fusion_threshold = threshold_bytes
 
     def shutdown(self) -> None:
         self._watch_event.set()  # release parked watchers with a clean stop
@@ -984,6 +1142,12 @@ class ControllerClient:
         self._cycle_no = 0
         self._rank = rank
         self._world_id = world_id
+        # cumulative + last-cycle negotiation wire bytes (cycle() only;
+        # payload exchanges excluded) — see utils/timeline.py counters
+        self.negotiation_tx_bytes = 0
+        self.negotiation_rx_bytes = 0
+        self.last_cycle_tx_bytes = 0
+        self.last_cycle_rx_bytes = 0
         # Generous connect window: ranks race the coordinator's service
         # startup (JAX import time dominates), like orted waiting on the
         # reference's driver registration (``util/timeout.py``). Identify
@@ -998,13 +1162,27 @@ class ControllerClient:
                 addr, secret, timeout_s, connect_attempts,
                 hello=lambda c: c.request(("hello", rank, world_id)))
 
-    def cycle(self, rank: int, request_list: RequestList) -> ResponseList:
+    def cycle(self, rank: int, request_list) -> Any:
+        """One negotiation round trip. ``request_list`` is a RequestList
+        or, on the steady-state bypass, a ``messages.CacheRequest``; the
+        answer is a ResponseList or a ``messages.CacheHitAck``
+        (docs/response-cache.md)."""
         # The controller registers this connection under ``rank`` for
         # failure detection; remember it so close() can detach cleanly even
         # when the caller did not pass rank= at construction.
         if self._rank is None:
             self._rank = rank
+        # Negotiation-byte accounting: cycle() and payload() share one
+        # connection but run sequentially on the engine loop thread, so a
+        # delta bracketed around the request counts ONLY this cycle's
+        # metadata bytes (the number the response cache exists to shrink).
+        wire = self._client._wire
+        tx0, rx0 = wire.tx_bytes, wire.rx_bytes
         out = self._client.request(("cycle", rank, request_list))
+        self.last_cycle_tx_bytes = wire.tx_bytes - tx0
+        self.last_cycle_rx_bytes = wire.rx_bytes - rx0
+        self.negotiation_tx_bytes += self.last_cycle_tx_bytes
+        self.negotiation_rx_bytes += self.last_cycle_rx_bytes
         self._last_cycle = self._cycle_no
         self._cycle_no += 1
         return out

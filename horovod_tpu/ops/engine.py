@@ -368,7 +368,9 @@ class Engine:
                         world_id=world_id,
                         stall_shutdown_s=cfg.stall_shutdown_time_s,
                         stall_warning_s=cfg.stall_warning_time_s,
-                        listen_fd=listen_fd)
+                        listen_fd=listen_fd,
+                        cache_capacity=cfg.cache_capacity,
+                        fusion_threshold_bytes=cfg.fusion_threshold_bytes)
                 port = self._service.port
             # The launcher may advertise several controller addresses
             # (comma-separated: every NIC of the controller host); the
@@ -389,6 +391,32 @@ class Engine:
                    if use_native else {}))
 
         self._host_fallback_warned = set()
+
+        # Steady-state negotiation bypass (docs/response-cache.md): the
+        # rank-side response cache, mirrored by the coordinator. Python
+        # controller wire only — the native controller's fixed binary wire
+        # predates the cache-bit field, so it deterministically keeps the
+        # full-RequestList cycle on every rank (the same pattern PR 1
+        # applies to quantized codecs there). Size-1 worlds negotiate
+        # in-process; there is no metadata round trip to bypass.
+        self._response_cache = None
+        if self._client is not None and cfg.cache_capacity > 0:
+            if self._native_controller:
+                LOG.debug(
+                    "response cache disabled: the native controller wire "
+                    "predates the cache-bit field; set "
+                    "HOROVOD_NATIVE_CONTROLLER=0 to enable the "
+                    "steady-state negotiation bypass.")
+            else:
+                from .response_cache import ResponseCache
+
+                self._response_cache = ResponseCache(cfg.cache_capacity)
+        # The bypass arms only after the coordinator's first full response
+        # CONFIRMS it carries a cache (cache_generation is not None): the
+        # loop idles from init, and an unconfirmed cache-bit tick against
+        # a capacity-0 coordinator (env divergence) would abort the world
+        # where this handshake instead degrades deterministically.
+        self._cache_confirmed = False
 
         # XLA-plane failure propagation: a rank blocked inside a compiled
         # collective is beyond the reach of a poisoned control-plane
@@ -601,7 +629,8 @@ class Engine:
                     response_list = self._negotiator.construct_response_list()
                 else:
                     assert self._client is not None
-                    response_list = self._client.cycle(self._rank, request_list)
+                    response_list = self._cycle_with_cache(
+                        request_list, requests, stop)
                 for idx, resp in enumerate(response_list.responses):
                     self._execute(idx, resp)
                 # autotune: local worlds score here; multi-process worlds
@@ -681,6 +710,76 @@ class Engine:
                     "finalizer still completing at shutdown; leaving the "
                     "timeline writer open to avoid a write-after-free")
             self._stopped.set()
+
+    def _cycle_with_cache(self, request_list: RequestList,
+                          requests: List[Request], stop: bool):
+        """One controller round trip, through the steady-state bypass when
+        the whole cycle hits the response cache (docs/response-cache.md):
+        ship a fixed-size cache-bit vector instead of the RequestList and,
+        on an all-ranks hit, replay the cached fused responses from the
+        coordinator's compact ack. A shutdown cycle always takes the full
+        path — the drain negotiation must reach the coordinator as-is."""
+        from .messages import CacheHitAck, CacheRequest
+        from .response_cache import bits_of
+
+        cache = self._response_cache
+        positions = None
+        if cache is not None and self._cache_confirmed and not stop:
+            positions = cache.plan_cycle(requests)
+        if positions is not None:
+            out = self._client.cycle(self._rank, CacheRequest(
+                rank=self._rank, bits=bits_of(positions, cache.capacity),
+                generation=cache.generation))
+        else:
+            out = self._client.cycle(self._rank, request_list)
+        if isinstance(out, CacheHitAck):
+            response_list = ResponseList(
+                responses=cache.accept_ack(out),
+                tuned_cycle_ms=out.tuned_cycle_ms,
+                stall_warnings=out.stall_warnings,
+                stall_check=out.stall_check)
+        else:
+            response_list = out
+            if cache is not None:
+                if getattr(response_list, "cache_generation", None) is None:
+                    # The coordinator runs without a cache (capacity knob
+                    # diverged, or a pre-cache service): planning bypasses
+                    # against it could only fail loudly later — disable.
+                    LOG.warning(
+                        "coordinator carries no response-cache generation; "
+                        "disabling the rank-side cache "
+                        "(HOROVOD_CACHE_CAPACITY should resolve "
+                        "identically on every rank).")
+                    self._response_cache = None
+                else:
+                    self._cache_confirmed = True
+                    with self._lock:
+                        in_flight = {name: self._request_of(e)
+                                     for name, e in self._pending.items()}
+                    cache.accept_response_list(response_list, in_flight)
+        self._emit_cache_counters()
+        return response_list
+
+    def _emit_cache_counters(self) -> None:
+        """Per-cycle bypass observability on the rank-0 timeline: hit/miss
+        cycle totals and this cycle's negotiation wire bytes, as a Chrome
+        counter track (satellite of docs/response-cache.md)."""
+        cache = self._response_cache
+        if cache is None or not self.timeline.enabled:
+            return
+        self.timeline.counter("response_cache", {
+            "hit_cycles": cache.hit_cycles,
+            "miss_cycles": cache.miss_cycles,
+            "negotiation_tx_bytes": self._client.last_cycle_tx_bytes,
+            "negotiation_rx_bytes": self._client.last_cycle_rx_bytes,
+        })
+
+    def cache_stats(self) -> Dict[str, int]:
+        """Rank-side response-cache counters (zeros when disabled)."""
+        if self._response_cache is None:
+            return {"entries": 0, "capacity": 0, "generation": 0,
+                    "hit_cycles": 0, "miss_cycles": 0}
+        return self._response_cache.stats()
 
     def _request_of(self, entry: TensorTableEntry) -> Request:
         return Request(
@@ -958,7 +1057,9 @@ def start_subset_service(subset_ranks) -> None:
             autotuner=autotuner, world_id=world_id,
             stall_shutdown_s=cfg.stall_shutdown_time_s,
             stall_warning_s=cfg.stall_warning_time_s,
-            listen_fd=listen_fd)
+            listen_fd=listen_fd,
+            cache_capacity=cfg.cache_capacity,
+            fusion_threshold_bytes=cfg.fusion_threshold_bytes)
 
     def _teardown() -> None:
         # Grace period: the host's own shutdown (often atexit) must not

@@ -151,3 +151,43 @@ class ResponseList:
     # falls back to warning-cadence pruning.
     stall_check: bool = False
     abort_reason: Optional[str] = None
+    # Response-cache generation on the coordinator when this list was
+    # finalized (docs/response-cache.md). A rank holding a DIFFERENT
+    # generation clears its cache, adopts this one, and skips caching this
+    # list's responses (they were fusion-planned before the bump). None
+    # means the coordinator has no cache at all (capacity 0, or the native
+    # controller wire, which predates the field) — ranks then disable
+    # their caches rather than bypass against a coordinator that cannot
+    # expand a cache-bit cycle.
+    cache_generation: Optional[int] = None
+
+
+@dataclass
+class CacheRequest:
+    """A rank's ENTIRE cycle submission when every locally-enqueued request
+    hits its response cache: a fixed-size bitvector of cache positions
+    instead of the full ``RequestList`` (upstream's cache-bit design;
+    docs/response-cache.md). ``generation`` pins the cache state the bits
+    were computed against — the coordinator refuses bits from another
+    generation as a desync rather than misinterpreting positions."""
+
+    rank: int
+    bits: bytes
+    generation: int
+
+
+@dataclass
+class CacheHitAck:
+    """Coordinator's compact answer when EVERY rank's cycle was the same
+    cache-bit set: replay the cached fused responses at ``positions`` (in
+    listed order — identical on every rank, which keeps lockstep execution
+    legal exactly like a broadcast ResponseList). Carries everything the
+    full list would have piggybacked: the autotuner's cycle time, and the
+    stall-check output — a cache hit must never mask a dead rank, so the
+    ``StallEscalation`` inputs keep flowing at full cadence."""
+
+    positions: List[int] = field(default_factory=list)
+    generation: int = 0
+    tuned_cycle_ms: Optional[float] = None
+    stall_warnings: List[str] = field(default_factory=list)
+    stall_check: bool = False

@@ -142,9 +142,14 @@ def decode_cycle_response(body: bytes,
         stalls.append(warning)
         if log_stalls:
             LOG.warning("%s", warning)
+    # cache_generation stays None: the C++ service's binary wire predates
+    # the response-cache field, so the steady-state bypass
+    # (docs/response-cache.md) is disabled against it — the engine sees
+    # None and never plans a cache-bit cycle (the deterministic
+    # full-precision fallback pattern this wire already applies to codecs).
     return ResponseList(responses=responses, shutdown=shutdown,
                         tuned_cycle_ms=tuned_ms if has_tuned else None,
-                        stall_warnings=stalls)
+                        stall_warnings=stalls, cache_generation=None)
 
 
 def decode_payload_response(body: bytes) -> bytes:

@@ -1,0 +1,319 @@
+"""Steady-state negotiation bypass: a capacity-bounded response cache.
+
+Upstream Horovod's answer to the coordinator metadata cycle being the
+latency floor of the design (the 1802.05799 paper's own observation; the
+MVAPICH characterization in 1810.11112 measures negotiation/launch overhead
+dominating small-tensor allreduce): once training reaches steady state,
+every rank submits the *same* tensor set in the *same* order every step, so
+re-shipping the full ``RequestList``/``ResponseList`` and re-running table
+insertion + fusion planning every cycle is pure overhead. Upstream grew a
+``response_cache.cc`` keyed by a per-cycle bitvector; this module is that
+design on our TCP control plane.
+
+One class serves both roles:
+
+* **rank side** (the engine): ``plan_cycle`` decides whether the cycle's
+  submissions are fully covered by cached fused responses — if so the rank
+  ships a fixed-size cache-bit vector (``messages.CacheRequest``) instead of
+  its ``RequestList``; ``accept_ack`` replays the cached fused responses a
+  ``messages.CacheHitAck`` references by position.
+* **coordinator side** (the ``ControllerService``): a mirror of the same
+  cache expands cache-bit cycles back into requests when any rank missed,
+  and materializes the effective ``ResponseList`` (for the payload exchange
+  and autotuner) when every rank hit.
+
+Coherence is by construction, not by synchronization: every state
+transition derives ONLY from data that is identical on all ranks — the
+broadcast ``ResponseList`` (insert/touch in response order) and the
+``CacheHitAck`` (touch in position order). Lookups never touch LRU state
+(a rank-local touch would diverge: ranks submit the same tensor in
+different cycles around a partial step). With identical transition streams,
+insert order, LRU order, and eviction choices — and therefore bit
+POSITIONS — stay identical everywhere, which is what makes the bitvector
+meaningful without ever shipping cache contents.
+
+Invalidation is generation-stamped: the coordinator owns an integer
+generation seeded from the elastic world epoch
+(``HOROVOD_ELASTIC_EPOCH`` — a relaunched world can never validate against
+a predecessor's cache state) and bumps it on any event that stales cached
+FUSED LAYOUTS (the autotuner moving ``HOROVOD_FUSION_THRESHOLD`` is the
+one that bites: repacking changes which batches exist). The new generation
+rides the next cycle response (list or ack); a rank seeing a generation it
+does not hold clears its cache, adopts, and skips inserting from that
+response (it was planned pre-bump). Codec switches (``HOROVOD_COMPRESSION``)
+and shape/dtype changes need no generation: the codec and shape are part of
+the request identity, so they simply miss.
+
+Only ALLREDUCE responses are cached: their request identity is equal on
+every rank (the negotiator errors on dtype/shape/codec divergence), so one
+coordinator mirror can reconstruct any rank's requests. Allgather's ragged
+first dim and broadcast's root-relative shapes are per-rank — they take the
+full path, which steady-state training does not care about (the hot loop is
+gradient allreduce).
+
+``HOROVOD_CACHE_CAPACITY`` (default 1024) bounds entries; ``0`` disables
+the bypass entirely. See docs/response-cache.md.
+"""
+
+from __future__ import annotations
+
+import heapq
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .messages import (
+    DataType,
+    Request,
+    RequestList,
+    RequestType,
+    Response,
+    ResponseList,
+    ResponseType,
+)
+
+# A generation namespace per elastic world epoch: epochs are small ints
+# (restart counts), generations bump at autotune cadence — 2^32 bumps per
+# epoch is unreachable, so stamped generations never collide across epochs.
+_EPOCH_STRIDE = 1 << 32
+
+
+def _default_epoch() -> int:
+    import os
+
+    from ..core import config as _config
+
+    return int(os.environ.get(_config.HOROVOD_ELASTIC_EPOCH, "0"))
+
+
+def request_identity(req: Request) -> Tuple:
+    """The full identity a cached response is keyed by: name, op, dtype,
+    shape (which fixes payload bytes), codec. ``request_rank`` is excluded
+    — allreduce identities are rank-invariant by negotiation contract."""
+    return (req.tensor_name, int(req.request_type), int(req.tensor_type),
+            tuple(req.tensor_shape), getattr(req, "codec", "none"))
+
+
+def bits_of(positions: List[int], capacity: int) -> bytes:
+    """Fixed-size bitvector (``capacity`` bits) with ``positions`` set —
+    the whole per-cycle negotiation payload of a steady-state rank."""
+    out = bytearray((capacity + 7) // 8)
+    for pos in positions:
+        out[pos >> 3] |= 1 << (pos & 7)
+    return bytes(out)
+
+
+def positions_of(bits: bytes) -> List[int]:
+    out: List[int] = []
+    for byte_idx, byte in enumerate(bits):
+        while byte:
+            low = byte & -byte
+            out.append((byte_idx << 3) + low.bit_length() - 1)
+            byte &= byte - 1
+    return out
+
+
+@dataclass
+class _Entry:
+    """One cached FUSED response: the ordered identities it covers (one per
+    tensor in ``response.tensor_names``) plus the replayable Response."""
+
+    identities: Tuple[Tuple, ...]
+    response: Response = field(repr=False)
+
+
+class ResponseCache:
+    """Deterministic capacity-bounded LRU over fused allreduce responses.
+
+    Not thread-safe by itself: the engine drives its copy from the
+    background-loop thread only, the service from inside the cycle
+    rendezvous' single compute call.
+    """
+
+    def __init__(self, capacity: int, epoch: Optional[int] = None) -> None:
+        self.capacity = max(int(capacity), 0)
+        if epoch is None:
+            epoch = _default_epoch()
+        self.generation = epoch * _EPOCH_STRIDE
+        # position -> entry, in LRU order (first = least recently used)
+        self._entries: "OrderedDict[int, _Entry]" = OrderedDict()
+        self._by_identity: Dict[Tuple, int] = {}
+        self._by_batch: Dict[Tuple, int] = {}
+        self._free: List[int] = []  # heap of reusable position slots
+        self._next_pos = 0
+        # observability (rank side): cycles bypassed vs negotiated
+        self.hit_cycles = 0
+        self.miss_cycles = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    # -- rank side ------------------------------------------------------------
+
+    def plan_cycle(self, requests: List[Request]) -> Optional[List[int]]:
+        """Positions (sorted) whose cached batches EXACTLY cover this
+        cycle's submissions, or None when any request misses or covers a
+        batch only partially (a partial batch cannot replay: the fused
+        response names tensors this cycle did not submit). An empty
+        submission list is a (trivial) hit — idle ticks ride the bitvector
+        too. Read-only: never touches LRU state (see module docstring)."""
+        if not self.enabled:
+            return None
+        covered: Dict[int, int] = {}
+        for req in requests:
+            pos = self._by_identity.get(request_identity(req))
+            if pos is None:
+                return None
+            covered[pos] = covered.get(pos, 0) + 1
+        for pos, count in covered.items():
+            if count != len(self._entries[pos].identities):
+                return None
+        return sorted(covered)
+
+    def accept_ack(self, ack) -> List[Response]:
+        """Replay the fused responses an all-ranks-hit ack references, then
+        apply its LRU touch — or, when the ack carries a NEW generation
+        (the coordinator invalidated mid-cycle), clear instead: the replay
+        itself is still valid (it was planned under the generation this
+        rank sent), but nothing after it may hit the stale state."""
+        responses = [self.response_at(pos) for pos in ack.positions]
+        if ack.generation == self.generation:
+            self.touch(ack.positions)
+        else:
+            self.clear(ack.generation)
+        self.hit_cycles += 1
+        return responses
+
+    def accept_response_list(self, response_list: ResponseList,
+                             requests_by_name: Dict[str, Request]) -> None:
+        """Apply a full negotiated cycle: adopt a bumped generation (clear,
+        skip insertion — those responses were planned pre-bump) or insert/
+        touch the cycle's cacheable responses. ``requests_by_name`` supplies
+        the identities (the rank's own in-flight requests; the coordinator
+        passes the union of the cycle's expanded request lists — equal for
+        allreduce by negotiation contract)."""
+        if not self.enabled:
+            return
+        generation = getattr(response_list, "cache_generation", None)
+        if generation is None:
+            # Pre-cache coordinator (native controller wire, or a service
+            # built without a cache): nothing to stay coherent WITH. The
+            # engine disables its cache when it sees this.
+            return
+        self.miss_cycles += 1
+        if generation != self.generation:
+            self.clear(generation)
+            return
+        if response_list.shutdown:
+            return  # the world is over; keep state untouched for waiters
+        self.insert_cycle(requests_by_name, response_list.responses)
+
+    # -- coordinator side -----------------------------------------------------
+
+    def expand(self, rank: int, positions: List[int]) -> RequestList:
+        """Reconstruct the RequestList a cache-bit cycle stands for (the
+        miss/partial path: some OTHER rank missed, so this rank's compact
+        submission must re-enter normal negotiation)."""
+        requests: List[Request] = []
+        for pos in sorted(positions):
+            entry = self._entries.get(pos)
+            if entry is None:
+                raise RuntimeError(
+                    f"response cache desync: rank {rank} referenced cache "
+                    f"position {pos} the coordinator does not hold; "
+                    f"HOROVOD_CACHE_CAPACITY must be identical on every "
+                    f"rank")
+            for name, rtype, dtype, shape, codec in entry.identities:
+                requests.append(Request(
+                    request_rank=rank, request_type=RequestType(rtype),
+                    tensor_name=name, tensor_type=DataType(dtype),
+                    tensor_shape=shape, codec=codec))
+        return RequestList(rank=rank, requests=requests)
+
+    def response_at(self, position: int) -> Response:
+        entry = self._entries.get(position)
+        if entry is None:
+            raise RuntimeError(
+                f"response cache desync: no entry at position {position}")
+        return entry.response
+
+    # -- shared state transitions (identical stream on every rank) -----------
+
+    def touch(self, positions: List[int]) -> None:
+        for pos in sorted(positions):
+            self._entries.move_to_end(pos)
+
+    def clear(self, generation: int) -> None:
+        self._entries.clear()
+        self._by_identity.clear()
+        self._by_batch.clear()
+        self._free = []
+        self._next_pos = 0
+        self.generation = generation
+
+    def bump(self) -> None:
+        """Invalidate everything under a fresh generation (fusion knob
+        moved, membership changed): coordinator-side; ranks follow via the
+        generation stamped on the next cycle response."""
+        self.clear(self.generation + 1)
+
+    def insert_cycle(self, requests_by_name: Dict[str, Request],
+                     responses: List[Response]) -> None:
+        """Insert/touch this cycle's cacheable responses, in response
+        order. Non-allreduce and ERROR responses, and responses naming a
+        tensor without a known request (an escalation-injected error names
+        tensors only SOME ranks submitted), are skipped — identically
+        everywhere, since the skip conditions read only shared data."""
+        if not self.enabled:
+            return
+        for resp in responses:
+            if resp.response_type != ResponseType.ALLREDUCE:
+                continue
+            identities = []
+            for name in resp.tensor_names:
+                req = requests_by_name.get(name)
+                if req is None:
+                    identities = None
+                    break
+                identities.append(request_identity(req))
+            if not identities:
+                continue
+            self._put(tuple(identities), resp)
+
+    def _put(self, batch_key: Tuple[Tuple, ...], response: Response) -> None:
+        pos = self._by_batch.get(batch_key)
+        if pos is not None:
+            # Re-negotiated identical batch: refresh the replayed object
+            # and touch — no new slot, no eviction.
+            self._entries[pos].response = response
+            self._entries.move_to_end(pos)
+            return
+        while len(self._entries) >= self.capacity:
+            evicted_pos, evicted = self._entries.popitem(last=False)
+            for ident in evicted.identities:
+                if self._by_identity.get(ident) == evicted_pos:
+                    del self._by_identity[ident]
+            self._by_batch.pop(evicted.identities, None)
+            heapq.heappush(self._free, evicted_pos)
+        if self._free:
+            pos = heapq.heappop(self._free)
+        else:
+            pos = self._next_pos
+            self._next_pos += 1
+        self._entries[pos] = _Entry(identities=batch_key, response=response)
+        self._by_batch[batch_key] = pos
+        for ident in batch_key:
+            # Remap: an identity that lived in an older (differently fused)
+            # batch now resolves here; the old entry can no longer be fully
+            # covered and ages out through the LRU.
+            self._by_identity[ident] = pos
+
+    def stats(self) -> Dict[str, int]:
+        return {"entries": len(self._entries), "capacity": self.capacity,
+                "generation": self.generation,
+                "hit_cycles": self.hit_cycles,
+                "miss_cycles": self.miss_cycles}

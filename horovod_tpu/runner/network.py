@@ -98,6 +98,12 @@ class Wire:
 
     def __init__(self, secret: Optional[bytes] = None) -> None:
         self._secret = secret if secret is not None else default_secret()
+        # Cumulative framed bytes through this wire, for control-plane
+        # observability (the response-cache bypass is sized by exactly
+        # these counters; see ControllerClient.negotiation_bytes). Plain
+        # ints under the GIL — callers read deltas, not exact snapshots.
+        self.tx_bytes = 0
+        self.rx_bytes = 0
 
     def frame(self, obj: Any) -> bytes:
         return self.frame_raw(
@@ -119,13 +125,17 @@ class Wire:
         expected = hmac.new(self._secret, body, hashlib.sha256).digest()
         if not hmac.compare_digest(digest, expected):
             raise WireError("message HMAC mismatch (wrong or missing secret)")
+        self.rx_bytes += _DIGEST_BYTES + _LEN.size + length
         return body
 
     def write(self, obj: Any, sock: socket.socket) -> None:
         if isinstance(obj, Preserialized):
+            self.tx_bytes += len(obj.payload)
             sock.sendall(obj.payload)
             return
-        sock.sendall(self.frame(obj))
+        data = self.frame(obj)
+        self.tx_bytes += len(data)
+        sock.sendall(data)
 
     def read(self, sock: socket.socket) -> Any:
         header = _read_exact(sock, _DIGEST_BYTES + _LEN.size)
@@ -134,6 +144,7 @@ class Wire:
         expected = hmac.new(self._secret, body, hashlib.sha256).digest()
         if not hmac.compare_digest(digest, expected):
             raise WireError("message HMAC mismatch (wrong or missing secret)")
+        self.rx_bytes += _DIGEST_BYTES + _LEN.size + length
         try:
             return pickle.loads(body)
         except Exception as exc:  # noqa: BLE001 - diagnose, then fail

@@ -127,6 +127,16 @@ class Timeline:
             self._emit({"name": CYCLE_NAME, "ph": "i", "pid": 0, "tid": 0,
                         "ts": self._ts_us(), "s": "g"})
 
+    def counter(self, name: str, values: dict) -> None:
+        """Chrome-tracing counter track (ph "C"): numeric series rendered
+        as stacked area charts. The engine emits one per negotiation cycle
+        for the response-cache bypass — hit/miss cycle totals and
+        per-cycle negotiation wire bytes — so a bypass regression shows in
+        the trace instead of silently re-inflating the control plane
+        (docs/response-cache.md)."""
+        self._emit({"name": name, "ph": "C", "pid": 0, "tid": 0,
+                    "ts": self._ts_us(), "args": dict(values)})
+
     # -- writer ---------------------------------------------------------------
 
     def _write_loop(self) -> None:

@@ -375,6 +375,90 @@ def main() -> None:
         for r in range(size):
             np.testing.assert_array_equal(gathered[r], gathered[0])
 
+    elif scenario == "cache_steady":
+        # Steady-state negotiation bypass (docs/response-cache.md): the
+        # same tensor set every step must turn into cache-bit cycles after
+        # the first negotiated step, with BIT-EXACT results either way.
+        # The parent runs this scenario with the cache on and with
+        # HOROVOD_CACHE_CAPACITY=0 and compares the CACHE-HASH lines.
+        import hashlib
+
+        from horovod_tpu.ops.engine import get_engine
+
+        digest = hashlib.sha256()
+        steps, n_tensors = 12, 5
+        for step in range(steps):
+            handles = [hvd.allreduce_async(
+                np.full((64,), float(rank * 17 + i) + 0.37 * i, np.float32),
+                average=False, name=f"cs.{i}") for i in range(n_tensors)]
+            for i, h in enumerate(handles):
+                out = np.asarray(hvd.synchronize(h))
+                # float32 accumulation in rank order — exactly the
+                # coordinator's host combine, so equality is bitwise
+                acc = np.zeros((64,), np.float32)
+                for r in range(size):
+                    acc = acc + np.full(
+                        (64,), float(r * 17 + i) + 0.37 * i, np.float32)
+                np.testing.assert_array_equal(out, acc)
+                digest.update(out.tobytes())
+        stats = get_engine().cache_stats()
+        if int(os.environ.get("HOROVOD_CACHE_CAPACITY", "1") or 0) > 0:
+            # idle ticks also ride the bitvector, so hit_cycles alone is
+            # weak; miss_cycles < steps is the real claim — at least one
+            # whole STEP negotiated through the bypass
+            assert stats["miss_cycles"] >= 1, stats
+            assert stats["miss_cycles"] < steps, stats
+            assert stats["hit_cycles"] > 0, stats
+            assert stats["entries"] >= 1, stats
+        else:
+            assert stats["capacity"] == 0, stats
+            assert stats["hit_cycles"] == 0 == stats["miss_cycles"], stats
+        print(f"CACHE-HASH {digest.hexdigest()}", flush=True)
+
+    elif scenario == "cache_stall":
+        # Acceptance: a stall injected DURING an all-hit steady state must
+        # still escalate to RanksAbortedError within
+        # HOROVOD_STALL_SHUTDOWN_TIME_S — the bypass keeps the
+        # coordinator's stall check and escalation deadline running (a
+        # cache hit must never mask a dead rank). Parent env: warning 1s,
+        # shutdown 2s, cache on, Python controller.
+        import time
+
+        from horovod_tpu.ops.engine import get_engine
+
+        engine = get_engine()
+        for _ in range(3):  # build the warm steady state
+            hvd.allreduce(np.ones((16,), np.float32), average=False,
+                          name="cst.steady")
+        trap = None
+        if rank == 0:
+            # planted stall: rank 1 never submits this name
+            trap = hvd.allreduce_async(np.ones((4,), np.float32),
+                                       average=False, name="cst.trap")
+        t0 = time.monotonic()
+        aborted = False
+        try:
+            while time.monotonic() - t0 < 20.0:
+                hvd.allreduce(np.full((16,), 2.0, np.float32),
+                              average=False, name="cst.steady")
+                time.sleep(0.005)
+        except (hvd.RanksAbortedError, RuntimeError) as exc:
+            assert "shut down" in str(exc), exc
+            aborted = True
+        assert aborted, "stall never escalated during the warm steady state"
+        assert time.monotonic() - t0 < 15.0
+        stats = engine.cache_stats()
+        assert stats["hit_cycles"] > 0, (
+            "steady state never reached the bypass; this scenario would "
+            "not be testing stall-under-hit at all", stats)
+        if rank == 0:
+            try:
+                hvd.synchronize(trap)
+            except hvd.RanksAbortedError as exc:
+                assert exc.ranks == [1], exc.ranks
+            else:
+                raise AssertionError("trap handle did not carry the abort")
+
     elif scenario == "stall_abort":
         # Abort-instead-of-hang (HOROVOD_STALL_SHUTDOWN_TIME_S): rank 0
         # submits a tensor the other rank NEVER submits. The reference

@@ -335,6 +335,36 @@ def test_preflight_nonfatal_returns_none(monkeypatch):
     assert len(calls) == 2
 
 
+def test_preflight_hang_fails_fast(monkeypatch):
+    """A probe that HANGS (TimeoutExpired) means a wedged accelerator, not
+    a transient failure: the preflight must stop after the FIRST hang
+    instead of burning attempts x probe-timeout on identical hangs (the
+    round-5 bench log lost ~8 min to 4 x 120 s of them before reaching the
+    fallback line). Transient NON-ZERO exits keep the full retry budget —
+    pinned by test_preflight_nonfatal_returns_none above."""
+    import types  # noqa: F401 - parity with the sibling test's imports
+
+    sys.path.insert(0, _ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(_ROOT)
+
+    calls = []
+
+    def fake_run(argv, capture_output, text, timeout):
+        calls.append(argv)
+        raise bench.subprocess.TimeoutExpired(argv, timeout)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.delenv("HOROVOD_BENCH_PREFLIGHT", raising=False)
+    monkeypatch.setenv("HOROVOD_BENCH_PREFLIGHT_ATTEMPTS", "4")
+    monkeypatch.setenv("HOROVOD_BENCH_PROBE_TIMEOUT_S", "10")
+    assert bench._preflight_backend(fatal=False) is None
+    assert len(calls) == 1  # one hang, zero identical retries
+
+
 def test_lm_bench_end_to_end_cpu():
     """The Transformer-LM benchmark (second flagship workload) must run
     end to end on CPU for both attention backends and emit the JSON line

@@ -182,7 +182,11 @@ def _native_bench_median(size: int, cycles: int = 10) -> tuple:
     result = subprocess.run(
         [sys.executable, os.path.join(root, "benchmarks",
                                       "controller_bench.py"),
-         "--sizes", str(size), "--impl", "native", "--cycles", str(cycles)],
+         "--sizes", str(size), "--impl", "native", "--cycles", str(cycles),
+         # this test times the MAIN table only; the steady-state cache
+         # table has its own coverage (test_response_cache + the bench
+         # default) and would spend this subprocess's latency budget
+         "--steady-sizes", ""],
         cwd=root, capture_output=True, text=True, timeout=300)
     assert result.returncode == 0, result.stderr
     # a child-side native-core load failure prints "native skipped: ..."
@@ -385,7 +389,8 @@ def test_controller_bench_multiprocess_mode():
     result = subprocess.run(
         [sys.executable,
          os.path.join(root, "benchmarks", "controller_bench.py"),
-         "--sizes", "8", "--cycles", "6", "--procs", "2"],
+         "--sizes", "8", "--cycles", "6", "--procs", "2",
+         "--steady-sizes", ""],  # main-table path only, as above
         cwd=root, env=env, capture_output=True, text=True, timeout=300)
     assert result.returncode == 0, result.stderr
     rows = [ln for ln in result.stdout.splitlines()

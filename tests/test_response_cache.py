@@ -1,0 +1,465 @@
+"""Response-cache (steady-state negotiation bypass) tests.
+
+docs/response-cache.md: unit coverage of the deterministic LRU and its
+invalidation edges (capacity eviction, capacity-0 disable, codec-switch
+identity misses, elastic epoch stamping), live ControllerService coverage
+of the all-hit ack fast path on BOTH negotiation cores, the
+fusion-threshold-flip generation bump (autotuner interplay regression),
+and multi-process acceptance: bit-exact cached vs uncached allreduce,
+timeline counters for the bypass, and a stall injected during an all-hit
+steady state still escalating to RanksAbortedError.
+"""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.controller import (
+    ControllerClient,
+    ControllerService,
+    Negotiator,
+)
+from horovod_tpu.ops.messages import (
+    CacheHitAck,
+    CacheRequest,
+    DataType,
+    Request,
+    RequestList,
+    RequestType,
+    ResponseList,
+    ResponseType,
+    Response,
+)
+from horovod_tpu.ops.response_cache import (
+    ResponseCache,
+    bits_of,
+    positions_of,
+    request_identity,
+)
+
+SECRET = b"s" * 32
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "_mp_worker.py")
+
+
+def _req(name, shape=(8,), codec="none", rank=0):
+    return Request(request_rank=rank, request_type=RequestType.ALLREDUCE,
+                   tensor_name=name, tensor_type=DataType.FLOAT32,
+                   tensor_shape=tuple(shape), root_rank=-1, codec=codec)
+
+
+def _resp(*names):
+    return Response(ResponseType.ALLREDUCE, tensor_names=list(names),
+                    tensor_dtype=DataType.FLOAT32, payload_bytes=32)
+
+
+def _rl(responses, generation=0, shutdown=False):
+    return ResponseList(responses=responses, shutdown=shutdown,
+                        cache_generation=generation)
+
+
+# -- unit: deterministic LRU + invalidation edges -----------------------------
+
+def test_bitvector_roundtrip():
+    cap = 1024  # the default knob: a 128-byte wire payload
+    positions = [0, 7, 8, 63, 500, 1023]
+    bits = bits_of(positions, cap)
+    assert len(bits) == cap // 8
+    assert positions_of(bits) == positions
+    assert positions_of(bits_of([], cap)) == []
+
+
+def test_hit_requires_exact_batch_cover():
+    cache = ResponseCache(8, epoch=0)
+    cache.insert_cycle({"a": _req("a"), "b": _req("b")}, [_resp("a", "b")])
+    assert cache.plan_cycle([_req("a")]) is None  # partial batch: no replay
+    assert cache.plan_cycle([_req("a"), _req("b")]) == [0]
+    assert cache.plan_cycle([]) == []  # idle tick: trivially covered
+
+
+def test_identity_misses_on_shape_dtype_codec_change():
+    cache = ResponseCache(8, epoch=0)
+    cache.insert_cycle({"g": _req("g")}, [_resp("g")])
+    assert cache.plan_cycle([_req("g")]) == [0]
+    # HOROVOD_COMPRESSION switch: the codec is part of the identity, so the
+    # quantized resubmission MISSES (renegotiates) instead of replaying a
+    # full-precision program
+    assert cache.plan_cycle([_req("g", codec="int8")]) is None
+    assert cache.plan_cycle([_req("g", shape=(16,))]) is None
+
+
+def test_capacity_zero_disables_cleanly():
+    cache = ResponseCache(0)
+    assert not cache.enabled
+    cache.insert_cycle({"a": _req("a")}, [_resp("a")])
+    assert len(cache) == 0
+    assert cache.plan_cycle([_req("a")]) is None
+    cache.accept_response_list(_rl([_resp("a")]), {"a": _req("a")})
+    assert len(cache) == 0
+
+
+def test_lru_eviction_at_capacity():
+    cache = ResponseCache(2, epoch=0)
+    for name in ("a", "b", "c"):
+        cache.insert_cycle({name: _req(name)}, [_resp(name)])
+    assert len(cache) == 2
+    assert cache.plan_cycle([_req("a")]) is None  # oldest evicted
+    assert cache.plan_cycle([_req("b")]) is not None
+    assert cache.plan_cycle([_req("c")]) is not None
+    # "c" reused "a"'s slot: positions stay inside the fixed bitvector
+    assert all(p < 2 for p in cache.plan_cycle([_req("b"), _req("c")]))
+    # a touch (the ack path) refreshes recency: "b" survives the next insert
+    cache.touch(cache.plan_cycle([_req("b")]))
+    cache.insert_cycle({"d": _req("d")}, [_resp("d")])
+    assert cache.plan_cycle([_req("b")]) is not None
+    assert cache.plan_cycle([_req("c")]) is None
+
+
+def test_epoch_stamps_generation_namespace():
+    # An elastic relaunch (HOROVOD_ELASTIC_EPOCH bump) starts every cache
+    # in a fresh generation namespace: nothing stamped by epoch 0 can
+    # validate against epoch 1 state, however many autotune bumps happened.
+    g0 = ResponseCache(4, epoch=0).generation
+    g1 = ResponseCache(4, epoch=1).generation
+    assert g1 > g0
+    assert g1 - g0 == 1 << 32
+    stale = ResponseCache(4, epoch=0)
+    stale.insert_cycle({"a": _req("a")}, [_resp("a")])
+    ack = CacheHitAck(positions=[0], generation=g1)
+    stale.accept_ack(ack)  # replay still valid, then clear + adopt
+    assert stale.generation == g1 and len(stale) == 0
+
+
+def test_generation_mismatch_clears_and_skips_insert():
+    cache = ResponseCache(4, epoch=0)
+    cache.insert_cycle({"a": _req("a")}, [_resp("a")])
+    # a bumped-generation list clears and does NOT cache its (pre-bump
+    # planned) responses; the next matching list repopulates
+    cache.accept_response_list(_rl([_resp("b")], generation=7),
+                               {"b": _req("b")})
+    assert cache.generation == 7 and len(cache) == 0
+    cache.accept_response_list(_rl([_resp("b")], generation=7),
+                               {"b": _req("b")})
+    assert cache.plan_cycle([_req("b")]) is not None
+
+
+def test_shutdown_and_error_responses_never_cached():
+    cache = ResponseCache(4, epoch=0)
+    cache.accept_response_list(_rl([_resp("a")], shutdown=True),
+                               {"a": _req("a")})
+    assert len(cache) == 0
+    err = Response(ResponseType.ERROR, tensor_names=["x"],
+                   error_message="boom")
+    cache.insert_cycle({"x": _req("x")}, [err])
+    assert len(cache) == 0
+
+
+def test_refused_against_cacheless_coordinator():
+    # pre-cache coordinator (native wire / capacity 0 there): the stamped
+    # generation is None and the rank side must not keep planning bypasses
+    cache = ResponseCache(4, epoch=0)
+    cache.accept_response_list(ResponseList(responses=[_resp("a")]),
+                               {"a": _req("a")})
+    assert len(cache) == 0  # not inserted: nothing to stay coherent with
+
+
+# -- service level: the all-hit ack on both negotiation cores -----------------
+
+def _make_core(core, size, threshold=1 << 26):
+    if core == "python":
+        return Negotiator(size, threshold)
+    import horovod_tpu.cc as cc
+
+    if not cc.available():
+        pytest.skip(f"native core unavailable: {cc.load_error()}")
+    return cc.NativeNegotiator(size, threshold)
+
+
+def _drive_world(service, size, plans, capacity=16):
+    """Run ``len(plans)`` lockstep cycles from ``size`` threaded clients;
+    ``plans[c]`` is a callable (rank, cycle) -> list[Request]. Returns rank
+    0's per-cycle (kind, responses, rx_bytes) observations."""
+    observations = []
+    errors = []
+    barrier = threading.Barrier(size)
+
+    def worker(rank):
+        try:
+            client = ControllerClient(("127.0.0.1", service.port),
+                                      secret=SECRET, rank=rank)
+            cache = ResponseCache(capacity, epoch=0)
+            for cycle, plan in enumerate(plans):
+                requests = plan(rank, cycle)
+                positions = cache.plan_cycle(requests)
+                barrier.wait(timeout=60)
+                if positions is not None:
+                    out = client.cycle(rank, CacheRequest(
+                        rank=rank, bits=bits_of(positions, cache.capacity),
+                        generation=cache.generation))
+                else:
+                    out = client.cycle(rank, RequestList(rank=rank,
+                                                         requests=requests))
+                if isinstance(out, CacheHitAck):
+                    responses = cache.accept_ack(out)
+                    kind = "ack"
+                else:
+                    responses = out.responses
+                    cache.accept_response_list(
+                        out, {r.tensor_name: r for r in requests})
+                    kind = "list"
+                if rank == 0:
+                    observations.append(
+                        (kind, [list(r.tensor_names) for r in responses],
+                         client.last_cycle_rx_bytes
+                         + client.last_cycle_tx_bytes))
+            client.close()
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+            barrier.abort()
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads)
+    return observations
+
+
+@pytest.mark.parametrize("core", ["python", "native"])
+def test_all_hit_cycle_returns_compact_ack(core):
+    size = 2
+    service = ControllerService(size, _make_core(core, size), secret=SECRET,
+                                port=0, cache_capacity=16,
+                                fusion_threshold_bytes=1 << 26)
+    try:
+        steady = lambda rank, cycle: [_req(f"t{i}", rank=rank)  # noqa: E731
+                                      for i in range(4)]
+        obs = _drive_world(service, size, [steady] * 4)
+    finally:
+        service.shutdown()
+    kinds = [k for k, _, _ in obs]
+    assert kinds == ["list", "ack", "ack", "ack"], obs
+    # the replayed fused batch is the negotiated one, in the same order
+    assert obs[1][1] == obs[0][1]
+    # the compact ack + bitvector move strictly fewer bytes than the full
+    # RequestList/ResponseList round trip — the acceptance criterion
+    assert obs[1][2] < obs[0][2], obs
+    assert obs[2][2] == obs[1][2]
+
+
+@pytest.mark.parametrize("core", ["python", "native"])
+def test_fusion_threshold_flip_invalidates_mid_run(core):
+    """Autotuner interplay regression: set_fusion_threshold mid-run must
+    bump the cache generation so ranks renegotiate under the new packing —
+    a warm cache must NOT keep replaying the old fused layout."""
+    size = 2
+    tensor_bytes = 8 * 4  # f32[8]
+    service = ControllerService(size, _make_core(core, size),
+                                secret=SECRET, port=0, cache_capacity=16,
+                                fusion_threshold_bytes=1 << 26)
+    flipped = threading.Event()
+
+    def plan(rank, cycle):
+        if cycle == 3 and rank == 0 and not flipped.is_set():
+            flipped.set()
+            # mid-run knob change, between cycles (the autotuner's own
+            # calls land inside the cycle; both defer the bump safely)
+            service.set_fusion_threshold(tensor_bytes)  # forces splits
+        return [_req(f"t{i}", rank=rank) for i in range(4)]
+
+    try:
+        obs = _drive_world(service, size, [plan] * 7)
+    finally:
+        service.shutdown()
+    kinds = [k for k, _, _ in obs]
+    # warm-up: miss, ack, ack; the flip cycle may still ack (replaying the
+    # pre-flip layout one last time is consistent) but must carry the new
+    # generation → exactly one renegotiating miss, then acks again
+    assert kinds[:3] == ["list", "ack", "ack"], obs
+    assert "list" in kinds[3:5], obs
+    renegotiated = obs[kinds.index("list", 3)][1]
+    assert len(renegotiated) == 4, (
+        "threshold flip did not repack: still replaying the old fused "
+        "layout", obs)
+    assert kinds[-1] == "ack", obs  # and the NEW layout is cached again
+    assert obs[-1][1] == renegotiated
+
+
+def test_capacity_desync_refused_loudly():
+    # the bitvector length IS the capacity; a diverged knob must refuse on
+    # the ALL-HIT path too (eviction choices diverge → silent misreplay)
+    size = 1
+    service = ControllerService(size, Negotiator(size, 1 << 26),
+                                secret=SECRET, port=0, cache_capacity=16,
+                                fusion_threshold_bytes=1 << 26)
+    try:
+        client = ControllerClient(("127.0.0.1", service.port),
+                                  secret=SECRET, rank=0)
+        with pytest.raises(Exception, match="capacity desync"):
+            client.cycle(0, CacheRequest(rank=0, bits=bytes(4),
+                                         generation=0))
+        client.close()
+    finally:
+        service.shutdown()
+
+
+def test_cacheless_service_refuses_cache_bits_loudly():
+    size = 1
+    service = ControllerService(size, Negotiator(size, 1 << 26),
+                                secret=SECRET, port=0, cache_capacity=0)
+    try:
+        client = ControllerClient(("127.0.0.1", service.port),
+                                  secret=SECRET, rank=0)
+        with pytest.raises(Exception, match="HOROVOD_CACHE_CAPACITY"):
+            client.cycle(0, CacheRequest(rank=0, bits=b"", generation=0))
+        client.close()
+    finally:
+        service.shutdown()
+
+
+# -- multi-process acceptance -------------------------------------------------
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_cache_world(scenario, size, extra_env=None, timeout=90.0):
+    """Minimal _mp_worker harness (the full battery lives in
+    test_multiprocess; these are the cache acceptance runs)."""
+    port = _free_port()
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(size),
+            "HOROVOD_LOCAL_RANK": str(rank), "HOROVOD_LOCAL_SIZE": str(size),
+            "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
+            "HOROVOD_CONTROLLER_PORT": str(port),
+            "HOROVOD_DATA_PLANE": "host",
+            "HOROVOD_CYCLE_TIME": "2",
+            "HOROVOD_NATIVE_CONTROLLER": "0",  # the cache-bit wire
+        })
+        env.update(extra_env or {})
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER, scenario], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    for rank, proc in enumerate(procs):
+        try:
+            out, err = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail(f"rank {rank} timed out in {scenario!r}")
+        assert proc.returncode == 0, (
+            f"rank {rank} exited {proc.returncode} in {scenario!r}\n"
+            f"stdout:\n{out}\nstderr:\n{err}")
+        assert f"WORKER-OK {rank}" in out, (rank, out)
+        outs.append(out)
+    return outs
+
+
+def _cache_hashes(outs):
+    hashes = [re.search(r"CACHE-HASH (\w+)", out).group(1) for out in outs]
+    assert len(set(hashes)) == 1, hashes  # identical on every rank
+    return hashes[0]
+
+
+def test_mp_cached_bit_exact_vs_uncached(tmp_path):
+    """The acceptance criterion: cached and uncached runs produce
+    bit-identical allreduce results — plus the observability satellite:
+    the bypass shows up as timeline counters, not silently."""
+    timeline = str(tmp_path / "cache_timeline.json")
+    warm = _run_cache_world("cache_steady", 2,
+                            extra_env={"HOROVOD_TIMELINE": timeline})
+    cold = _run_cache_world("cache_steady", 2,
+                            extra_env={"HOROVOD_CACHE_CAPACITY": "0"})
+    assert _cache_hashes(warm) == _cache_hashes(cold)
+
+    counters = []
+    with open(timeline) as fh:
+        for line in fh:
+            if '"response_cache"' not in line:
+                continue
+            counters.append(json.loads(line.rstrip().rstrip(","))["args"])
+    assert counters, "bypass ran but emitted no timeline counters"
+    last = counters[-1]
+    assert last["hit_cycles"] > 0, last
+    assert last["miss_cycles"] >= 1, last
+    # negotiation bytes/cycle: an ack cycle must be visibly cheaper than a
+    # full negotiated cycle in the same trace
+    tx = [c["negotiation_tx_bytes"] for c in counters
+          if c["negotiation_tx_bytes"] > 0]
+    assert min(tx) < max(tx), counters[:5]
+
+
+def test_mp_stall_during_all_hit_steady_state_still_escalates():
+    """Acceptance: HOROVOD_STALL_SHUTDOWN_TIME_S keeps firing when every
+    cycle is a cache hit — the hit path still runs the coordinator's stall
+    check and ships its warnings, so PR 2's escalation converts the
+    planted stall into RanksAbortedError instead of a masked hang."""
+    _run_cache_world("cache_stall", 2, timeout=120.0, extra_env={
+        "HOROVOD_STALL_WARNING_TIME": "1",
+        "HOROVOD_STALL_SHUTDOWN_TIME_S": "2",
+    })
+
+
+# -- elastic interplay --------------------------------------------------------
+
+def _elastic_cache_fn(heal_epoch):
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.basics import world_epoch
+    from horovod_tpu.ops.engine import get_engine
+
+    hvd.init()
+    if world_epoch() < heal_epoch and hvd.rank() == 1:
+        os._exit(11)
+    for step in range(4):
+        out = hvd.allreduce(np.full((8,), 1.0, np.float32), average=False,
+                            name="ec.g")
+        np.testing.assert_array_equal(np.asarray(out), float(hvd.size()))
+    stats = get_engine().cache_stats()
+    hvd.shutdown()
+    return {"epoch": world_epoch(), "generation": stats["generation"],
+            "hits": stats["hit_cycles"]}
+
+
+def test_elastic_relaunch_epoch_invalidates():
+    """Invalidation edge: a relaunched world's caches live in the NEW
+    epoch's generation namespace (epoch << 32), so nothing stamped before
+    the crash can validate after it — and the relaunched steady state
+    still reaches the bypass."""
+    from horovod_tpu.runner import run_elastic
+
+    results = run_elastic(
+        _elastic_cache_fn, args=(1,), np=2, min_np=2, max_restarts=2,
+        backoff_s=0.1, timeout_s=120.0, start_timeout_s=120.0,
+        heartbeat_interval_s=0.5, heartbeat_miss_limit=6,
+        env_extra={"HOROVOD_NATIVE_CONTROLLER": "0",  # the cache-bit wire
+                   "HOROVOD_CYCLE_TIME": "2"})
+    assert len(results) == 2
+    for result in results:
+        assert result["epoch"] == 1, results
+        assert result["generation"] == 1 << 32, results  # epoch-stamped
+        assert result["hits"] > 0, results  # cache live after relaunch

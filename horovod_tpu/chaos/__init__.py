@@ -1,0 +1,325 @@
+"""Deterministic fault-injection plane for the TCP control plane.
+
+The coordinator protocol keeps every rank in lockstep (1802.05799 §3), which
+makes the control-plane wire a single point of fragility: any transport
+fault used to be terminal for the job. ``HOROVOD_CHAOS`` injects those
+faults ON PURPOSE, deterministically, so the self-healing machinery
+(``runner.network.BasicClient`` reconnect + request dedup, the controller's
+reconnect window, the stall escalation) can be proven to convert every one
+of them into recovery or a structured abort — never a hang
+(docs/chaos.md).
+
+Spec grammar (comma-separated clauses)::
+
+    HOROVOD_CHAOS="drop@rank1:msg12,delay@rank0:50ms:every7,seed:7"
+
+    clause   := kind "@" scope { ":" arg }    |  "seed" ":" INT
+    kind     := drop | delay | corrupt | close | refuse
+    scope    := "rank" INT   (that rank's controller client only)
+              | "all"        (every rank)
+              | "relaunch"   (refuse's ONLY scope: reconnect attempts,
+                              any rank — refuse@rankN/all are rejected,
+                              a spec must inject exactly what it says)
+    trigger  := "msg" INT    (the INT-th request round trip, once)
+              | "every" INT  (every INT-th request round trip)
+              | "p" FLOAT    (per-request probability, seeded RNG)
+    delay    := FLOAT "ms" | FLOAT "s"       (delay kind, first arg)
+    refuse   := INT                          (refusals per reconnect episode)
+
+Fault semantics, all at the frame boundary of the rank's controller client:
+
+* ``drop``    — the response frame is consumed and discarded
+                (``ConnectionClosedError``: a transport loss).
+* ``delay``   — the response frame is delayed; a delay at or past the
+                socket timeout raises ``socket.timeout`` WITHOUT consuming
+                the frame, leaving the stale bytes buffered — the exact
+                post-timeout desync hazard the client's broken-latch
+                exists for.
+* ``corrupt`` — one bit of the response body is flipped before HMAC
+                verification (``CorruptFrameError``).
+* ``close``   — the connection is closed instead of sending the request.
+* ``refuse``  — the first N reconnect attempts of each reconnect episode
+                fail at connect time (exercises the exponential backoff;
+                N larger than the retry budget forces escalation).
+
+Determinism: faults are keyed by (rank, request ordinal). The ordinal
+counts LOGICAL requests on the rank's controller client — retries of a
+faulted request do not advance it, so a replay under the same spec and the
+same request stream injects bit-identical faults. Probabilistic triggers
+draw from ``random.Random(seed ^ rank)`` exactly once per ordinal, so they
+replay too.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..core.config import HOROVOD_CHAOS
+
+
+class ChaosSpecError(ValueError):
+    """A malformed HOROVOD_CHAOS spec must fail LOUDLY at client
+    construction: a typo'd fault plan silently injecting nothing would
+    certify nothing."""
+
+
+@dataclass
+class FaultRule:
+    kind: str                      # drop | delay | corrupt | close | refuse
+    rank: Optional[int]            # None = any rank
+    ordinal: Optional[int] = None  # msgN trigger (fires once)
+    every: Optional[int] = None    # everyK trigger
+    prob: Optional[float] = None   # pF trigger
+    delay_s: float = 0.0           # delay kind only
+    refusals: int = 0              # refuse kind: budget per episode
+
+    def describe(self) -> str:
+        if self.kind == "refuse":  # relaunch is refuse's only scope
+            return f"refuse@relaunch:{self.refusals}"
+        scope = "all" if self.rank is None else f"rank{self.rank}"
+        trig = (f"msg{self.ordinal}" if self.ordinal is not None
+                else f"every{self.every}" if self.every is not None
+                else f"p{self.prob}")
+        return f"{self.kind}@{scope}:{trig}"
+
+
+@dataclass
+class ChaosPlan:
+    rules: List[FaultRule] = field(default_factory=list)
+    seed: int = 0
+    spec: str = ""
+
+
+def _parse_trigger(rule: FaultRule, tok: str, clause: str) -> None:
+    if tok.startswith("msg"):
+        rule.ordinal = int(tok[3:])
+        if rule.ordinal < 1:
+            raise ChaosSpecError(f"msg ordinal must be >= 1 in {clause!r}")
+    elif tok.startswith("every"):
+        rule.every = int(tok[5:])
+        if rule.every < 1:
+            raise ChaosSpecError(f"every period must be >= 1 in {clause!r}")
+    elif tok.startswith("p"):
+        rule.prob = float(tok[1:])
+        if not 0.0 <= rule.prob <= 1.0:
+            raise ChaosSpecError(f"probability out of [0,1] in {clause!r}")
+    else:
+        raise ChaosSpecError(
+            f"unknown trigger {tok!r} in {clause!r} (msgN/everyK/pF)")
+
+
+def parse_chaos_spec(spec: str) -> ChaosPlan:
+    """Parse a ``HOROVOD_CHAOS`` spec string; raises ``ChaosSpecError``
+    on any malformed clause."""
+    plan = ChaosPlan(spec=spec)
+    for clause in spec.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if clause.startswith("seed:"):
+            try:
+                plan.seed = int(clause[5:])
+            except ValueError as exc:
+                raise ChaosSpecError(f"bad seed in {clause!r}") from exc
+            continue
+        if "@" not in clause:
+            raise ChaosSpecError(
+                f"chaos clause {clause!r} is not kind@scope[:args]")
+        kind, rest = clause.split("@", 1)
+        toks = rest.split(":")
+        scope, args = toks[0], toks[1:]
+        if kind not in ("drop", "delay", "corrupt", "close", "refuse"):
+            raise ChaosSpecError(f"unknown fault kind {kind!r} in {clause!r}")
+        rule = FaultRule(kind=kind, rank=None)
+        if kind == "refuse":
+            # relaunch is refuse's ONLY scope: a rank/all-scoped refuse
+            # would parse as if it meant something narrower than it does
+            # (refusals hit whichever rank reconnects), and a spec must
+            # inject exactly what it says
+            if scope != "relaunch":
+                raise ChaosSpecError(
+                    f"refuse scope must be 'relaunch' in {clause!r}")
+        elif scope.startswith("rank"):
+            try:
+                rule.rank = int(scope[4:])
+            except ValueError as exc:
+                raise ChaosSpecError(f"bad rank in {clause!r}") from exc
+        elif scope == "all":
+            pass
+        else:
+            raise ChaosSpecError(
+                f"unknown scope {scope!r} in {clause!r} "
+                f"(rankN / all / relaunch-for-refuse)")
+        try:
+            if kind == "refuse":
+                if len(args) != 1:
+                    raise ChaosSpecError(
+                        f"refuse takes exactly one count arg in {clause!r}")
+                rule.refusals = int(args[0])
+                if rule.refusals < 1:
+                    raise ChaosSpecError(
+                        f"refuse count must be >= 1 in {clause!r}")
+            elif kind == "delay":
+                if not args:
+                    raise ChaosSpecError(
+                        f"delay needs a duration in {clause!r}")
+                dur = args[0]
+                if dur.endswith("ms"):
+                    rule.delay_s = float(dur[:-2]) / 1000.0
+                elif dur.endswith("s"):
+                    rule.delay_s = float(dur[:-1])
+                else:
+                    raise ChaosSpecError(
+                        f"delay duration needs ms/s suffix in {clause!r}")
+                if len(args) > 2:
+                    raise ChaosSpecError(f"too many args in {clause!r}")
+                _parse_trigger(rule, args[1] if len(args) > 1 else "every1",
+                               clause)
+            else:  # drop | corrupt | close
+                if len(args) != 1:
+                    raise ChaosSpecError(
+                        f"{kind} takes exactly one trigger arg in {clause!r}")
+                _parse_trigger(rule, args[0], clause)
+        except ChaosSpecError:
+            raise
+        except ValueError as exc:
+            raise ChaosSpecError(f"bad numeric arg in {clause!r}") from exc
+        plan.rules.append(rule)
+    return plan
+
+
+class ChaosInjector:
+    """Per-client fault injector; installed on a ``BasicClient``'s wire.
+
+    Hook protocol (all called by ``runner.network`` with the client lock
+    held, so no cross-thread state races for a given client):
+
+    * ``begin_request()``   — once per LOGICAL request; advances the
+      ordinal and arms this ordinal's faults (retries re-use the arming).
+    * ``on_connect(reconnecting)`` / ``on_connected()`` — refuse faults.
+    * ``on_send(sock)``     — close faults, before the request frame.
+    * ``on_recv_begin(sock)``       — delay faults, before the header read.
+    * ``on_recv_frame(body) -> body`` — drop / corrupt faults, after the
+      body read and before HMAC verification.
+
+    ``events`` records every fired fault as ``(kind, ordinal)`` — the
+    proof, in tests and the dryrun certification, that the plan actually
+    executed."""
+
+    def __init__(self, plan: ChaosPlan, rank: int) -> None:
+        self.plan = plan
+        self.rank = rank
+        self.ordinal = 0
+        self.events: List[Tuple[str, int]] = []
+        self._rules = [r for r in plan.rules
+                       if r.rank is None or r.rank == rank]
+        self._rng = random.Random(plan.seed ^ (rank + 1) * 0x9E3779B1)
+        self._armed: dict = {}
+        self._fired_once: set = set()
+        self._episode_refusals: dict = {}
+
+    def _fire(self, kind: str) -> Optional[FaultRule]:
+        """Consume this ordinal's armed fault of ``kind``, if any."""
+        rule = self._armed.pop(kind, None)
+        if rule is not None:
+            self.events.append((kind, self.ordinal))
+        return rule
+
+    # -- lifecycle hooks ------------------------------------------------------
+
+    def begin_request(self) -> None:
+        self.ordinal += 1
+        self._armed = {}
+        for rule in self._rules:
+            if rule.kind == "refuse":
+                continue  # connection-scoped, not ordinal-scoped
+            if rule.ordinal is not None:
+                hit = (rule.ordinal == self.ordinal
+                       and id(rule) not in self._fired_once)
+                if hit:
+                    self._fired_once.add(id(rule))
+            elif rule.every is not None:
+                hit = self.ordinal % rule.every == 0
+            else:
+                # exactly one draw per (rule, ordinal): replay-stable
+                hit = self._rng.random() < (rule.prob or 0.0)
+            if hit:
+                # one fault per kind per ordinal; first clause wins
+                self._armed.setdefault(rule.kind, rule)
+
+    def on_connect(self, reconnecting: bool) -> None:
+        if not reconnecting:
+            return  # the initial connect has its own retry machinery
+        for rule in self._rules:
+            if rule.kind != "refuse":
+                continue
+            used = self._episode_refusals.get(id(rule), 0)
+            if used < rule.refusals:
+                self._episode_refusals[id(rule)] = used + 1
+                self.events.append(("refuse", self.ordinal))
+                raise ConnectionRefusedError(
+                    f"chaos: reconnect refused ({rule.describe()}, "
+                    f"refusal {used + 1}/{rule.refusals})")
+
+    def on_connected(self) -> None:
+        self._episode_refusals.clear()  # next episode gets a fresh budget
+
+    def on_send(self, sock: socket.socket) -> None:
+        rule = self._fire("close")
+        if rule is None:
+            return
+        try:
+            sock.close()  # the peer sees a real EOF, not just our error
+        except OSError:
+            pass
+        raise OSError(f"chaos: connection closed before send "
+                      f"({rule.describe()} at msg {self.ordinal})")
+
+    def on_recv_begin(self, sock: socket.socket) -> None:
+        rule = self._fire("delay")
+        if rule is None:
+            return
+        timeout = sock.gettimeout()
+        if timeout is not None and rule.delay_s >= timeout:
+            # the frame stays BUFFERED: exactly the stale-response hazard
+            # the client's broken-latch must defuse
+            raise socket.timeout(
+                f"chaos: frame delayed {rule.delay_s:.3f}s past the "
+                f"{timeout:.3f}s socket timeout ({rule.describe()})")
+        time.sleep(rule.delay_s)
+
+    def on_recv_frame(self, body: bytes) -> bytes:
+        # drop preempts corrupt on a shared ordinal: a dropped frame never
+        # reaches HMAC verification, so firing corrupt first would record
+        # an event (and consume a msgN rule) for a fault that never ran —
+        # events must stay the proof the plan actually executed
+        rule = self._fire("drop")
+        if rule is not None:
+            from ..runner.network import ConnectionClosedError
+
+            raise ConnectionClosedError(
+                f"chaos: dropped response frame ({rule.describe()} at "
+                f"msg {self.ordinal})")
+        rule = self._fire("corrupt")
+        if rule is not None:
+            body = (bytes([body[0] ^ 0x01]) + body[1:]) if body else b"\x00"
+        return body
+
+
+def injector_from_env(rank: Optional[int] = None) -> Optional[ChaosInjector]:
+    """Build the injector for this process's ``HOROVOD_CHAOS`` spec, or
+    None when unset. ``rank`` defaults to ``HOROVOD_RANK``; rank-scoped
+    clauses not matching it are filtered out (the injector still exists,
+    carrying 'all'/'relaunch' clauses)."""
+    import os
+
+    spec = os.environ.get(HOROVOD_CHAOS, "")
+    if not spec:
+        return None
+    if rank is None:
+        rank = int(os.environ.get("HOROVOD_RANK", "-1"))
+    return ChaosInjector(parse_chaos_spec(spec), rank)

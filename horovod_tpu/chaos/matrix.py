@@ -1,0 +1,235 @@
+"""Chaos matrix cells: drive a small world under one fault spec and
+classify the outcome.
+
+Every cell's acceptance contract (ISSUE 4 / docs/chaos.md): a 2-process
+run under single-fault injection either completes with bit-exact results
+(``healed``) or surfaces a structured abort within the stall-shutdown
+deadline (``escalated``) — never a hang. ``tools/chaos_matrix.sh`` sweeps
+the fault grid under both controller implementations
+(``HOROVOD_NATIVE_CONTROLLER=0/1``) and both negotiation cores
+(``HOROVOD_NATIVE_CORE=0/1``); ``tests/test_chaos.py`` drives the same
+cells in-process.
+
+Run directly::
+
+    python -m horovod_tpu.chaos.matrix            # default single-fault grid
+    python -m horovod_tpu.chaos.matrix --spec "drop@rank1:every3"
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, List, Optional
+
+# One fault of each kind, aimed at rank 1's controller client. The msgN
+# ordinals land during warmup (negotiation cycles), the everyK clauses
+# keep firing through the warm steady state (cache-bit cycles on the
+# Python controller) — both boundaries of the acceptance matrix.
+DEFAULT_SPECS = [
+    "drop@rank1:msg6,drop@rank1:every9",
+    "delay@rank1:40ms:every5",
+    "corrupt@rank1:msg7,corrupt@rank1:every11",
+    "close@rank1:msg8,refuse@relaunch:1",
+]
+
+# A fault budget no reconnect can satisfy: the rank must escalate into a
+# structured abort, and its healthy peer must see RanksAbortedError.
+ESCALATION_SPEC = "close@rank1:msg6,refuse@relaunch:999"
+
+
+def _matrix_fn(steps: int, expect_escalation: bool):
+    """Per-rank body (shipped by value through runner.run's driver)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops.engine import get_engine
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    try:
+        for step in range(steps):
+            for i in range(2):
+                out = hvd.allreduce(
+                    np.full((16,), float(rank + i + 1), np.float32),
+                    average=False, name=f"chaos.m.{i}")
+                # bit-exact-or-escalate: small integers sum exactly in
+                # float32, so equality IS the fault-free result
+                np.testing.assert_array_equal(
+                    np.asarray(out),
+                    float(sum(r + i + 1 for r in range(size))))
+    except hvd.RanksAbortedError as exc:
+        # timeliness is judged by the DRIVER (run_cell's deadline_s →
+        # late-escalation), not here — a worker-side assert would turn a
+        # slow escalation into an AssertionError and hide the real label
+        assert expect_escalation, f"unexpected escalation: {exc}"
+        return {"rank": rank, "outcome": "escalated",
+                "aborted_ranks": exc.ranks}
+    except hvd.HorovodInternalError as exc:
+        # The faulted rank itself fails with the transport cause; only
+        # under an escalation run is that acceptable.
+        assert expect_escalation, f"unexpected world failure: {exc}"
+        return {"rank": rank, "outcome": "escalated", "aborted_ranks": []}
+    engine = get_engine()
+    client = getattr(engine, "_client", None)
+    chaos = getattr(client, "_chaos", None)
+    events = list(chaos.events) if chaos is not None else []
+    stats = engine.cache_stats()
+    reconnects = getattr(getattr(client, "_client", None), "reconnects", 0)
+    hvd.shutdown()
+    return {"rank": rank, "outcome": "healed", "events": events,
+            "reconnects": reconnects, "hit_cycles": stats["hit_cycles"]}
+
+
+def _classify_worker_failure(exc) -> str:
+    """``escalated`` only when every failed rank's structured record says
+    the WORLD failed under it; any rank whose record pins the failure on
+    its own code (``world_fault`` false — e.g. the bit-exact assertion)
+    is a ``worker-failure``, an outcome no cell ever accepts."""
+    records = getattr(exc, "records", None) or {}
+    if any(not rec.get("world_fault") for rec in records.values()):
+        return "worker-failure"
+    return "escalated"
+
+
+def run_cell(spec: str,
+             native_controller: Optional[int] = None,
+             native_core: Optional[int] = None,
+             np_: int = 2, steps: int = 8,
+             expect_escalation: bool = False,
+             timeout_s: float = 120.0,
+             deadline_s: float = 60.0) -> Dict:
+    """Run one matrix cell; returns a classification dict and never
+    hangs past ``timeout_s`` (the runner tears the world down). An
+    escalation past ``deadline_s`` is classified ``late-escalation`` —
+    the contract is a structured abort INSIDE the deadline, and a
+    verdict that only arrives because the runner's teardown timer fired
+    is a wedge, not an escalation."""
+    from horovod_tpu.runner import run
+    from horovod_tpu.runner.run_api import WorkerFailedError, WorkerLostError
+    from horovod_tpu.runner.launcher import LaunchError
+
+    env = {
+        "HOROVOD_CHAOS": spec,
+        "HOROVOD_PLATFORM": "cpu",
+        "HOROVOD_CYCLE_TIME": "2",
+        # tight-but-real healing budgets so escalation cells stay quick
+        "HOROVOD_RECONNECT_ATTEMPTS": "4",
+        "HOROVOD_RECONNECT_BACKOFF_S": "0.05",
+        "HOROVOD_RECONNECT_WINDOW_S": "2",
+        "HOROVOD_STALL_WARNING_TIME": "2",
+        "HOROVOD_STALL_SHUTDOWN_TIME_S": "4",
+    }
+    if native_controller is not None:
+        env["HOROVOD_NATIVE_CONTROLLER"] = str(native_controller)
+    if native_core is not None:
+        env["HOROVOD_NATIVE_CORE"] = str(native_core)
+    t0 = time.monotonic()
+    # Workers inherit the launcher's environment: pin the cell's knobs in
+    # os.environ for the duration of the run (the dryrun pattern).
+    import os
+
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        results = run(_matrix_fn, args=(steps, expect_escalation), np=np_,
+                      timeout_s=timeout_s, start_timeout_s=120.0)
+        outcome = ("escalated" if any(
+            r.get("outcome") == "escalated" for r in results) else "healed")
+        cell = {"outcome": outcome, "results": results}
+    except WorkerFailedError as exc:
+        # A rank raised before reporting. Only a WORLD fault (abort /
+        # shut-down collectives, per the structured failure records) is an
+        # escalation; a rank that died of its own assertion — a bit-exact
+        # mismatch — means the run produced WRONG RESULTS, which must
+        # never certify as a passing escalation in --allow-escalation
+        # cells. Old-format peers ship no records: keep the escalation
+        # reading, the abort tag in the text attributed it.
+        cell = {"outcome": _classify_worker_failure(exc),
+                "error": str(exc)[:500]}
+    except (WorkerLostError, LaunchError) as exc:
+        # a rank died of the fault before reporting: escalation — the
+        # structured record/abort tag attributes it; the deadline check
+        # below decides whether it counts
+        cell = {"outcome": "escalated", "error": str(exc)[:500]}
+    except TimeoutError as exc:
+        cell = {"outcome": "hang", "error": str(exc)[:500]}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    cell["spec"] = spec
+    cell["elapsed_s"] = round(time.monotonic() - t0, 2)
+    if cell["outcome"] == "escalated" and cell["elapsed_s"] > deadline_s:
+        cell["outcome"] = "late-escalation"
+    cell["native_controller"] = native_controller
+    cell["native_core"] = native_core
+    return cell
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--spec", action="append", default=None,
+                        help="fault spec(s); default: the single-fault grid")
+    parser.add_argument("--steps", type=int, default=8)
+    parser.add_argument("--np", type=int, default=2, dest="np_")
+    parser.add_argument("--escalation", action="store_true",
+                        help="run the escalation cell instead of the grid")
+    parser.add_argument("--allow-escalation", action="store_true",
+                        help="accept escalated outcomes for heal cells "
+                             "(the native controller's binary wire has no "
+                             "request dedup, so faults escalate by design)")
+    args = parser.parse_args(argv)
+    if not args.allow_escalation:
+        from horovod_tpu.core.config import Config
+        from horovod_tpu.ops.native_controller import (
+            native_controller_enabled,
+        )
+
+        if native_controller_enabled(Config.from_env()):
+            # the effective controller for this env is the native one:
+            # its dedup-less binary wire escalates single faults by
+            # design, so heal-cell strictness would only certify a
+            # misconfiguration
+            args.allow_escalation = True
+            print("native controller in effect: escalated outcomes "
+                  "accepted for heal cells (--allow-escalation implied; "
+                  "set HOROVOD_NATIVE_CONTROLLER=0 to certify the "
+                  "dedup-heal path)", flush=True)
+    specs = args.spec or (
+        [ESCALATION_SPEC] if args.escalation else DEFAULT_SPECS)
+    failed = 0
+    for spec in specs:
+        escalation_cell = args.escalation or spec == ESCALATION_SPEC
+        cell = run_cell(spec, np_=args.np_, steps=args.steps,
+                        expect_escalation=escalation_cell
+                        or args.allow_escalation)
+        # The expectation IS the certification: an escalation cell must
+        # escalate, and a heal cell must HEAL — accepting "escalated"
+        # there would hide a broken dedup-heal path behind a green sweep
+        # (--allow-escalation relaxes heal cells for the native
+        # controller's dedup-less binary wire, where faults escalate by
+        # design).
+        expected = (("escalated",) if escalation_cell
+                    else ("healed", "escalated") if args.allow_escalation
+                    else ("healed",))
+        ok = cell["outcome"] in expected
+        if not ok:
+            failed += 1
+        print(f"chaos-cell {'OK ' if ok else 'BAD'} "
+              f"outcome={cell['outcome']:<9} {cell['elapsed_s']:6.1f}s  "
+              f"{spec}", flush=True)
+        if not ok:
+            print(f"  {cell.get('error', '')}", flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

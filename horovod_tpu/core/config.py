@@ -107,6 +107,25 @@ HOROVOD_HEARTBEAT_INTERVAL = "HOROVOD_HEARTBEAT_INTERVAL"
 # docs/elastic.md.
 HOROVOD_ELASTIC_FAULT = "HOROVOD_ELASTIC_FAULT"
 
+# --- chaos plane + self-healing control plane (ours; docs/chaos.md) ----------
+# Deterministic fault-injection spec for the controller wire, e.g.
+# "drop@rank1:msg12,delay@rank0:50ms:every7,seed:7" (grammar in
+# horovod_tpu.chaos). Empty = no injection. Malformed specs fail loudly at
+# client construction.
+HOROVOD_CHAOS = "HOROVOD_CHAOS"
+# Seconds a rank-bound controller connection that dropped may reconnect
+# and supersede before the drop is declared a rank death (the self-healing
+# grace window). 0 restores abort-on-first-drop. Python controller service
+# only; the native (C++) service keeps immediate attribution.
+HOROVOD_RECONNECT_WINDOW = "HOROVOD_RECONNECT_WINDOW_S"
+# Client-side transparent-reconnect budget: attempts and the initial /
+# maximum exponential backoff between them. Read by
+# ``runner.network.ReconnectPolicy.from_env`` at client construction, not
+# through Config (clients are built in places that never see a Config).
+HOROVOD_RECONNECT_ATTEMPTS = "HOROVOD_RECONNECT_ATTEMPTS"
+HOROVOD_RECONNECT_BACKOFF = "HOROVOD_RECONNECT_BACKOFF_S"
+HOROVOD_RECONNECT_MAX_BACKOFF = "HOROVOD_RECONNECT_MAX_BACKOFF_S"
+
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:1838
 DEFAULT_CACHE_CAPACITY = 1024  # upstream response_cache.cc default
 DEFAULT_CYCLE_TIME_MS = 5.0  # operations.cc:1846
@@ -163,6 +182,14 @@ class Config:
     autotune_log: str = ""
     start_timeout_s: float = DEFAULT_START_TIMEOUT_S
     data_plane: str = "auto"
+    chaos_spec: str = ""
+    reconnect_window_s: float = 5.0
+    # True when HOROVOD_RECONNECT_WINDOW_S was set explicitly: the engine
+    # then applies it even to XLA-data-plane worlds, which otherwise keep
+    # immediate death attribution (a compiled collective cannot outlive a
+    # dead peer, and on the gloo CPU test backend it can complete with
+    # GARBAGE before a delayed abort lands — see ops/engine.py).
+    reconnect_window_explicit: bool = False
     # An explicitly-set env knob is pinned: the autotuner treats it as fixed
     # (reference SetValue(..., fixed=true), ``parameter_manager.cc:329-336``).
     fusion_threshold_explicit: bool = False
@@ -197,4 +224,8 @@ class Config:
             start_timeout_s=_env_float(
                 HOROVOD_START_TIMEOUT, DEFAULT_START_TIMEOUT_S),
             data_plane=os.environ.get(HOROVOD_DATA_PLANE, "auto"),
+            chaos_spec=os.environ.get(HOROVOD_CHAOS, ""),
+            reconnect_window_s=_env_float(HOROVOD_RECONNECT_WINDOW, 5.0),
+            reconnect_window_explicit=bool(
+                os.environ.get(HOROVOD_RECONNECT_WINDOW)),
         )

@@ -170,6 +170,39 @@ def parse_aborted_ranks(message: str,
     return None
 
 
+def failure_record(exc: BaseException, traceback_str: str) -> dict:
+    """Structured failure payload a worker ships to the driver (the wire
+    form of a worker exception). Replaces text-parsing abort reasons out
+    of tracebacks: the attribution ships as DATA — ``aborted_ranks`` from
+    the exception object itself (``RanksAbortedError.ranks``), falling
+    back to the tagged text for exceptions that only carry the reason as
+    a message. ``format`` versions the record so old-format peers (a
+    plain traceback string) keep decoding via the text fallback."""
+    ranks = getattr(exc, "ranks", None)
+    if ranks is None:
+        ranks = parse_aborted_ranks(str(exc))
+    if ranks is None:
+        # chained/wrapped aborts (`raise UserError(...) from
+        # RanksAbortedError`): the attribution may only survive in the
+        # traceback text — the record must not be WEAKER than the text
+        # fallback it replaces, since its presence disables that fallback
+        # in the elastic driver
+        ranks = parse_aborted_ranks(traceback_str)
+    return {
+        "format": 1,
+        "error_type": type(exc).__name__,
+        "traceback": traceback_str,
+        "aborted_ranks": sorted(int(r) for r in ranks) if ranks else None,
+        # world fault = the WORLD failed under this rank (aborted/shut-down
+        # collectives), not the user's code — the elastic driver only
+        # relaunches for these
+        "world_fault": isinstance(exc, HorovodInternalError)
+        or ranks is not None
+        or "shut down" in str(exc)
+        or "shut down" in traceback_str,
+    }
+
+
 class NotInitializedError(ValueError):
     """Raised when the API is used before ``init()``.
 

@@ -60,8 +60,18 @@ def _is_world_fault(exc: WorkerFailedError) -> bool:
     """True when the worker exceptions describe the WORLD failing
     (aborted/shut-down collectives) rather than the user's code: only
     those are worth a relaunch — a deterministic application bug would
-    just burn the restart budget and blacklist healthy slots."""
-    for _rank, detail in exc.failures:
+    just burn the restart budget and blacklist healthy slots.
+
+    The structured failure record (``core.status.failure_record``) is
+    authoritative when present; the text heuristics remain only for
+    old-format peers that shipped a bare traceback string."""
+    records = getattr(exc, "records", {})
+    for rank, detail in exc.failures:
+        record = records.get(rank)
+        if record is not None:
+            if record.get("world_fault"):
+                return True
+            continue  # structured and explicitly NOT a world fault
         if parse_aborted_ranks(detail) is not None or \
                 "shut down" in detail:
             return True
@@ -84,8 +94,17 @@ def _failed_ranks(exc: BaseException) -> List[int]:
         return list(exc.ranks)
     if isinstance(exc, WorkerFailedError):
         # Same: a worker whose fn raised RanksAbortedError is a victim;
-        # prefer the ranks its abort message names.
-        for _rank, detail in exc.failures:
+        # prefer the ranks its abort names — as structured wire data
+        # when the worker shipped a failure record, by text parse only
+        # for old-format peers.
+        records = getattr(exc, "records", {})
+        for rank, detail in exc.failures:
+            record = records.get(rank)
+            if record is not None:
+                named = record.get("aborted_ranks")
+                if named:
+                    return [int(r) for r in named]
+                continue
             named = parse_aborted_ranks(detail)
             if named:
                 return named

@@ -532,9 +532,21 @@ class ControllerService:
                  stall_warning_s: float = 60.0,
                  listen_fd: Optional[int] = None,
                  cache_capacity: int = 0,
-                 fusion_threshold_bytes: Optional[int] = None) -> None:
+                 fusion_threshold_bytes: Optional[int] = None,
+                 reconnect_window_s: Optional[float] = None) -> None:
         self._negotiator = negotiator
         self._world_id = world_id
+        # Self-healing grace (docs/chaos.md): a rank-bound connection that
+        # drops is given this long to reconnect and supersede before the
+        # drop is declared a rank death. 0 restores abort-on-first-drop.
+        if reconnect_window_s is None:
+            # direct construction (tests/tooling): same env default as the
+            # engine's Config, parsed in exactly one place
+            from ..core.config import Config
+
+            reconnect_window_s = Config.from_env().reconnect_window_s
+        self._reconnect_window_s = reconnect_window_s
+        self._pending_reconnect: Dict[int, float] = {}
         # Steady-state negotiation bypass (docs/response-cache.md): the
         # coordinator's mirror of every rank's ResponseCache. None when
         # disabled — a cache-bit cycle arriving anyway is a configuration
@@ -605,6 +617,42 @@ class ControllerService:
             rank = self._deregister(sock)
             if rank is None or self._world_shutdown:
                 return
+            window = self._reconnect_window_s
+            if window > 0 and not self._abort_fired:
+                # Self-healing grace: the drop may be a transient fault
+                # the client is already reconnecting through
+                # (BasicClient latches broken and redials with backoff).
+                # Park the verdict; a superseding registration inside the
+                # window heals it, expiry escalates it to a rank death.
+                deadline = time.monotonic() + window
+                self._pending_reconnect[rank] = deadline
+            else:
+                deadline = None
+        if deadline is not None:
+            LOG.warning(
+                "rank %d connection dropped before shutdown; waiting "
+                "%.1fs for a reconnect before declaring it dead", rank,
+                window)
+            timer = threading.Timer(window + 0.05,
+                                    self._reconnect_deadline,
+                                    args=(rank, deadline))
+            timer.daemon = True
+            timer.start()
+            return
+        self._abort_for_rank(rank)
+
+    def _reconnect_deadline(self, rank: int, deadline: float) -> None:
+        """Timer body: the reconnect window for ``rank`` expired."""
+        with self._lock:
+            if self._pending_reconnect.get(rank) != deadline:
+                return  # healed, or a newer drop owns the verdict
+            del self._pending_reconnect[rank]
+            if self._world_shutdown or rank in self._rank_conns:
+                return
+        self._abort_for_rank(rank)
+
+    def _abort_for_rank(self, rank: int) -> None:
+        with self._lock:
             first = not self._abort_fired
             self._abort_fired = True
         if first:
@@ -707,6 +755,10 @@ class ControllerService:
                 self._conn_ranks.pop(old, None)
             self._rank_conns[rank] = id(_sock)
             self._conn_ranks[id(_sock)] = rank
+            healed = self._pending_reconnect.pop(rank, None)
+        if healed is not None:
+            LOG.warning("rank %d reconnected within the window; the "
+                        "dropped connection is forgiven", rank)
         if kind == "hello":
             return ("ok",)
         if kind == "cycle":
@@ -976,8 +1028,16 @@ def _combine(resp: Response, slot: Dict[int, bytes]) -> bytes:
 
 
 def connect_with_hello(addr, secret, timeout_s, connect_attempts,
-                       hello) -> BasicClient:
+                       hello, chaos=None, on_reconnect=None) -> BasicClient:
     """Connect and identify, retrying the connect+hello PAIR as a unit.
+
+    ``on_reconnect`` is armed on the client BEFORE the hello runs: if the
+    hello's own response frame is lost, ``request()`` heals by reconnect
+    + resend, and the service's dedup REPLAYS the stored reply without
+    invoking the handler — only the hook's bare re-identify can bind the
+    healed connection to the rank. Arming after this function returns
+    leaves that window open (a healthy rank gets its fresh connection
+    treated as anonymous and is aborted at reconnect-window expiry).
 
     On re-init (``shutdown(); init()`` on the same port) a connect can
     land in the DYING previous service's kernel backlog — accepted by the
@@ -1013,7 +1073,8 @@ def connect_with_hello(addr, secret, timeout_s, connect_attempts,
             # the same time-based windows as a lost hello instead of
             # escaping them (round-4 advisor).
             client = BasicClient(addr, secret=secret, timeout_s=timeout_s,
-                                 attempts=connect_attempts)
+                                 attempts=connect_attempts, chaos=chaos)
+            client.on_reconnect = on_reconnect
             hello(client)
             return client
         except (WireError, OSError) as exc:
@@ -1148,6 +1209,12 @@ class ControllerClient:
         self.negotiation_rx_bytes = 0
         self.last_cycle_tx_bytes = 0
         self.last_cycle_rx_bytes = 0
+        # Deterministic fault injection (docs/chaos.md): the controller
+        # request channel is THE chaos target — ordinals count this
+        # client's logical round trips.
+        from ..chaos import injector_from_env
+
+        self._chaos = injector_from_env(rank)
         # Generous connect window: ranks race the coordinator's service
         # startup (JAX import time dominates), like orted waiting on the
         # reference's driver registration (``util/timeout.py``). Identify
@@ -1156,11 +1223,26 @@ class ControllerClient:
         if rank is None:
             self._client = BasicClient(addr, secret=secret,
                                        timeout_s=timeout_s,
-                                       attempts=connect_attempts)
+                                       attempts=connect_attempts,
+                                       chaos=self._chaos)
         else:
             self._client = connect_with_hello(
                 addr, secret, timeout_s, connect_attempts,
-                hello=lambda c: c.request(("hello", rank, world_id)))
+                hello=lambda c: c.request(("hello", rank, world_id)),
+                chaos=self._chaos, on_reconnect=self._reconnect_hello)
+
+    def _reconnect_hello(self, client) -> None:
+        """Re-identify after a transparent reconnect: the superseding
+        hello is what tells the controller the dropped connection was a
+        fault, not a death (it clears the reconnect-window verdict), and
+        it must precede the resent request so a dedup REPLAY — which
+        bypasses the handler — cannot leave the new connection
+        anonymous. Armed BEFORE the initial hello (connect_with_hello),
+        which can itself lose its response frame and heal."""
+        client.bare_request(("hello", self._rank, self._world_id))
+
+    def _arm_reconnect_hello(self) -> None:
+        self._client.on_reconnect = self._reconnect_hello
 
     def cycle(self, rank: int, request_list) -> Any:
         """One negotiation round trip. ``request_list`` is a RequestList
@@ -1172,6 +1254,7 @@ class ControllerClient:
         # when the caller did not pass rank= at construction.
         if self._rank is None:
             self._rank = rank
+            self._arm_reconnect_hello()
         # Negotiation-byte accounting: cycle() and payload() share one
         # connection but run sequentially on the engine loop thread, so a
         # delta bracketed around the request counts ONLY this cycle's
@@ -1214,7 +1297,11 @@ class ControllerClient:
         on its crash path would mask its own death and deadlock the world."""
         if detach and self._rank is not None:
             try:
-                self._client.request(("bye", self._rank))
+                # farewell, not request(): a bye must never trigger a
+                # reconnect+re-hello against a possibly dying controller
+                # just to announce a departure the socket close already
+                # announces
+                self._client.farewell(("bye", self._rank))
             except Exception:  # noqa: BLE001 - controller may already be gone
                 pass
         self._client.close()

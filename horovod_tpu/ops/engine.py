@@ -355,6 +355,16 @@ class Engine:
                 bind_host = os.environ.get(
                     "HOROVOD_CONTROLLER_BIND", "127.0.0.1")
                 listen_fd = _adopt_controller_fd(use_native)
+                # Self-healing grace for dropped rank connections: host-
+                # plane worlds only, unless the knob was set explicitly.
+                # With the XLA data plane a dead peer's in-flight compiled
+                # collective cannot be outlived safely — on the gloo CPU
+                # backend it can even complete with GARBAGE buffers before
+                # a delayed abort lands — so death attribution stays
+                # immediate there by default.
+                window_s = cfg.reconnect_window_s if (
+                    self._plane is None or cfg.reconnect_window_explicit
+                ) else 0.0
                 if use_native:
                     self._service = NativeControllerService(
                         self._size, cfg, secret=secret, port=port,
@@ -370,7 +380,8 @@ class Engine:
                         stall_warning_s=cfg.stall_warning_time_s,
                         listen_fd=listen_fd,
                         cache_capacity=cfg.cache_capacity,
-                        fusion_threshold_bytes=cfg.fusion_threshold_bytes)
+                        fusion_threshold_bytes=cfg.fusion_threshold_bytes,
+                        reconnect_window_s=window_s)
                 port = self._service.port
             # The launcher may advertise several controller addresses
             # (comma-separated: every NIC of the controller host); the
@@ -1059,7 +1070,15 @@ def start_subset_service(subset_ranks) -> None:
             stall_warning_s=cfg.stall_warning_time_s,
             listen_fd=listen_fd,
             cache_capacity=cfg.cache_capacity,
-            fusion_threshold_bytes=cfg.fusion_threshold_bytes)
+            fusion_threshold_bytes=cfg.fusion_threshold_bytes,
+            # Same gating as the member-hosted service above: the subset's
+            # members resolve their own data plane from this same config,
+            # so only a definitely-host-plane world gets the grace window
+            # by default ("auto" may resolve to XLA on the members, where
+            # death attribution must stay immediate).
+            reconnect_window_s=cfg.reconnect_window_s if (
+                cfg.data_plane == "host" or cfg.reconnect_window_explicit
+            ) else 0.0)
 
     def _teardown() -> None:
         # Grace period: the host's own shutdown (often atexit) must not

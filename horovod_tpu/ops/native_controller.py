@@ -190,10 +190,14 @@ class NativeControllerClient:
         # every client reaches the same abort verdict.
         self._escalation = StallEscalation(
             stall_shutdown_s, warning_interval_s=stall_warning_s)
+        from ..chaos import injector_from_env
+
+        self._chaos = injector_from_env(rank)
         if rank is None:
             self._client = BasicClient(addr, secret=secret,
                                        attempts=connect_attempts,
-                                       timeout_s=timeout_s)
+                                       timeout_s=timeout_s,
+                                       chaos=self._chaos)
         else:
             # connect+hello retried as a unit against a dying previous
             # service on the same port (see connect_with_hello)
@@ -202,11 +206,28 @@ class NativeControllerClient:
             self._client = connect_with_hello(
                 addr, secret, timeout_s, connect_attempts,
                 hello=lambda c: _decode_status(
-                    c.request_raw(encode_hello(rank, world_id))))
+                    c.request_raw(encode_hello(rank, world_id))),
+                chaos=self._chaos, on_reconnect=self._reconnect_hello)
+
+    def _reconnect_hello(self, client) -> None:
+        """Re-identify after the client reconnects off a latched-broken
+        connection. The binary wire has no request dedup, so faults that
+        strike mid-request are NOT transparently resent (they surface and
+        escalate); the hook covers the connect-phase heal — a refused or
+        reset dial retried under backoff — and keeps a post-timeout
+        reconnect from reading the dead stream's stale response. Armed
+        before the initial hello (connect_with_hello) for parity with
+        the Python wire, though ``request_raw`` never heals in-flight."""
+        _decode_status(
+            client.bare_request_raw(encode_hello(self._rank, self._world_id)))
+
+    def _arm_reconnect_hello(self) -> None:
+        self._client.on_reconnect = self._reconnect_hello
 
     def cycle(self, rank: int, request_list: RequestList) -> ResponseList:
         if self._rank is None:
             self._rank = rank
+            self._arm_reconnect_hello()
         out = decode_cycle_response(
             self._client.request_raw(encode_cycle(rank, request_list)),
             log_stalls=self._log_stalls)
@@ -273,7 +294,9 @@ class NativeControllerClient:
     def close(self, detach: bool = True) -> None:
         if detach and self._rank is not None:
             try:
-                self._client.request_raw(encode_bye(self._rank))
+                # farewell, not request_raw(): a bye must never trigger a
+                # reconnect+re-hello against a possibly dying controller
+                self._client.farewell_raw(encode_bye(self._rank))
             except Exception:  # noqa: BLE001 - controller may be gone
                 pass
         self._client.close()

@@ -44,6 +44,16 @@ response (it was planned pre-bump). Codec switches (``HOROVOD_COMPRESSION``)
 and shape/dtype changes need no generation: the codec and shape are part of
 the request identity, so they simply miss.
 
+Fault tolerance (docs/chaos.md): every cache state transition rides the
+request/response wire, so exactly-once delivery is load-bearing — a resent
+cycle whose response frame was lost to a transport fault must not re-apply
+its insert/touch on the coordinator mirror, or positions diverge silently.
+That guarantee lives in the wire layer: ``BasicClient.request`` retries
+under a per-request sequence number and ``BasicService`` replays the stored
+response instead of re-invoking the cycle handler, so ``insert_cycle``/
+``touch`` run exactly once per logical cycle no matter how many times its
+frames were dropped, delayed, or corrupted in transit.
+
 Only ALLREDUCE responses are cached: their request identity is equal on
 every rank (the negotiator errors on dtype/shape/codec divergence), so one
 coordinator mirror can reconstruct any rank's requests. Allgather's ragged

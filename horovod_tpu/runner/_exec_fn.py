@@ -52,9 +52,15 @@ def main() -> int:
         result = fn(*args, **kwargs)
         client.request(("result", rank, True, pickle.dumps(result)))
         return 0
-    except BaseException:  # noqa: BLE001 - ship the traceback to the driver
+    except BaseException as exc:  # noqa: BLE001 - ship failure to driver
+        # Structured failure record: the abort attribution (e.g.
+        # RanksAbortedError.ranks) rides the wire as data, not as text
+        # the driver would have to regex out of the traceback.
+        from ..core.status import failure_record
+
         client.request(("result", rank, False,
-                        pickle.dumps(traceback.format_exc())))
+                        pickle.dumps(failure_record(
+                            exc, traceback.format_exc()))))
         return 1
     finally:
         if reporter is not None:

@@ -21,6 +21,7 @@ import socketserver
 import struct
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
 _LEN = struct.Struct(">Q")
@@ -35,6 +36,14 @@ class ConnectionClosedError(WireError):
     """The peer closed mid-message — a transport-level loss, retryable by
     callers that can reconnect (unlike decoded server error frames, which
     are deliberate and final)."""
+
+
+class CorruptFrameError(WireError):
+    """An authenticated frame failed HMAC verification. Either the secret
+    is wrong (every frame fails, the retry budget exhausts immediately) or
+    the frame was damaged in transit — a transport-level loss after which
+    the stream cannot be trusted, so the client latches broken and
+    reconnects like any other transport fault."""
 
 
 class RemoteError:
@@ -104,6 +113,10 @@ class Wire:
         # ints under the GIL — callers read deltas, not exact snapshots.
         self.tx_bytes = 0
         self.rx_bytes = 0
+        # Optional fault injector (``horovod_tpu.chaos``): hooks at the
+        # frame boundary, None-cost when absent. Installed only on client
+        # wires whose owning BasicClient was built with chaos enabled.
+        self.chaos = None
 
     def frame(self, obj: Any) -> bytes:
         return self.frame_raw(
@@ -115,36 +128,46 @@ class Wire:
         digest = hmac.new(self._secret, body, hashlib.sha256).digest()
         return digest + _LEN.pack(len(body)) + body
 
-    def read_raw(self, sock: socket.socket) -> bytes:
-        """Read one authenticated frame, returning the body bytes verbatim
-        (no unpickling)."""
+    def _read_body(self, sock: socket.socket) -> bytes:
+        """Read one frame and verify its HMAC (chaos hooks bracket the
+        reads: delay before the header, corrupt/drop after the body)."""
+        if self.chaos is not None:
+            self.chaos.on_recv_begin(sock)
         header = _read_exact(sock, _DIGEST_BYTES + _LEN.size)
         digest = header[:_DIGEST_BYTES]
         (length,) = _LEN.unpack(header[_DIGEST_BYTES:])
         body = _read_exact(sock, length)
+        if self.chaos is not None:
+            body = self.chaos.on_recv_frame(body)
         expected = hmac.new(self._secret, body, hashlib.sha256).digest()
         if not hmac.compare_digest(digest, expected):
-            raise WireError("message HMAC mismatch (wrong or missing secret)")
+            raise CorruptFrameError(
+                "message HMAC mismatch (wrong or missing secret, or a "
+                "frame damaged in transit)")
         self.rx_bytes += _DIGEST_BYTES + _LEN.size + length
         return body
 
+    def read_raw(self, sock: socket.socket) -> bytes:
+        """Read one authenticated frame, returning the body bytes verbatim
+        (no unpickling)."""
+        return self._read_body(sock)
+
     def write(self, obj: Any, sock: socket.socket) -> None:
         if isinstance(obj, Preserialized):
-            self.tx_bytes += len(obj.payload)
-            sock.sendall(obj.payload)
+            self.write_frame(obj.payload, sock)
             return
-        data = self.frame(obj)
-        self.tx_bytes += len(data)
-        sock.sendall(data)
+        self.write_frame(self.frame(obj), sock)
+
+    def write_frame(self, frame: bytes, sock: socket.socket) -> None:
+        """Send an already-framed message (counts tx bytes; chaos close
+        faults fire here, before any byte leaves)."""
+        if self.chaos is not None:
+            self.chaos.on_send(sock)
+        self.tx_bytes += len(frame)
+        sock.sendall(frame)
 
     def read(self, sock: socket.socket) -> Any:
-        header = _read_exact(sock, _DIGEST_BYTES + _LEN.size)
-        digest, (length,) = header[:_DIGEST_BYTES], _LEN.unpack(header[_DIGEST_BYTES:])
-        body = _read_exact(sock, length)
-        expected = hmac.new(self._secret, body, hashlib.sha256).digest()
-        if not hmac.compare_digest(digest, expected):
-            raise WireError("message HMAC mismatch (wrong or missing secret)")
-        self.rx_bytes += _DIGEST_BYTES + _LEN.size + length
+        body = self._read_body(sock)
         try:
             return pickle.loads(body)
         except Exception as exc:  # noqa: BLE001 - diagnose, then fail
@@ -232,13 +255,62 @@ def probe_addresses(candidates: Dict[str, Tuple[str, int]],
     return reachable
 
 
+# Responses above this size are NOT retained for dedup replay (only a
+# sentinel survives): the slot holds its client's last response until the
+# client's NEXT request supersedes it — milliseconds in steady state, but
+# a departed client's slot survives until LRU displacement, which would
+# pin a fusion-threshold-sized payload frame (64MB default) for the rest
+# of the job. A replayed request whose oversized response was not
+# retained gets a deliberate RemoteError instead (escalation, not a
+# hang): losing that response takes a transport fault in the one cycle
+# whose payload exceeded the cap — rarer than the leak it prevents.
+_RPC_RETAIN_MAX_BYTES = 1 << 20
+
+
+class _NotRetained:
+    """Sentinel slot.resp for an oversized response (see above)."""
+
+    __slots__ = ()
+
+
+_NOT_RETAINED = _NotRetained()
+
+
+class _RpcSlot:
+    """Dedup state for one client's latest sequenced request: the seq, the
+    completed response object (re-framed on replay — response objects are
+    shared/immutable by contract, so this retains no extra copies while
+    the response is otherwise alive; oversized frames are dropped to a
+    sentinel, see ``_RPC_RETAIN_MAX_BYTES``), and a done event duplicate
+    arrivals park on while the first invocation is still running."""
+
+    __slots__ = ("seq", "resp", "done")
+
+    def __init__(self, seq: int) -> None:
+        self.seq = seq
+        self.resp = None
+        self.done = threading.Event()
+
+
 class BasicService:
     """Threaded TCP request/response server on a random port
     (reference ``BasicService``, ``network.py:81-141``).
 
     ``handler(request, connection)`` returns the response object to write
     back, or ``None`` for one-way requests.
-    """
+
+    Self-healing wire: requests arriving inside a ``("#rpc", client_id,
+    seq, obj)`` envelope (every ``BasicClient.request``) are deduplicated —
+    a client that lost a response to a transport fault reconnects and
+    resends the SAME seq, and the service replays the stored response
+    instead of re-invoking the handler. That exactly-once handler contract
+    is what makes transparent client retry safe for non-idempotent
+    requests (controller cycles: table insertions and cache-bit
+    transitions must never double-apply). One slot per client suffices:
+    the client lock serializes its requests. A resend that arrives while
+    the FIRST invocation is still running (post-timeout retry against a
+    slow handler) parks until it completes and replays its response —
+    never a second invocation, never a stale pairing."""
 
     def __init__(self, name: str,
                  handler: Callable[[Any, socket.socket], Any],
@@ -270,6 +342,10 @@ class BasicService:
         self._conns_lock = threading.Lock()
         self._conns: set = set()
         self._monitor_stop = threading.Event()
+        self._rpc_lock = threading.Lock()
+        # client_id -> _RpcSlot, LRU-bounded (a departed client's last
+        # response is retained until enough new clients displace it)
+        self._rpc_slots: "OrderedDict[str, _RpcSlot]" = OrderedDict()
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -283,10 +359,38 @@ class BasicService:
                 try:
                     while True:
                         req = outer._wire.read(sock)
+                        slot = None
+                        if type(req) is tuple and len(req) == 4 and \
+                                req[0] == "#rpc":
+                            _tag, client_id, seq, req = req
+                            slot, replayed = outer._rpc_claim(client_id, seq)
+                            if replayed:
+                                # duplicate of an earlier request: wait out
+                                # a still-running first invocation, then
+                                # replay its response — never re-invoke
+                                slot.done.wait()
+                                if slot.resp is _NOT_RETAINED:
+                                    outer._wire.write(RemoteError(
+                                        "response exceeded the dedup "
+                                        "retention cap and its original "
+                                        "frame was lost in transit — "
+                                        "cannot replay"), sock)
+                                elif slot.resp is not None:
+                                    outer._wire.write(slot.resp, sock)
+                                continue
                         try:
                             resp = outer._handler(req, sock)
                         except Exception as exc:  # noqa: BLE001
                             resp = RemoteError(f"{type(exc).__name__}: {exc}")
+                        if slot is not None:
+                            # store BEFORE the write: if this connection is
+                            # already dead, the retry on a fresh connection
+                            # must still find the response
+                            slot.resp = resp
+                            if isinstance(resp, Preserialized) and \
+                                    len(resp.payload) > _RPC_RETAIN_MAX_BYTES:
+                                slot.resp = _NOT_RETAINED
+                            slot.done.set()
                         if resp is not None:
                             outer._wire.write(resp, sock)
                 except (WireError, OSError):
@@ -329,6 +433,44 @@ class BasicService:
                 target=self._monitor_loop, name=f"{name}-liveness",
                 daemon=True)
             self._monitor.start()
+
+    # Enough for every rank's controller client plus tooling; a real
+    # world holds `size` live clients, far below the cap.
+    _RPC_CLIENT_CAP = 1024
+
+    def _rpc_claim(self, client_id: str, seq: int):
+        """Claim or replay a sequenced request. Returns ``(slot,
+        replayed)``: ``replayed=False`` means the caller owns the (new)
+        slot and must invoke the handler; ``True`` means wait on
+        ``slot.done`` and resend ``slot.resp``."""
+        with self._rpc_lock:
+            slot = self._rpc_slots.get(client_id)
+            if slot is not None and seq == slot.seq:
+                return slot, True
+            if slot is not None and seq < slot.seq:
+                # a sequential client can never legitimately regress; a
+                # stale seq means the stream is confused — refuse loudly
+                # rather than re-apply an old request
+                stale = _RpcSlot(seq)
+                stale.resp = RemoteError(
+                    f"stale rpc seq {seq} (already at {slot.seq})")
+                stale.done.set()
+                return stale, True
+            fresh = _RpcSlot(seq)
+            self._rpc_slots[client_id] = fresh
+            self._rpc_slots.move_to_end(client_id)
+            if len(self._rpc_slots) > self._RPC_CLIENT_CAP:
+                # LRU displacement must skip slots whose first invocation
+                # is still running: evicting one lets that client's retry
+                # claim a fresh slot and re-invoke the handler — the
+                # double-apply the dedup layer exists to prevent. The cap
+                # may be transiently exceeded by in-flight slots.
+                for cid, s in list(self._rpc_slots.items()):
+                    if len(self._rpc_slots) <= self._RPC_CLIENT_CAP:
+                        break
+                    if s.done.is_set():
+                        del self._rpc_slots[cid]
+            return fresh, False
 
     def _notify_disconnect(self, sock: socket.socket) -> None:
         """Idempotence is the callback's job (disconnects are observed both
@@ -389,33 +531,137 @@ class BasicService:
         self._server.server_close()
 
 
+class ReconnectPolicy:
+    """Bounded exponential backoff budget for transparent reconnect."""
+
+    __slots__ = ("attempts", "backoff_s", "max_backoff_s")
+
+    def __init__(self, attempts: int = 6, backoff_s: float = 0.2,
+                 max_backoff_s: float = 2.0) -> None:
+        self.attempts = max(int(attempts), 1)
+        self.backoff_s = max(float(backoff_s), 0.0)
+        self.max_backoff_s = max(float(max_backoff_s), self.backoff_s)
+
+    @staticmethod
+    def from_env() -> "ReconnectPolicy":
+        # lazy import: config is a leaf module, but keep this wire layer
+        # importable on its own (same idiom as connect_with_hello)
+        from ..core.config import (
+            HOROVOD_RECONNECT_ATTEMPTS,
+            HOROVOD_RECONNECT_BACKOFF,
+            HOROVOD_RECONNECT_MAX_BACKOFF,
+            _env_float,
+        )
+
+        return ReconnectPolicy(
+            attempts=int(_env_float(HOROVOD_RECONNECT_ATTEMPTS, 6)),
+            backoff_s=_env_float(HOROVOD_RECONNECT_BACKOFF, 0.2),
+            max_backoff_s=_env_float(HOROVOD_RECONNECT_MAX_BACKOFF, 2.0))
+
+    def delay(self, attempt: int) -> float:
+        return min(self.backoff_s * (2.0 ** max(attempt - 1, 0)),
+                   self.max_backoff_s)
+
+
+# Transport-level losses a reconnect can heal. Decoded server error frames
+# (RemoteError -> "service-side failure") and protocol errors ("unpicklable
+# message body") are DELIBERATE and final — never in this set.
+# socket.timeout is a subclass of OSError on this Python.
+_TRANSPORT_ERRORS = (ConnectionClosedError, CorruptFrameError, OSError)
+
+def _reconnect_hello_timeout_s() -> float:
+    """Ceiling on the re-identify hello during a reconnect, applied only
+    when the client itself has no timeout (timeout_s=None). A live service
+    answers a hello in microseconds; only an accepted-but-never-served
+    connection (dying service's backlog) takes longer, and that one must
+    fail the attempt, not hang it. Read per reconnect, like every other
+    HOROVOD_* knob (env pins after import must take effect)."""
+    from ..core.config import _env_float
+
+    return _env_float("HOROVOD_RECONNECT_HELLO_TIMEOUT_S", 10.0)
+
+
 class BasicClient:
-    """Persistent client connection with connect retries
+    """Persistent client connection with connect retries, transparent
+    reconnect, and a broken-connection latch
     (reference ``BasicClient``, ``network.py:144-236``).
 
     ``addr`` may be a single ``(host, port)`` or a dict of candidates
     ``{intf: (host, port)}`` — multiple candidates are probed in parallel
     each attempt and the first reachable one wins, which is how a worker
-    finds a routable path to a service that advertised every NIC."""
+    finds a routable path to a service that advertised every NIC.
+
+    Self-healing contract:
+
+    * Any transport fault (EOF, reset, timeout, HMAC-corrupt frame)
+      LATCHES the client broken and closes the socket — a timed-out
+      request's late response can never be misread as the next request's
+      answer (the stale frame dies with the socket).
+    * ``request()`` retries transparently: reconnect with bounded
+      exponential backoff (``ReconnectPolicy``), re-identify via the
+      ``on_reconnect`` hook, and resend under the SAME sequence number —
+      the service's dedup layer guarantees exactly-once handler
+      invocation, so the retry is safe even for non-idempotent requests.
+    * ``request_raw()`` (the native controller's binary wire, which has no
+      dedup) never resends a possibly-delivered request: it latches and
+      raises, and the NEXT call reconnects on a fresh stream.
+    """
 
     def __init__(self, addr,
                  secret: Optional[bytes] = None,
                  attempts: int = 10,
                  retry_delay_s: float = 0.3,
-                 timeout_s: Optional[float] = None) -> None:
+                 timeout_s: Optional[float] = None,
+                 chaos=None,
+                 reconnect: Optional[ReconnectPolicy] = None) -> None:
         self._wire = Wire(secret)
         self._lock = threading.Lock()
-        candidates: Dict[str, Tuple[str, int]] = (
+        self._candidates: Dict[str, Tuple[str, int]] = (
             dict(addr) if isinstance(addr, dict) else {"addr": tuple(addr)})
-        self.connected_intf: Optional[str] = None
-        last_err: Optional[Exception] = None
-        if not candidates:
+        if not self._candidates:
             raise WireError("no service addresses given (empty candidate "
                             "list — check HOROVOD_CONTROLLER_ADDR)")
-        for _ in range(attempts):
+        self._connect_attempts = attempts
+        self._retry_delay_s = retry_delay_s
+        self._timeout_s = timeout_s
+        self._policy = reconnect or ReconnectPolicy.from_env()
+        self._chaos = chaos
+        self._wire.chaos = chaos
+        # Request dedup identity: the service keys its exactly-once replay
+        # cache by (client_id, seq); seq advances once per logical request,
+        # never on a retry of the same request.
+        self._client_id = os.urandom(8).hex()
+        self._seq = 0
+        self._broken = False
+        self._closed = False
+        self.reconnects = 0  # observability: healed transport faults
+        self.on_reconnect: Optional[Callable[["BasicClient"], None]] = None
+        self.connected_intf: Optional[str] = None
+        self._sock: Optional[socket.socket] = self._dial(
+            rounds=attempts, reconnecting=False)
+
+    # -- connection management ------------------------------------------------
+
+    def _dial(self, rounds: int, reconnecting: bool) -> socket.socket:
+        """One candidate-probing connect pass of up to ``rounds`` rounds."""
+        last_err: Optional[Exception] = None
+        candidates = self._candidates
+        for _ in range(rounds):
+            if self._chaos is not None:
+                # One refusal per dial ATTEMPT, not per candidate:
+                # refuse@relaunch:N means N failed reconnect attempts
+                # (each burning a backoff iteration), however many NICs
+                # an attempt probes — per-candidate consumption would
+                # silently under-inject on multi-NIC worlds.
+                try:
+                    self._chaos.on_connect(reconnecting)
+                except OSError as exc:
+                    last_err = exc
+                    time.sleep(self._retry_delay_s)
+                    continue
             if len(candidates) > 1:
                 reachable = probe_addresses(
-                    candidates, timeout_s=min(timeout_s or 2.0, 2.0))
+                    candidates, timeout_s=min(self._timeout_s or 2.0, 2.0))
                 if not reachable:
                     last_err = OSError(
                         f"no candidate reachable within probe timeout "
@@ -424,19 +670,112 @@ class BasicClient:
                 reachable = candidates
             for intf, target in reachable.items():
                 try:
-                    self._sock = socket.create_connection(
-                        target, timeout=timeout_s)
-                    self._sock.settimeout(timeout_s)
-                    self._sock.setsockopt(
+                    sock = socket.create_connection(
+                        target, timeout=self._timeout_s)
+                    sock.settimeout(self._timeout_s)
+                    sock.setsockopt(
                         socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                     self.connected_intf = intf
-                    return
+                    if self._chaos is not None:
+                        self._chaos.on_connected()
+                    return sock
                 except OSError as exc:
                     last_err = exc
-            time.sleep(retry_delay_s)
+            time.sleep(self._retry_delay_s)
         raise WireError(
             f"unable to connect to service at any of "
             f"{sorted(candidates.values())}: {last_err}")
+
+    def _reconnect(self) -> None:
+        """Replace a latched-broken connection: bounded exponential
+        backoff, re-identify via ``on_reconnect``, and only then retire
+        the old socket — the service must see the superseding identity
+        before (or while) it notices the old connection die, and the old
+        socket's teardown discards any stale buffered response."""
+        old, self._sock = self._sock, None
+        last_err: Optional[Exception] = None
+        for attempt in range(1, self._policy.attempts + 1):
+            if self._closed:
+                # close() already ran and saw self._sock=None, so the
+                # retired pre-fault socket is ours to release here
+                if old is not None:
+                    try:
+                        old.close()
+                    except OSError:
+                        pass
+                raise WireError("client closed during reconnect")
+            if attempt > 1:
+                time.sleep(self._policy.delay(attempt - 1))
+            try:
+                sock = self._dial(rounds=1, reconnecting=True)
+            except (WireError, OSError) as exc:
+                last_err = exc
+                continue
+            self._sock = sock
+            if self.on_reconnect is not None:
+                # The re-identify MUST be time-bounded even on clients
+                # built with timeout_s=None (negotiation parks by design):
+                # a reconnect can land in a dying service's kernel backlog
+                # — connect succeeds, nobody ever serves it — and an
+                # unbounded hello read would hang forever instead of
+                # burning an attempt and escalating.
+                if self._timeout_s is None:
+                    sock.settimeout(_reconnect_hello_timeout_s())
+                try:
+                    self.on_reconnect(self)
+                except _TRANSPORT_ERRORS as exc:
+                    # the re-identify itself hit a transport fault: this
+                    # attempt failed, back off and redial
+                    last_err = exc
+                    self._sock = None
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    continue
+                except BaseException:
+                    # a DECISION, not a loss (the service refused the
+                    # hello: world over / restarting): propagate — but
+                    # retire the pre-fault socket first, or its fd leaks
+                    # for the client's remaining lifetime (close() only
+                    # knows about self._sock)
+                    if old is not None:
+                        try:
+                            old.close()
+                        except OSError:
+                            pass
+                    raise
+                finally:
+                    if self._timeout_s is None and self._sock is not None:
+                        sock.settimeout(None)
+                # any other failure (e.g. the service refusing the hello:
+                # world over / restarting) is a DECISION, not a loss —
+                # propagate without burning the rest of the budget
+            if self._closed:
+                # close() may have landed while the new socket was not yet
+                # visible to it (mid-dial, self._sock was None): finish the
+                # close here, or the healed request parks forever in recv
+                # on a socket close() can no longer reach.
+                for stale in (sock, old):
+                    if stale is not None:
+                        try:
+                            stale.close()
+                        except OSError:
+                            pass
+                self._sock = None
+                raise WireError("client closed during reconnect")
+            self._broken = False
+            self.reconnects += 1
+            if old is not None:
+                try:
+                    old.close()
+                except OSError:
+                    pass
+            return
+        self._sock = old  # keep ownership for close()
+        raise WireError(
+            f"reconnect failed after {self._policy.attempts} attempts: "
+            f"{last_err}") from last_err
 
     def enable_keepalive(self, idle_s: int = 60, interval_s: int = 20,
                          count: int = 3) -> None:
@@ -452,27 +791,140 @@ class BasicClient:
             if hasattr(socket, opt):  # Linux; other platforms keep defaults
                 s.setsockopt(socket.IPPROTO_TCP, getattr(socket, opt), val)
 
+    # -- request paths --------------------------------------------------------
+
     def request(self, obj: Any) -> Any:
+        """One sequenced round trip with transparent retry: transport
+        faults latch the connection broken, reconnect with backoff, and
+        resend under the same seq (the service dedups — see
+        ``BasicService``)."""
         with self._lock:
-            self._wire.write(obj, self._sock)
-            resp = self._wire.read(self._sock)
+            seq = self._seq
+            self._seq += 1
+            envelope = ("#rpc", self._client_id, seq, obj)
+            if self._chaos is not None:
+                self._chaos.begin_request()
+            attempt = 0
+            while True:
+                try:
+                    if self._broken or self._sock is None:
+                        self._reconnect()
+                    self._wire.write(envelope, self._sock)
+                    resp = self._wire.read(self._sock)
+                    break
+                except _TRANSPORT_ERRORS as exc:
+                    self._broken = True
+                    attempt += 1
+                    if self._closed or attempt > self._policy.attempts:
+                        raise
+                    _log_heal_attempt(exc, attempt)
+                    time.sleep(self._policy.delay(attempt))
         if isinstance(resp, RemoteError):
             raise WireError(f"service-side failure: {resp.message}")
         return resp
 
     def request_raw(self, body: bytes) -> bytes:
         """One round-trip of pre-encoded bytes over the same framing (the
-        native controller client's path)."""
+        native controller client's path). No dedup rides this wire, so a
+        fault after the send is NOT retried (a resend could double-apply);
+        the client latches broken and the next call reconnects — a timed-
+        out request's stale response dies with the old socket instead of
+        desyncing the stream."""
         with self._lock:
-            self._sock.sendall(self._wire.frame_raw(body))
-            return self._wire.read_raw(self._sock)
+            if self._chaos is not None:
+                self._chaos.begin_request()
+            if self._broken or self._sock is None:
+                self._reconnect()  # connect-phase only: nothing sent yet
+            try:
+                self._wire.write_frame(self._wire.frame_raw(body),
+                                       self._sock)
+                return self._wire.read_raw(self._sock)
+            except _TRANSPORT_ERRORS:
+                self._broken = True
+                raise
+
+    def farewell(self, obj: Any) -> Optional[Any]:
+        """Best-effort final round trip (the clean-detach "bye"): never
+        heals. A goodbye only means anything on the connection the
+        service already knows; reconnecting to deliver one would re-hello
+        through ``on_reconnect`` against a possibly dying service — whose
+        backlog can accept the dial and never serve it — to say something
+        the connection's own close already says. Returns None if the
+        transport is (or becomes) broken."""
+        with self._lock:
+            if self._closed or self._broken or self._sock is None:
+                return None
+            seq = self._seq
+            self._seq += 1
+            envelope = ("#rpc", self._client_id, seq, obj)
+            if self._chaos is not None:
+                self._chaos.begin_request()
+            try:
+                self._wire.write(envelope, self._sock)
+                resp = self._wire.read(self._sock)
+            except _TRANSPORT_ERRORS:
+                self._broken = True
+                return None
+        if isinstance(resp, RemoteError):
+            raise WireError(f"service-side failure: {resp.message}")
+        return resp
+
+    def farewell_raw(self, body: bytes) -> Optional[bytes]:
+        """Raw-wire twin of ``farewell`` (the native client's bye)."""
+        with self._lock:
+            if self._closed or self._broken or self._sock is None:
+                return None
+            if self._chaos is not None:
+                self._chaos.begin_request()
+            try:
+                self._wire.write_frame(self._wire.frame_raw(body),
+                                       self._sock)
+                return self._wire.read_raw(self._sock)
+            except _TRANSPORT_ERRORS:
+                self._broken = True
+                return None
+
+    def bare_request(self, obj: Any) -> Any:
+        """One UNSEQUENCED round trip on the current socket, no retry —
+        the re-identify hello an ``on_reconnect`` hook sends (hello is
+        idempotent: a superseding registration replaces the old one)."""
+        self._wire.write(obj, self._sock)
+        resp = self._wire.read(self._sock)
+        if isinstance(resp, RemoteError):
+            raise WireError(f"service-side failure: {resp.message}")
+        return resp
+
+    def bare_request_raw(self, body: bytes) -> bytes:
+        """Raw-wire twin of ``bare_request`` (the native client's
+        reconnect hello)."""
+        self._wire.write_frame(self._wire.frame_raw(body), self._sock)
+        return self._wire.read_raw(self._sock)
 
     def send(self, obj: Any) -> None:
         with self._lock:
-            self._wire.write(obj, self._sock)
+            if self._broken or self._sock is None:
+                self._reconnect()
+            try:
+                self._wire.write(obj, self._sock)
+            except _TRANSPORT_ERRORS:
+                self._broken = True
+                raise
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # No lock: close() must be able to cut through a parked request
+        # (the watch channel blocks in recv for the whole job).
+        self._closed = True
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def _log_heal_attempt(exc: Exception, attempt: int) -> None:
+    import logging
+
+    logging.getLogger("horovod_tpu").warning(
+        "control-plane transport fault (%s: %s); reconnect attempt %d",
+        type(exc).__name__, exc, attempt)

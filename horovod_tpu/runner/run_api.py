@@ -42,9 +42,14 @@ class WorkerLostError(RuntimeError):
 
 class WorkerFailedError(RuntimeError):
     """The job function raised on one or more ranks; carries the rank list
-    so an elastic driver can attribute the failure to slots."""
+    so an elastic driver can attribute the failure to slots.
 
-    def __init__(self, failures: List[Tuple[int, str]]) -> None:
+    ``records`` maps rank -> the structured ``core.status.failure_record``
+    the worker shipped (absent for old-format peers whose payload was a
+    plain traceback string — consumers fall back to text parsing then)."""
+
+    def __init__(self, failures: List[Tuple[int, str]],
+                 records: Optional[Dict[int, dict]] = None) -> None:
         rank, detail = failures[0]
         msg = f"run(fn) failed on rank {rank}: {detail}"
         if len(failures) > 1:
@@ -53,6 +58,7 @@ class WorkerFailedError(RuntimeError):
         super().__init__(msg)
         self.ranks = sorted(r for r, _ in failures)
         self.failures = failures
+        self.records = records or {}
 
 
 def _dumps_by_value(fn, args: Tuple, kwargs: dict) -> bytes:
@@ -148,14 +154,22 @@ class _Driver:
                 self._cond.wait(timeout=0.2)
         out = []
         failures: List[Tuple[int, str]] = []
+        records: Dict[int, dict] = {}
         for rank in range(self._np):
             ok, payload = self._results[rank]
             value = pickle.loads(payload)
             if not ok:
-                failures.append((rank, str(value)))
+                # structured failure record (core.status.failure_record);
+                # old-format peers ship a bare traceback string and stay
+                # on the text-parse fallback path
+                if isinstance(value, dict) and value.get("format") == 1:
+                    records[rank] = value
+                    failures.append((rank, str(value.get("traceback", ""))))
+                else:
+                    failures.append((rank, str(value)))
             out.append(value)
         if failures:
-            raise WorkerFailedError(failures)
+            raise WorkerFailedError(failures, records=records)
         return out
 
     def missing_results(self) -> List[int]:

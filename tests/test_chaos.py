@@ -1,0 +1,834 @@
+"""Chaos plane + self-healing control plane (docs/chaos.md).
+
+Tier-1 pins: the HOROVOD_CHAOS spec grammar and deterministic replay; the
+client's broken-latch/reconnect/dedup machinery against stub services
+(including the post-timeout desync regression); the controller's
+reconnect window (heal and escalate); probe/multi-candidate connect; and
+a quick 2-process single-fault matrix on both negotiation cores. The
+multi-fault soaks and the full fault grid run under ``slow``.
+
+Each tier-1 test stays well under 10 s (the 870 s tier-1 budget truncates
+alphabetically — this file must not starve the tail).
+"""
+
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.chaos import (
+    ChaosInjector,
+    ChaosSpecError,
+    parse_chaos_spec,
+)
+from horovod_tpu.runner.network import (
+    BasicClient,
+    BasicService,
+    ConnectionClosedError,
+    CorruptFrameError,
+    ReconnectPolicy,
+    Wire,
+    WireError,
+    probe_addresses,
+)
+
+pytestmark = pytest.mark.chaos
+
+SECRET = b"chaos-test-secret-chaos-test-sec"
+
+# Small budgets keep failure-path tests quick without loosening semantics.
+_FAST = ReconnectPolicy(attempts=3, backoff_s=0.05, max_backoff_s=0.2)
+
+
+# -- spec grammar -------------------------------------------------------------
+
+def test_chaos_spec_parse_grammar():
+    plan = parse_chaos_spec(
+        "drop@rank1:msg12,delay@rank0:50ms:every7,corrupt@rank2:msg30,"
+        "close@rank1:msg45,refuse@relaunch:2,delay@all:1.5s,"
+        "drop@rank0:p0.25,seed:42")
+    assert plan.seed == 42
+    kinds = [r.kind for r in plan.rules]
+    assert kinds == ["drop", "delay", "corrupt", "close", "refuse",
+                     "delay", "drop"]
+    drop = plan.rules[0]
+    assert (drop.rank, drop.ordinal) == (1, 12)
+    delay = plan.rules[1]
+    assert (delay.rank, delay.every, delay.delay_s) == (0, 7, 0.05)
+    refuse = plan.rules[4]
+    assert refuse.refusals == 2 and refuse.rank is None
+    assert refuse.describe() == "refuse@relaunch:2"
+    assert plan.rules[5].rank is None  # scope "all"
+    assert plan.rules[5].every == 1  # delay defaults to every request
+    assert plan.rules[5].delay_s == 1.5
+    assert plan.rules[6].prob == 0.25
+    assert parse_chaos_spec("").rules == []  # empty spec = no injection
+
+
+def test_chaos_spec_parse_errors():
+    for bad in ["boom@rank1:msg2",        # unknown kind
+                "drop@host1:msg2",        # unknown scope
+                "drop@rank1",             # missing trigger
+                "drop@rank1:once",        # unknown trigger
+                "drop@rank1:msg0",        # ordinals are 1-based
+                "delay@rank1:50:every2",  # duration needs a unit
+                "drop@rank1:p1.5",        # probability out of range
+                "refuse@relaunch:0",      # refusals must be >= 1
+                "refuse@rank0:2",         # refuse's only scope is relaunch
+                "refuse@all:2",           # (a spec injects what it says)
+                "close@relaunch:msg2",    # relaunch scope is refuse-only
+                "seed:x"]:
+        with pytest.raises(ChaosSpecError):
+            parse_chaos_spec(bad)
+
+
+def test_injector_deterministic_replay():
+    """Same spec + seed => bit-identical fault stream over the same
+    ordinal sequence (the replay guarantee)."""
+    spec = "drop@rank0:p0.2,corrupt@rank0:p0.1,delay@rank0:1ms:every5,seed:9"
+
+    def firing_stream():
+        inj = ChaosInjector(parse_chaos_spec(spec), rank=0)
+        stream = []
+        for _ in range(200):
+            inj.begin_request()
+            stream.append(tuple(sorted(inj._armed)))
+        return stream
+
+    a, b = firing_stream(), firing_stream()
+    assert a == b
+    assert any(s for s in a), "seeded faults never armed in 200 requests"
+    # a different seed moves the probabilistic firings
+    other = ChaosInjector(
+        parse_chaos_spec(spec.replace("seed:9", "seed:10")), rank=0)
+    stream2 = []
+    for _ in range(200):
+        other.begin_request()
+        stream2.append(tuple(sorted(other._armed)))
+    assert stream2 != a
+
+
+def test_injector_rank_scoping():
+    plan = parse_chaos_spec("drop@rank1:msg1,corrupt@all:msg1")
+    inj0 = ChaosInjector(plan, rank=0)
+    inj0.begin_request()
+    assert sorted(inj0._armed) == ["corrupt"]  # rank1 clause filtered out
+    inj1 = ChaosInjector(plan, rank=1)
+    inj1.begin_request()
+    assert sorted(inj1._armed) == ["corrupt", "drop"]
+
+
+# -- client self-healing against a stub service -------------------------------
+
+def _counting_service():
+    calls = {"n": 0}
+
+    def handle(req, _sock):
+        calls["n"] += 1
+        if req == "slow":
+            time.sleep(0.5)
+        return ("resp", req, calls["n"])
+
+    return BasicService("chaos-stub", handle, secret=SECRET), calls
+
+
+def _chaos_client(port, spec, timeout_s=5.0):
+    inj = ChaosInjector(parse_chaos_spec(spec), rank=0)
+    client = BasicClient(("127.0.0.1", port), secret=SECRET,
+                         timeout_s=timeout_s, chaos=inj, reconnect=_FAST)
+    return client, inj
+
+
+def test_drop_fault_heals_exactly_once():
+    """A dropped response frame reconnects + resends under the same seq;
+    the service dedup REPLAYS the stored response — the handler runs
+    exactly once per logical request (no double-applied transitions)."""
+    svc, calls = _counting_service()
+    try:
+        client, inj = _chaos_client(svc.port, "drop@rank0:msg2")
+        for i in range(4):
+            assert client.request(("m", i)) == ("resp", ("m", i), i + 1)
+        assert calls["n"] == 4  # exactly-once despite the drop
+        assert ("drop", 2) in inj.events
+        assert client.reconnects == 1
+        client.close()
+    finally:
+        svc.shutdown()
+
+
+def test_corrupt_fault_latches_and_heals():
+    svc, calls = _counting_service()
+    try:
+        client, inj = _chaos_client(svc.port, "corrupt@rank0:msg2")
+        for i in range(3):
+            assert client.request(("c", i)) == ("resp", ("c", i), i + 1)
+        assert calls["n"] == 3
+        assert ("corrupt", 2) in inj.events and client.reconnects == 1
+        client.close()
+    finally:
+        svc.shutdown()
+
+
+def test_close_fault_reconnects_with_refused_attempts():
+    """close + refuse: the reconnect survives refused dials under
+    exponential backoff and the request still completes exactly once."""
+    svc, calls = _counting_service()
+    try:
+        client, inj = _chaos_client(
+            svc.port, "close@rank0:msg2,refuse@relaunch:2")
+        for i in range(3):
+            assert client.request(("k", i)) == ("resp", ("k", i), i + 1)
+        assert calls["n"] == 3
+        kinds = [k for k, _ in inj.events]
+        assert kinds.count("close") == 1 and kinds.count("refuse") == 2
+        client.close()
+    finally:
+        svc.shutdown()
+
+
+def test_refuse_budget_is_per_attempt_not_per_candidate():
+    """Regression: on a multi-candidate (multi-NIC) address,
+    ``refuse@relaunch:N`` must burn one refusal per reconnect ATTEMPT —
+    each with its own backoff iteration — not one per probed candidate,
+    or a 2-NIC world exhausts the whole budget inside attempt 1 and the
+    backoff path the fault exists to exercise never runs."""
+    svc, _calls = _counting_service()
+    backoffs = []
+
+    class _CountingPolicy(ReconnectPolicy):
+        def delay(self, attempt):
+            backoffs.append(attempt)
+            return min(super().delay(attempt), 0.02)
+
+    inj = ChaosInjector(parse_chaos_spec("refuse@relaunch:2"), rank=0)
+    addr = ("127.0.0.1", svc.port)
+    client = BasicClient({"nic-a": addr, "nic-b": addr}, secret=SECRET,
+                         timeout_s=5.0, retry_delay_s=0.05, chaos=inj,
+                         reconnect=_CountingPolicy(attempts=6,
+                                                   backoff_s=0.01,
+                                                   max_backoff_s=0.02))
+    try:
+        client._broken = True  # as a transport fault would latch it
+        assert client.request("n") == ("resp", "n", 1)
+        assert client.reconnects == 1
+        kinds = [k for k, _ in inj.events]
+        assert kinds.count("refuse") == 2
+        # refusals landed on reconnect attempts 1 and 2, the connect on
+        # attempt 3: two backoff sleeps — per-candidate consumption
+        # would burn the whole budget inside attempt 1 and leave one
+        assert len(backoffs) == 2
+        client.close()
+    finally:
+        svc.shutdown()
+
+
+def test_refuse_exhausts_retry_budget_and_escalates():
+    """A fault budget beyond the reconnect policy surfaces as an error
+    within the bounded backoff budget — never a hang."""
+    svc, _calls = _counting_service()
+    try:
+        client, _inj = _chaos_client(
+            svc.port, "close@rank0:msg1,refuse@relaunch:999")
+        t0 = time.monotonic()
+        with pytest.raises(WireError):
+            client.request("doomed")
+        assert time.monotonic() - t0 < 10.0
+        client.close()
+    finally:
+        svc.shutdown()
+
+
+def test_reconnect_into_dead_backlog_bounded_not_hung(monkeypatch):
+    """Regression: a reconnect can land in a dying service's kernel
+    backlog — the connect SUCCEEDS, but the exiting service never serves
+    it — so the re-identify hello on a timeout-less client must be
+    time-bounded (``HOROVOD_RECONNECT_HELLO_TIMEOUT_S``): the attempt
+    fails and the budget escalates instead of blocking forever in the
+    hello read. Also pins the bye path: ``farewell()`` on a broken client
+    is a no-op — it must never reconnect (and re-hello into that same
+    backlog) just to announce a departure the socket close already
+    announces."""
+    monkeypatch.setenv("HOROVOD_RECONNECT_HELLO_TIMEOUT_S", "0.3")
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)  # dials land in the backlog; nobody ever accepts
+    try:
+        client = BasicClient(("127.0.0.1", lsock.getsockname()[1]),
+                             secret=SECRET, timeout_s=None,
+                             reconnect=ReconnectPolicy(
+                                 attempts=2, backoff_s=0.01,
+                                 max_backoff_s=0.02))
+        client.on_reconnect = lambda c: c.bare_request(("hello", 0, ""))
+        client._broken = True  # as a transport fault would latch it
+        t0 = time.monotonic()
+        with pytest.raises(WireError):
+            client.request(("cycle", 0))
+        # bounded: 2 dials x 0.3 s hello ceiling + backoff, not forever
+        assert time.monotonic() - t0 < 5.0
+        assert client.farewell(("bye", 0)) is None and client._broken
+        client.close()
+    finally:
+        lsock.close()
+
+
+def test_post_timeout_desync_regression():
+    """Satellite regression: after a socket timeout the connection may
+    hold a partial/late frame; the client must latch broken and force a
+    reconnect so the NEXT request can never read the previous response.
+    Both hazards covered: a chaos-delayed frame left buffered, and a
+    genuinely slow handler whose first invocation is still running when
+    the retry arrives (the dedup layer parks and replays — no second
+    invocation, no stale pairing)."""
+    svc, calls = _counting_service()
+    try:
+        # hazard 1: response delayed past the socket timeout, frame stays
+        # buffered on the old connection
+        client, _ = _chaos_client(svc.port, "delay@rank0:900ms:msg1",
+                                  timeout_s=0.25)
+        assert client.request("a") == ("resp", "a", 1)
+        assert client.reconnects == 1
+        assert client.request("b") == ("resp", "b", 2)  # not a's stale frame
+        client.close()
+        # hazard 2: handler slower than the timeout; retry arrives while
+        # the first invocation is mid-flight
+        client2 = BasicClient(("127.0.0.1", svc.port), secret=SECRET,
+                              timeout_s=0.25, reconnect=_FAST)
+        assert client2.request("slow") == ("resp", "slow", 3)
+        assert client2.request("x") == ("resp", "x", 4)
+        assert calls["n"] == 4  # the slow handler ran ONCE
+        client2.close()
+    finally:
+        svc.shutdown()
+
+
+def test_close_during_reconnect_does_not_park():
+    """close() racing a mid-heal request: while ``_reconnect`` is dialing,
+    ``_sock`` is None, so close() has no socket to cut through — the
+    reconnect must notice the closed latch after the dial and retire the
+    fresh socket itself, or the request parks forever in recv on a
+    connection close() never saw (a listener backlog accepts the dial;
+    nobody ever serves it)."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    try:
+        client = BasicClient(("127.0.0.1", lsock.getsockname()[1]),
+                             secret=SECRET, timeout_s=None,
+                             reconnect=_FAST)
+        real_dial = client._dial
+
+        def dial_then_teardown(*args, **kwargs):
+            sock = real_dial(*args, **kwargs)
+            client.close()  # teardown lands while _sock is still None
+            return sock
+
+        client._dial = dial_then_teardown
+        client._broken = True
+        result = {}
+
+        def go():
+            try:
+                client.request("x")
+                result["r"] = "returned"
+            except Exception as exc:  # noqa: BLE001 - recording the type
+                result["r"] = type(exc).__name__
+        t = threading.Thread(target=go, daemon=True)
+        t.start()
+        t.join(5.0)
+        assert result.get("r") == "WireError", (
+            f"request on a closed client parked instead of failing: "
+            f"{result or 'still running'}")
+    finally:
+        lsock.close()
+
+
+def test_matrix_worker_assertion_never_certifies_as_escalation():
+    """A rank that dies of its own bit-exact assertion produced WRONG
+    RESULTS; the matrix must classify that ``worker-failure`` (accepted by
+    no cell), never ``escalated`` — or --allow-escalation sweeps would
+    certify silent corruption as a passing escalation."""
+    from horovod_tpu.chaos.matrix import _classify_worker_failure
+    from horovod_tpu.core.status import RanksAbortedError, failure_record
+    from horovod_tpu.runner.run_api import WorkerFailedError
+
+    wrong = failure_record(AssertionError("arrays differ"), "Traceback ...")
+    aborted = failure_record(
+        RanksAbortedError([1], "rank 1 exited mid-job"), "Traceback ...")
+    assert _classify_worker_failure(
+        WorkerFailedError([(0, "assert")], records={0: wrong})
+    ) == "worker-failure"
+    # ...even alongside a genuine abort on another rank
+    assert _classify_worker_failure(
+        WorkerFailedError([(0, "assert"), (1, "abort")],
+                          records={0: wrong, 1: aborted})
+    ) == "worker-failure"
+    # pure world faults, and old-format peers with no records, escalate
+    assert _classify_worker_failure(
+        WorkerFailedError([(1, "abort")], records={1: aborted})
+    ) == "escalated"
+    assert _classify_worker_failure(
+        WorkerFailedError([(1, "abort")])) == "escalated"
+
+
+def test_oversized_response_not_retained_for_replay():
+    """The dedup slot must not pin payload-frame-sized responses (a
+    departed client's slot survives until LRU displacement — retaining a
+    fusion-threshold frame there leaks it for the rest of the job). An
+    oversized response is served normally but only a sentinel is
+    retained: a resend whose original frame was lost gets a deliberate
+    RemoteError (escalation), never a hang; small responses replay
+    verbatim."""
+    from horovod_tpu.runner.network import (
+        BasicService,
+        Preserialized,
+        RemoteError,
+    )
+
+    svc_ref = {}
+
+    def handler(req, _sock):
+        if req == "big":
+            return Preserialized(
+                svc_ref["svc"].wire.frame(b"x" * (2 << 20)))
+        return ("small", req)
+
+    svc = BasicService("retain-test", handler, secret=SECRET)
+    svc_ref["svc"] = svc
+    try:
+        client = BasicClient(("127.0.0.1", svc.port), secret=SECRET,
+                             reconnect=_FAST)
+        assert client.request("big") == b"x" * (2 << 20)
+        # a duplicate of that seq (the client's resend after a lost
+        # response frame) cannot be replayed — it must fail loudly
+        raw = socket.create_connection(("127.0.0.1", svc.port))
+        wire = Wire(SECRET)
+        wire.write(("#rpc", client._client_id, client._seq - 1, "big"), raw)
+        resp = wire.read(raw)
+        assert isinstance(resp, RemoteError)
+        assert "retention cap" in resp.message
+        # small responses stay replayable
+        out = client.request("s")
+        wire.write(("#rpc", client._client_id, client._seq - 1, "s"), raw)
+        assert wire.read(raw) == out
+        raw.close()
+        client.close()
+    finally:
+        svc.shutdown()
+
+
+def test_request_raw_latches_broken_after_timeout():
+    """The native binary wire has no dedup: a timed-out request_raw must
+    NOT be resent, but the latch must force a fresh connection so the
+    next request cannot read the stale late response."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(4)
+    wire = Wire(SECRET)
+    conns = []
+
+    def server():
+        # conn 1: delay the first response past the client timeout
+        conn, _ = lsock.accept()
+        conns.append(conn)
+        body = wire.read_raw(conn)
+        assert body == b"a"
+        time.sleep(0.5)
+        try:
+            conn.sendall(wire.frame_raw(b"resp-a"))  # lands in a dead buffer
+        except OSError:
+            pass
+        # conn 2: the latched client reconnects; serve normally
+        conn, _ = lsock.accept()
+        conns.append(conn)
+        assert wire.read_raw(conn) == b"b"
+        conn.sendall(wire.frame_raw(b"resp-b"))
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    client = BasicClient(("127.0.0.1", lsock.getsockname()[1]),
+                         secret=SECRET, timeout_s=0.2, reconnect=_FAST)
+    with pytest.raises(OSError):
+        client.request_raw(b"a")
+    assert client._broken
+    time.sleep(0.5)  # let the late resp-a land in the dead buffer first
+    assert client.request_raw(b"b") == b"resp-b"  # fresh stream, not resp-a
+    t.join(timeout=10)
+    client.close()
+    lsock.close()
+    for conn in conns:
+        conn.close()
+
+
+def test_corrupt_frame_error_is_wire_error():
+    """Compatibility: HMAC mismatches keep raising WireError (the new
+    CorruptFrameError subclass) so existing handlers still catch them."""
+    assert issubclass(CorruptFrameError, WireError)
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(2)
+
+    def server():
+        # every attempt gets a wrong-secret frame: a wrong key fails the
+        # whole retry budget and surfaces as the HMAC diagnostic
+        while True:
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            try:
+                Wire(SECRET).read(conn)
+                conn.sendall(Wire(b"a" * 32).frame(("evil",)))
+            except (WireError, OSError):
+                pass
+
+    threading.Thread(target=server, daemon=True).start()
+    client = BasicClient(("127.0.0.1", lsock.getsockname()[1]),
+                         secret=SECRET, timeout_s=2.0,
+                         reconnect=ReconnectPolicy(attempts=2,
+                                                   backoff_s=0.05))
+    with pytest.raises(WireError) as excinfo:
+        client.request("x")
+    assert "HMAC mismatch" in str(excinfo.value)
+    client.close()
+    lsock.close()
+
+
+# -- satellite: probe_addresses / multi-candidate connect ---------------------
+
+def _listener():
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(8)
+    return sock
+
+
+def test_probe_addresses_unreachable_candidate_fallback():
+    live = _listener()
+    dead = _listener()
+    dead_addr = dead.getsockname()
+    dead.close()  # nothing listens here anymore
+    candidates = {"dead": dead_addr, "live": live.getsockname()}
+    reachable = probe_addresses(candidates, timeout_s=1.0)
+    assert reachable == {"live": live.getsockname()}
+    # the client lands on the reachable candidate
+    svc = BasicService("probe-stub", lambda req, s: ("ok", req),
+                       secret=SECRET)
+    client = BasicClient({"dead": dead_addr,
+                          "svc": ("127.0.0.1", svc.port)},
+                         secret=SECRET, timeout_s=2.0)
+    assert client.connected_intf == "svc"
+    assert client.request("hi") == ("ok", "hi")
+    client.close()
+    svc.shutdown()
+    live.close()
+
+
+def test_connect_all_unreachable_error_text():
+    gone1, gone2 = _listener(), _listener()
+    a1, a2 = gone1.getsockname(), gone2.getsockname()
+    gone1.close()
+    gone2.close()
+    with pytest.raises(WireError) as excinfo:
+        BasicClient({"a": a1, "b": a2}, secret=SECRET, attempts=2,
+                    retry_delay_s=0.05, timeout_s=0.5)
+    msg = str(excinfo.value)
+    assert "unable to connect" in msg
+    assert str(a1[1]) in msg and str(a2[1]) in msg  # names every candidate
+    with pytest.raises(WireError) as excinfo:
+        BasicClient({}, secret=SECRET)
+    assert "empty candidate" in str(excinfo.value)
+
+
+def test_reconnect_chooses_surviving_interface():
+    """Reconnect re-probes ALL candidates: when the first-connect
+    interface dies, the retry must land on another candidate, not spin on
+    the dead one."""
+    svc_a = BasicService("intf-a", lambda req, s: ("from-a", req),
+                        secret=SECRET)
+    b_listener = _listener()  # reserves the port, not serving yet
+    b_addr = b_listener.getsockname()
+    candidates = {"a": ("127.0.0.1", svc_a.port), "b": b_addr}
+    b_listener.close()  # during the first connect, only "a" is reachable
+    client = BasicClient(candidates, secret=SECRET, timeout_s=2.0,
+                         reconnect=ReconnectPolicy(attempts=5,
+                                                   backoff_s=0.1))
+    assert client.connected_intf == "a"
+    assert client.request("one") == ("from-a", "one")
+    # interface "a" dies; "b" comes up on its reserved address
+    svc_b = BasicService("intf-b", lambda req, s: ("from-b", req),
+                         secret=SECRET, port=b_addr[1])
+    svc_a.shutdown()
+    client._broken = True  # the drop is noticed at the next request
+    assert client.request("two") == ("from-b", "two")
+    assert client.connected_intf == "b"
+    client.close()
+    svc_b.shutdown()
+
+
+# -- controller reconnect window ----------------------------------------------
+
+def _request_of(rank, name):
+    from horovod_tpu.ops.messages import DataType, Request, RequestType
+
+    return Request(request_rank=rank, request_type=RequestType.ALLREDUCE,
+                   tensor_name=name, tensor_type=DataType.FLOAT32,
+                   tensor_shape=(4,))
+
+
+def test_reconnect_window_heals_dropped_rank():
+    """A rank-bound connection that drops and reconnects inside the
+    window is forgiven: no abort, the world keeps cycling."""
+    from horovod_tpu.core.config import Config
+    from horovod_tpu.ops.controller import (
+        ControllerClient,
+        ControllerService,
+        make_negotiator,
+    )
+    from horovod_tpu.ops.messages import RequestList
+
+    cfg = Config.from_env()
+    service = ControllerService(2, make_negotiator(2, cfg), secret=SECRET,
+                                port=0, reconnect_window_s=3.0)
+    addr = ("127.0.0.1", service.port)
+    c0 = ControllerClient(addr, secret=SECRET, rank=0)
+    c1 = ControllerClient(addr, secret=SECRET, rank=1)
+    outs = {}
+    t = threading.Thread(target=lambda: outs.setdefault(
+        0, c0.cycle(0, RequestList(rank=0,
+                                   requests=[_request_of(0, "w.t")]))))
+    t.start()
+    # rank 1's transport dies mid-world; the client latches and heals
+    c1._client._sock.close()
+    c1._client._broken = True
+    time.sleep(0.3)  # let the service notice the EOF and open the window
+    outs[1] = c1.cycle(1, RequestList(rank=1,
+                                      requests=[_request_of(1, "w.t")]))
+    t.join(timeout=20)
+    assert set(outs) == {0, 1}
+    for out in outs.values():
+        assert [n for r in out.responses
+                for n in r.tensor_names] == ["w.t"]
+    c0.close()
+    c1.close()
+    service.shutdown()
+
+
+def test_initial_hello_lost_response_still_binds_rank(monkeypatch):
+    """Regression: the re-identify hook must be armed BEFORE the initial
+    hello (inside connect_with_hello), not after it returns. A dropped
+    hello response heals by reconnect + resend, and the service dedup
+    REPLAYS the stored reply without invoking the handler — only the
+    hook's bare hello binds the fresh connection, so arming late left a
+    healthy rank anonymous, to be spuriously aborted at reconnect-window
+    expiry."""
+    from horovod_tpu.core.config import Config
+    from horovod_tpu.ops.controller import (
+        ControllerClient,
+        ControllerService,
+        make_negotiator,
+    )
+    from horovod_tpu.ops.messages import RequestList
+
+    monkeypatch.setenv("HOROVOD_CHAOS", "drop@rank1:msg1")
+    cfg = Config.from_env()
+    service = ControllerService(2, make_negotiator(2, cfg), secret=SECRET,
+                                port=0, reconnect_window_s=5.0)
+    addr = ("127.0.0.1", service.port)
+    c0 = ControllerClient(addr, secret=SECRET, rank=0)
+    c1 = ControllerClient(addr, secret=SECRET, rank=1)
+    inj = c1._client._chaos
+    assert ("drop", 1) in inj.events and c1._client.reconnects == 1
+    time.sleep(0.6)  # let the service notice the retired socket's EOF
+    with service._lock:
+        assert 1 in service._rank_conns  # the healed connection is bound
+        assert not service._pending_reconnect  # the old EOF was anonymous
+    # the world is genuinely healthy: a full negotiation cycle completes
+    outs = {}
+    t = threading.Thread(target=lambda: outs.setdefault(
+        0, c0.cycle(0, RequestList(rank=0,
+                                   requests=[_request_of(0, "h.t")]))))
+    t.start()
+    outs[1] = c1.cycle(1, RequestList(rank=1,
+                                      requests=[_request_of(1, "h.t")]))
+    t.join(timeout=20)
+    assert set(outs) == {0, 1}
+    c0.close()
+    c1.close()
+    service.shutdown()
+
+
+def test_reconnect_window_expiry_escalates_structured():
+    """A rank that never returns is declared dead at window expiry — the
+    survivor's poisoned cycle names it with the structured abort tag,
+    inside a bounded wall-clock."""
+    from horovod_tpu.core.config import Config
+    from horovod_tpu.ops.controller import (
+        ControllerClient,
+        ControllerService,
+        make_negotiator,
+    )
+    from horovod_tpu.ops.messages import RequestList
+
+    cfg = Config.from_env()
+    service = ControllerService(2, make_negotiator(2, cfg), secret=SECRET,
+                                port=0, reconnect_window_s=1.0)
+    addr = ("127.0.0.1", service.port)
+    c0 = ControllerClient(addr, secret=SECRET, rank=0)
+    c1 = ControllerClient(addr, secret=SECRET, rank=1)
+    c1._client.close()  # abrupt death, never reconnects
+    t0 = time.monotonic()
+    with pytest.raises(WireError) as excinfo:
+        c0.cycle(0, RequestList(rank=0, requests=[_request_of(0, "e.t")]))
+    elapsed = time.monotonic() - t0
+    assert "[aborted ranks: 1]" in str(excinfo.value)
+    assert 0.5 < elapsed < 10.0, elapsed  # gated by the window, bounded
+    c0.close()
+    service.shutdown()
+
+
+def test_reconnect_window_zero_keeps_immediate_abort():
+    from horovod_tpu.core.config import Config
+    from horovod_tpu.ops.controller import (
+        ControllerClient,
+        ControllerService,
+        make_negotiator,
+    )
+    from horovod_tpu.ops.messages import RequestList
+
+    cfg = Config.from_env()
+    service = ControllerService(2, make_negotiator(2, cfg), secret=SECRET,
+                                port=0, reconnect_window_s=0.0)
+    addr = ("127.0.0.1", service.port)
+    c0 = ControllerClient(addr, secret=SECRET, rank=0)
+    c1 = ControllerClient(addr, secret=SECRET, rank=1)
+    c1._client.close()
+    t0 = time.monotonic()
+    with pytest.raises(WireError) as excinfo:
+        c0.cycle(0, RequestList(rank=0, requests=[_request_of(0, "z.t")]))
+    assert "[aborted ranks: 1]" in str(excinfo.value)
+    assert time.monotonic() - t0 < 5.0
+    c0.close()
+    service.shutdown()
+
+
+# -- satellite: structured failure records ------------------------------------
+
+def test_failure_record_structured_attribution():
+    from horovod_tpu.core.status import RanksAbortedError, failure_record
+
+    record = failure_record(
+        RanksAbortedError([2, 1], "stalled, aborting"), "Traceback ...")
+    assert record["format"] == 1
+    assert record["aborted_ranks"] == [1, 2]
+    assert record["world_fault"] is True
+    assert record["error_type"] == "RanksAbortedError"
+    user = failure_record(KeyError("bug"), "Traceback ...")
+    assert user["aborted_ranks"] is None and user["world_fault"] is False
+    # text-tagged reasons still attribute even without a .ranks attr
+    tagged = failure_record(
+        RuntimeError("shut down [aborted ranks: 3]"), "tb")
+    assert tagged["aborted_ranks"] == [3] and tagged["world_fault"]
+
+
+def test_worker_failed_error_prefers_structured_records():
+    from horovod_tpu.elastic.driver import _failed_ranks, _is_world_fault
+    from horovod_tpu.runner.run_api import WorkerFailedError
+
+    structured = WorkerFailedError(
+        [(0, "Traceback: RanksAbortedError ...")],
+        records={0: {"format": 1, "aborted_ranks": [2],
+                     "world_fault": True, "traceback": "tb"}})
+    assert _failed_ranks(structured) == [2]
+    assert _is_world_fault(structured)
+    # structured and explicitly NOT a world fault: the record wins even
+    # if the traceback text would have matched the old regexes
+    user_bug = WorkerFailedError(
+        [(1, "user assert mentioning shut down in a string")],
+        records={1: {"format": 1, "aborted_ranks": None,
+                     "world_fault": False, "traceback": "tb"}})
+    assert not _is_world_fault(user_bug)
+    assert _failed_ranks(user_bug) == [1]
+    # old-format peers (no records): the text fallback still works
+    legacy = WorkerFailedError([(0, "shut down [aborted ranks: 2]")])
+    assert _failed_ranks(legacy) == [2]
+    assert _is_world_fault(legacy)
+
+
+# -- tier-1 acceptance: 2-process single-fault matrix -------------------------
+
+@pytest.mark.parametrize("native_core", ["0", "1"])
+def test_mp_single_fault_drop_heals_bit_exact(native_core):
+    """THE chaos contract, on both negotiation cores: a 2-process world
+    under drop injection — at a cold negotiation boundary (msg6) and
+    through the warm cache-ack steady state (every9) — completes with
+    results bit-exact to the fault-free run."""
+    from horovod_tpu.chaos.matrix import run_cell
+
+    cell = run_cell("drop@rank1:msg6,drop@rank1:every9",
+                    native_controller=0, native_core=int(native_core))
+    assert cell["outcome"] == "healed", cell
+    r1 = next(r for r in cell["results"] if r["rank"] == 1)
+    assert r1["events"], "no fault fired — the cell proved nothing"
+    assert r1["reconnects"] >= 1
+    # the faults kept firing through response-cache steady state
+    assert r1["hit_cycles"] > 0, r1
+
+
+def test_mp_fault_beyond_budget_escalates_within_deadline():
+    """Escalation guarantee: a fault exceeding the retry budget surfaces
+    as a structured RanksAbortedError on the healthy rank within the
+    stall-shutdown deadline — never a wedge."""
+    from horovod_tpu.chaos.matrix import ESCALATION_SPEC, run_cell
+
+    cell = run_cell(ESCALATION_SPEC, native_controller=0, native_core=1,
+                    expect_escalation=True)
+    assert cell["outcome"] == "escalated", cell
+    assert cell["elapsed_s"] < 60.0, cell
+    if "results" in cell:
+        aborted = [r for r in cell["results"]
+                   if r.get("outcome") == "escalated"]
+        assert any(1 in r.get("aborted_ranks", []) for r in aborted), cell
+
+
+# -- slow tier: the full single-fault grid + multi-fault soak -----------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("native_core", ["0", "1"])
+@pytest.mark.parametrize("spec_idx", [0, 1, 2, 3])
+def test_mp_single_fault_grid_slow(spec_idx, native_core):
+    from horovod_tpu.chaos.matrix import DEFAULT_SPECS, run_cell
+
+    cell = run_cell(DEFAULT_SPECS[spec_idx], native_controller=0,
+                    native_core=int(native_core))
+    assert cell["outcome"] == "healed", cell
+
+
+@pytest.mark.slow
+def test_mp_multi_fault_soak():
+    """Every fault kind at once, repeatedly, through warm steady state:
+    recovery-or-escalation, never a wedge."""
+    from horovod_tpu.chaos.matrix import run_cell
+
+    cell = run_cell(
+        "drop@rank1:every7,corrupt@rank1:every11,close@rank1:every13,"
+        "delay@rank1:20ms:every5,delay@rank0:10ms:every9,"
+        "refuse@relaunch:1,seed:3",
+        native_controller=0, native_core=1, steps=16,
+        expect_escalation=True)
+    assert cell["outcome"] in ("healed", "escalated"), cell
+
+
+@pytest.mark.slow
+def test_mp_native_controller_never_wedges():
+    """Under the native (C++) controller the binary wire has no dedup, so
+    transport faults escalate instead of healing — the guarantee to pin
+    is heal-or-escalate inside the deadline, never a hang."""
+    from horovod_tpu.chaos.matrix import run_cell
+
+    for spec in ("drop@rank1:msg6", "delay@rank1:40ms:every5"):
+        cell = run_cell(spec, native_controller=1, native_core=1,
+                        expect_escalation=True)
+        assert cell["outcome"] in ("healed", "escalated"), cell
+        assert cell["elapsed_s"] < 90.0, cell

@@ -304,34 +304,49 @@ def test_hello_retries_through_dying_server_backlog():
     connect+hello pair, not give up on the first EOF."""
     import socket
 
-    from horovod_tpu.runner.network import Wire
+    from horovod_tpu.runner.network import Wire, WireError
 
     lsock = socket.socket()
     lsock.bind(("127.0.0.1", 0))
     lsock.listen(8)
     port = lsock.getsockname()[1]
     wire = Wire(SECRET)
-    served = {"conns": 0}
+    served = {"conns": 0, "hellos": 0}
 
     def server() -> None:
         # conn 1: the dying-server backlog victim — closed unserved
         conn, _ = lsock.accept()
         served["conns"] += 1
         conn.close()
-        # conn 2: a live service — answer the hello properly
+        # conn 2: a live service — like the real one, serve requests
+        # until the client hangs up. A healed connection carries TWO
+        # hellos: the on_reconnect bare re-identify (armed before the
+        # initial hello — see connect_with_hello) and then the resent
+        # sequenced request.
         conn, _ = lsock.accept()
         served["conns"] += 1
-        req = wire.read(conn)
-        assert req == ("hello", 0, ""), req  # world id rides the hello
-        conn.sendall(wire.frame(("ok",)))
+        while True:
+            try:
+                req = wire.read(conn)
+            except (WireError, OSError):
+                break  # client closed the healed connection
+            if isinstance(req, tuple) and req[0] == "#rpc":
+                req = req[3]  # unwrap the dedup envelope (BasicService)
+            if req == ("bye", 0):  # clean detach from close()
+                conn.sendall(wire.frame(("ok",)))
+                continue
+            assert req == ("hello", 0, ""), req  # world id rides the hello
+            served["hellos"] += 1
+            conn.sendall(wire.frame(("ok",)))
         conn.close()
 
     t = threading.Thread(target=server, daemon=True)
     t.start()
     client = ControllerClient(("127.0.0.1", port), secret=SECRET, rank=0)
+    client.close()
     t.join(timeout=10)
     assert served["conns"] == 2  # first EOF'd, second served the hello
-    client.close()
+    assert served["hellos"] >= 1  # the retried hello reached the service
     lsock.close()
 
 

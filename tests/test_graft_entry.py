@@ -73,6 +73,28 @@ def test_dryrun_elastic_restart_subprocess():
     assert "elastic restart OK" in result.stderr
 
 
+@pytest.mark.slow
+def test_dryrun_chaos_subprocess():
+    """The chaos certification, exactly as the driver invokes it.
+    Slow-tier: the same drop→reconnect→dedup machinery is pinned in
+    tier-1 by test_chaos.py's acceptance matrix."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    result = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_chaos(); print('OK')"],
+        cwd=root, env=env, capture_output=True, text=True, timeout=300)
+    assert result.returncode == 0, result.stderr
+    assert "OK" in result.stdout
+    assert "chaos OK" in result.stderr
+
+
 def test_init_on_host_cpu_noop_on_cpu():
     """On a CPU default backend the helper defers to plain on-device init
     (None) — there is no separate host backend to shelter compiles on."""

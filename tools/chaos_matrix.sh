@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Sweep the chaos fault grid (docs/chaos.md) across both controller
+# implementations and both negotiation cores:
+#
+#   HOROVOD_NATIVE_CONTROLLER=0/1  — Python vs C++ controller service
+#   HOROVOD_NATIVE_CORE=0/1        — Python vs C++ negotiation core
+#
+# Every cell must end "healed" or "escalated", never hang. The Python
+# controller wire carries the request-dedup envelope, so single faults
+# HEAL there; the native controller's binary wire has no dedup, so faults
+# escalate by design (--allow-escalation). Extra args are forwarded to
+# horovod_tpu.chaos.matrix (e.g. --spec "drop@rank1:every5" --steps 16).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+for nc in 0 1; do
+  for core in 0 1; do
+    echo "=== HOROVOD_NATIVE_CONTROLLER=$nc HOROVOD_NATIVE_CORE=$core ==="
+    extra=()
+    if [ "$nc" = "1" ]; then
+      extra+=(--allow-escalation)
+    fi
+    if ! JAX_PLATFORMS=cpu HOROVOD_NATIVE_CONTROLLER=$nc \
+        HOROVOD_NATIVE_CORE=$core \
+        python -m horovod_tpu.chaos.matrix "${extra[@]}" "$@"; then
+      rc=1
+    fi
+  done
+done
+
+echo "=== escalation cell (refuse budget beyond retry) ==="
+if ! JAX_PLATFORMS=cpu HOROVOD_NATIVE_CONTROLLER=0 \
+    python -m horovod_tpu.chaos.matrix --escalation; then
+  rc=1
+fi
+
+exit $rc

@@ -65,7 +65,8 @@ if _platform:
     del _jax, _live
 del _os, _platform
 
-from . import callbacks, checkpoint, elastic, parallel, runner
+from . import callbacks, checkpoint, elastic, obs, parallel, runner
+from .obs import metrics_snapshot
 from .basics import (
     cross_rank,
     cross_size,
@@ -129,7 +130,7 @@ __all__ = [
     "allreduce", "allreduce_async", "allgather", "allgather_async",
     "broadcast", "broadcast_async", "poll", "synchronize", "release",
     "Compression", "spmd", "parallel", "callbacks", "checkpoint",
-    "elastic",
+    "elastic", "obs", "metrics_snapshot",
     "IndexedSlices", "allreduce_sparse", "flash_attention",
     "DistributedOptimizer", "allreduce_gradients",
     "broadcast_parameters", "broadcast_optimizer_state",

@@ -131,6 +131,36 @@ def init(ranks=None, comm=None) -> None:
 
             start_subset_service(list(ranks))
         epoch = world_epoch()
+        # Observability plane (docs/metrics.md): world-identity gauges,
+        # plus the opt-in HTTP exposition server on rank 0. Gauges are set
+        # on every rank; the server only where the aggregated view lives.
+        from .obs.registry import registry as _metrics_registry
+
+        reg = _metrics_registry()
+        reg.gauge("horovod_world_size",
+                  "World size in processes").set(topo.size)
+        reg.gauge("horovod_world_rank",
+                  "This process's world rank").set(topo.rank)
+        reg.gauge("horovod_elastic_world_epoch",
+                  "Elastic world epoch (0 = first launch)").set(epoch)
+        if _global.config.metrics_port and topo.rank == 0 \
+                and topo.is_member:
+            from .obs import exposition as _expo, world_snapshot_provider
+
+            try:
+                server = _expo.serve(_global.config.metrics_port,
+                                     world_snapshot_provider)
+                _global.engine_shutdown_hooks.append(server.close)
+                LOG.info("metrics exposition serving on "
+                         "http://127.0.0.1:%d/metrics (and /metrics.json)",
+                         server.port)
+            except OSError as exc:
+                # Observability must never take the job down: a taken
+                # port degrades to no exposition, loudly.
+                LOG.warning("HOROVOD_METRICS_PORT=%d: exposition server "
+                            "failed to start (%s); metrics HTTP disabled "
+                            "for this run", _global.config.metrics_port,
+                            exc)
         if epoch > 0:
             # An elastic relaunch: say so at default verbosity — operators
             # reading a worker log must be able to tell attempt N from a

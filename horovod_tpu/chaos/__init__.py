@@ -59,6 +59,14 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from ..core.config import HOROVOD_CHAOS
+from ..obs.registry import registry as _metrics
+
+# Observability plane (docs/metrics.md): every fired fault counts here
+# beside the per-injector ``events`` audit trail (events stay the replay
+# proof; the counter is the live operational signal).
+_CHAOS_INJECTIONS = _metrics().counter(
+    "horovod_chaos_injections_total",
+    "Faults injected by the HOROVOD_CHAOS plane", labels=("kind",))
 
 
 class ChaosSpecError(ValueError):
@@ -227,6 +235,7 @@ class ChaosInjector:
         rule = self._armed.pop(kind, None)
         if rule is not None:
             self.events.append((kind, self.ordinal))
+            _CHAOS_INJECTIONS.labels(kind=kind).inc()
         return rule
 
     # -- lifecycle hooks ------------------------------------------------------
@@ -261,6 +270,7 @@ class ChaosInjector:
             if used < rule.refusals:
                 self._episode_refusals[id(rule)] = used + 1
                 self.events.append(("refuse", self.ordinal))
+                _CHAOS_INJECTIONS.labels(kind="refuse").inc()
                 raise ConnectionRefusedError(
                     f"chaos: reconnect refused ({rule.describe()}, "
                     f"refusal {used + 1}/{rule.refusals})")

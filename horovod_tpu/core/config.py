@@ -126,6 +126,19 @@ HOROVOD_RECONNECT_ATTEMPTS = "HOROVOD_RECONNECT_ATTEMPTS"
 HOROVOD_RECONNECT_BACKOFF = "HOROVOD_RECONNECT_BACKOFF_S"
 HOROVOD_RECONNECT_MAX_BACKOFF = "HOROVOD_RECONNECT_MAX_BACKOFF_S"
 
+# --- observability plane (horovod_tpu.obs; ours, docs/metrics.md) ------------
+# HTTP exposition of the metrics registry on rank 0: Prometheus text at
+# /metrics, JSON snapshot at /metrics.json, loopback-bound. 0 or unset =
+# no server, no thread (strictly opt-in).
+HOROVOD_METRICS_PORT = "HOROVOD_METRICS_PORT"
+# Seconds between each rank's registry-snapshot pushes to the coordinator
+# (the cross-rank aggregation feed, an anonymous control-wire channel).
+# The publisher is as opt-in as the server: it runs only when
+# HOROVOD_METRICS_PORT is set or this interval is set explicitly (a job
+# with neither spawns no thread and no connection); <= 0 disables it
+# outright, and world snapshots then carry the calling rank only.
+HOROVOD_METRICS_INTERVAL = "HOROVOD_METRICS_INTERVAL_S"
+
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:1838
 DEFAULT_CACHE_CAPACITY = 1024  # upstream response_cache.cc default
 DEFAULT_CYCLE_TIME_MS = 5.0  # operations.cc:1846
@@ -182,6 +195,12 @@ class Config:
     autotune_log: str = ""
     start_timeout_s: float = DEFAULT_START_TIMEOUT_S
     data_plane: str = "auto"
+    metrics_port: int = 0
+    metrics_interval_s: float = 2.0
+    # True when HOROVOD_METRICS_INTERVAL_S was set explicitly: the
+    # publisher runs iff the port or the interval was asked for (same
+    # pattern as reconnect_window_explicit)
+    metrics_interval_explicit: bool = False
     chaos_spec: str = ""
     reconnect_window_s: float = 5.0
     # True when HOROVOD_RECONNECT_WINDOW_S was set explicitly: the engine
@@ -224,6 +243,10 @@ class Config:
             start_timeout_s=_env_float(
                 HOROVOD_START_TIMEOUT, DEFAULT_START_TIMEOUT_S),
             data_plane=os.environ.get(HOROVOD_DATA_PLANE, "auto"),
+            metrics_port=max(_env_int(HOROVOD_METRICS_PORT, 0), 0),
+            metrics_interval_s=_env_float(HOROVOD_METRICS_INTERVAL, 2.0),
+            metrics_interval_explicit=bool(
+                os.environ.get(HOROVOD_METRICS_INTERVAL)),
             chaos_spec=os.environ.get(HOROVOD_CHAOS, ""),
             reconnect_window_s=_env_float(HOROVOD_RECONNECT_WINDOW, 5.0),
             reconnect_window_explicit=bool(

@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..core import config as _config
 from ..core.logging import LOG
 from ..core.status import parse_aborted_ranks
+from ..obs.registry import registry as _metrics
 from ..runner.launcher import LaunchError
 from ..runner.network import make_secret
 from ..runner.run_api import (
@@ -38,6 +39,16 @@ from ..runner.run_api import (
     _execute_world,
 )
 from .health import ElasticService
+
+
+# Observability plane (docs/metrics.md): driver-process families (the
+# launcher's registry, not the workers' — each process snapshots its own).
+_ELASTIC_FAILURES = _metrics().counter(
+    "horovod_elastic_attempt_failures_total",
+    "Elastic attempts that ended in a recoverable world fault")
+_ELASTIC_RELAUNCHES = _metrics().counter(
+    "horovod_elastic_relaunches_total",
+    "Worlds relaunched by run_elastic after a failed attempt")
 
 
 class WorkerDeadError(RuntimeError):
@@ -197,6 +208,7 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                     # (upstream elastic likewise only recovers from
                     # HorovodInternalError-class failures)
                     raise
+                _ELASTIC_FAILURES.inc()
                 last_err = exc
                 failed = _failed_ranks(exc)
                 for rank in failed:
@@ -213,6 +225,7 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                     raise ElasticExhaustedError(
                         f"gave up after {max_restarts} restart(s); last "
                         f"failure: {exc}") from exc
+                _ELASTIC_RELAUNCHES.inc()
                 delay = backoff_s * (2.0 ** (epoch - 1))
                 LOG.warning("elastic backoff: %.1fs before relaunch",
                             delay)

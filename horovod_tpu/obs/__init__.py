@@ -1,0 +1,108 @@
+"""Observability plane: unified metrics registry + cross-rank aggregation.
+
+The subsystem docs live in docs/metrics.md; the pieces:
+
+* :mod:`.registry` — process-local counters/gauges/mergeable histograms
+  plus ``merge_snapshots`` (the pointwise world fold);
+* :mod:`.exposition` — Prometheus text + JSON rendering, the loopback
+  HTTP server (``HOROVOD_METRICS_PORT``), and the ``parse_prometheus``
+  format-lint helper;
+* :mod:`.bridge` — registry deltas as ``Timeline.counter`` tracks so the
+  existing Chrome-tracing tooling keeps working;
+* :func:`metrics_snapshot` — the Python API: this process's families, or
+  the world-aggregated view rank 0's coordinator assembled from the
+  per-rank pushes riding the HMAC control wire.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .registry import (  # noqa: F401 - public surface
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    merge_snapshots,
+    registry,
+)
+from .bridge import TimelineBridge  # noqa: F401
+from . import exposition  # noqa: F401
+
+
+def _pull_world_store(client) -> Dict[int, dict]:
+    """Fetch the coordinator's per-rank snapshot store over a transient
+    ANONYMOUS control-wire connection — never the engine's cycle client,
+    whose request lock a pull would contend with mid-negotiation (the
+    "metrics must not perturb the cycle" contract)."""
+    from ..runner.network import BasicClient
+
+    pull = None
+    try:
+        pull = BasicClient(client._addr, secret=client._secret,
+                           timeout_s=5.0, attempts=3)
+        kind, store = pull.request(
+            ("metrics_pull", getattr(client, "_world_id", "")))
+        assert kind == "metrics", kind
+        return dict(store)
+    finally:
+        if pull is not None:
+            pull.close()
+
+
+def metrics_snapshot(world: bool = False):
+    """Live metrics of this job (docs/metrics.md).
+
+    ``world=False``: this process's registry families, as a plain dict.
+
+    ``world=True``: ``{"world": merged_families, "ranks": {rank:
+    families}}`` — the merged view plus the per-rank snapshots it was
+    folded from. On the rank hosting the Python controller service the
+    per-rank section is the coordinator's live push store; other ranks
+    pull that store over a transient control-wire connection. This
+    process's own entry is always refreshed from its live registry, so
+    local families are exact while remote ones are as fresh as the last
+    publisher push (``HOROVOD_METRICS_INTERVAL_S``; publishers run only
+    when the plane is opted into — port or interval set — so an
+    un-opted-in job's world view carries this rank alone). Size-1 worlds
+    and the native (C++) controller — whose fixed binary wire predates
+    the metrics RPC — degrade to a world of this rank alone too."""
+    local = registry().snapshot()
+    if not world:
+        return local
+    rank = 0
+    engine = None
+    try:
+        from .. import basics
+        from ..ops import engine as _engine_mod
+
+        if basics.is_initialized():
+            rank = basics.rank()
+        engine = _engine_mod._engine
+    except Exception:  # noqa: BLE001 - pre-init callers get local-only
+        pass
+    store: Dict[int, dict] = {}
+    if engine is not None and not getattr(engine, "_native_controller",
+                                          False):
+        service = getattr(engine, "_service", None)
+        client = getattr(engine, "_client", None)
+        if service is not None and hasattr(service, "metrics_store"):
+            store = service.metrics_store()
+        elif client is not None and hasattr(client, "_addr"):
+            try:
+                store = _pull_world_store(client)
+            except Exception:  # noqa: BLE001 - degraded view, not a crash
+                store = {}
+    ranks = dict(store)
+    ranks[rank] = local
+    return {"world": merge_snapshots(ranks.values()), "ranks": ranks}
+
+
+def world_snapshot_provider():
+    """The exposition server's provider (``basics.init`` wires it up)."""
+    return metrics_snapshot(world=True)
+
+
+def metrics_port() -> Optional[int]:
+    """Port of the live HTTP exposition server, or None when disabled."""
+    return exposition.metrics_port()

@@ -1,0 +1,62 @@
+"""Registry → Timeline bridge: metric deltas as Chrome counter tracks.
+
+The timeline (``utils.timeline``) predates the registry and its tooling
+is established (chrome://tracing, the response-cache counter assertions
+in tests); this bridge keeps that surface alive by emitting, once per
+engine cycle, every registry family that CHANGED since the last emit as
+a ``Timeline.counter`` record named ``metrics/<family>``. Counters and
+histogram counts emit their per-interval DELTA (a rate, the useful
+trace shape); gauges emit their absolute value. Families that did not
+move emit nothing, so an idle metric costs no trace bytes.
+
+Cheap when the timeline is disabled (one attribute check), and safe
+after ``Timeline.close()`` — the timeline itself drops late events
+loudly instead of writing to a closed file (see ``utils.timeline``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from .registry import Registry
+
+
+class TimelineBridge:
+    """One per engine; ``emit()`` is called from the engine loop thread
+    only, so the delta state needs no lock."""
+
+    def __init__(self, registry: Registry, timeline) -> None:
+        self._registry = registry
+        self._timeline = timeline
+        self._last: Dict[Tuple[str, str], float] = {}
+
+    @staticmethod
+    def _series_key(labels: Dict[str, str]) -> str:
+        return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+
+    def emit(self) -> None:
+        if not self._timeline.enabled:
+            return
+        snapshot = self._registry.snapshot()
+        for name, fam in snapshot.items():
+            track: Dict[str, float] = {}
+            for sample in fam["samples"]:
+                key = self._series_key(sample.get("labels", {}))
+                if fam["type"] == "gauge":
+                    cur = sample["value"]
+                    if self._last.get((name, key)) != cur:
+                        self._last[(name, key)] = cur
+                        track[key or "value"] = cur
+                    continue
+                if fam["type"] == "histogram":
+                    series = ((key + "," if key else "") + "count",
+                              sample["count"])
+                else:
+                    series = (key or "value", sample["value"])
+                skey, cur = series
+                prev = self._last.get((name, skey), 0)
+                if cur != prev:
+                    self._last[(name, skey)] = cur
+                    track[skey] = cur - prev
+            if track:
+                self._timeline.counter("metrics/" + name, track)

@@ -1,0 +1,291 @@
+"""Metrics exposition: Prometheus text format, JSON snapshots, HTTP server.
+
+Three consumers share the same snapshot shape (docs/metrics.md):
+
+* ``GET /metrics`` — Prometheus text exposition (format 0.0.4) of the
+  WORLD-merged families, scraped by any Prometheus-compatible collector;
+* ``GET /metrics.json`` — the full structured snapshot: the merged world
+  view plus the raw per-rank snapshots it was folded from (the per-rank
+  section is what makes "world bucket sums == sum of per-rank sums"
+  checkable from one scrape, and what ``tools/metrics_summary.py``
+  pretty-prints);
+* ``horovod_tpu.metrics_snapshot(world=True)`` — the same dict, in
+  Python.
+
+The server is stdlib-only (``http.server``), loopback-bound, started by
+``hvd.init()`` on rank 0 when ``HOROVOD_METRICS_PORT`` names a port —
+0/unset means no server, no thread, no socket (the exposition plane is
+strictly opt-in). It never blocks the hot path: scrapes run on the HTTP
+thread and only take per-metric locks long enough to copy values.
+
+``parse_prometheus`` is the format-lint helper the tests and the
+``dryrun_metrics`` certification share: a tiny validating parser for the
+subset of the exposition format we emit, so "Prometheus-parseable" is an
+executable claim, not a hope.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+# -- Prometheus text rendering -------------------------------------------------
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _label_str(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _num(v) -> str:
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def render_prometheus(families: Dict[str, dict]) -> str:
+    """Render a (merged) families snapshot as Prometheus text format."""
+    lines = []
+    for name in sorted(families):
+        fam = families[name]
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid Prometheus metric name {name!r}")
+        if fam.get("help"):
+            lines.append(f"# HELP {name} " +
+                         fam["help"].replace("\n", " "))
+        lines.append(f"# TYPE {name} {fam['type']}")
+        for sample in fam["samples"]:
+            labels = sample.get("labels", {})
+            if fam["type"] == "histogram":
+                # Prometheus buckets are CUMULATIVE with an le edge label;
+                # the registry stores per-bucket counts, fold here.
+                cum = 0
+                for bound, count in zip(sample["bounds"],
+                                        sample["buckets"]):
+                    cum += count
+                    le = 'le="' + _num(float(bound)) + '"'
+                    lines.append(
+                        f"{name}_bucket{_label_str(labels, le)} {cum}")
+                cum += sample["buckets"][-1]
+                inf = 'le="+Inf"'
+                lines.append(
+                    f"{name}_bucket{_label_str(labels, inf)} {cum}")
+                lines.append(f"{name}_sum{_label_str(labels)} "
+                             f"{_num(sample['sum'])}")
+                lines.append(f"{name}_count{_label_str(labels)} "
+                             f"{sample['count']}")
+            else:
+                lines.append(f"{name}{_label_str(labels)} "
+                             f"{_num(sample['value'])}")
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^{}]*\})?"
+    r" (?P<value>[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN))$")
+_LABEL_RE = re.compile(
+    r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+
+
+def parse_prometheus(text: str) -> Dict[str, str]:
+    """Validate Prometheus text exposition; return {family: type}.
+
+    The shared format-lint helper (tests + ``dryrun_metrics``): checks
+    every sample line's shape, that each sample belongs to a declared
+    ``# TYPE`` family, that histogram buckets are cumulative and end at
+    ``+Inf`` with ``_count`` equal to the ``+Inf`` bucket. Raises
+    ``ValueError`` with the offending line on any violation."""
+    types: Dict[str, str] = {}
+    hist_state: Dict[str, dict] = {}  # family(+labels) -> bucket audit
+    for raw in text.splitlines():
+        line = raw.rstrip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4 or parts[3] not in (
+                    "counter", "gauge", "histogram"):
+                raise ValueError(f"malformed TYPE line: {line!r}")
+            types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            raise ValueError(f"unknown comment line: {line!r}")
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"malformed sample line: {line!r}")
+        name, labels_s = m.group("name"), m.group("labels") or ""
+        if labels_s:
+            inner = labels_s[1:-1]
+            for pair in _split_labels(inner):
+                if not _LABEL_RE.match(pair):
+                    raise ValueError(
+                        f"malformed label {pair!r} in line: {line!r}")
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[:-len(suffix)] if name.endswith(suffix) else None
+            if base and types.get(base) == "histogram":
+                family = base
+                break
+        if family not in types:
+            raise ValueError(f"sample without TYPE declaration: {line!r}")
+        if types[family] == "histogram" and name.endswith("_bucket"):
+            le = re.search(r'le="([^"]*)"', labels_s)
+            if le is None:
+                raise ValueError(f"histogram bucket without le: {line!r}")
+            key = family + _labels_key(labels_s, drop_le=True)
+            st = hist_state.setdefault(key, {"last": -1.0, "prev": 0.0,
+                                             "inf": None})
+            edge = float("inf") if le.group(1) == "+Inf" \
+                else float(le.group(1))
+            cum = float(m.group("value"))
+            if edge <= st["last"]:
+                raise ValueError(f"bucket edges not increasing: {line!r}")
+            if cum < st["prev"]:
+                raise ValueError(f"bucket counts not cumulative: {line!r}")
+            st["last"], st["prev"] = edge, cum
+            if edge == float("inf"):
+                st["inf"] = cum
+        elif types[family] == "histogram" and name.endswith("_count"):
+            key = family + _labels_key(labels_s)
+            st = hist_state.get(key)
+            if st is None or st["inf"] is None:
+                raise ValueError(
+                    f"histogram _count before +Inf bucket: {line!r}")
+            if float(m.group("value")) != st["inf"]:
+                raise ValueError(
+                    f"histogram _count != +Inf bucket: {line!r}")
+    for key, st in hist_state.items():
+        if st["inf"] is None:
+            raise ValueError(f"histogram {key!r} has no +Inf bucket")
+    return types
+
+
+def _labels_key(labels_s: str, drop_le: bool = False) -> str:
+    """Canonical label-set key for bucket/series matching: sorted pairs,
+    optionally without the ``le`` edge (empty set and no-braces agree)."""
+    if not labels_s:
+        return ""
+    pairs = [p for p in _split_labels(labels_s[1:-1])
+             if not (drop_le and p.startswith('le="'))]
+    return ",".join(sorted(pairs))
+
+
+def _split_labels(inner: str):
+    """Split label pairs on commas outside quoted values."""
+    out, buf, quoted, escaped = [], [], False, False
+    for ch in inner:
+        if escaped:
+            buf.append(ch)
+            escaped = False
+            continue
+        if ch == "\\":
+            buf.append(ch)
+            escaped = True
+            continue
+        if ch == '"':
+            quoted = not quoted
+            buf.append(ch)
+            continue
+        if ch == "," and not quoted:
+            out.append("".join(buf))
+            buf = []
+            continue
+        buf.append(ch)
+    if buf:
+        out.append("".join(buf))
+    return out
+
+
+# -- HTTP server ---------------------------------------------------------------
+
+
+class MetricsServer:
+    """Loopback HTTP exposition of a snapshot provider.
+
+    ``provider()`` returns ``{"world": families, "ranks": {rank:
+    families}}`` (the ``metrics_snapshot(world=True)`` shape); scrapes
+    call it fresh each time."""
+
+    def __init__(self, port: int, provider: Callable[[], dict],
+                 bind_host: str = "127.0.0.1") -> None:
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 - stdlib handler name
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = render_prometheus(
+                            outer._provider()["world"]).encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    elif self.path.split("?")[0] == "/metrics.json":
+                        body = json.dumps(outer._provider()).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404, "try /metrics or /metrics.json")
+                        return
+                except Exception as exc:  # noqa: BLE001 - surface, not hang
+                    self.send_error(500, f"snapshot failed: {exc}")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:  # scrapes are not news
+                pass
+
+        self._provider = provider
+        self._server = ThreadingHTTPServer((bind_host, port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="horovod-metrics-http",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        global _server
+        self._server.shutdown()
+        self._server.server_close()
+        if _server is self:
+            _server = None
+
+
+_server: Optional[MetricsServer] = None
+
+
+def serve(port: int, provider: Callable[[], dict]) -> MetricsServer:
+    """Start (and register as the process's) exposition server. The env
+    gate — ``HOROVOD_METRICS_PORT`` 0/unset means never call this — lives
+    with the caller (``basics.init``); here ``port`` may legitimately be
+    0 for an ephemeral test port."""
+    global _server
+    server = MetricsServer(port, provider)
+    _server = server
+    return server
+
+
+def active_server() -> Optional[MetricsServer]:
+    return _server
+
+
+def metrics_port() -> Optional[int]:
+    """Port of the live exposition server, or None when disabled — the
+    introspection hook scrape-yourself certifications use."""
+    return _server.port if _server is not None else None

@@ -1,0 +1,343 @@
+"""Process-local metrics registry: counters, gauges, mergeable histograms.
+
+The observability plane's core (docs/metrics.md). Everything this repo
+grew beyond the reference's Chrome-tracing timeline — response-cache hit
+rates (PR 3), reconnect/chaos events (PR 4), elastic epochs (PR 2), wire
+byte counters — used to live in ad-hoc attributes scattered per object;
+this registry is the one place a running job's state can be asked for
+(the 1802.05799 operational lesson: diagnosing stragglers and stalls is
+the hard part of running the system, and it needs live numbers, not
+post-hoc log scraping).
+
+Design constraints, in order:
+
+* **Hot-path cheap.** ``Counter.inc`` is one lock acquire and one int
+  add — O(1), no allocation beyond Python's int arithmetic — because it
+  sits on the wire framing path (every framed byte counts through it).
+  Locks, not bare ``+=``: the service's ``Wire`` is shared by every
+  connection handler thread, and a bytecode-level read-modify-write race
+  would silently undercount (the PR's multi-threaded-Wire satellite).
+* **Mergeable.** Cross-rank aggregation is a pointwise fold over plain
+  snapshots: counters and histogram buckets sum; gauges merge by MAX
+  (every gauge this repo registers is world-identical or per-rank
+  identity — world size, rank, epoch — and a sum would read as nonsense
+  on the world view Prometheus scrapes; per-rank values stay readable in
+  the unmerged sections). Histograms use FIXED bucket bounds chosen at
+  registration, so a world merge is a bucket-wise sum with no
+  re-binning — the property that makes
+  ``merge_snapshots(per_rank_snapshots)`` exact.
+* **Plain-data snapshots.** ``Registry.snapshot()`` returns
+  pickle/JSON-able dicts, because snapshots ride the HMAC control wire
+  (``ControllerService`` ``("metrics", rank, snap)``) and the
+  ``/metrics.json`` endpoint verbatim.
+
+Stdlib-only on purpose: the registry is imported by ``runner.network``,
+which must stay importable without jax (launcher processes).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Latency-oriented default bounds (seconds), Prometheus-style: the last
+# implicit bucket is +Inf. Negotiation cycles live in the 1-50 ms range
+# (docs/response-cache.md steady-state table), stalls in whole seconds.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is the hot-path primitive; see module
+    docstring for why it takes a lock."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: float = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge:
+    """Settable instantaneous value (world epoch, cache entries)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+
+    def inc(self, n: float = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram; mergeable by pointwise bucket sum.
+
+    ``bounds`` are upper edges (a value v lands in the first bucket with
+    v <= bound; values past the last bound land in the implicit +Inf
+    bucket), so ``buckets`` has ``len(bounds) + 1`` slots."""
+
+    __slots__ = ("_lock", "bounds", "_buckets", "_sum", "_count")
+
+    def __init__(self, bounds: Iterable[float] = DEFAULT_BUCKETS) -> None:
+        self.bounds = tuple(sorted(float(b) for b in bounds))
+        if not self.bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._lock = threading.Lock()
+        self._buckets = [0] * (len(self.bounds) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        i = bisect_left(self.bounds, v)
+        with self._lock:
+            self._buckets[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        # one lock: buckets/sum/count must be a consistent cut, or a
+        # merged world histogram's _count could disagree with its buckets
+        with self._lock:
+            return {"bounds": list(self.bounds),
+                    "buckets": list(self._buckets),
+                    "sum": self._sum, "count": self._count}
+
+
+_TYPE_NAMES = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
+
+
+class Family:
+    """One named metric family, optionally labeled.
+
+    Without label names the family IS the metric (``fam.inc(...)``
+    delegates to a single default child); with label names,
+    ``fam.labels(kind="drop")`` returns the per-label-value child,
+    created on demand. Children are cached forever — label values must
+    be low-cardinality by contract (fault kinds, data-plane paths), not
+    tensor names."""
+
+    def __init__(self, name: str, help: str, metric_cls,
+                 label_names: Tuple[str, ...] = (),
+                 buckets: Optional[Tuple[float, ...]] = None) -> None:
+        self.name = name
+        self.help = help
+        self.metric_cls = metric_cls
+        self.type = _TYPE_NAMES[metric_cls]
+        self.label_names = tuple(label_names)
+        self._buckets = tuple(buckets) if buckets is not None else None
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+        if not self.label_names:
+            self._children[()] = self._make()
+
+    def _make(self):
+        if self.metric_cls is Histogram:
+            return Histogram(self._buckets or DEFAULT_BUCKETS)
+        return self.metric_cls()
+
+    def labels(self, **kv):
+        try:
+            key = tuple(str(kv[n]) for n in self.label_names)
+        except KeyError as exc:
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.label_names}, "
+                f"got {sorted(kv)}") from exc
+        if len(kv) != len(self.label_names):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.label_names}, "
+                f"got {sorted(kv)}")
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make()
+        return child
+
+    # -- unlabeled delegation (the hot-path spelling) -------------------------
+
+    def _default(self):
+        if self.label_names:
+            raise ValueError(
+                f"metric {self.name!r} is labeled {self.label_names}; "
+                f"call .labels(...) first")
+        return self._children[()]
+
+    def inc(self, n: float = 1) -> None:
+        self._default().inc(n)
+
+    def dec(self, n: float = 1) -> None:
+        self._default().dec(n)
+
+    def set(self, v) -> None:
+        self._default().set(v)
+
+    def observe(self, v: float) -> None:
+        self._default().observe(v)
+
+    @property
+    def value(self):
+        return self._default().value
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            items = list(self._children.items())
+        samples: List[dict] = []
+        for key, child in items:
+            labels = dict(zip(self.label_names, key))
+            if isinstance(child, Histogram):
+                sample = child.snapshot()
+            else:
+                sample = {"value": child.value}
+            sample["labels"] = labels
+            samples.append(sample)
+        return {"type": self.type, "help": self.help,
+                "label_names": list(self.label_names), "samples": samples}
+
+
+class Registry:
+    """Named families, get-or-create. One process-global instance
+    (``registry()``) serves the whole framework; construct private ones
+    in tests."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: Dict[str, Family] = {}
+
+    def _family(self, name: str, help: str, metric_cls,
+                labels: Tuple[str, ...],
+                buckets: Optional[Tuple[float, ...]] = None) -> Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.metric_cls is not metric_cls or \
+                        fam.label_names != tuple(labels):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{fam.type}{fam.label_names}, cannot re-register "
+                        f"as {_TYPE_NAMES[metric_cls]}{tuple(labels)}")
+                if metric_cls is Histogram and buckets is not None and \
+                        fam._buckets is not None and \
+                        fam._buckets != tuple(buckets):
+                    # the in-process twin of merge_snapshots' cross-rank
+                    # bounds check: silently observing into another
+                    # caller's bounds would skew its distribution
+                    raise ValueError(
+                        f"metric {name!r} already registered with buckets "
+                        f"{fam._buckets}, cannot re-register with "
+                        f"{tuple(buckets)}")
+                return fam
+            fam = Family(name, help, metric_cls, tuple(labels), buckets)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labels: Tuple[str, ...] = ()) -> Family:
+        return self._family(name, help, Counter, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Tuple[str, ...] = ()) -> Family:
+        return self._family(name, help, Gauge, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Tuple[str, ...] = (),
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> Family:
+        return self._family(name, help, Histogram, labels, buckets)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Plain-data snapshot of every family (pickle/JSON-able)."""
+        with self._lock:
+            fams = list(self._families.items())
+        return {name: fam.snapshot() for name, fam in fams}
+
+
+def _sample_key(sample: dict) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted(sample.get("labels", {}).items()))
+
+
+def merge_snapshots(snapshots: Iterable[Dict[str, dict]]) -> Dict[str, dict]:
+    """Pointwise world merge of per-rank ``Registry.snapshot()`` dicts.
+
+    Counters and histograms sum (histograms bucket-wise — exact because
+    bounds are fixed at registration); gauges merge by MAX: the world
+    size/rank/epoch gauges are identity values, and a sum would put
+    size^2 or n(n-1)/2 on the only view ``/metrics`` serves (the merged
+    world). Per-rank gauge readings stay visible in the unmerged
+    ``ranks`` section. Mismatched types or histogram bounds for the same
+    family name are a version skew across ranks and fail loudly."""
+    merged: Dict[str, dict] = {}
+    for snap in snapshots:
+        for name, fam in snap.items():
+            out = merged.get(name)
+            if out is None:
+                # deep-ish copy: samples are mutated below
+                merged[name] = {
+                    "type": fam["type"], "help": fam.get("help", ""),
+                    "label_names": list(fam.get("label_names", [])),
+                    "samples": [dict(s) for s in fam["samples"]],
+                }
+                for s in merged[name]["samples"]:
+                    if "buckets" in s:
+                        s["buckets"] = list(s["buckets"])
+                continue
+            if out["type"] != fam["type"]:
+                raise ValueError(
+                    f"metric {name!r} type mismatch across ranks: "
+                    f"{out['type']} vs {fam['type']}")
+            by_key = {_sample_key(s): s for s in out["samples"]}
+            for sample in fam["samples"]:
+                key = _sample_key(sample)
+                into = by_key.get(key)
+                if into is None:
+                    into = dict(sample)
+                    if "buckets" in into:
+                        into["buckets"] = list(into["buckets"])
+                    out["samples"].append(into)
+                    by_key[key] = into
+                    continue
+                if "buckets" in sample:
+                    if list(into["bounds"]) != list(sample["bounds"]):
+                        raise ValueError(
+                            f"metric {name!r} histogram bounds differ "
+                            f"across ranks; cannot merge")
+                    into["buckets"] = [a + b for a, b in
+                                       zip(into["buckets"],
+                                           sample["buckets"])]
+                    into["sum"] += sample["sum"]
+                    into["count"] += sample["count"]
+                elif out["type"] == "gauge":
+                    into["value"] = max(into["value"], sample["value"])
+                else:
+                    into["value"] += sample["value"]
+    return merged
+
+
+_global_registry = Registry()
+
+
+def registry() -> Registry:
+    """The process-global registry every subsystem instruments into."""
+    return _global_registry

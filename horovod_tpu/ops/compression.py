@@ -46,6 +46,19 @@ class Compressor:
     def decompress(tensor, ctx):
         raise NotImplementedError
 
+    @classmethod
+    def wire_cost(cls, n_elems: int, size: int,
+                  in_itemsize: int = 4) -> tuple:
+        """(pre, post) bytes one allreduce leg moves for an
+        ``n_elems``-element payload over ``size`` ranks: ``pre`` is the
+        uncompressed (input-dtype) cost, ``post`` the on-wire cost under
+        this codec. THE single accounting definition the observability
+        plane charges wire-byte counters from (``ops.xla_plane``,
+        ``ops.spmd``) — the same geometry the benchmark auditor and the
+        error-bound tests derive (``block_layout``). Identity for the
+        base/none codec."""
+        return n_elems * in_itemsize, n_elems * in_itemsize
+
 
 class NoneCompressor(Compressor):
     """Default no-op compression (``compression.py:36-46``)."""
@@ -77,6 +90,12 @@ class _CastCompressor(Compressor):
         if ctx is not None and tensor.dtype != ctx:
             return tensor.astype(ctx)
         return tensor
+
+    @classmethod
+    def wire_cost(cls, n_elems: int, size: int,
+                  in_itemsize: int = 4) -> tuple:
+        return (n_elems * in_itemsize,
+                n_elems * jnp.dtype(cls.WIRE_DTYPE).itemsize)
 
 
 class FP16Compressor(_CastCompressor):
@@ -148,6 +167,16 @@ class _BlockQuantCompressor(Compressor):
     @staticmethod
     def decompress(tensor, ctx):
         return tensor
+
+    @classmethod
+    def wire_cost(cls, n_elems: int, size: int,
+                  in_itemsize: int = 4) -> tuple:
+        """Quantized wire: the padded payload at the wire dtype plus one
+        shared scale per block (the pmax pre-pass bytes)."""
+        block, padded = cls.block_layout(n_elems, size)
+        return (n_elems * in_itemsize,
+                padded * jnp.dtype(cls.wire_dtype()).itemsize
+                + (padded // block) * jnp.dtype(cls.SCALE_DTYPE).itemsize)
 
 
 class Int8Compressor(_BlockQuantCompressor):

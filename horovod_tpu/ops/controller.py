@@ -34,6 +34,7 @@ from ..core.status import (
     WORLD_MISMATCH,
     format_aborted_ranks,
 )
+from ..obs.registry import Counter, registry as _metrics
 from ..runner.network import (
     BasicClient,
     BasicService,
@@ -59,6 +60,41 @@ _DTYPE_BYTES = {
     DataType.FLOAT16: 2, DataType.FLOAT32: 4, DataType.FLOAT64: 8,
     DataType.BOOL: 1, DataType.BFLOAT16: 2,
 }
+
+# Observability plane (docs/metrics.md): control-plane families. The
+# worker-side cycle histogram times the full client round trip (straggler
+# wait included); the coordinator-side one times the service's ACTIVE
+# window (first arrival → response framed), the same number the autotuner
+# scores.
+_NEG_CYCLES = _metrics().counter(
+    "horovod_negotiation_cycles_total",
+    "Negotiation round trips completed by this rank's controller client")
+_NEG_TX = _metrics().counter(
+    "horovod_negotiation_tx_bytes_total",
+    "Cycle-metadata bytes sent by this rank (payload exchanges excluded)")
+_NEG_RX = _metrics().counter(
+    "horovod_negotiation_rx_bytes_total",
+    "Cycle-metadata bytes received by this rank (payloads excluded)")
+_NEG_CYCLE_SECONDS = _metrics().histogram(
+    "horovod_negotiation_cycle_seconds",
+    "Client-observed negotiation cycle latency (includes straggler wait)")
+_COORD_CYCLE_SECONDS = _metrics().histogram(
+    "horovod_coordinator_cycle_seconds",
+    "Coordinator-side active cycle window (first arrival to response)")
+_STALL_WARNINGS = _metrics().counter(
+    "horovod_stall_warnings_total",
+    "Stalled-tensor warnings produced by the coordinator's stall check")
+_STALL_ESCALATIONS = _metrics().counter(
+    "horovod_stall_escalations_total",
+    "Stalls escalated into a structured world abort "
+    "(HOROVOD_STALL_SHUTDOWN_TIME_S)")
+_WORLD_ABORTS = _metrics().counter(
+    "horovod_world_aborts_total",
+    "Worlds aborted after a rank death (first attribution only; "
+    "cascading teardown disconnects are not re-counted)")
+_RECONNECT_WINDOW_HEALS = _metrics().counter(
+    "horovod_reconnect_window_heals_total",
+    "Dropped rank connections forgiven by an in-window reconnect")
 
 def _nbytes(req: Request) -> int:
     n = _DTYPE_BYTES[req.tensor_type]
@@ -598,6 +634,13 @@ class ControllerService:
         # reach — an asynchronous SHUT_DOWN_ERROR signal.
         self._watch_event = threading.Event()
         self._watch_reason: Optional[str] = None
+        # Observability plane (docs/metrics.md): latest registry snapshot
+        # per rank, pushed by each rank's metrics publisher over this same
+        # wire ("metrics" requests — so aggregation inherits the dedup/
+        # reconnect semantics of every other control message). Read by
+        # rank 0's exposition server and by "metrics_pull" requests.
+        self._metrics_lock = threading.Lock()
+        self._metrics_ranks: Dict[int, dict] = {}
         self._service = BasicService(
             "horovod-controller", self._handle, secret=secret, port=port,
             bind_host=bind_host, on_disconnect=self._on_disconnect,
@@ -656,6 +699,7 @@ class ControllerService:
             first = not self._abort_fired
             self._abort_fired = True
         if first:
+            _WORLD_ABORTS.inc()
             LOG.warning("rank %d disconnected before shutdown; aborting "
                         "in-flight collectives on all ranks", rank)
         else:
@@ -674,8 +718,39 @@ class ControllerService:
                 self._watch_reason = str(exc)
         self._watch_event.set()
 
+    def metrics_store(self) -> Dict[int, dict]:
+        """Copy of the per-rank snapshot store (rank → registry families),
+        as fresh as each rank's last publisher push."""
+        with self._metrics_lock:
+            return dict(self._metrics_ranks)
+
     def _handle(self, req: Any, _sock: Any) -> Any:
         kind = req[0]
+        if kind == "metrics":
+            # Per-rank registry push (observability plane). Handled BEFORE
+            # the rank-binding block below, like "watch": the publisher's
+            # connection is deliberately anonymous, so tearing it down is
+            # never mistaken for a rank death. A push from a DIFFERENT
+            # co-located world (subset schedules share this port) is
+            # refused like "watch"/"hello" — storing it would merge
+            # another world's counters into this world's /metrics.
+            _, push_rank, snap = req[:3]
+            push_wid = req[3] if len(req) > 3 else ""
+            if push_wid and self._world_id and push_wid != self._world_id:
+                raise RuntimeError(
+                    world_mismatch_error(self._world_id, push_wid))
+            with self._metrics_lock:
+                self._metrics_ranks[int(push_rank)] = snap
+            return ("ok",)
+        if kind == "metrics_pull":
+            caller_wid = req[1] if len(req) > 1 else ""
+            if caller_wid and self._world_id and \
+                    caller_wid != self._world_id:
+                # symmetric with the push: never leak THIS world's store
+                # to a co-located different world's caller
+                raise RuntimeError(
+                    world_mismatch_error(self._world_id, caller_wid))
+            return ("metrics", self.metrics_store())
         if kind == "bye":
             # Clean detach for clients that leave without a negotiated
             # world shutdown (tests, tooling): de-register so the
@@ -757,6 +832,7 @@ class ControllerService:
             self._conn_ranks[id(_sock)] = rank
             healed = self._pending_reconnect.pop(rank, None)
         if healed is not None:
+            _RECONNECT_WINDOW_HEALS.inc()
             LOG.warning("rank %d reconnected within the window; the "
                         "dropped connection is forgiven", rank)
         if kind == "hello":
@@ -883,10 +959,13 @@ class ControllerService:
             for rank in sorted(slot):
                 self._negotiator.add_request_list(slot[rank])
             response_list = self._negotiator.construct_response_list()
+        if response_list.stall_warnings:
+            _STALL_WARNINGS.inc(len(response_list.stall_warnings))
         escalation = self._stall_escalation.check(
             response_list.stall_warnings,
             check_ran=getattr(response_list, "stall_check", False))
         if escalation is not None:
+            _STALL_ESCALATIONS.inc()
             # Abort-instead-of-hang: stalled tensors become ERROR responses
             # (their submitters' handles fail with the structured reason),
             # and the shutdown+abort_reason pair tells EVERY engine —
@@ -914,6 +993,8 @@ class ControllerService:
         with self._lock:
             t0 = self._cycle_t0.pop(key, None)
         active_us = (time.monotonic() - t0) * 1e6 if t0 is not None else None
+        if active_us is not None:
+            _COORD_CYCLE_SECONDS.observe(active_us / 1e6)
         self._maybe_autotune(response_list, active_us)
         ack = None
         if self._cache is not None:
@@ -1204,9 +1285,12 @@ class ControllerClient:
         self._rank = rank
         self._world_id = world_id
         # cumulative + last-cycle negotiation wire bytes (cycle() only;
-        # payload exchanges excluded) — see utils/timeline.py counters
-        self.negotiation_tx_bytes = 0
-        self.negotiation_rx_bytes = 0
+        # payload exchanges excluded) — registry Counter primitives, with
+        # the historical attribute names kept as read-through properties
+        # (tests and controller_bench read them). The process-global
+        # horovod_negotiation_* families aggregate across clients.
+        self._neg_tx = Counter()
+        self._neg_rx = Counter()
         self.last_cycle_tx_bytes = 0
         self.last_cycle_rx_bytes = 0
         # Deterministic fault injection (docs/chaos.md): the controller
@@ -1244,6 +1328,16 @@ class ControllerClient:
     def _arm_reconnect_hello(self) -> None:
         self._client.on_reconnect = self._reconnect_hello
 
+    @property
+    def negotiation_tx_bytes(self) -> int:
+        """Cumulative cycle-metadata bytes sent (back-compat read-through;
+        the canonical store is the metrics registry)."""
+        return self._neg_tx.value
+
+    @property
+    def negotiation_rx_bytes(self) -> int:
+        return self._neg_rx.value
+
     def cycle(self, rank: int, request_list) -> Any:
         """One negotiation round trip. ``request_list`` is a RequestList
         or, on the steady-state bypass, a ``messages.CacheRequest``; the
@@ -1261,11 +1355,16 @@ class ControllerClient:
         # metadata bytes (the number the response cache exists to shrink).
         wire = self._client._wire
         tx0, rx0 = wire.tx_bytes, wire.rx_bytes
+        t0 = time.monotonic()
         out = self._client.request(("cycle", rank, request_list))
+        _NEG_CYCLE_SECONDS.observe(time.monotonic() - t0)
+        _NEG_CYCLES.inc()
         self.last_cycle_tx_bytes = wire.tx_bytes - tx0
         self.last_cycle_rx_bytes = wire.rx_bytes - rx0
-        self.negotiation_tx_bytes += self.last_cycle_tx_bytes
-        self.negotiation_rx_bytes += self.last_cycle_rx_bytes
+        self._neg_tx.inc(self.last_cycle_tx_bytes)
+        self._neg_rx.inc(self.last_cycle_rx_bytes)
+        _NEG_TX.inc(self.last_cycle_tx_bytes)
+        _NEG_RX.inc(self.last_cycle_rx_bytes)
         self._last_cycle = self._cycle_no
         self._cycle_no += 1
         return out

@@ -35,6 +35,7 @@ from .. import basics
 from ..core import config as _config
 from ..core.logging import LOG
 from ..core.status import SHUT_DOWN_ERROR, Status
+from ..obs import TimelineBridge, registry as _obs_registry
 from ..runner.network import default_secret
 from ..utils.timeline import Timeline
 from .autotuner import Autotuner
@@ -271,6 +272,11 @@ class Engine:
         timeline_path = cfg.timeline_path \
             if topo.rank == 0 and topo.is_member else ""
         self.timeline = Timeline(timeline_path, cfg.timeline_mark_cycles)
+        # Observability plane (docs/metrics.md): registry deltas ride the
+        # timeline as Chrome counter tracks (no-op when the timeline is
+        # off); the publisher below feeds cross-rank aggregation.
+        self._metrics_bridge = TimelineBridge(_obs_registry(), self.timeline)
+        self._metrics_stop: Optional[threading.Event] = None
 
         self._service: Optional[ControllerService] = None
         self._client: Optional[ControllerClient] = None
@@ -400,6 +406,17 @@ class Engine:
                     "stall_shutdown_s": cfg.stall_shutdown_time_s,
                     "stall_warning_s": cfg.stall_warning_time_s}
                    if use_native else {}))
+            if not use_native:
+                # Metrics publisher (docs/metrics.md): pushes this rank's
+                # registry snapshot to the coordinator's store on an
+                # interval, over its own ANONYMOUS connection — never the
+                # cycle client, whose strict request/response sequencing a
+                # metrics push would corrupt. Python controller wire only:
+                # the native service's fixed binary protocol predates the
+                # metrics RPC (same pattern as the cache-bit and codec
+                # fields).
+                self._start_metrics_publisher(
+                    {a: (a, port) for a in addr_list}, secret, world_id)
 
         self._host_fallback_warned = set()
 
@@ -461,6 +478,75 @@ class Engine:
         self._thread = threading.Thread(
             target=self._loop, name="horovod-background", daemon=True)
         self._thread.start()
+
+    def _start_metrics_publisher(self, addr, secret,
+                                 world_id: str = "") -> None:
+        """Cross-rank metrics aggregation feed: a daemon thread pushes
+        this process's registry snapshot to the coordinator every
+        ``HOROVOD_METRICS_INTERVAL_S`` (<= 0 disables). Faults drop the
+        sample and redial next tick — the controller restarting or gone
+        means the world is ending and a lost metrics push is noise. The
+        push rides ``BasicClient.request``, so a frame lost in transit
+        heals by the wire's dedup/reconnect machinery like any other
+        control message; no chaos injector is attached (chaos ordinals
+        target the CYCLE channel, and a second injected stream would
+        desynchronize replay determinism)."""
+        interval = self._cfg.metrics_interval_s
+        if interval <= 0:
+            return
+        if not self._cfg.metrics_port and \
+                not self._cfg.metrics_interval_explicit:
+            # as opt-in as the exposition server: no port and no explicit
+            # interval means nothing consumes the pushes — spawn no
+            # thread, dial no connection
+            return
+        self._metrics_stop = threading.Event()
+        stop = self._metrics_stop
+        rank = self._rank
+        from ..runner.network import BasicClient
+
+        def _push_loop() -> None:
+            client = None
+            failures = 0  # consecutive; a single lost push is noise, a
+            # persistent streak (wrong world on a shared port, bad secret)
+            # must degrade LOUDLY like every other plane here
+            try:
+                while not stop.wait(interval):
+                    try:
+                        if client is None:
+                            client = BasicClient(addr, secret=secret,
+                                                 timeout_s=5.0, attempts=3)
+                        # world_id rides along so a co-located different
+                        # world's service (shared port) refuses the push
+                        # instead of storing it
+                        client.request(("metrics", rank,
+                                        _obs_registry().snapshot(),
+                                        world_id))
+                        failures = 0
+                    except Exception as exc:  # noqa: BLE001 - drop, redial
+                        failures += 1
+                        if failures == 3 and not stop.is_set():
+                            LOG.warning(
+                                "metrics publisher: %d consecutive push "
+                                "failures (last: %s); world snapshots will "
+                                "miss rank %d until the feed recovers",
+                                failures, exc, rank)
+                        if client is not None:
+                            try:
+                                client.close()
+                            except Exception:  # noqa: BLE001
+                                pass
+                            client = None
+            finally:
+                if client is not None:
+                    try:
+                        client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        threading.Thread(target=_push_loop,
+                         name="horovod-metrics-publisher",
+                         daemon=True).start()
 
     def _warn_host_fallback(self, op_name: str, tensor_name: str,
                             array: np.ndarray) -> None:
@@ -644,6 +730,9 @@ class Engine:
                         request_list, requests, stop)
                 for idx, resp in enumerate(response_list.responses):
                     self._execute(idx, resp)
+                # registry deltas as timeline counter tracks (no-op when
+                # the timeline is disabled — one attribute check)
+                self._metrics_bridge.emit()
                 # autotune: local worlds score here; multi-process worlds
                 # score on the coordinator and ship cycle time back
                 if self._negotiator is not None and self._autotuner is not None:
@@ -678,6 +767,8 @@ class Engine:
             self._flush_outstanding(Status.unknown_error(reason))
         finally:
             self._stop_requested = True
+            if self._metrics_stop is not None:
+                self._metrics_stop.set()  # publisher drains before teardown
             self._flush_outstanding(Status.unknown_error(
                 self._shutdown_reason or SHUT_DOWN_ERROR))
             crashed = getattr(self, "_crashed", False)

@@ -22,11 +22,21 @@ only from config + library availability, never per-rank state.
 from __future__ import annotations
 
 import struct
+import time
 from typing import Optional
 
 from ..core.logging import LOG
 from ..core.status import SHUT_DOWN_ERROR
 from ..runner.network import WireError
+# observability counters shared with the Python client (controller.py
+# never imports this module, so the import is cycle-free); bound at
+# module level because cycle() is the ~5 ms hot path
+from .controller import (
+    _NEG_CYCLE_SECONDS,
+    _NEG_CYCLES,
+    _NEG_RX,
+    _NEG_TX,
+)
 from .messages import (
     DataType,
     RequestList,
@@ -228,9 +238,18 @@ class NativeControllerClient:
         if self._rank is None:
             self._rank = rank
             self._arm_reconnect_hello()
+        # same observability families as the Python client (the binary
+        # wire negotiates identically; only the body codec differs)
+        wire = self._client._wire
+        tx0, rx0 = wire.tx_bytes, wire.rx_bytes
+        t0 = time.monotonic()
         out = decode_cycle_response(
             self._client.request_raw(encode_cycle(rank, request_list)),
             log_stalls=self._log_stalls)
+        _NEG_CYCLE_SECONDS.observe(time.monotonic() - t0)
+        _NEG_CYCLES.inc()
+        _NEG_TX.inc(wire.tx_bytes - tx0)
+        _NEG_RX.inc(wire.rx_bytes - rx0)
         escalation = self._escalation.check(out.stall_warnings)
         if escalation is not None:
             # Abort-instead-of-hang (HOROVOD_STALL_SHUTDOWN_TIME_S): fail

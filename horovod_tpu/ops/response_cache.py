@@ -72,6 +72,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..obs.registry import registry as _metrics
 from .messages import (
     DataType,
     Request,
@@ -81,6 +82,16 @@ from .messages import (
     ResponseList,
     ResponseType,
 )
+
+# Observability plane (docs/metrics.md): rank-side bypass counters. The
+# per-cache hit_cycles/miss_cycles attributes stay (cache_stats(), the
+# timeline counter track); these aggregate process-wide for exposition.
+_HIT_CYCLES = _metrics().counter(
+    "horovod_cache_hit_cycles_total",
+    "Negotiation cycles bypassed via the response-cache bit vector")
+_MISS_CYCLES = _metrics().counter(
+    "horovod_cache_miss_cycles_total",
+    "Negotiation cycles that shipped a full RequestList")
 
 # A generation namespace per elastic world epoch: epochs are small ints
 # (restart counts), generations bump at autotune cadence — 2^32 bumps per
@@ -196,6 +207,7 @@ class ResponseCache:
         else:
             self.clear(ack.generation)
         self.hit_cycles += 1
+        _HIT_CYCLES.inc()
         return responses
 
     def accept_response_list(self, response_list: ResponseList,
@@ -215,6 +227,7 @@ class ResponseCache:
             # engine disables its cache when it sees this.
             return
         self.miss_cycles += 1
+        _MISS_CYCLES.inc()
         if generation != self.generation:
             self.clear(generation)
             return

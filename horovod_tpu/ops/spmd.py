@@ -23,7 +23,28 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.registry import registry as _metrics
+
 AxisName = Union[str, Sequence[str]]
+
+# Observability plane (docs/metrics.md): SPMD collectives execute inside
+# compiled programs, so Python counters can only see TRACE time — these
+# count lowerings (one per trace, not per training step) and the wire
+# bytes each lowered collective moves per execution. A steady training
+# loop re-traces nothing, so steps after the first leave these flat;
+# compare against step counts from your training loop, not wall clock.
+_SPMD_LOWERINGS = _metrics().counter(
+    "horovod_spmd_lowerings_total",
+    "Collective lowerings traced by the in-jit SPMD layer "
+    "(per trace, not per step)", labels=("op",))
+_SPMD_WIRE_PRE = _metrics().counter(
+    "horovod_spmd_wire_bytes_pre_total",
+    "Per-execution full-precision bytes the traced quantized allreduces "
+    "would have moved")
+_SPMD_WIRE_POST = _metrics().counter(
+    "horovod_spmd_wire_bytes_post_total",
+    "Per-execution on-wire bytes of the traced quantized allreduces "
+    "(payload at wire dtype + shared block scales)")
 
 
 def _axes(axis_name: AxisName) -> tuple:
@@ -103,6 +124,7 @@ def allreduce(x: jax.Array, axis_name: AxisName, average: bool = True) -> jax.Ar
     sum == size * x under Horovod semantics; write that explicitly as
     ``x * hvd.num_devices()`` — it is not an allreduce.
     """
+    _SPMD_LOWERINGS.labels(op="allreduce").inc()
     if _varies_over(x, axis_name) or not _vma_tracking_active(axis_name):
         return lax.pmean(x, axis_name) if average else lax.psum(x, axis_name)
     return x / _axis_size(axis_name) if average else x
@@ -176,6 +198,7 @@ def quantized_allreduce(x: jax.Array, axis_name: AxisName,
     """
     from .compression import Compression
 
+    _SPMD_LOWERINGS.labels(op="quantized_allreduce").inc()
     codec = codec or Compression.int8
     if not jnp.issubdtype(x.dtype, jnp.floating):
         return allreduce(x, axis_name, average=average)
@@ -205,6 +228,9 @@ def _quantized_axis_sum(x: jax.Array, axis: str, codec) -> jax.Array:
     # (codec.block_layout is the single definition of this geometry,
     # shared with the tests' error-bound math and the benchmark auditor)
     block, padded = codec.block_layout(n_elems, size)
+    pre_b, post_b = codec.wire_cost(n_elems, size)
+    _SPMD_WIRE_PRE.inc(pre_b)
+    _SPMD_WIRE_POST.inc(post_b)
     if padded != n_elems:
         # zeros_like(flat, shape=...) keeps flat's varying-axes type under
         # vma tracking (a bare zeros() is replicated and the concat would

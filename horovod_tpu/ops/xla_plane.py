@@ -32,9 +32,28 @@ from typing import Dict, List, Sequence, Tuple
 import numpy as np
 
 from ..core.logging import LOG
+from ..obs.registry import registry as _obs_metrics
 from .messages import DataType, dtype_of
 
 _MIN_BUCKET = 1024  # elements; below this padding cost is noise
+
+# Observability plane (docs/metrics.md): the eager device plane executes
+# once per negotiated batch, so these count real per-step work (unlike
+# the trace-time SPMD counters). "post" charges the padded bucket at the
+# negotiated wire dtype — plus scales for a quantized codec — per
+# Compression.wire_cost, the single accounting definition.
+_EAGER_BATCHES = _obs_metrics().counter(
+    "horovod_eager_allreduce_batches_total",
+    "Fused allreduce batches executed on the eager device plane",
+    labels=("path",))
+_EAGER_PRE = _obs_metrics().counter(
+    "horovod_eager_wire_bytes_pre_total",
+    "Uncompressed payload bytes entering eager device-plane allreduce",
+    labels=("path",))
+_EAGER_POST = _obs_metrics().counter(
+    "horovod_eager_wire_bytes_post_total",
+    "Estimated on-wire bytes after bucket padding and codec",
+    labels=("path",))
 
 
 def _next_bucket(n: int) -> int:
@@ -214,6 +233,20 @@ class XlaDataPlane:
 
     # -- collectives ----------------------------------------------------------
 
+    def _account_allreduce(self, path: str, n_elems: int,
+                           in_itemsize: int, wire_dt, codec: str) -> None:
+        """Charge one fused batch to the eager wire-byte families."""
+        _EAGER_BATCHES.labels(path=path).inc()
+        _EAGER_PRE.labels(path=path).inc(n_elems * in_itemsize)
+        bucket = _next_bucket(n_elems)
+        if codec != "none":
+            from .compression import Compression
+
+            post = Compression.lookup(codec).wire_cost(bucket, self._size)[1]
+        else:
+            post = bucket * np.dtype(wire_dt).itemsize
+        _EAGER_POST.labels(path=path).inc(post)
+
     def _reduce_fn(self, codec: str = "none"):
         """The bucketed fused-reduction program: full-precision psum, or
         the block-quantized variant when the negotiated codec asks for it
@@ -262,6 +295,8 @@ class XlaDataPlane:
         for a, n in zip(arrays, sizes):
             buf = write(buf, a, off)
             off += n
+        self._account_allreduce("onchip", total, in_dt.itemsize, wire_dt,
+                                codec)
         result = self._reduce_fn(codec)(self._global_put(buf))
         # out_specs=P(): replicated, so this process's single shard holds
         # the full reduced value, already on the lead device
@@ -396,6 +431,8 @@ class XlaDataPlane:
         """Sum a flat (possibly fused) buffer across all ranks."""
         wire_dt, out_dt = self._wire_parts(buf.dtype)
         n = buf.size
+        self._account_allreduce("host", n, buf.dtype.itemsize, wire_dt,
+                                codec)
         padded = np.zeros((_next_bucket(n),), dtype=wire_dt)
         padded[:n] = buf
         result = self._reduce_fn(codec)(self._global_put(padded))

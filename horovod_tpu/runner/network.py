@@ -24,8 +24,30 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..obs.registry import Counter, registry as _metrics
+
 _LEN = struct.Struct(">Q")
 _DIGEST_BYTES = hashlib.sha256().digest_size
+
+# Process-wide wire totals (docs/metrics.md): every authenticated frame
+# through ANY Wire in this process counts here, alongside the per-wire
+# counters the Wire properties read. Registered once at import — the obs
+# registry is stdlib-only, so this module stays importable without jax.
+_WIRE_TX = _metrics().counter(
+    "horovod_wire_tx_bytes_total",
+    "Framed bytes sent over every authenticated control-plane wire")
+_WIRE_RX = _metrics().counter(
+    "horovod_wire_rx_bytes_total",
+    "Framed bytes received over every authenticated control-plane wire")
+_RECONNECT_ATTEMPTS = _metrics().counter(
+    "horovod_reconnect_attempts_total",
+    "Transparent-reconnect dial attempts after a transport fault")
+_RECONNECTS_HEALED = _metrics().counter(
+    "horovod_reconnects_healed_total",
+    "Transport faults healed by a successful reconnect + re-identify")
+_RECONNECT_FAILURES = _metrics().counter(
+    "horovod_reconnect_failures_total",
+    "Reconnect episodes that exhausted the backoff budget")
 
 
 class WireError(RuntimeError):
@@ -109,14 +131,26 @@ class Wire:
         self._secret = secret if secret is not None else default_secret()
         # Cumulative framed bytes through this wire, for control-plane
         # observability (the response-cache bypass is sized by exactly
-        # these counters; see ControllerClient.negotiation_bytes). Plain
-        # ints under the GIL — callers read deltas, not exact snapshots.
-        self.tx_bytes = 0
-        self.rx_bytes = 0
+        # these counters; see ControllerClient.negotiation_bytes).
+        # Registry Counter primitives, not bare ints: a service's wire is
+        # shared by every connection handler thread, and the old unlocked
+        # `+=` could silently undercount under that interleaving. The
+        # public tx_bytes/rx_bytes attributes live on as read-through
+        # properties below.
+        self._tx = Counter()
+        self._rx = Counter()
         # Optional fault injector (``horovod_tpu.chaos``): hooks at the
         # frame boundary, None-cost when absent. Installed only on client
         # wires whose owning BasicClient was built with chaos enabled.
         self.chaos = None
+
+    @property
+    def tx_bytes(self) -> int:
+        return self._tx.value
+
+    @property
+    def rx_bytes(self) -> int:
+        return self._rx.value
 
     def frame(self, obj: Any) -> bytes:
         return self.frame_raw(
@@ -144,7 +178,9 @@ class Wire:
             raise CorruptFrameError(
                 "message HMAC mismatch (wrong or missing secret, or a "
                 "frame damaged in transit)")
-        self.rx_bytes += _DIGEST_BYTES + _LEN.size + length
+        n = _DIGEST_BYTES + _LEN.size + length
+        self._rx.inc(n)
+        _WIRE_RX.inc(n)
         return body
 
     def read_raw(self, sock: socket.socket) -> bytes:
@@ -163,7 +199,8 @@ class Wire:
         faults fire here, before any byte leaves)."""
         if self.chaos is not None:
             self.chaos.on_send(sock)
-        self.tx_bytes += len(frame)
+        self._tx.inc(len(frame))
+        _WIRE_TX.inc(len(frame))
         sock.sendall(frame)
 
     def read(self, sock: socket.socket) -> Any:
@@ -706,6 +743,7 @@ class BasicClient:
                 raise WireError("client closed during reconnect")
             if attempt > 1:
                 time.sleep(self._policy.delay(attempt - 1))
+            _RECONNECT_ATTEMPTS.inc()
             try:
                 sock = self._dial(rounds=1, reconnecting=True)
             except (WireError, OSError) as exc:
@@ -766,6 +804,7 @@ class BasicClient:
                 raise WireError("client closed during reconnect")
             self._broken = False
             self.reconnects += 1
+            _RECONNECTS_HEALED.inc()
             if old is not None:
                 try:
                     old.close()
@@ -773,6 +812,7 @@ class BasicClient:
                     pass
             return
         self._sock = old  # keep ownership for close()
+        _RECONNECT_FAILURES.inc()
         raise WireError(
             f"reconnect failed after {self._policy.attempts} attempts: "
             f"{last_err}") from last_err

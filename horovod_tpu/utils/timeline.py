@@ -38,6 +38,8 @@ class Timeline:
         self._tids: dict = {}
         self._lock = threading.Lock()
         self._writer: Optional[threading.Thread] = None
+        self._closed = False
+        self._drop_warned = False
         self._native = None
         if path:
             self._native = self._try_native(path)
@@ -75,6 +77,22 @@ class Timeline:
         return time.monotonic_ns() / 1e3
 
     def _emit(self, record: dict) -> None:
+        if self._closed:
+            # Dropped LOUDLY, never written: the file was terminated by
+            # close() (and the native writer's handle freed — a late
+            # write there is a use-after-free). Late emitters are bugs in
+            # shutdown ordering (a finalizer or metrics bridge outliving
+            # the engine), so say so once instead of corrupting the
+            # artifact or silently queueing records nobody will drain.
+            if self._path and not self._drop_warned:
+                self._drop_warned = True
+                import logging
+
+                logging.getLogger("horovod_tpu").warning(
+                    "timeline event %r arrived after close(); dropping it "
+                    "(and any later ones) instead of writing to the "
+                    "closed trace", record.get("name", record.get("ph")))
+            return
         if self._native is not None:
             self._native.write(json.dumps(record))
         elif self._path:
@@ -133,7 +151,11 @@ class Timeline:
         for the response-cache bypass — hit/miss cycle totals and
         per-cycle negotiation wire bytes — so a bypass regression shows in
         the trace instead of silently re-inflating the control plane
-        (docs/response-cache.md)."""
+        (docs/response-cache.md). The observability plane's
+        ``obs.TimelineBridge`` emits every changed metrics-registry family
+        through here as ``metrics/<family>`` tracks (docs/metrics.md).
+        After ``close()`` counter events are dropped loudly, never written
+        to the terminated file."""
         self._emit({"name": name, "ph": "C", "pid": 0, "tid": 0,
                     "ts": self._ts_us(), "args": dict(values)})
 
@@ -154,6 +176,8 @@ class Timeline:
             fh.write("{}]\n")
 
     def close(self) -> None:
+        self._closed = True  # before the writer teardown: an emit racing
+        # close must drop rather than enqueue behind the sentinel
         if self._native is not None:
             self._native.close()
             self._native = None

@@ -4,7 +4,7 @@
     python tools/bench_table.py bench_results_r4
 
 Reads every ``*.json`` bench capture in the directory (one JSON line per
-file, as written by ``tools/chip_watch4.sh``) and prints the
+file, as written by ``tools/chip_watch.sh``) and prints the
 docs/benchmarks.md measured table — config, img|tokens/s/device, ±1.96σ
 when present, achieved TFLOP/s, MFU, and vs-reference ratio — so landing
 a capture into the docs is one copy-paste, not hand-transcription.

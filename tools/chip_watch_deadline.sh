@@ -1,52 +1,64 @@
 #!/bin/bash
-# Deadline supervisor for the chip watcher (round-5 tail).
+# Deadline supervisor for the chip watcher.
 #
 # The builder session that killed watcher v5 at 19:35 expected the round to
 # end immediately; the driver instead restarted the builder, leaving free
 # tail minutes in which a late healthy tunnel window could still land the
-# queued series.  This wrapper re-runs chip_watch5.sh but guarantees the
+# queued series.  This wrapper runs tools/chip_watch.sh (any extra
+# arguments are forwarded to it) but guarantees the
 # end-of-round hygiene rule (the driver's bench run must own the tunnel
 # alone) mechanically: at DEADLINE_EPOCH it SIGKILLs the watcher's whole
 # process group, including any in-flight bench child.
 #
-# Usage: setsid bash tools/chip_watch_deadline.sh <deadline_epoch> &
+# Usage: setsid bash tools/chip_watch_deadline.sh <deadline_epoch> [watcher args...] &
 set -u
-DEADLINE=${1:?usage: chip_watch_deadline.sh <deadline_epoch>}
+DEADLINE=${1:?usage: chip_watch_deadline.sh <deadline_epoch> [watcher args...]}
 case "$DEADLINE" in
     ''|*[!0-9]*) echo "deadline must be a unix epoch, got: $DEADLINE" >&2; exit 2 ;;
 esac
+shift  # the rest is forwarded to chip_watch.sh (e.g. --out, --entries)
 if [ "$(date +%s)" -ge "$DEADLINE" ]; then
     echo "deadline $DEADLINE is already in the past; refusing to start" >&2
     exit 2
 fi
 cd /root/repo
+# Log beside the watcher: mirror a forwarded --out so the kill-audit
+# trail lands in the same watch.log the watcher writes.
 OUT=bench_results_r5
+args=("$@")
+for i in "${!args[@]}"; do
+    if [ "${args[$i]}" = "--out" ] && [ $((i + 1)) -lt ${#args[@]} ]; then
+        OUT="${args[$((i + 1))]}"
+    fi
+done
 mkdir -p "$OUT"
 log() { echo "[deadline $(date +%H:%M:%S)] $*" >> "$OUT/watch.log"; }
 
 # Refuse to start while a prior watcher or an orphaned bench child is
 # alive: the group kill below only covers the watcher THIS script spawns,
-# so strays from an earlier instance (e.g. a `pkill -f chip_watch5` that
+# so strays from an earlier instance (e.g. a `pkill -f chip_watch` that
 # killed the watcher bash but not its bench child) would survive the
 # deadline.  Match every process shape the watcher tree can leave
 # behind: the relative-path supervisor itself (`^python bench\.py`, how
-# chip_watch5 spawns it), the supervisor's measure child
+# chip_watch.sh spawns it), the supervisor's measure child
 # (`<python> /abs/path/bench.py --_measure` — the anchored pattern never
 # matches an absolute interpreter or script path), and the python
 # invocations of lm_bench / onchip_path / the torch synthetic benchmark
 # — anchored on `python... <path>.py` so an editor or `tail -f` whose
 # argv merely mentions a file name cannot match.  The patterns contain
 # tokens absent from this script's own argv
-# (chip_watch_deadline.sh <epoch>), so the guard cannot match itself.
+# (chip_watch_deadline.sh <epoch> ...; `chip_watch\.sh` needs the dot
+# right after "watch", which the _deadline suffix breaks), so the guard
+# cannot match itself.
 orphan_pat='^python bench\.py|bench\.py --_measure|python[0-9.]* [^ ]*(lm_bench|onchip_path_bench|pytorch_synthetic_benchmark)\.py'
-if pgrep -f 'chip_watch5\.sh' >/dev/null || pgrep -f "$orphan_pat" >/dev/null; then
-    echo "a chip_watch5/bench process is already running; kill it first" >&2
+if pgrep -f 'chip_watch\.sh' >/dev/null || pgrep -f "$orphan_pat" >/dev/null; then
+    echo "a chip_watch/bench process is already running; kill it first" >&2
     exit 2
 fi
 
 # setsid makes the watcher a session+group leader, so its pgid == $WPID —
 # no ps round-trip (which races the child's setsid()) needed.
-setsid bash tools/chip_watch5.sh &
+setsid bash tools/chip_watch.sh "$@" &
 WPID=$!
 log "watcher restarted for round tail (pid/pgid $WPID), hard deadline $(date -d @"$DEADLINE" +%H:%M:%S)"
 
@@ -59,7 +71,7 @@ while kill -0 "$WPID" 2>/dev/null; do
     sleep $(( r < 10 ? (r > 0 ? r : 1) : 10 ))
 done
 # Unconditional group kill on every exit path: if the watcher bash died
-# (e.g. pkill -f chip_watch5) while a bench child survived in its group,
+# (e.g. pkill -f chip_watch) while a bench child survived in its group,
 # the orphan must not hold the tunnel past the deadline either.
 kill -KILL -- "-$WPID" 2>/dev/null
 log "deadline supervisor exiting (group $WPID killed)"

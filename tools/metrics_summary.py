@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Pretty-print a saved metrics snapshot (docs/metrics.md).
+
+    curl -s http://127.0.0.1:$HOROVOD_METRICS_PORT/metrics.json > snap.json
+    python tools/metrics_summary.py snap.json
+    python tools/metrics_summary.py snap.json --rank 1
+    python tools/metrics_summary.py snap.json --family horovod_wire
+
+Reads either shape the observability plane emits: the ``/metrics.json``
+document (``{"world": families, "ranks": {rank: families}}``) or a bare
+``metrics_snapshot()`` families dict, and renders one aligned table per
+section — counters and gauges as values, histograms as count / mean /
+approximate p50/p99 read off the cumulative buckets. The world section
+prints first; ``--rank N`` adds that rank's unmerged section, ``--all``
+adds every rank. ``--family PREFIX`` filters family names.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Optional
+
+
+def _fmt_num(v) -> str:
+    if isinstance(v, float) and v != int(v):
+        return f"{v:.6g}"
+    n = int(v)
+    return f"{n:_}" if abs(n) >= 10000 else str(n)
+
+
+def _quantile(bounds, buckets, q: float) -> Optional[float]:
+    """Approximate quantile from per-bucket counts: the upper edge of the
+    bucket where the cumulative count crosses q (+Inf reports the last
+    finite edge with a ``>`` marker upstream)."""
+    total = sum(buckets)
+    if total == 0:
+        return None
+    target = q * total
+    cum = 0
+    for bound, count in zip(bounds, buckets):
+        cum += count
+        if cum >= target:
+            return float(bound)
+    return float("inf")
+
+
+def _render_family(name: str, fam: dict, out) -> None:
+    for sample in fam["samples"]:
+        labels = sample.get("labels") or {}
+        label_s = ("{" + ",".join(f"{k}={v}" for k, v in
+                                  sorted(labels.items())) + "}"
+                   if labels else "")
+        if fam["type"] == "histogram":
+            count = sample["count"]
+            mean = sample["sum"] / count if count else 0.0
+            p50 = _quantile(sample["bounds"], sample["buckets"], 0.50)
+            p99 = _quantile(sample["bounds"], sample["buckets"], 0.99)
+
+            def edge(p):
+                if p is None:
+                    return "-"
+                if p == float("inf"):
+                    return f">{sample['bounds'][-1]:g}"
+                return f"<={p:g}"
+
+            detail = (f"count={_fmt_num(count)} mean={mean:.6g} "
+                      f"p50{edge(p50)} p99{edge(p99)}")
+        else:
+            detail = _fmt_num(sample["value"])
+        out.write(f"  {name + label_s:<58} {fam['type']:<9} {detail}\n")
+
+
+def _render_section(title: str, families: Dict[str, dict], prefix: str,
+                    out) -> None:
+    names = [n for n in sorted(families) if n.startswith(prefix)]
+    out.write(f"{title} ({len(names)} families)\n")
+    if not names:
+        out.write("  (none match)\n")
+    for name in names:
+        _render_family(name, families[name], out)
+    out.write("\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="pretty-print a saved /metrics.json or "
+                    "metrics_snapshot() document")
+    ap.add_argument("path", help="snapshot file, or - for stdin")
+    ap.add_argument("--rank", type=int, default=None,
+                    help="also print this rank's unmerged section")
+    ap.add_argument("--all", action="store_true",
+                    help="print every rank's unmerged section")
+    ap.add_argument("--family", default="",
+                    help="only families whose name starts with this")
+    args = ap.parse_args(argv)
+
+    fh = sys.stdin if args.path == "-" else open(args.path)
+    with fh:
+        doc = json.load(fh)
+
+    if "world" in doc and "ranks" in doc:
+        world, ranks = doc["world"], doc["ranks"]
+    else:
+        # a bare metrics_snapshot() families dict: one local section
+        world, ranks = doc, {}
+
+    _render_section("world", world, args.family, sys.stdout)
+    # JSON round-trips rank keys as strings; accept either
+    by_rank = {int(k): v for k, v in ranks.items()}
+    wanted = sorted(by_rank) if args.all else (
+        [args.rank] if args.rank is not None else [])
+    for rank in wanted:
+        if rank not in by_rank:
+            print(f"rank {rank}: not in snapshot "
+                  f"(have {sorted(by_rank)})", file=sys.stderr)
+            return 1
+        _render_section(f"rank {rank}", by_rank[rank], args.family,
+                        sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -447,6 +447,13 @@ def _parse_args(argv=None):
     parser.add_argument("--num-warmup-batches", type=int, default=10)
     parser.add_argument("--num-batches-per-iter", type=int, default=10)
     parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--timeline-dir", default="",
+                        help="capture per-rank Chrome-trace timeline "
+                             "artifacts of the eager control plane into "
+                             "this directory alongside the BENCH json "
+                             "(sets HOROVOD_TIMELINE + "
+                             "HOROVOD_TIMELINE_ALL_RANKS; merge with "
+                             "tools/trace_merge.py — docs/tracing.md)")
     parser.add_argument("--_measure", action="store_true",
                         help=argparse.SUPPRESS)  # internal: child mode
     parser.add_argument("--warm-init-cache", action="store_true",
@@ -507,7 +514,9 @@ def _supervise(args) -> None:
                   "--num-batches-per-iter", str(args.num_batches_per_iter),
                   "--num-iters", str(args.num_iters)] + \
         (["--fp16-allreduce"] if args.fp16_allreduce else []) + \
-        (["--int8-allreduce"] if args.int8_allreduce else [])
+        (["--int8-allreduce"] if args.int8_allreduce else []) + \
+        (["--timeline-dir", args.timeline_dir] if args.timeline_dir
+         else [])
     import signal
     import subprocess as sp
 
@@ -620,6 +629,21 @@ def main() -> None:
                           "1" if preflight_on else "0") != "0":
             _supervise(args)
             return
+
+    if args.timeline_dir:
+        # Per-rank timeline capture (docs/tracing.md): BEFORE hvd.init()
+        # reads the config. setdefault so an operator's explicit
+        # HOROVOD_TIMELINE pins win; ALL_RANKS makes the artifacts
+        # rank-suffixed and therefore merge-ready for trace_merge.py the
+        # moment a healthy accelerator window produces them.
+        os.makedirs(args.timeline_dir, exist_ok=True)
+        os.environ.setdefault(
+            "HOROVOD_TIMELINE",
+            os.path.join(args.timeline_dir, f"{args.model}_timeline.json"))
+        os.environ.setdefault("HOROVOD_TIMELINE_ALL_RANKS", "1")
+        os.environ.setdefault("HOROVOD_TIMELINE_MARK_CYCLES", "1")
+        _log(f"timeline capture -> {os.environ['HOROVOD_TIMELINE']} "
+             f"(per-rank; merge with tools/trace_merge.py)")
 
     import jax
 

@@ -84,6 +84,15 @@ def init(ranks=None, comm=None) -> None:
         _global.config = Config.from_env()
         _global.topology = discover(subset=list(ranks) if ranks else None)
         _global.initialized = True
+        if _global.config.timeline_all_ranks and \
+                not _global.config.timeline_path:
+            # the all-ranks knob only suffixes the base path; without one
+            # there is nothing to record and the operator should hear
+            # that rather than find an empty trace dir later
+            LOG.warning(
+                "HOROVOD_TIMELINE_ALL_RANKS=1 has no effect without "
+                "HOROVOD_TIMELINE=<path>; set the base path to record "
+                "per-rank traces (docs/tracing.md)")
         # Steps traced before init resolved the hierarchical knob from the
         # env and keep that routing baked in; warn if the pinned config now
         # disagrees (optimizers.check_build_time_resolutions).

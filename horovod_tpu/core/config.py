@@ -19,6 +19,17 @@ HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
 HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
 HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
 HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+# Distributed tracing (docs/tracing.md; ours): plain HOROVOD_TIMELINE
+# stays rank-0-only for back-compat with the reference artifact; setting
+# this to 1 makes EVERY member rank record spans into a rank-suffixed
+# file (<path>.rankN.json) that tools/trace_merge.py folds into one
+# clock-corrected Chrome trace with a process lane per rank.
+HOROVOD_TIMELINE_ALL_RANKS = "HOROVOD_TIMELINE_ALL_RANKS"
+# Seconds between clock-alignment handshakes against the coordinator
+# (min-RTT-filtered ping battery; obs/tracing.py). <= 0 disables the
+# periodic re-sync (the init-time sync still runs where the plane is
+# active at all).
+HOROVOD_CLOCK_SYNC_INTERVAL = "HOROVOD_CLOCK_SYNC_INTERVAL_S"
 # TPU-side twin of the timeline (SURVEY §5.1 mapping): the host timeline
 # records enqueue/negotiate/execute; on-device time lives in the XLA
 # profiler. This knob brackets init→shutdown with a jax.profiler trace on
@@ -182,6 +193,8 @@ class Config:
     cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
     timeline_path: str = ""
     timeline_mark_cycles: bool = False
+    timeline_all_ranks: bool = False
+    clock_sync_interval_s: float = 30.0
     jax_profile_dir: str = ""
     stall_check_disable: bool = False
     stall_warning_time_s: float = STALL_WARNING_TIME_S
@@ -225,6 +238,9 @@ class Config:
             cycle_time_ms=_env_float(HOROVOD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS),
             timeline_path=os.environ.get(HOROVOD_TIMELINE, ""),
             timeline_mark_cycles=_env_bool(HOROVOD_TIMELINE_MARK_CYCLES),
+            timeline_all_ranks=_env_bool(HOROVOD_TIMELINE_ALL_RANKS),
+            clock_sync_interval_s=_env_float(HOROVOD_CLOCK_SYNC_INTERVAL,
+                                             30.0),
             jax_profile_dir=os.environ.get(HOROVOD_JAX_PROFILE, ""),
             stall_check_disable=_env_bool(HOROVOD_STALL_CHECK_DISABLE),
             stall_warning_time_s=_env_float(HOROVOD_STALL_WARNING_TIME,

@@ -9,6 +9,9 @@ The subsystem docs live in docs/metrics.md; the pieces:
   format-lint helper;
 * :mod:`.bridge` — registry deltas as ``Timeline.counter`` tracks so the
   existing Chrome-tracing tooling keeps working;
+* :mod:`.tracing` — the distributed-tracing half (docs/tracing.md):
+  NTP-style clock alignment over the control wire and the coordinator's
+  straggler attribution folded into :func:`straggler_report`;
 * :func:`metrics_snapshot` — the Python API: this process's families, or
   the world-aggregated view rank 0's coordinator assembled from the
   per-rank pushes riding the HMAC control wire.
@@ -28,6 +31,11 @@ from .registry import (  # noqa: F401 - public surface
 )
 from .bridge import TimelineBridge  # noqa: F401
 from . import exposition  # noqa: F401
+from .tracing import (  # noqa: F401 - public surface (docs/tracing.md)
+    ClockSync,
+    build_straggler_report,
+    straggler_report,
+)
 
 
 def _pull_world_store(client) -> Dict[int, dict]:

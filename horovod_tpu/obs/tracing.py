@@ -1,0 +1,356 @@
+"""Distributed tracing plane: clock alignment + straggler attribution.
+
+The Horovod paper (1802.05799) calls straggler diagnosis the hardest
+operational problem in synchronous data parallelism, and the MPI
+characterization work (1810.11112) locates the damage at the
+coordinator: arrival spread is where world-scale cycles die. Diagnosing
+it needs two things this module provides on top of PR 5's metrics plane
+(docs/tracing.md):
+
+* **Clock alignment** — per-rank monotonic clocks are uncorrelatable, so
+  :class:`ClockSync` runs an NTP-style handshake against the coordinator
+  over the existing HMAC control wire: a battery of ``clock_probe``
+  round trips, keep the sample with the smallest RTT (asymmetric delay
+  corrupts the midpoint estimate, and the minimum-RTT sample bounds that
+  error by rtt/2), offset = server_time - local_midpoint. The offset
+  lands on the obs registry (``horovod_clock_offset_us``) and, when a
+  timeline is recording, as ``CLOCK_SYNC`` metadata records that
+  ``tools/trace_merge.py`` uses to fold per-rank trace files onto the
+  coordinator's timebase.
+
+* **Straggler attribution** — the coordinator charges each cycle's
+  arrival spread to the last-arriving rank (``ops/controller.py``:
+  ``horovod_straggler_last_arriver_total`` /
+  ``horovod_straggler_blame_seconds_total`` /
+  ``horovod_arrival_spread_seconds``). :func:`straggler_report` folds
+  those families — riding the PR 5 snapshot wire, so any rank can ask —
+  into per-rank blame fractions plus each rank's negotiation-wait vs
+  execute breakdown. ``tools/straggler_report.py`` runs the same fold
+  over a saved ``/metrics.json`` document.
+
+Degrades deterministically: the native (C++) controller wire predates
+the ``clock_probe`` RPC (``NativeControllerClient.clock_sync_supported``
+is False, the metrics_pull pattern), so traces there keep their local
+timebase and reports carry ``degraded: true`` instead of invented data.
+
+Module level is deliberately STDLIB-ONLY (package imports stay inside
+the functions that need them): ``tools/straggler_report.py`` analyzes
+saved snapshots on machines without the training environment by loading
+this file directly when ``import horovod_tpu`` (and therefore jax) is
+unavailable — the report fold itself is pure dict math.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+# Families this module owns. Offset/RTT are per-rank identity values
+# (gauges merge by MAX in the world fold, like world_size; the per-rank
+# sections carry the real readings — docs/metrics.md).
+GAUGE_OFFSET = "horovod_clock_offset_us"
+GAUGE_RTT = "horovod_clock_rtt_us"
+COUNTER_SYNCS = "horovod_clock_syncs_total"
+
+# Coordinator-side attribution families (registered in ops/controller.py).
+FAMILY_LAST = "horovod_straggler_last_arriver_total"
+FAMILY_BLAME_S = "horovod_straggler_blame_seconds_total"
+FAMILY_SPREAD = "horovod_arrival_spread_seconds"
+
+# Below this mean attributed spread the coordinator is watching scheduler
+# jitter, not a straggler: a "dominant rank" verdict needs both a
+# majority of the blame seconds AND spreads worth acting on. 5 ms is an
+# order of magnitude above healthy same-host jitter and well below any
+# fault a human would chase (docs/tracing.md).
+DEFAULT_MIN_SPREAD_S = 0.005
+
+
+def _clock_gauges():
+    """The one registration site for the clock families (get-or-create:
+    help/type must agree wherever they are touched)."""
+    from .registry import registry as _metrics
+
+    reg = _metrics()
+    return (
+        reg.gauge(GAUGE_OFFSET,
+                  "This rank's estimated monotonic-clock offset to the "
+                  "coordinator (rank-0 timebase), microseconds"),
+        reg.gauge(GAUGE_RTT,
+                  "RTT of the minimum-RTT clock probe behind the current "
+                  "offset estimate, microseconds"),
+        reg.counter(COUNTER_SYNCS, "Completed clock-alignment handshakes"),
+    )
+
+
+def set_reference_clock(rank: int, timeline=None) -> None:
+    """The coordinator-hosting rank IS the reference timebase: offset 0
+    by definition, no probes. Sets the same gauges / timeline metadata a
+    ClockSync would, so world snapshots and trace files stay uniform and
+    trace_merge never special-cases rank 0."""
+    g_offset, g_rtt, _ = _clock_gauges()
+    g_offset.set(0)
+    g_rtt.set(0)
+    if timeline is not None and timeline.enabled:
+        from ..utils.timeline import CLOCK_SYNC
+
+        timeline.meta(CLOCK_SYNC, {"offset_us": 0.0, "rtt_us": 0.0,
+                                   "rank": rank})
+
+
+class ClockSync:
+    """Periodic offset-to-coordinator estimation for one rank.
+
+    Owns its own ANONYMOUS control-wire connection (the metrics-publisher
+    pattern: never the engine's cycle client, whose request lock a probe
+    battery would contend with mid-negotiation; tearing this connection
+    down is never a rank death). ``sync_once`` runs a battery of
+    ``probes`` round trips and keeps the minimum-RTT sample; failures
+    drop the battery and redial next tick, degrading loudly after a
+    persistent streak like every other plane here."""
+
+    def __init__(self, addr, secret, world_id: str = "",
+                 rank: int = 0, timeline=None,
+                 probes: int = 8, interval_s: float = 30.0) -> None:
+        self._addr = addr
+        self._secret = secret
+        self._world_id = world_id
+        self._rank = rank
+        self._timeline = timeline
+        self._probes = max(int(probes), 1)
+        self._interval_s = interval_s
+        self._client = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._failures = 0
+        self.offset_us: Optional[float] = None
+        self.rtt_us: Optional[float] = None
+        self._g_offset, self._g_rtt, self._c_syncs = _clock_gauges()
+
+    def sync_once(self) -> Optional[Tuple[float, float]]:
+        """One battery; returns ``(offset_us, rtt_us)`` or None on fault.
+
+        The filter is MIN RTT, not mean: queueing delay is one-sided and
+        bursty, so averaging mixes corrupted midpoints into the estimate,
+        while the fastest round trip is the one that saw the least of it
+        — its midpoint error is bounded by rtt/2 (docs/tracing.md)."""
+        from ..runner.network import BasicClient
+
+        try:
+            if self._client is None:
+                self._client = BasicClient(self._addr, secret=self._secret,
+                                           timeout_s=5.0, attempts=3)
+            best: Optional[Tuple[float, float]] = None  # (rtt_s, offset_us)
+            for _ in range(self._probes):
+                resp, t0, t1 = self._client.rtt_probe(
+                    ("clock_probe", self._rank, self._world_id))
+                kind, server_us = resp
+                assert kind == "clock", resp
+                rtt = t1 - t0
+                midpoint_us = (t0 + t1) / 2.0 * 1e6
+                offset_us = float(server_us) - midpoint_us
+                if best is None or rtt < best[0]:
+                    best = (rtt, offset_us)
+            self._failures = 0
+        except Exception as exc:  # noqa: BLE001 - drop battery, redial
+            from ..core.logging import LOG
+
+            self._failures += 1
+            if self._failures == 3 and not self._stop.is_set():
+                LOG.warning(
+                    "clock sync: %d consecutive failed probe batteries "
+                    "(last: %s); rank %d's trace timebase will drift "
+                    "uncorrected until the wire recovers",
+                    self._failures, exc, self._rank)
+            if self._client is not None:
+                try:
+                    self._client.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._client = None
+            return None
+        rtt_s, offset_us = best
+        self.offset_us = offset_us
+        self.rtt_us = rtt_s * 1e6
+        self._g_offset.set(round(offset_us, 1))
+        self._g_rtt.set(round(self.rtt_us, 1))
+        self._c_syncs.inc()
+        if self._timeline is not None and self._timeline.enabled:
+            from ..utils.timeline import CLOCK_SYNC
+
+            self._timeline.meta(CLOCK_SYNC, {
+                "offset_us": round(offset_us, 1),
+                "rtt_us": round(self.rtt_us, 1),
+                "rank": self._rank,
+            })
+        return offset_us, self.rtt_us
+
+    def start(self) -> None:
+        """Sync at init and every ``interval_s`` (<= 0: init-time only),
+        on a daemon thread so a slow wire never blocks the engine."""
+
+        def _loop() -> None:
+            try:
+                self.sync_once()
+                if self._interval_s <= 0:
+                    return
+                while not self._stop.wait(self._interval_s):
+                    self.sync_once()
+            finally:
+                if self._client is not None:
+                    try:
+                        self._client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._client = None
+
+        self._thread = threading.Thread(
+            target=_loop, name="horovod-clock-sync", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# -- straggler report ----------------------------------------------------------
+
+
+def _histogram_quantile(bounds, buckets, q: float) -> Optional[float]:
+    """Upper edge of the bucket where the cumulative count crosses q
+    (the fixed-bucket approximation every consumer of these histograms
+    uses — tools/metrics_summary.py renders the same number). Returns
+    None when the quantile lands in the +Inf overflow bucket: the report
+    is json.dumps'd verbatim (the tools' one-line-JSON contract), and
+    float('inf') would serialize as the non-RFC token ``Infinity``."""
+    total = sum(buckets)
+    if total == 0:
+        return None
+    target = q * total
+    cum = 0
+    for bound, count in zip(bounds, buckets):
+        cum += count
+        if cum >= target:
+            return float(bound)
+    return None  # beyond the last finite bound
+
+
+def _sum_labeled_counter(families: dict, name: str) -> Dict[int, float]:
+    out: Dict[int, float] = {}
+    fam = families.get(name)
+    if not fam:
+        return out
+    for sample in fam.get("samples", []):
+        rank = sample.get("labels", {}).get("rank")
+        if rank is None:
+            continue
+        out[int(rank)] = out.get(int(rank), 0.0) + sample.get("value", 0.0)
+    return out
+
+
+def _unlabeled_sample(families: dict, name: str) -> Optional[dict]:
+    fam = families.get(name)
+    if not fam or not fam.get("samples"):
+        return None
+    return fam["samples"][0]
+
+
+def build_straggler_report(ranks: Dict[int, dict],
+                           min_spread_s: float = DEFAULT_MIN_SPREAD_S
+                           ) -> dict:
+    """Fold per-rank registry families into the attribution report.
+
+    ``ranks`` is the ``metrics_snapshot(world=True)["ranks"]`` shape:
+    {rank: families}. The attribution families live on the COORDINATOR's
+    registry (rank 0's section); each rank's own section contributes its
+    negotiation-wait vs execute breakdown. A document with no
+    attribution families (native controller wire, or a pull that never
+    reached the coordinator's snapshot) reports ``degraded: true``.
+
+    ``dominant_rank`` is deliberately two-gated: a rank must own more
+    than half the accumulated blame SECONDS (counts alone let a rank
+    late by microseconds every cycle outrank one late by 50 ms on a
+    tenth of them) AND the mean attributed spread must exceed
+    ``min_spread_s`` — below that the coordinator is measuring scheduler
+    jitter and naming a "straggler" would send an operator chasing
+    noise."""
+    last: Dict[int, float] = {}
+    blame_s: Dict[int, float] = {}
+    spread = None
+    for fams in ranks.values():
+        for rank, v in _sum_labeled_counter(fams, FAMILY_LAST).items():
+            last[rank] = last.get(rank, 0.0) + v
+        for rank, v in _sum_labeled_counter(fams, FAMILY_BLAME_S).items():
+            blame_s[rank] = blame_s.get(rank, 0.0) + v
+        s = _unlabeled_sample(fams, FAMILY_SPREAD)
+        if s is not None and s.get("count"):
+            if spread is None:
+                spread = {"bounds": list(s["bounds"]),
+                          "buckets": list(s["buckets"]),
+                          "sum": s["sum"], "count": s["count"]}
+            else:  # same-family fold (pointwise: bounds fixed by contract)
+                spread["buckets"] = [a + b for a, b in
+                                     zip(spread["buckets"], s["buckets"])]
+                spread["sum"] += s["sum"]
+                spread["count"] += s["count"]
+    cycles = int(sum(last.values()))
+    total_blame = sum(blame_s.values())
+    report: dict = {
+        "cycles_attributed": cycles,
+        "min_spread_s": min_spread_s,
+        "degraded": cycles == 0,
+        "blame": {},
+        "per_rank": {},
+        "dominant_rank": None,
+    }
+    for rank in sorted(set(last) | set(blame_s)):
+        seconds = blame_s.get(rank, 0.0)
+        report["blame"][rank] = {
+            "last_arriver_cycles": int(last.get(rank, 0)),
+            "cycle_share": (last.get(rank, 0.0) / cycles) if cycles else 0.0,
+            "blame_seconds": seconds,
+            "blame_share": (seconds / total_blame) if total_blame else 0.0,
+        }
+    if spread is not None:
+        mean = spread["sum"] / spread["count"]
+        report["spread"] = {
+            "count": spread["count"],
+            "mean_s": mean,
+            "p50_s": _histogram_quantile(spread["bounds"],
+                                         spread["buckets"], 0.50),
+            "p99_s": _histogram_quantile(spread["bounds"],
+                                         spread["buckets"], 0.99),
+            "sum_s": spread["sum"],
+        }
+        if report["blame"]:
+            top = max(report["blame"],
+                      key=lambda r: report["blame"][r]["blame_seconds"])
+            if report["blame"][top]["blame_share"] > 0.5 and \
+                    mean > min_spread_s:
+                report["dominant_rank"] = top
+    # Per-rank phase breakdown: where each rank's wall time went —
+    # negotiation wait (client-observed cycle latency, straggler wait
+    # included) vs executing negotiated responses.
+    for rank, fams in sorted(ranks.items()):
+        wait = _unlabeled_sample(fams, "horovod_negotiation_cycle_seconds")
+        execute = _unlabeled_sample(fams, "horovod_execute_seconds")
+        report["per_rank"][int(rank)] = {
+            "negotiation_wait_s": wait["sum"] if wait else 0.0,
+            "negotiation_cycles": wait["count"] if wait else 0,
+            "execute_s": execute["sum"] if execute else 0.0,
+        }
+    return report
+
+
+def straggler_report(min_spread_s: float = DEFAULT_MIN_SPREAD_S) -> dict:
+    """Live attribution report for this job (docs/tracing.md).
+
+    On the coordinator rank the attribution families are read from the
+    live local registry; elsewhere they arrive via the PR 5 snapshot
+    wire (``metrics_pull`` — only as fresh as rank 0's last publisher
+    push, and absent entirely when the publisher plane is not opted in,
+    in which case the report says ``degraded: true`` rather than
+    guessing)."""
+    from . import metrics_snapshot
+
+    return build_straggler_report(
+        metrics_snapshot(world=True)["ranks"], min_spread_s=min_spread_s)

@@ -95,6 +95,25 @@ _WORLD_ABORTS = _metrics().counter(
 _RECONNECT_WINDOW_HEALS = _metrics().counter(
     "horovod_reconnect_window_heals_total",
     "Dropped rank connections forgiven by an in-window reconnect")
+# Straggler attribution (docs/tracing.md): the coordinator is the one
+# place arrival ORDER is observable, so it charges each cycle's spread
+# (last arrival - first arrival) to the rank that arrived last. Count
+# AND seconds per blamed rank: counts answer "who is late", seconds
+# answer "who is costing the world time" — a rank late by microseconds
+# on every cycle must not outrank one late by 50 ms on a tenth of them.
+# rank labels are low-cardinality by the registry's contract (a world's
+# rank set, not tensor names).
+_STRAGGLER_LAST = _metrics().counter(
+    "horovod_straggler_last_arriver_total",
+    "Negotiation cycles in which this rank arrived last at the "
+    "coordinator", labels=("rank",))
+_STRAGGLER_BLAME_S = _metrics().counter(
+    "horovod_straggler_blame_seconds_total",
+    "Arrival-spread seconds charged to this rank as the cycle's last "
+    "arriver", labels=("rank",))
+_ARRIVAL_SPREAD = _metrics().histogram(
+    "horovod_arrival_spread_seconds",
+    "Per-cycle coordinator arrival spread (last arrival - first)")
 
 def _nbytes(req: Request) -> int:
     n = _DTYPE_BYTES[req.tensor_type]
@@ -617,6 +636,12 @@ class ControllerService:
         self._history: Dict[int, ResponseList] = {}
         self._lock = threading.Lock()
         self._cycle_t0: Dict[Any, float] = {}
+        # Straggler attribution (docs/tracing.md): per-cycle arrival time
+        # of every rank's cycle request, popped (and charged to the last
+        # arriver) when the cycle completes. Size matches in-flight
+        # cycles, so an aborted world leaks at most one entry per key.
+        self._cycle_arrivals: Dict[Any, Dict[int, float]] = {}
+        self._size = size
         self._autotuner = autotuner
         self._tuned_cycle_ms: Optional[float] = None
         # Failure detection: map each connection to the rank it serves; a
@@ -751,6 +776,21 @@ class ControllerService:
                 raise RuntimeError(
                     world_mismatch_error(self._world_id, caller_wid))
             return ("metrics", self.metrics_store())
+        if kind == "clock_probe":
+            # Clock alignment (docs/tracing.md): answer with THIS host's
+            # monotonic clock in µs — the same clock every Timeline here
+            # stamps spans with — so a min-RTT-filtered battery of probes
+            # lets each rank compute its offset to the coordinator's
+            # timebase. Anonymous like "metrics"/"watch" (handled before
+            # rank binding: a probing connection's teardown is never a
+            # rank death); a co-located different world's probe is refused
+            # — its reference clock lives behind its own service.
+            caller_wid = req[2] if len(req) > 2 else ""
+            if caller_wid and self._world_id and \
+                    caller_wid != self._world_id:
+                raise RuntimeError(
+                    world_mismatch_error(self._world_id, caller_wid))
+            return ("clock", time.monotonic_ns() / 1e3)
         if kind == "bye":
             # Clean detach for clients that leave without a negotiated
             # world shutdown (tests, tooling): de-register so the
@@ -840,11 +880,15 @@ class ControllerService:
         if kind == "cycle":
             _, _, request_list = req
             key = ("cycle", self._current_cycle(rank))
+            now = time.monotonic()
             with self._lock:
                 # active-window start: first rank's arrival for this cycle
                 # (straggler wait + construct count toward the autotune
                 # score; inter-cycle client think time does not)
-                self._cycle_t0.setdefault(key, time.monotonic())
+                self._cycle_t0.setdefault(key, now)
+                # per-rank arrival order: the input straggler attribution
+                # charges the cycle's spread from (docs/tracing.md)
+                self._cycle_arrivals.setdefault(key, {})[rank] = now
             return self._cycles.submit(key, rank, request_list,
                                        lambda slot: self._run_cycle(slot, key))
         if kind == "payload":
@@ -992,9 +1036,20 @@ class ControllerService:
                 self._world_shutdown = True
         with self._lock:
             t0 = self._cycle_t0.pop(key, None)
+            arrivals = self._cycle_arrivals.pop(key, None)
         active_us = (time.monotonic() - t0) * 1e6 if t0 is not None else None
         if active_us is not None:
             _COORD_CYCLE_SECONDS.observe(active_us / 1e6)
+        if arrivals is not None and len(arrivals) == self._size > 1:
+            # Straggler attribution: charge this cycle's arrival spread to
+            # the last arriver. Only fully-observed cycles count — a
+            # partial map (a rank's request expanded from history during
+            # teardown) would misattribute the missing rank's timing.
+            last_rank, last_t = max(arrivals.items(), key=lambda kv: kv[1])
+            spread = last_t - min(arrivals.values())
+            _STRAGGLER_LAST.labels(rank=last_rank).inc()
+            _STRAGGLER_BLAME_S.labels(rank=last_rank).inc(spread)
+            _ARRIVAL_SPREAD.observe(spread)
         self._maybe_autotune(response_list, active_us)
         ack = None
         if self._cache is not None:
@@ -1273,6 +1328,10 @@ def spawn_watch_thread(addr, secret, request_reason, on_abort) -> None:
 class ControllerClient:
     """Worker-side handle on the controller (one per process)."""
 
+    # The Python service answers "clock_probe" (docs/tracing.md); the
+    # engine reads this to decide whether a ClockSync thread can run.
+    clock_sync_supported = True
+
     def __init__(self, addr,  # (host, port) or {intf: (host, port)}
                  secret: Optional[bytes] = None,
                  timeout_s: Optional[float] = None,
@@ -1282,6 +1341,9 @@ class ControllerClient:
         self._addr = addr
         self._secret = secret
         self._cycle_no = 0
+        self._last_cycle = 0  # parity with the native client: the
+        # last_cycle property must read 0 (not raise) before a first
+        # cycle completes
         self._rank = rank
         self._world_id = world_id
         # cumulative + last-cycle negotiation wire bytes (cycle() only;
@@ -1327,6 +1389,16 @@ class ControllerClient:
 
     def _arm_reconnect_hello(self) -> None:
         self._client.on_reconnect = self._reconnect_hello
+
+    @property
+    def last_cycle(self) -> int:
+        """Ordinal of the most recently completed negotiation cycle —
+        the engine's cross-rank span stamp (docs/tracing.md: every rank
+        joins every cycle in order, so ordinal N names the same
+        coordinator rendezvous in every per-rank trace). Part of the
+        client interface, like ``clock_sync_supported``; the native
+        client carries the same contract."""
+        return self._last_cycle
 
     @property
     def negotiation_tx_bytes(self) -> int:

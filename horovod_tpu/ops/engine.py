@@ -37,7 +37,7 @@ from ..core.logging import LOG
 from ..core.status import SHUT_DOWN_ERROR, Status
 from ..obs import TimelineBridge, registry as _obs_registry
 from ..runner.network import default_secret
-from ..utils.timeline import Timeline
+from ..utils.timeline import TRACE_META, Timeline, rank_timeline_path
 from .autotuner import Autotuner
 from .controller import (
     ControllerClient,
@@ -54,6 +54,16 @@ from .messages import (
     ResponseType,
     dtype_of,
 )
+
+# Observability plane (docs/tracing.md): time spent turning negotiated
+# responses into results — the "execute" half of the straggler report's
+# negotiation-wait vs execute breakdown. Device-plane batches are
+# asynchronous dispatches, so this measures dispatch + host-path data
+# movement; device completion time lives in the JAX profiler.
+_EXECUTE_SECONDS = _obs_registry().histogram(
+    "horovod_execute_seconds",
+    "Per-response execution time on the engine loop (dispatch + "
+    "host-path data movement; device completion is asynchronous)")
 
 
 @dataclass
@@ -267,16 +277,40 @@ class Engine:
         self._stopped = threading.Event()
         self._wake = threading.Event()
 
-        # member rank 0 only: subset-world NON-members also carry rank 0
-        # (their self-world) and would clobber the same timeline file
-        timeline_path = cfg.timeline_path \
-            if topo.rank == 0 and topo.is_member else ""
+        # Plain HOROVOD_TIMELINE stays rank-0-only (the reference
+        # artifact, back-compat); HOROVOD_TIMELINE_ALL_RANKS=1 records on
+        # EVERY member rank into rank-suffixed files that
+        # tools/trace_merge.py folds into one clock-corrected world trace
+        # (docs/tracing.md). Members only either way: subset-world
+        # NON-members also carry rank 0 (their self-world) and would
+        # clobber the member artifact.
+        timeline_path = ""
+        if cfg.timeline_path and topo.is_member:
+            if cfg.timeline_all_ranks:
+                timeline_path = rank_timeline_path(cfg.timeline_path,
+                                                   topo.rank)
+            elif topo.rank == 0:
+                timeline_path = cfg.timeline_path
         self.timeline = Timeline(timeline_path, cfg.timeline_mark_cycles)
+        if self.timeline.enabled:
+            # identity record first: trace_merge must know whose lane
+            # this file is even if the job dies before any span closes
+            self.timeline.meta(TRACE_META, {
+                "rank": topo.rank, "size": topo.size,
+                "epoch": basics.world_epoch()})
+        # Per-cycle span stamps (cycle ordinal + cache generation): set
+        # each tick by _cycle_span_args, attached to NEGOTIATE end /
+        # EXECUTE begin records so spans correlate across per-rank trace
+        # files without a shared clock (docs/tracing.md).
+        self._span_args: Optional[dict] = None
+        self._local_cycle_no = 0
         # Observability plane (docs/metrics.md): registry deltas ride the
         # timeline as Chrome counter tracks (no-op when the timeline is
         # off); the publisher below feeds cross-rank aggregation.
         self._metrics_bridge = TimelineBridge(_obs_registry(), self.timeline)
         self._metrics_stop: Optional[threading.Event] = None
+        self._metrics_thread: Optional[threading.Thread] = None
+        self._clock_sync = None
 
         self._service: Optional[ControllerService] = None
         self._client: Optional[ControllerClient] = None
@@ -399,8 +433,9 @@ class Engine:
                     f"the launcher must export the controller address.")
             client_cls = (NativeControllerClient if use_native
                           else ControllerClient)
+            addr_map = {a: (a, port) for a in addr_list}
             self._client = client_cls(
-                {a: (a, port) for a in addr_list}, secret=secret,
+                addr_map, secret=secret,
                 timeout_s=None, rank=self._rank, world_id=world_id,
                 **({"log_stalls": self._rank == 0,
                     "stall_shutdown_s": cfg.stall_shutdown_time_s,
@@ -415,8 +450,11 @@ class Engine:
                 # the native service's fixed binary protocol predates the
                 # metrics RPC (same pattern as the cache-bit and codec
                 # fields).
-                self._start_metrics_publisher(
-                    {a: (a, port) for a in addr_list}, secret, world_id)
+                self._start_metrics_publisher(addr_map, secret, world_id)
+            # Clock alignment (docs/tracing.md): offset-to-coordinator
+            # estimation where something consumes it; degrades
+            # deterministically on the native wire (clock_sync_supported).
+            self._maybe_start_clock_sync(addr_map, secret, world_id)
 
         self._host_fallback_warned = set()
 
@@ -506,12 +544,30 @@ class Engine:
         from ..runner.network import BasicClient
 
         def _push_loop() -> None:
-            client = None
             failures = 0  # consecutive; a single lost push is noise, a
             # persistent streak (wrong world on a shared port, bad secret)
             # must degrade LOUDLY like every other plane here
+            client = None
             try:
-                while not stop.wait(interval):
+                # Eager dial (final-flush contract): the connection must
+                # exist BEFORE a negotiated shutdown closes the
+                # coordinator's listener — an ESTABLISHED connection's
+                # handler thread outlives service.shutdown(), so the final
+                # push below still lands, while a first-ever dial at that
+                # point would find the listener gone and silently lose the
+                # whole final interval.
+                client = BasicClient(addr, secret=secret,
+                                     timeout_s=5.0, attempts=3)
+            except Exception:  # noqa: BLE001 - the first tick retries
+                client = None
+            try:
+                while True:
+                    # stop.wait returning True is the engine's teardown
+                    # signal: push ONE final snapshot (the last partial
+                    # interval must not be silently lost), then exit. The
+                    # engine's bounded join is the time cap — best-effort
+                    # by contract, the wire may already be gone.
+                    stopping = stop.wait(interval)
                     try:
                         if client is None:
                             client = BasicClient(addr, secret=secret,
@@ -537,6 +593,8 @@ class Engine:
                             except Exception:  # noqa: BLE001
                                 pass
                             client = None
+                    if stopping:
+                        return
             finally:
                 if client is not None:
                     try:
@@ -544,9 +602,35 @@ class Engine:
                     except Exception:  # noqa: BLE001
                         pass
 
-        threading.Thread(target=_push_loop,
-                         name="horovod-metrics-publisher",
-                         daemon=True).start()
+        self._metrics_thread = threading.Thread(
+            target=_push_loop, name="horovod-metrics-publisher",
+            daemon=True)
+        self._metrics_thread.start()
+
+    def _maybe_start_clock_sync(self, addr, secret,
+                                world_id: str = "") -> None:
+        """Clock alignment (docs/tracing.md): runs only where something
+        consumes the offset — a recording timeline on this rank, or the
+        metrics plane opted in (the gauges then ride the snapshot wire).
+        The coordinator-hosting rank IS the reference timebase (offset 0
+        by definition, no probes); the native controller wire predates
+        the clock_probe RPC and degrades deterministically."""
+        if self._client is None or not getattr(
+                self._client, "clock_sync_supported", False):
+            return
+        if not (self.timeline.enabled or self._cfg.metrics_port or
+                self._cfg.metrics_interval_explicit):
+            return
+        from ..obs.tracing import ClockSync, set_reference_clock
+
+        if self._service is not None:
+            set_reference_clock(self._rank, self.timeline)
+            return
+        self._clock_sync = ClockSync(
+            addr, secret, world_id=world_id, rank=self._rank,
+            timeline=self.timeline,
+            interval_s=self._cfg.clock_sync_interval_s)
+        self._clock_sync.start()
 
     def _warn_host_fallback(self, op_name: str, tensor_name: str,
                             array: np.ndarray) -> None:
@@ -724,12 +808,16 @@ class Engine:
                 if self._negotiator is not None:
                     self._negotiator.add_request_list(request_list)
                     response_list = self._negotiator.construct_response_list()
+                    self._local_cycle_no += 1
                 else:
                     assert self._client is not None
                     response_list = self._cycle_with_cache(
                         request_list, requests, stop)
+                self._span_args = self._cycle_span_args(response_list)
                 for idx, resp in enumerate(response_list.responses):
+                    t_exec = time.monotonic()
                     self._execute(idx, resp)
+                    _EXECUTE_SECONDS.observe(time.monotonic() - t_exec)
                 # registry deltas as timeline counter tracks (no-op when
                 # the timeline is disabled — one attribute check)
                 self._metrics_bridge.emit()
@@ -767,6 +855,8 @@ class Engine:
             self._flush_outstanding(Status.unknown_error(reason))
         finally:
             self._stop_requested = True
+            if self._clock_sync is not None:
+                self._clock_sync.stop()
             if self._metrics_stop is not None:
                 self._metrics_stop.set()  # publisher drains before teardown
             self._flush_outstanding(Status.unknown_error(
@@ -779,6 +869,15 @@ class Engine:
                 # stopping it here could strand an unsubmitted batch.)
                 self._finalizer_q.put(None)
                 self._finalizer.join(timeout=15.0)
+            if self._metrics_thread is not None:
+                # Final-flush rendezvous (docs/metrics.md): the stop event
+                # wakes the publisher, which pushes one last snapshot so
+                # the final partial interval isn't silently lost. Join
+                # BEFORE the client/service teardown below — the bounded
+                # timeout is what keeps the flush best-effort rather than
+                # a shutdown hazard (the thread is a daemon; an overrun
+                # push is abandoned, never waited out).
+                self._metrics_thread.join(timeout=3.0)
             if self._client is not None:
                 # Never a clean detach: after a negotiated shutdown the
                 # controller ignores the drop anyway, and on the crash path
@@ -813,6 +912,25 @@ class Engine:
                     "timeline writer open to avoid a write-after-free")
             self._stopped.set()
 
+    def _cycle_span_args(self, response_list) -> Optional[dict]:
+        """Cross-rank correlation stamps for this cycle's span records
+        (docs/tracing.md): the cycle ordinal — every rank participates in
+        every negotiation cycle exactly once and in order, so ordinal N
+        names the SAME rendezvous in every per-rank trace file — plus the
+        response-cache generation, which distinguishes replayed-layout
+        cycles from renegotiated ones when reading a merged trace."""
+        if not self.timeline.enabled:
+            return None
+        if self._client is not None:
+            ordinal = self._client.last_cycle
+        else:
+            ordinal = self._local_cycle_no - 1
+        args = {"cycle": ordinal}
+        generation = getattr(response_list, "cache_generation", None)
+        if generation is not None:
+            args["cache_generation"] = generation
+        return args
+
     def _cycle_with_cache(self, request_list: RequestList,
                           requests: List[Request], stop: bool):
         """One controller round trip, through the steady-state bypass when
@@ -839,7 +957,11 @@ class Engine:
                 responses=cache.accept_ack(out),
                 tuned_cycle_ms=out.tuned_cycle_ms,
                 stall_warnings=out.stall_warnings,
-                stall_check=out.stall_check)
+                stall_check=out.stall_check,
+                # carried for the span stamps (_cycle_span_args): an
+                # all-hit cycle's trace must still say which cache
+                # generation it replayed under
+                cache_generation=out.generation)
         else:
             response_list = out
             if cache is not None:
@@ -927,7 +1049,9 @@ class Engine:
             return
         tl = self.timeline
         for entry in entries:
-            tl.negotiate_end(entry.name)
+            # cycle-ordinal + cache-generation stamps: how the same
+            # span is found across per-rank trace files (docs/tracing.md)
+            tl.negotiate_end(entry.name, args=self._span_args)
 
         if resp.response_type == ResponseType.ERROR:
             status = Status.precondition_error(resp.error_message)
@@ -937,7 +1061,7 @@ class Engine:
 
         op_name = _OP_NAMES[entries[0].op]
         for entry in entries:
-            tl.start(entry.name, op_name)
+            tl.start(entry.name, op_name, args=self._span_args)
         try:
             if resp.response_type == ResponseType.ALLREDUCE:
                 results = self._run_allreduce(

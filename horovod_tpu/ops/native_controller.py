@@ -177,6 +177,13 @@ class NativeControllerClient:
     probing, retries, TCP_NODELAY, HMAC + u64-length frames via
     ``request_raw``); only the body codec differs from the pickle wire."""
 
+    # Deterministic degrade (docs/tracing.md): the C++ service's fixed
+    # binary wire predates the clock_probe RPC — same pattern as
+    # metrics_pull and the cache-bit field — so clock alignment never
+    # runs against it; per-rank traces keep their local timebase and
+    # trace_merge says so instead of pretending correction happened.
+    clock_sync_supported = False
+
     def __init__(self, addr, secret: Optional[bytes] = None,
                  timeout_s: Optional[float] = None,
                  connect_attempts: int = 100,
@@ -218,6 +225,13 @@ class NativeControllerClient:
                 hello=lambda c: _decode_status(
                     c.request_raw(encode_hello(rank, world_id))),
                 chaos=self._chaos, on_reconnect=self._reconnect_hello)
+
+    @property
+    def last_cycle(self) -> int:
+        """Ordinal of the most recently completed negotiation cycle (the
+        engine's cross-rank span stamp — same contract as
+        ``ControllerClient.last_cycle``)."""
+        return self._last_cycle
 
     def _reconnect_hello(self, client) -> None:
         """Re-identify after the client reconnects off a latched-broken

@@ -934,6 +934,32 @@ class BasicClient:
             raise WireError(f"service-side failure: {resp.message}")
         return resp
 
+    def rtt_probe(self, obj: Any) -> Tuple[Any, float, float]:
+        """One unsequenced round trip timed tightly around the socket I/O:
+        returns ``(response, sent_monotonic_s, received_monotonic_s)`` so
+        the caller can do NTP midpoint math. The clock-alignment plane's
+        primitive (``obs.tracing``, docs/tracing.md) — deliberately OFF the
+        ``#rpc`` dedup envelope: a replayed probe would return a STALE
+        server timestamp as if it were fresh, which is exactly the
+        corruption the min-RTT filter exists to reject (and a reconnect
+        mid-probe inflates the RTT so far the sample filters out anyway).
+        Transport faults latch the connection broken and raise — the
+        caller drops the sample and redials on its own cadence."""
+        with self._lock:
+            if self._broken or self._sock is None:
+                self._reconnect()
+            try:
+                t0 = time.monotonic()
+                self._wire.write(obj, self._sock)
+                resp = self._wire.read(self._sock)
+                t1 = time.monotonic()
+            except _TRANSPORT_ERRORS:
+                self._broken = True
+                raise
+        if isinstance(resp, RemoteError):
+            raise WireError(f"service-side failure: {resp.message}")
+        return resp, t0, t1
+
     def bare_request_raw(self, body: bytes) -> bytes:
         """Raw-wire twin of ``bare_request`` (the native client's
         reconnect hello)."""

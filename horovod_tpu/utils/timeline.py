@@ -26,6 +26,22 @@ from typing import Optional
 
 _PHASE_NEGOTIATE = "NEGOTIATE_"
 CYCLE_NAME = "CYCLE_START"
+# Distributed-tracing metadata records (docs/tracing.md): one
+# TRACE_META per file identifies the rank/world the spans belong to;
+# CLOCK_SYNC records carry the min-RTT-filtered offset-to-rank-0 the
+# merge tool uses to fold per-rank files onto one corrected timebase.
+TRACE_META = "horovod_trace_meta"
+CLOCK_SYNC = "horovod_clock_sync"
+
+
+def rank_timeline_path(path: str, rank: int) -> str:
+    """Per-rank artifact name under ``HOROVOD_TIMELINE_ALL_RANKS=1``:
+    ``<base>.rank<N><ext>`` so ``tools/trace_merge.py`` can glob the
+    world's files from the configured base path. Plain ``HOROVOD_TIMELINE``
+    (rank 0 only) keeps the unsuffixed reference name."""
+    if path.endswith(".json"):
+        return f"{path[:-len('.json')]}.rank{rank}.json"
+    return f"{path}.rank{rank}"
 
 
 class Timeline:
@@ -117,14 +133,28 @@ class Timeline:
                     "pid": 0, "tid": self._tid(tensor_name),
                     "ts": self._ts_us()})
 
-    def negotiate_end(self, tensor_name: str) -> None:
-        self._emit({"ph": "E", "pid": 0, "tid": self._tid(tensor_name),
-                    "ts": self._ts_us()})
+    def negotiate_end(self, tensor_name: str,
+                      args: Optional[dict] = None) -> None:
+        """``args`` (docs/tracing.md): the engine stamps the cycle ordinal
+        and cache generation on the E record — every rank participates in
+        every negotiation cycle exactly once and in order, so the ordinal
+        correlates the same span across per-rank trace files without any
+        shared clock (Chrome tracing merges E-record args into the span)."""
+        record = {"ph": "E", "pid": 0, "tid": self._tid(tensor_name),
+                  "ts": self._ts_us()}
+        if args:
+            record["args"] = dict(args)
+        self._emit(record)
 
-    def start(self, tensor_name: str, op_name: str) -> None:
-        """Collective execution begins (top-level span, ``timeline.cc:230``)."""
-        self._emit({"name": op_name.upper(), "ph": "B", "pid": 0,
-                    "tid": self._tid(tensor_name), "ts": self._ts_us()})
+    def start(self, tensor_name: str, op_name: str,
+              args: Optional[dict] = None) -> None:
+        """Collective execution begins (top-level span, ``timeline.cc:230``).
+        ``args``: cycle-correlation stamps, as on ``negotiate_end``."""
+        record = {"name": op_name.upper(), "ph": "B", "pid": 0,
+                  "tid": self._tid(tensor_name), "ts": self._ts_us()}
+        if args:
+            record["args"] = dict(args)
+        self._emit(record)
 
     def activity_start(self, tensor_name: str, activity: str) -> None:
         self._emit({"name": activity, "ph": "B", "pid": 0,
@@ -158,6 +188,16 @@ class Timeline:
         to the terminated file."""
         self._emit({"name": name, "ph": "C", "pid": 0, "tid": 0,
                     "ts": self._ts_us(), "args": dict(values)})
+
+    def meta(self, name: str, args: dict) -> None:
+        """File-scoped metadata record (Chrome ph "M"): the distributed-
+        tracing plane writes one ``TRACE_META`` per file (rank, size,
+        epoch) and a ``CLOCK_SYNC`` per alignment handshake (offset to
+        rank 0, filter RTT), which is how ``tools/trace_merge.py`` knows
+        which lane a file is and how to correct its timebase without any
+        side-channel manifest (docs/tracing.md)."""
+        self._emit({"name": name, "ph": "M", "pid": 0, "tid": 0,
+                    "ts": self._ts_us(), "args": dict(args)})
 
     # -- writer ---------------------------------------------------------------
 

@@ -616,6 +616,56 @@ def test_mp_bit_exact_with_metrics_and_chaos_vs_off():
     assert set(on) == set(off), (on, off)  # bit-exact, metrics on vs off
 
 
+def _final_flush_fn():
+    """Publisher interval far longer than the whole job: the ONLY way a
+    rank's snapshot can reach the coordinator's store is the final flush
+    at engine teardown (the eager-dialed connection outlives the
+    negotiated shutdown's listener close). No pre-shutdown barrier — the
+    exact shutdown-ordering fragility PR 5's dryrun documented."""
+    import time as _time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as _np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops.engine import get_engine
+
+    hvd.init()
+    rank = hvd.rank()
+    for _ in range(3):
+        hvd.allreduce(_np.ones((8,), _np.float32), name="obs.flush")
+    engine = get_engine()  # keep a handle past shutdown's global clear
+    hvd.shutdown()
+    if rank != 0:
+        return []
+    service = engine._service
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline:
+        store = service.metrics_store()
+        if len(store) >= 2:
+            break
+        _time.sleep(0.05)
+    # every stored snapshot is a FINAL one: it carries the rank's full
+    # cycle count, not an empty pre-first-interval registry
+    return sorted(
+        (r, s["horovod_negotiation_cycles_total"]["samples"][0]["value"])
+        for r, s in service.metrics_store().items())
+
+
+def test_publisher_final_flush_beats_shutdown_ordering():
+    """The final partial interval must not be silently lost: with a 60 s
+    interval the store can only be populated by the teardown flush, from
+    BOTH ranks, each with its complete final counters."""
+    entries = [r for r in _run_world(
+        _final_flush_fn, (), 2,
+        _world_env({"HOROVOD_METRICS_INTERVAL_S": "60"})) if r][0]
+    assert [r for r, _ in entries] == [0, 1], entries
+    for _rank, cycles in entries:
+        assert cycles > 0, entries
+
+
 # -- elastic interplay (wall-clock heavy: slow tier) --------------------------
 
 @pytest.mark.slow

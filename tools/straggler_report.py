@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Who is slow, in which phase: the coordinator's straggler attribution.
+
+Reads a metrics document — a saved ``/metrics.json`` file, a live
+exposition URL, or a bare ``metrics_snapshot(world=True)`` dict — and
+folds the coordinator's arrival-order families (docs/tracing.md) into
+per-rank blame fractions plus each rank's negotiation-wait vs execute
+breakdown:
+
+    curl -s http://127.0.0.1:$HOROVOD_METRICS_PORT/metrics.json > snap.json
+    python tools/straggler_report.py snap.json
+    python tools/straggler_report.py http://127.0.0.1:9090/metrics.json
+
+In-job, the same report is ``hvd.straggler_report()``. The final stdout
+line is the report as one JSON object (the repo's tool contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# runnable straight from a checkout: `python tools/straggler_report.py`
+# puts tools/ (not the repo root) on sys.path
+sys.path.insert(0, _REPO)
+
+
+def _load_fold():
+    """The report fold lives in horovod_tpu.obs.tracing — but this tool
+    must analyze snapshots copied OFF a pod, on machines where importing
+    the package would pull in jax. obs/tracing.py keeps its module level
+    stdlib-only for exactly this: when the package import fails, load the
+    file directly (the fold is pure dict math)."""
+    try:
+        from horovod_tpu.obs.tracing import (
+            DEFAULT_MIN_SPREAD_S,
+            build_straggler_report,
+        )
+
+        return DEFAULT_MIN_SPREAD_S, build_straggler_report
+    except ImportError:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_straggler_fold",
+            os.path.join(_REPO, "horovod_tpu", "obs", "tracing.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.DEFAULT_MIN_SPREAD_S, mod.build_straggler_report
+
+
+DEFAULT_MIN_SPREAD_S, build_straggler_report = _load_fold()
+
+
+def _load(source: str) -> dict:
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(source, timeout=10) as resp:
+            return json.loads(resp.read().decode())
+    with open(source, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _ranks_of(doc: dict) -> dict:
+    """Accept both emitted shapes: the ``/metrics.json`` document
+    ({"world": ..., "ranks": {rank: families}}) or a bare families dict
+    (``metrics_snapshot()`` — single-rank view, degraded unless it is
+    the coordinator's)."""
+    if "ranks" in doc and isinstance(doc["ranks"], dict):
+        return {int(r): fams for r, fams in doc["ranks"].items()}
+    return {0: doc}
+
+
+def render(report: dict, out=sys.stdout) -> None:
+    w = out.write
+    cycles = report["cycles_attributed"]
+    w(f"# straggler report: {cycles} attributed cycle(s)\n")
+    if report["degraded"]:
+        w("DEGRADED: no attribution families in this document — the "
+          "coordinator's snapshot never reached it (native controller "
+          "wire, publisher not opted in, or a single-rank snapshot from "
+          "a non-coordinator rank).\n")
+    spread = report.get("spread")
+    if spread:
+        def q(v):  # None = beyond the histogram's last finite bound
+            return "beyond range" if v is None else f"<= {v * 1e3:.2f} ms"
+
+        w(f"arrival spread: mean {spread['mean_s'] * 1e3:.2f} ms, "
+          f"p50 {q(spread['p50_s'])}, p99 {q(spread['p99_s'])} over "
+          f"{spread['count']} cycle(s)\n")
+    dom = report["dominant_rank"]
+    if dom is not None:
+        w(f"dominant rank: {dom}\n")
+    else:
+        w("dominant rank: none (no rank owns >50% of blame seconds with "
+          "spreads above the significance floor)\n")
+    if report["blame"]:
+        w("\n## last-arriver blame\n")
+        w(f"{'rank':>6} {'cycles':>8} {'cycle%':>8} "
+          f"{'blame s':>10} {'blame%':>8}\n")
+        for rank, b in sorted(report["blame"].items()):
+            w(f"{rank:>6} {b['last_arriver_cycles']:>8} "
+              f"{100 * b['cycle_share']:>7.1f}% "
+              f"{b['blame_seconds']:>10.4f} "
+              f"{100 * b['blame_share']:>7.1f}%\n")
+    if report["per_rank"]:
+        w("\n## phase breakdown (negotiation wait vs execute)\n")
+        w(f"{'rank':>6} {'cycles':>8} {'neg wait s':>12} "
+          f"{'execute s':>12}\n")
+        for rank, p in sorted(report["per_rank"].items()):
+            w(f"{rank:>6} {p['negotiation_cycles']:>8} "
+              f"{p['negotiation_wait_s']:>12.4f} "
+              f"{p['execute_s']:>12.4f}\n")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("source",
+                        help="/metrics.json file path or live URL")
+    parser.add_argument("--min-spread-ms", type=float,
+                        default=DEFAULT_MIN_SPREAD_S * 1e3,
+                        help="significance floor for the dominant-rank "
+                             "verdict (mean attributed spread below this "
+                             "is scheduler jitter, not a straggler)")
+    args = parser.parse_args(argv)
+    try:
+        doc = _load(args.source)
+    except (OSError, ValueError) as exc:
+        print(f"cannot read metrics document {args.source!r}: {exc}",
+              file=sys.stderr)
+        return 1
+    report = build_straggler_report(
+        _ranks_of(doc), min_spread_s=args.min_spread_ms / 1e3)
+    render(report)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

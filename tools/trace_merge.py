@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Fold per-rank timeline files into one clock-corrected Chrome trace.
+
+``HOROVOD_TIMELINE=<base>.json HOROVOD_TIMELINE_ALL_RANKS=1`` makes every
+member rank record spans into ``<base>.rank<N>.json`` (docs/tracing.md).
+This tool merges them into a single chrome://tracing / Perfetto document:
+
+    python tools/trace_merge.py /tmp/trace.json --out /tmp/trace.merged.json
+    python tools/trace_merge.py /tmp/trace.rank0.json /tmp/trace.rank1.json
+
+* each rank becomes its own PROCESS lane (``pid`` = rank, named
+  ``rank N``), with the per-tensor thread rows preserved inside it;
+* every timestamp is corrected onto the coordinator's (rank 0's)
+  timebase using the minimum-RTT ``CLOCK_SYNC`` metadata record the
+  rank's ClockSync wrote into its own file (``obs/tracing.py``) — no
+  side-channel manifest. A file with no sync record (native controller
+  wire, sync disabled) merges uncorrected and the summary says so;
+* span nesting is validated per (rank, tid): every E must close a B and
+  timestamps must be monotone within the lane — a violation means the
+  artifact is corrupt and the tool fails loudly rather than emitting a
+  trace that silently lies.
+
+The final stdout line is one JSON object (the repo's tool contract):
+``{"ranks": N, "events": M, "corrected": K, "out": path}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+# Metadata record names; mirrors horovod_tpu.utils.timeline (kept as
+# literals so the tool works from a checkout without the package
+# importable, e.g. against artifacts copied off a pod).
+TRACE_META = "horovod_trace_meta"
+CLOCK_SYNC = "horovod_clock_sync"
+
+
+def _load_records(path: str) -> list:
+    with open(path, encoding="utf-8") as fh:
+        content = fh.read()
+    try:
+        records = json.loads(content)
+    except ValueError:
+        # A live (unclosed) file is a truncated array — Chrome tolerates
+        # it, so we do too: drop the trailing partial line and close it.
+        body = content.rstrip()
+        if body.endswith(","):
+            body = body[:-1]
+        elif "\n" in body:
+            body = body.rsplit("\n", 1)[0].rstrip().rstrip(",")
+        records = json.loads(body + "]")
+    if not isinstance(records, list):
+        raise ValueError(f"{path}: not a Chrome-tracing JSON array")
+    # the Python writer terminates with a bare {} element
+    return [r for r in records if isinstance(r, dict) and r]
+
+
+def _rank_of(path: str, records: list):
+    """Lane identity: the TRACE_META record, else the .rankN suffix."""
+    for rec in records:
+        if rec.get("name") == TRACE_META and rec.get("ph") == "M":
+            return int(rec["args"]["rank"])
+    import re
+
+    m = re.search(r"\.rank(\d+)(?:\.json)?$", path)
+    if m:
+        return int(m.group(1))
+    return None
+
+
+def _offset_of(records: list):
+    """Best clock correction for this file: the CLOCK_SYNC record with
+    the smallest filter RTT (the least queueing-corrupted estimate),
+    or None when the file never synced."""
+    best = None
+    for rec in records:
+        if rec.get("name") != CLOCK_SYNC or rec.get("ph") != "M":
+            continue
+        args = rec.get("args", {})
+        rtt = float(args.get("rtt_us", 0.0))
+        if best is None or rtt < best[0]:
+            best = (rtt, float(args.get("offset_us", 0.0)))
+    return None if best is None else best[1]
+
+
+def _validate_nesting(records: list, rank) -> int:
+    """Monotone span nesting per (pid, tid); returns the span count.
+    Unclosed B's at EOF are fine (the job may have died mid-span); an E
+    without a B, or time running backwards inside a lane, is corruption."""
+    stacks: dict = {}
+    spans = 0
+    last_ts: dict = {}
+    for rec in records:
+        ph = rec.get("ph")
+        if ph not in ("B", "E"):
+            continue
+        key = (rec.get("pid", 0), rec.get("tid", 0))
+        ts = rec.get("ts")
+        if ts is None:
+            raise ValueError(f"rank {rank}: span record without ts: {rec}")
+        if key in last_ts and ts < last_ts[key]:
+            raise ValueError(
+                f"rank {rank}: timestamps run backwards in lane {key} "
+                f"({ts} after {last_ts[key]})")
+        last_ts[key] = ts
+        stack = stacks.setdefault(key, [])
+        if ph == "B":
+            stack.append(ts)
+        else:
+            if not stack:
+                raise ValueError(
+                    f"rank {rank}: E record without a matching B in lane "
+                    f"{key} at ts {ts}")
+            begin = stack.pop()
+            if ts < begin:
+                raise ValueError(
+                    f"rank {rank}: span ends before it begins in lane "
+                    f"{key} ({begin} -> {ts})")
+            spans += 1
+    return spans
+
+
+def merge(paths, out_path: str) -> dict:
+    """Merge per-rank timeline files; returns the summary dict."""
+    merged = []
+    ranks = []
+    unsynced = []
+    corrected = 0
+    for path in sorted(paths):
+        records = _load_records(path)
+        rank = _rank_of(path, records)
+        if rank is None:
+            raise ValueError(
+                f"{path}: no {TRACE_META} record and no .rankN suffix — "
+                f"cannot assign a lane")
+        _validate_nesting(records, rank)
+        offset = _offset_of(records)
+        ranks.append(rank)
+        if offset is None:
+            unsynced.append(rank)
+        lane_note = (f"rank {rank}" if offset is None else
+                     f"rank {rank} (clock {offset:+.0f}us)")
+        merged.append({"name": "process_name", "ph": "M", "pid": rank,
+                       "tid": 0, "args": {"name": lane_note}})
+        if offset is None:
+            print(f"[trace_merge] {path}: no {CLOCK_SYNC} record; lane "
+                  f"rank {rank} keeps its LOCAL timebase (native "
+                  f"controller wire, or clock sync disabled)",
+                  file=sys.stderr)
+        for rec in records:
+            rec = dict(rec)
+            rec["pid"] = rank
+            if offset is not None and "ts" in rec:
+                rec["ts"] = rec["ts"] + offset
+                corrected += 1
+            merged.append(rec)
+    if len(set(ranks)) != len(ranks):
+        raise ValueError(f"duplicate rank lanes in inputs: {sorted(ranks)}")
+    # Global ordering by corrected time reads better in Perfetto and is a
+    # cheap smoke test that the correction produced sane numbers.
+    merged.sort(key=lambda r: (r.get("ts", 0.0), r.get("pid", 0)))
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(merged, fh)
+    # unsynced_ranks makes the correction claim FALSIFIABLE per lane: a
+    # consumer asserting "clocks aligned" must check this list is empty,
+    # not just that corrected > 0 (rank 0's offset-0 records alone would
+    # satisfy that while every other lane drifted uncorrected).
+    return {"ranks": len(ranks), "events": len(merged),
+            "corrected": corrected, "unsynced_ranks": sorted(unsynced),
+            "out": out_path}
+
+
+def expand_inputs(args_paths) -> list:
+    """CLI convenience: a single base path (the HOROVOD_TIMELINE value)
+    expands to its rank-suffixed family; explicit file lists pass
+    through. The base itself usually does not exist under ALL_RANKS —
+    only its ``.rankN`` family does."""
+    if len(args_paths) != 1:
+        return list(args_paths)
+    base = args_paths[0]
+    stem = base[:-len(".json")] if base.endswith(".json") else base
+    family = sorted(glob.glob(glob.escape(stem) + ".rank*[0-9].json") +
+                    glob.glob(glob.escape(stem) + ".rank*[0-9]"))
+    if family:
+        return family
+    return [base] if os.path.exists(base) else []
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("paths", nargs="+",
+                        help="per-rank timeline files, or the one "
+                             "HOROVOD_TIMELINE base path (expands to its "
+                             ".rankN family)")
+    parser.add_argument("--out", default="",
+                        help="merged trace path (default: <first "
+                             "input>.merged.json)")
+    args = parser.parse_args(argv)
+    paths = expand_inputs(args.paths)
+    if not paths:
+        print(f"no input trace files found for {args.paths}",
+              file=sys.stderr)
+        return 1
+    out = args.out or (paths[0].rsplit(".json", 1)[0] + ".merged.json")
+    try:
+        summary = merge(paths, out)
+    except (OSError, ValueError) as exc:
+        print(f"trace merge failed: {exc}", file=sys.stderr)
+        return 1
+    print(f"[trace_merge] {summary['ranks']} rank lane(s), "
+          f"{summary['events']} events ({summary['corrected']} "
+          f"clock-corrected) -> {out}", file=sys.stderr)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -454,6 +454,15 @@ def _parse_args(argv=None):
                              "(sets HOROVOD_TIMELINE + "
                              "HOROVOD_TIMELINE_ALL_RANKS; merge with "
                              "tools/trace_merge.py — docs/tracing.md)")
+    parser.add_argument("--autotune", action="store_true", default=False,
+                        help="enable the closed-loop tuning plane for "
+                             "this run (HOROVOD_AUTOTUNE=1) and capture "
+                             "its JSONL decision log beside the BENCH "
+                             "json (into --timeline-dir when set, else "
+                             "the cwd; render with tools/tune_report.py "
+                             "— docs/autotune.md). Governs the eager "
+                             "control plane; SPMD steps have no cycles "
+                             "to tune.")
     parser.add_argument("--_measure", action="store_true",
                         help=argparse.SUPPRESS)  # internal: child mode
     parser.add_argument("--warm-init-cache", action="store_true",
@@ -516,7 +525,8 @@ def _supervise(args) -> None:
         (["--fp16-allreduce"] if args.fp16_allreduce else []) + \
         (["--int8-allreduce"] if args.int8_allreduce else []) + \
         (["--timeline-dir", args.timeline_dir] if args.timeline_dir
-         else [])
+         else []) + \
+        (["--autotune"] if args.autotune else [])
     import signal
     import subprocess as sp
 
@@ -644,6 +654,21 @@ def main() -> None:
         os.environ.setdefault("HOROVOD_TIMELINE_MARK_CYCLES", "1")
         _log(f"timeline capture -> {os.environ['HOROVOD_TIMELINE']} "
              f"(per-rank; merge with tools/trace_merge.py)")
+
+    if args.autotune:
+        # Closed-loop tuning plane (docs/autotune.md): like --timeline-dir,
+        # BEFORE hvd.init() reads the config; setdefault so an operator's
+        # explicit pins win. The decision log lands beside the other
+        # artifacts so a capture round carries its own tuning audit.
+        dest = args.timeline_dir or "."
+        os.makedirs(dest, exist_ok=True)
+        os.environ.setdefault("HOROVOD_AUTOTUNE", "1")
+        os.environ.setdefault(
+            "HOROVOD_AUTOTUNE_DECISIONS",
+            os.path.join(dest, f"{args.model}_autotune_decisions.jsonl"))
+        _log(f"autotune decision log -> "
+             f"{os.environ['HOROVOD_AUTOTUNE_DECISIONS']} "
+             f"(render with tools/tune_report.py)")
 
     import jax
 

@@ -65,7 +65,7 @@ if _platform:
     del _jax, _live
 del _os, _platform
 
-from . import callbacks, checkpoint, elastic, obs, parallel, runner
+from . import callbacks, checkpoint, elastic, obs, parallel, runner, tune
 from .obs import metrics_snapshot, straggler_report
 from .basics import (
     cross_rank,
@@ -130,7 +130,7 @@ __all__ = [
     "allreduce", "allreduce_async", "allgather", "allgather_async",
     "broadcast", "broadcast_async", "poll", "synchronize", "release",
     "Compression", "spmd", "parallel", "callbacks", "checkpoint",
-    "elastic", "obs", "metrics_snapshot", "straggler_report",
+    "elastic", "obs", "tune", "metrics_snapshot", "straggler_report",
     "IndexedSlices", "allreduce_sparse", "flash_attention",
     "DistributedOptimizer", "allreduce_gradients",
     "broadcast_parameters", "broadcast_optimizer_state",

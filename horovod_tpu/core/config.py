@@ -64,6 +64,51 @@ HOROVOD_COMPRESSION = "HOROVOD_COMPRESSION"
 HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
 HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
 HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+# --- closed-loop tuning plane (horovod_tpu.tune; ours, docs/autotune.md) -----
+# Optimizer backend behind HOROVOD_AUTOTUNE=1: "policy" (default) is the
+# pure-Python coordinate-descent/hill-climb loop — no native core needed;
+# "native" opts back into the C++ GP/Bayesian parameter manager
+# (cc/autotune.cc), which tunes only the classic (fusion, cycle) pair.
+HOROVOD_AUTOTUNE_BACKEND = "HOROVOD_AUTOTUNE_BACKEND"
+# Scored cycles folded (median) into one measurement window (default 5,
+# the reference's median-of-5), and cycles discarded after each knob move
+# before measurement resumes (default 5) — a just-applied knob reaches
+# every rank one response later, so the first post-move cycles mix
+# configurations and must not score.
+HOROVOD_AUTOTUNE_WINDOW = "HOROVOD_AUTOTUNE_WINDOW"
+HOROVOD_AUTOTUNE_COOLDOWN = "HOROVOD_AUTOTUNE_COOLDOWN"
+# Relative score-regression tolerance of the revert guard (default 0.05):
+# a measured window worse than best_known * (1 - tolerance) rolls the
+# move back to the best-known config.
+HOROVOD_AUTOTUNE_TOLERANCE = "HOROVOD_AUTOTUNE_TOLERANCE"
+# JSONL decision audit log (one line per retune/revert; rendered by
+# tools/tune_report.py). Distinct from HOROVOD_AUTOTUNE_LOG, the per-cycle
+# CSV sample log.
+HOROVOD_AUTOTUNE_DECISIONS = "HOROVOD_AUTOTUNE_DECISIONS"
+# Opt-in codec ladder for the codec knob, e.g. "int8,fp8". EMPTY (the
+# default) pins the codec: quantized wires are lossy, so the tuner may
+# only explore them when the operator explicitly consents. Only
+# codec=="none" allreduce batches at least CODEC_MIN_BYTES big (the
+# "large gradient" tensor class, default 4096) are rewritten; explicitly
+# quantized traffic is never touched.
+HOROVOD_AUTOTUNE_CODECS = "HOROVOD_AUTOTUNE_CODECS"
+HOROVOD_AUTOTUNE_CODEC_MIN_BYTES = "HOROVOD_AUTOTUNE_CODEC_MIN_BYTES"
+# Deterministic test hook (the HOROVOD_ELASTIC_FAULT pattern):
+# "regress@N" scales every score observed after the Nth accepted retune
+# so the next measured window regresses and the revert guard must fire
+# exactly once (the fault clears itself on the first revert).
+HOROVOD_AUTOTUNE_FAULT = "HOROVOD_AUTOTUNE_FAULT"
+# Persistent-straggler mitigation (docs/autotune.md): "off" (default) /
+# "advisory" (detector verdicts are counted, logged, and pushed to the
+# elastic driver, which records them) / "enforce" (the elastic driver
+# additionally blacklists the named slot and relaunches through the
+# elastic path). Unknown values fail loudly at detector construction.
+HOROVOD_STRAGGLER_EVICT = "HOROVOD_STRAGGLER_EVICT"
+# Sliding window the detector folds blame-seconds over (default 30 s)
+# and the minimum attributed cycles inside it before any verdict
+# (default 20) — a handful of cycles must never name a straggler.
+HOROVOD_STRAGGLER_WINDOW = "HOROVOD_STRAGGLER_WINDOW_S"
+HOROVOD_STRAGGLER_MIN_CYCLES = "HOROVOD_STRAGGLER_MIN_CYCLES"
 HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
 HOROVOD_LOG_HIDE_TIME = "HOROVOD_LOG_HIDE_TIME"
 
@@ -206,6 +251,22 @@ class Config:
     cache_capacity: int = DEFAULT_CACHE_CAPACITY
     autotune: bool = False
     autotune_log: str = ""
+    # closed-loop tuning plane (docs/autotune.md)
+    autotune_backend: str = "policy"
+    autotune_window: int = 5
+    autotune_cooldown: int = 5
+    autotune_tolerance: float = 0.05
+    autotune_decisions: str = ""
+    autotune_codecs: tuple = ()
+    autotune_codec_min_bytes: int = 4096
+    autotune_fault: str = ""
+    straggler_evict: str = "off"
+    straggler_window_s: float = 30.0
+    straggler_min_cycles: int = 20
+    # True when HOROVOD_CACHE_CAPACITY was set explicitly: the tuner then
+    # treats the capacity knob as pinned (same contract as
+    # fusion_threshold_explicit below).
+    cache_capacity_explicit: bool = False
     start_timeout_s: float = DEFAULT_START_TIMEOUT_S
     data_plane: str = "auto"
     metrics_port: int = 0
@@ -256,6 +317,28 @@ class Config:
                                         DEFAULT_CACHE_CAPACITY), 0),
             autotune=_env_bool(HOROVOD_AUTOTUNE),
             autotune_log=os.environ.get(HOROVOD_AUTOTUNE_LOG, ""),
+            autotune_backend=(os.environ.get(HOROVOD_AUTOTUNE_BACKEND,
+                                             "policy").strip().lower()
+                              or "policy"),
+            autotune_window=max(_env_int(HOROVOD_AUTOTUNE_WINDOW, 5), 1),
+            autotune_cooldown=max(_env_int(HOROVOD_AUTOTUNE_COOLDOWN, 5), 0),
+            autotune_tolerance=_env_float(HOROVOD_AUTOTUNE_TOLERANCE, 0.05),
+            autotune_decisions=os.environ.get(HOROVOD_AUTOTUNE_DECISIONS,
+                                              ""),
+            autotune_codecs=tuple(
+                c.strip().lower() for c in
+                os.environ.get(HOROVOD_AUTOTUNE_CODECS, "").split(",")
+                if c.strip()),
+            autotune_codec_min_bytes=max(
+                _env_int(HOROVOD_AUTOTUNE_CODEC_MIN_BYTES, 4096), 0),
+            autotune_fault=os.environ.get(HOROVOD_AUTOTUNE_FAULT, ""),
+            straggler_evict=(os.environ.get(HOROVOD_STRAGGLER_EVICT, "off")
+                             .strip().lower() or "off"),
+            straggler_window_s=_env_float(HOROVOD_STRAGGLER_WINDOW, 30.0),
+            straggler_min_cycles=max(
+                _env_int(HOROVOD_STRAGGLER_MIN_CYCLES, 20), 1),
+            cache_capacity_explicit=bool(
+                os.environ.get(HOROVOD_CACHE_CAPACITY)),
             start_timeout_s=_env_float(
                 HOROVOD_START_TIMEOUT, DEFAULT_START_TIMEOUT_S),
             data_plane=os.environ.get(HOROVOD_DATA_PLANE, "auto"),

@@ -27,7 +27,12 @@ paying the jax import.
 from __future__ import annotations
 
 from ..core.status import RanksAbortedError
-from .driver import ElasticExhaustedError, WorkerDeadError, run_elastic
+from .driver import (
+    ElasticExhaustedError,
+    StragglerEvictError,
+    WorkerDeadError,
+    run_elastic,
+)
 from .health import ElasticService, HeartbeatReporter
 
 __all__ = [
@@ -36,6 +41,7 @@ __all__ = [
     "HeartbeatReporter",
     "RanksAbortedError",
     "State",
+    "StragglerEvictError",
     "WorkerDeadError",
     "run_elastic",
     "world_epoch",

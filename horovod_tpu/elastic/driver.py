@@ -24,6 +24,7 @@ subsystem era was exactly this driver). Per attempt:
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -49,6 +50,11 @@ _ELASTIC_FAILURES = _metrics().counter(
 _ELASTIC_RELAUNCHES = _metrics().counter(
     "horovod_elastic_relaunches_total",
     "Worlds relaunched by run_elastic after a failed attempt")
+_STRAGGLER_EVICTIONS = _metrics().counter(
+    "horovod_straggler_evictions_total",
+    "Straggler eviction advisories the elastic driver received, by mode "
+    "(advisory = recorded; enforce = slot blacklisted and world "
+    "relaunched) and evicted world rank", labels=("mode", "rank"))
 
 
 class WorkerDeadError(RuntimeError):
@@ -61,6 +67,25 @@ class WorkerDeadError(RuntimeError):
             f"{miss_limit} x {interval_s:.1f}s; declaring them dead and "
             f"tearing the world down for relaunch.")
         self.ranks = sorted(ranks)
+
+
+class StragglerEvictError(RuntimeError):
+    """The coordinator's persistent-straggler detector named ranks and
+    ``HOROVOD_STRAGGLER_EVICT=enforce`` told the driver to act: the world
+    is torn down, the named slots are blacklisted outright, and the
+    survivors relaunch through the normal elastic path
+    (docs/autotune.md)."""
+
+    def __init__(self, ranks: List[int], info: Optional[dict] = None) -> None:
+        super().__init__(
+            f"persistent straggler(s) at world rank(s) {sorted(ranks)}; "
+            f"evicting the slot(s) and relaunching the survivors "
+            f"(HOROVOD_STRAGGLER_EVICT=enforce).")
+        self.ranks = sorted(ranks)
+        # per evicted world rank: the detector's verdict evidence
+        # (blame_share / mean_spread_s / cycles) — keyed so a multi-rank
+        # eviction never attributes one rank's numbers to another
+        self.info = {int(r): dict(i) for r, i in (info or {}).items()}
 
 
 class ElasticExhaustedError(RuntimeError):
@@ -101,7 +126,8 @@ def _failed_ranks(exc: BaseException) -> List[int]:
         # warnings whose "missing ranks" are transient, not failures.
         named = parse_aborted_ranks(exc.stderr_tail or "", strict=True)
         return named if named else [exc.rank]
-    if isinstance(exc, (WorkerDeadError, WorkerLostError)):
+    if isinstance(exc, (WorkerDeadError, WorkerLostError,
+                        StragglerEvictError)):
         return list(exc.ranks)
     if isinstance(exc, WorkerFailedError):
         # Same: a worker whose fn raised RanksAbortedError is a victim;
@@ -132,7 +158,8 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                 heartbeat_interval_s: float = 1.0,
                 heartbeat_miss_limit: int = 5,
                 slot_fail_limit: int = 2,
-                stall_shutdown_s: float = 30.0) -> List[Any]:
+                stall_shutdown_s: float = 30.0,
+                straggler_evict: Optional[str] = None) -> List[Any]:
     """Fault-tolerant ``runner.run``: relaunch on worker death.
 
     ``np`` slots are launched initially; a slot that fails
@@ -145,10 +172,27 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
     so an in-world stall aborts into a relaunch instead of eating the
     whole ``timeout_s``. Returns the successful attempt's per-rank
     results. State continuity across relaunches is ``elastic.State``'s
-    job (its commits live in this driver's store)."""
+    job (its commits live in this driver's store).
+
+    ``straggler_evict`` closes the loop on the coordinator's
+    persistent-straggler detector (docs/autotune.md; default: the
+    ``HOROVOD_STRAGGLER_EVICT`` env, off): under ``advisory`` the driver
+    records and counts advisories the coordinator pushes; under
+    ``enforce`` an advisory additionally tears the world down, blacklists
+    the named slot outright, and relaunches the survivors — the same
+    PR-2 path a dead rank takes."""
+    from ..tune.detector import MODES
+
     if not 1 <= min_np <= np:
         raise ValueError(f"need 1 <= min_np <= np, got min_np={min_np} "
                          f"np={np}")
+    evict_mode = (straggler_evict if straggler_evict is not None else
+                  os.environ.get(_config.HOROVOD_STRAGGLER_EVICT,
+                                 "off")).strip().lower() or "off"
+    if evict_mode not in MODES:
+        raise ValueError(
+            f"bad straggler_evict mode {evict_mode!r}; expected one of "
+            f"{'/'.join(MODES)}")
     secret = make_secret()
     service = ElasticService(bytes.fromhex(secret),
                              heartbeat_interval_s=heartbeat_interval_s,
@@ -178,14 +222,45 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
             if stall_shutdown_s > 0:
                 merged_env.setdefault(_config.HOROVOD_STALL_SHUTDOWN_TIME,
                                       str(stall_shutdown_s))
+            if evict_mode != "off":
+                # the worker-side detector activates off the same knob,
+                # and its advisories come back over this driver's service
+                merged_env.setdefault(_config.HOROVOD_STRAGGLER_EVICT,
+                                      evict_mode)
             if env_extra:
                 merged_env.update(env_extra)
+            seen_advisories: Dict[int, Any] = {}  # rank -> last seq seen
 
             def _health_check() -> None:
                 dead = service.dead_ranks()
                 if dead:
                     raise WorkerDeadError(dead, heartbeat_interval_s,
                                           heartbeat_miss_limit)
+                if evict_mode == "off":
+                    return
+                advisories = service.evict_advisories()
+                # fresh = new rank OR a refire (higher seq): a straggler
+                # that persists for hours re-advises every window, and
+                # each refire must count — a flatlined counter would read
+                # as "the condition cleared after the first window"
+                fresh = {r: i for r, i in advisories.items()
+                         if seen_advisories.get(r) != i.get("seq")}
+                if not fresh:
+                    return
+                seen_advisories.update(
+                    (r, i.get("seq")) for r, i in fresh.items())
+                for evict_rank, info in sorted(fresh.items()):
+                    _STRAGGLER_EVICTIONS.labels(
+                        mode=evict_mode, rank=evict_rank).inc()
+                    LOG.warning(
+                        "straggler eviction advisory: world rank %d "
+                        "(blame share %.0f%%, mean spread %.1fms over %s "
+                        "cycles) [mode=%s]", evict_rank,
+                        100 * info.get("blame_share", 0.0),
+                        1e3 * info.get("mean_spread_s", 0.0),
+                        info.get("cycles", "?"), evict_mode)
+                if evict_mode == "enforce":
+                    raise StragglerEvictError(sorted(fresh), fresh)
 
             try:
                 if epoch > 0:
@@ -197,8 +272,9 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                     start_timeout_s, use_host_data_plane,
                     env_extra=merged_env, extra_abort_check=_health_check,
                     secret=secret)
-            except (LaunchError, WorkerDeadError, WorkerFailedError,
-                    WorkerLostError, TimeoutError) as exc:
+            except (LaunchError, StragglerEvictError, WorkerDeadError,
+                    WorkerFailedError, WorkerLostError,
+                    TimeoutError) as exc:
                 # Deliberately NOT a bare RuntimeError: an arbitrary
                 # internal error is a deterministic bug that must fail
                 # fast, not burn max_restarts x timeout_s retrying.
@@ -211,9 +287,18 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                 _ELASTIC_FAILURES.inc()
                 last_err = exc
                 failed = _failed_ranks(exc)
-                for rank in failed:
-                    if 0 <= rank < world:
-                        fail_counts[active[rank]] += 1
+                if isinstance(exc, StragglerEvictError):
+                    # An enforced eviction is a VERDICT, not a strike:
+                    # the slot is blacklisted outright — re-scheduling
+                    # onto a persistently slow host until it "fails
+                    # enough" would tax every relaunch on the way there.
+                    for rank in failed:
+                        if 0 <= rank < world:
+                            fail_counts[active[rank]] = slot_fail_limit
+                else:
+                    for rank in failed:
+                        if 0 <= rank < world:
+                            fail_counts[active[rank]] += 1
                 LOG.warning(
                     "elastic attempt %d failed (%s: %s); failed world "
                     "rank(s) %s -> slot(s) %s",

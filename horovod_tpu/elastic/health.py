@@ -32,10 +32,12 @@ class ElasticService:
     """Driver-side heartbeat monitor + committed-state store.
 
     Requests on the wire:
-      ("beat", epoch, rank)            -> ("ok",)
-      ("goodbye", epoch, rank)         -> ("ok",)   # clean exit: stop watching
-      ("commit", epoch, meta, payload) -> ("ok",)   # rank 0's state push
-      ("fetch",)                       -> ("commit", meta, payload | None)
+      ("beat", epoch, rank)              -> ("ok",)
+      ("goodbye", epoch, rank)           -> ("ok",)  # clean exit: stop watching
+      ("commit", epoch, meta, payload)   -> ("ok",)  # rank 0's state push
+      ("fetch",)                         -> ("commit", meta, payload | None)
+      ("advise_evict", epoch, rank, info)-> ("ok",)  # straggler advisory
+                                                     # (docs/autotune.md)
 
     Beats are tagged with the world epoch so a straggler from a torn-down
     attempt cannot resurrect itself into the successor world's liveness
@@ -53,6 +55,7 @@ class ElasticService:
         self._epoch = 0
         self._last_beat: Dict[int, float] = {}
         self._departed: set = set()
+        self._evict_advisories: Dict[int, dict] = {}
         self._commit: Optional[bytes] = None
         self._commit_meta: Optional[dict] = None
         self._service = BasicService("horovod-elastic", self._handle,
@@ -87,6 +90,16 @@ class ElasticService:
         if kind == "fetch":
             with self._lock:
                 return ("commit", self._commit_meta, self._commit)
+        if kind == "advise_evict":
+            # Persistent-straggler advisory from the coordinator's
+            # detector (horovod_tpu.tune.detector; docs/autotune.md).
+            # Epoch-fenced like beats: a torn-down attempt's late
+            # advisory must not evict a slot from the successor world.
+            _, epoch, rank, info = req
+            with self._lock:
+                if epoch == self._epoch:
+                    self._evict_advisories[int(rank)] = dict(info)
+            return ("ok",)
         raise ValueError(f"unknown elastic request {kind!r}")
 
     def begin_epoch(self, epoch: int) -> None:
@@ -95,6 +108,13 @@ class ElasticService:
             self._epoch = epoch
             self._last_beat = {}
             self._departed = set()
+            self._evict_advisories = {}
+
+    def evict_advisories(self) -> Dict[int, dict]:
+        """This epoch's straggler eviction advisories (world rank → the
+        detector's verdict info), as pushed by the coordinator."""
+        with self._lock:
+            return {r: dict(i) for r, i in self._evict_advisories.items()}
 
     def dead_ranks(self) -> List[int]:
         """Ranks whose heartbeats stopped for > miss_limit intervals."""

@@ -1,74 +1,175 @@
-"""Autotuner wiring: score cycles, retune fusion threshold + cycle time.
+"""Autotuner wiring: score cycles, retune the live knobs.
 
-Rebuild of the runtime side of ``horovod/common/parameter_manager.cc``: when
+Rebuild of the runtime side of ``horovod/common/parameter_manager.cc``,
+grown into the closed-loop tuning plane (docs/autotune.md): when
 ``HOROVOD_AUTOTUNE=1``, each completed cycle contributes (bytes processed,
-elapsed microseconds); the native GP/Bayesian optimizer
-(``cc/autotune.cc``) scores points as bytes/us (median-of-5 windows) and
-proposes the next (fusion threshold, cycle time) to try. Knobs explicitly
-pinned via env stay fixed. ``HOROVOD_AUTOTUNE_LOG`` appends a CSV of
-parameter/score history (``parameter_manager.cc:255-293``).
+elapsed microseconds) and the optimizer proposes the next knob config.
+Two backends share this facade (``HOROVOD_AUTOTUNE_BACKEND``):
+
+* ``policy`` (default) — the pure-Python coordinate-descent loop of
+  ``horovod_tpu.tune.policy``: no native core required, and it tunes the
+  full knob set (fusion threshold, cycle time, response-cache capacity,
+  codec, metrics interval) with median-of-window scoring, cooldown, and
+  the best-known-config revert guard.
+* ``native`` — the C++ GP/Bayesian optimizer (``cc/autotune.cc``, the
+  reference's ``optim/bayesian_optimization``), classic (fusion, cycle)
+  pair only.
 
 Placement differs from the reference by design: the reference tunes on the
 coordinator and broadcasts a Params struct over MPI; here the tuner lives
 wherever the negotiator lives — in-process for size-1 worlds, on the rank-0
-controller service for multi-process worlds, which piggybacks the tuned
-cycle time on the ResponseList (``messages.ResponseList.tuned_cycle_ms``)
-AND on the response-cache bypass ack (``messages.CacheHitAck``), so a warm
-steady state keeps receiving retunes. A retuned FUSION THRESHOLD is applied
-through ``ControllerService.set_fusion_threshold``, which bumps the
-response-cache generation: repacking stales every cached fused layout, and
-without the bump a warm cache would replay the old packing forever
-(docs/response-cache.md).
+controller service for multi-process worlds, which piggybacks decisions on
+the ``ResponseList`` AND the response-cache bypass ack (``CacheHitAck``),
+so a warm steady state keeps receiving retunes. A retuned FUSION THRESHOLD
+(or codec) bumps the response-cache generation through
+``ControllerService.set_fusion_threshold``/the codec tracker: repacking
+(or re-stamping) stales every cached fused layout, and without the bump a
+warm cache would replay the old packing forever (docs/response-cache.md).
+
+Audit trail: ``HOROVOD_AUTOTUNE_LOG`` appends a CSV of per-cycle samples
+(``parameter_manager.cc:255-293``; header written only when the file is
+new — a restarted run APPENDS, it must not re-write the header);
+``HOROVOD_AUTOTUNE_DECISIONS`` appends a JSONL decision log rendered by
+``tools/tune_report.py``; retune/revert counters and knob gauges land on
+the obs registry either way.
 """
 
 from __future__ import annotations
 
+import json
 import time
-from typing import Optional, Tuple
+from typing import Optional
 
 from ..core.config import Config
 from ..core.logging import LOG
+from ..tune.policy import Decision, TuningPolicy, audit_decision, \
+    default_knobs
 
 
-class Autotuner:
-    """Feeds cycle measurements to the native parameter manager and reports
-    knob changes. Returns None from ``record`` until the knobs move."""
+class _NativeBackend:
+    """The C++ GP behind the same observe() contract as TuningPolicy."""
 
     def __init__(self, cfg: Config) -> None:
         from .. import cc
 
         if not cc.available():
             raise RuntimeError(
-                f"HOROVOD_AUTOTUNE=1 requires the native core "
+                f"HOROVOD_AUTOTUNE_BACKEND=native requires the native core "
                 f"(horovod_tpu/cc): {cc.load_error()}")
         self._pm = cc.NativeParameterManager(
             float(cfg.fusion_threshold_bytes), float(cfg.cycle_time_ms),
             fusion_fixed=cfg.fusion_threshold_explicit,
             cycle_fixed=cfg.cycle_time_explicit)
+        self.retunes = 0
+        self.reverts = 0
+
+    def config(self) -> dict:
+        return {"fusion_threshold_bytes": self.fusion_threshold_bytes,
+                "cycle_time_ms": self.cycle_time_ms}
+
+    @property
+    def fusion_threshold_bytes(self) -> int:
+        return self._pm.fusion_threshold_bytes
+
+    @property
+    def cycle_time_ms(self) -> float:
+        return self._pm.cycle_time_ms
+
+    @property
+    def best(self) -> dict:
+        return self._pm.best
+
+    def observe(self, bytes_processed: float,
+                microseconds: float) -> Optional[Decision]:
+        if not self._pm.update(bytes_processed, microseconds):
+            return None
+        self.retunes += 1
+        decision = Decision(
+            action="retune", knob="native_gp",
+            value=(self.fusion_threshold_bytes, self.cycle_time_ms),
+            score=bytes_processed / microseconds, best_score=float(
+                self._pm.best.get("score_bytes_per_us", 0.0)),
+            config=self.config())
+        audit_decision(decision)
+        return decision
+
+
+class Autotuner:
+    """Feeds cycle measurements to the configured optimizer backend and
+    reports knob changes. Returns None from ``record`` until the knobs
+    move; a non-None return is a :class:`tune.policy.Decision` whose
+    ``config`` map the caller applies."""
+
+    def __init__(self, cfg: Config, extended: bool = False) -> None:
+        if cfg.autotune_backend not in ("policy", "native"):
+            raise ValueError(
+                f"bad HOROVOD_AUTOTUNE_BACKEND "
+                f"{cfg.autotune_backend!r}; expected 'policy' or 'native'")
+        self._decisions = None
+        self._native = cfg.autotune_backend == "native"
+        try:
+            self._decisions = open(cfg.autotune_decisions, "a",
+                                   encoding="utf-8") \
+                if cfg.autotune_decisions else None
+            if self._native:
+                self._backend = _NativeBackend(cfg)
+                self._sink({"action": "init", "backend": "native",
+                            "config": self._backend.config()})
+            else:
+                self._backend = TuningPolicy(
+                    default_knobs(cfg, extended=extended),
+                    window=cfg.autotune_window,
+                    cooldown=cfg.autotune_cooldown,
+                    tolerance=cfg.autotune_tolerance,
+                    decision_sink=self._sink,
+                    fault=cfg.autotune_fault)
+            self._log = open(cfg.autotune_log, "a", encoding="utf-8") \
+                if cfg.autotune_log else None
+        except BaseException:
+            # backend construction can refuse (missing native core, bad
+            # fault/codec spec) and the CSV open can fail AFTER the sink
+            # opened; under run_elastic every retried attempt would leak
+            # another fd
+            if self._decisions is not None:
+                self._decisions.close()
+                self._decisions = None
+            raise
         self._last_cycle_ts = time.monotonic()
-        self._log = open(cfg.autotune_log, "a", encoding="utf-8") \
-            if cfg.autotune_log else None
         if self._log is not None:
-            self._log.write("timestamp,fusion_threshold_bytes,cycle_time_ms,"
-                            "bytes,microseconds,score_bytes_per_us\n")
-            self._log.flush()
+            # Append mode + restartable jobs: the header belongs to the
+            # FILE, not the construction — only an empty/new file gets one
+            # (restarted runs used to accumulate a duplicate header per
+            # attempt, corrupting column-indexed readers).
+            self._log.seek(0, 2)
+            if self._log.tell() == 0:
+                self._log.write(
+                    "timestamp,fusion_threshold_bytes,cycle_time_ms,"
+                    "bytes,microseconds,score_bytes_per_us\n")
+                self._log.flush()
+
+    def _sink(self, record: dict) -> None:
+        if self._decisions is None:
+            return
+        record = dict(record, t=round(time.time(), 3))
+        self._decisions.write(json.dumps(record, sort_keys=True) + "\n")
+        self._decisions.flush()
 
     def observe_cycle(self, response_list,
                       active_us: Optional[float] = None
-                      ) -> Optional[Tuple[int, float]]:
-        """Score one completed cycle and return
-        (fusion_threshold_bytes, cycle_ms) when the optimizer moved the
-        knobs. Exactly one component owns an Autotuner per process — the
-        engine in local worlds, the controller service on rank 0 of
-        multi-process worlds — so the timestamp state lives here.
+                      ) -> Optional[Decision]:
+        """Score one completed cycle and return the Decision when the
+        optimizer moved the knobs. Exactly one component owns an Autotuner
+        per process — the engine in local worlds, the controller service
+        on rank 0 of multi-process worlds — so the timestamp state lives
+        here.
 
         ``active_us`` is the cycle's ACTIVE window: negotiation wait +
         execution, excluding idle sleep between cycles. The reference
         samples saturated training where wall time equals active time
         (``parameter_manager.cc:145-171``); under sparse submission the
-        wall clock would mix user think-time into the score and the GP
-        would partly optimize noise, so callers pass the active window
-        and the wall delta is only a fallback."""
+        wall clock would mix user think-time into the score and the
+        optimizer would partly tune noise, so callers pass the active
+        window and the wall delta is only a fallback."""
         from .messages import ResponseType
 
         now = time.monotonic()
@@ -81,7 +182,7 @@ class Autotuner:
         return self.observe(bytes_processed, microseconds)
 
     def observe(self, bytes_processed: float,
-                microseconds: float) -> Optional[Tuple[int, float]]:
+                microseconds: float) -> Optional[Decision]:
         """Score one (bytes, active µs) sample — the raw form the native
         controller service drains from C++ (no ResponseList exists on the
         Python side there)."""
@@ -89,24 +190,36 @@ class Autotuner:
             return None
         if self._log is not None:
             self._log.write(f"{time.time():.3f},"
-                            f"{self._pm.fusion_threshold_bytes},"
-                            f"{self._pm.cycle_time_ms:.3f},"
+                            f"{self._backend.fusion_threshold_bytes},"
+                            f"{self._backend.cycle_time_ms:.3f},"
                             f"{bytes_processed:.0f},{microseconds:.1f},"
                             f"{bytes_processed / microseconds:.3f}\n")
             self._log.flush()
-        if not self._pm.update(bytes_processed, microseconds):
-            return None
-        new_threshold = self._pm.fusion_threshold_bytes
-        new_cycle = self._pm.cycle_time_ms
-        LOG.debug("autotune: fusion_threshold=%d cycle_time=%.2fms",
-                  new_threshold, new_cycle)
-        return new_threshold, new_cycle
+        decision = self._backend.observe(bytes_processed, microseconds)
+        if decision is not None:
+            if self._native:
+                # the policy sinks its own decisions; the native GP has
+                # no sink hook, so the facade keeps the JSONL audit
+                # complete for it too
+                self._sink({"action": decision.action,
+                            "knob": decision.knob,
+                            "value": decision.value,
+                            "score": decision.score,
+                            "best_score": decision.best_score,
+                            "config": decision.config})
+            LOG.debug("autotune %s: %s -> %r (score %.3f, best %.3f)",
+                      decision.action, decision.knob, decision.value,
+                      decision.score, decision.best_score)
+        return decision
 
     def close(self) -> None:
         if self._log is not None:
             self._log.close()
             self._log = None
+        if self._decisions is not None:
+            self._decisions.close()
+            self._decisions = None
 
     @property
     def best(self) -> dict:
-        return self._pm.best
+        return self._backend.best

@@ -588,7 +588,9 @@ class ControllerService:
                  listen_fd: Optional[int] = None,
                  cache_capacity: int = 0,
                  fusion_threshold_bytes: Optional[int] = None,
-                 reconnect_window_s: Optional[float] = None) -> None:
+                 reconnect_window_s: Optional[float] = None,
+                 straggler_detector=None,
+                 codec_min_bytes: int = 4096) -> None:
         self._negotiator = negotiator
         self._world_id = world_id
         # Self-healing grace (docs/chaos.md): a rank-bound connection that
@@ -644,6 +646,20 @@ class ControllerService:
         self._size = size
         self._autotuner = autotuner
         self._tuned_cycle_ms: Optional[float] = None
+        # Closed-loop tuning plane (docs/autotune.md): the latest
+        # extended-knob map piggybacked on every response/ack, the tuned
+        # codec applied to negotiated allreduce batches (response-side
+        # rewrite: requests stay uniform, so codec retunes can never
+        # desynchronize the negotiation table mid-flight), and a deferred
+        # cache-capacity retune applied at the same bookkeeping point as
+        # the generation bump it implies.
+        self._tuned_knobs: Optional[dict] = None
+        self._applied_codec: Optional[str] = None
+        self._codec_min_bytes = codec_min_bytes
+        self._cache_capacity_pending: Optional[int] = None
+        # Straggler mitigation (horovod_tpu.tune.detector): fed one
+        # (last_rank, spread) per fully-observed cycle; None = plane off.
+        self._straggler = straggler_detector
         # Failure detection: map each connection to the rank it serves; a
         # connection that drops before the world reached a clean shutdown
         # means that rank died, and every peer blocked in a rendezvous with
@@ -1050,7 +1066,26 @@ class ControllerService:
             _STRAGGLER_LAST.labels(rank=last_rank).inc()
             _STRAGGLER_BLAME_S.labels(rank=last_rank).inc(spread)
             _ARRIVAL_SPREAD.observe(spread)
+            if self._straggler is not None and not response_list.shutdown:
+                # closed-loop mitigation: the detector folds the same
+                # attribution stream over its sliding window and raises
+                # the eviction advisory itself (off the cycle path)
+                self._straggler.observe_cycle(last_rank, spread)
         self._maybe_autotune(response_list, active_us)
+        if self._applied_codec not in (None, "none"):
+            # Tuned-codec application is a RESPONSE rewrite, never a
+            # request rule: ranks submit their default codec as always
+            # (the negotiation table stays uniform — a rank-side switch
+            # would race in-flight submissions into mismatch errors), and
+            # the coordinator re-stamps eligible negotiated batches so
+            # every rank executes the identical quantized program. Only
+            # default-wire allreduces of the large tensor class are
+            # eligible; explicitly quantized traffic keeps its codec.
+            for resp in response_list.responses:
+                if resp.response_type == ResponseType.ALLREDUCE and \
+                        resp.tensor_codec == "none" and \
+                        resp.payload_bytes >= self._codec_min_bytes:
+                    resp.tensor_codec = self._applied_codec
         ack = None
         if self._cache is not None:
             # Cache bookkeeping AFTER autotune: a threshold retune queues a
@@ -1060,6 +1095,14 @@ class ControllerService:
             unchanged = not self._cache_bump_pending
             if self._cache_bump_pending:
                 self._cache_bump_pending = False
+                if self._cache_capacity_pending is not None:
+                    # capacity retune rides the same deferred point: the
+                    # bump's clear() resets positions, so resizing here
+                    # can never orphan a live slot; ranks adopt the new
+                    # capacity from tuned_knobs alongside the new
+                    # generation, keeping bitvector lengths in lockstep
+                    self._cache.capacity = self._cache_capacity_pending
+                    self._cache_capacity_pending = None
                 self._cache.bump()
             if hit_positions is not None:
                 if escalation is None and not response_list.shutdown:
@@ -1069,6 +1112,7 @@ class ControllerService:
                         positions=hit_positions,
                         generation=self._cache.generation,
                         tuned_cycle_ms=response_list.tuned_cycle_ms,
+                        tuned_knobs=response_list.tuned_knobs,
                         stall_warnings=response_list.stall_warnings,
                         stall_check=response_list.stall_check)
                 # degraded hit (escalation / latched shutdown): ranks get
@@ -1093,18 +1137,44 @@ class ControllerService:
 
     def _maybe_autotune(self, response_list: ResponseList,
                         active_us: Optional[float] = None) -> None:
-        """Apply retuned knobs: fusion threshold directly on the negotiator,
-        cycle time piggybacked to every rank on the response (the Params
-        broadcast of ``parameter_manager.cc:213``)."""
+        """Apply a tuning-plane decision: fusion threshold directly on the
+        negotiator (bumping the cache generation on a real change), cycle
+        time and the extended knob map piggybacked to every rank on the
+        response (the Params broadcast of ``parameter_manager.cc:213``,
+        docs/autotune.md)."""
         if self._autotuner is None:
             return
-        tuned = self._autotuner.observe_cycle(response_list,
-                                              active_us=active_us)
-        if tuned is not None:
-            threshold, cycle_ms = tuned
-            self.set_fusion_threshold(threshold)
-            self._tuned_cycle_ms = cycle_ms
+        decision = self._autotuner.observe_cycle(response_list,
+                                                 active_us=active_us)
+        if decision is not None:
+            knobs = decision.config
+            if "fusion_threshold_bytes" in knobs:
+                self.set_fusion_threshold(
+                    int(knobs["fusion_threshold_bytes"]))
+            if "cycle_time_ms" in knobs:
+                self._tuned_cycle_ms = float(knobs["cycle_time_ms"])
+            if "cache_capacity" in knobs:
+                self.set_cache_capacity(int(knobs["cache_capacity"]))
+            if "codec" in knobs:
+                codec = str(knobs["codec"])
+                # never-applied == the "none" baseline: the FIRST decision
+                # can already carry a flip (codec may be the only unpinned
+                # knob), and skipping its bump would leave warm cached
+                # layouts replaying the full-precision wire forever
+                if codec != (self._applied_codec or "none") and \
+                        self._cache is not None:
+                    # a codec flip re-stamps every future batch: the whole
+                    # cached working set is stale AT ONCE — bump instead
+                    # of letting dead entries displace through the LRU
+                    self._cache_bump_pending = True
+                self._applied_codec = codec
+            extras = {k: knobs[k] for k in
+                      ("cache_capacity", "metrics_interval_s", "codec")
+                      if k in knobs}
+            if extras:
+                self._tuned_knobs = extras
         response_list.tuned_cycle_ms = self._tuned_cycle_ms
+        response_list.tuned_knobs = self._tuned_knobs
 
     def set_fusion_threshold(self, threshold_bytes: int) -> None:
         """Apply a (re)tuned fusion threshold. Repacking changes which
@@ -1122,6 +1192,21 @@ class ControllerService:
                 self._fusion_threshold != threshold_bytes:
             self._cache_bump_pending = True
         self._fusion_threshold = threshold_bytes
+
+    def set_cache_capacity(self, capacity: int) -> None:
+        """Apply a (re)tuned response-cache capacity. The bitvector length
+        IS the capacity, so both mirrors must move at one generation
+        boundary: the resize is deferred to the cycle bookkeeping point
+        (with the generation bump it implies), and ranks adopt the new
+        capacity from the same response's ``tuned_knobs`` — a no-op when
+        the cache is disabled or the value is unchanged."""
+        capacity = max(int(capacity), 1)
+        if self._cache is None or capacity == self._cache.capacity or \
+                (self._cache_capacity_pending is not None and
+                 capacity == self._cache_capacity_pending):
+            return
+        self._cache_capacity_pending = capacity
+        self._cache_bump_pending = True
 
     def shutdown(self) -> None:
         self._watch_event.set()  # release parked watchers with a clean stop

@@ -310,6 +310,11 @@ class Engine:
         self._metrics_bridge = TimelineBridge(_obs_registry(), self.timeline)
         self._metrics_stop: Optional[threading.Event] = None
         self._metrics_thread: Optional[threading.Thread] = None
+        self._metrics_interval_s = cfg.metrics_interval_s
+        # Closed-loop tuning plane (docs/autotune.md): the last
+        # extended-knob map this rank applied from a cycle response — the
+        # change detector behind the timeline AUTOTUNE audit records.
+        self._applied_knobs: dict = {}
         self._clock_sync = None
 
         self._service: Optional[ControllerService] = None
@@ -320,9 +325,17 @@ class Engine:
         # The autotuner lives with the controller service — launcher
         # world-rank 0 (when a member; a non-member service host builds its
         # own in start_subset_service, and this engine's size-1 self-world
-        # must not grow an orphan tuner beside it).
+        # must not grow an orphan tuner beside it). The extended knob set
+        # (cache capacity / codec / metrics interval) needs the Python
+        # controller wire to apply; size-1 and native-controller worlds
+        # tune the classic (fusion, cycle) pair only (docs/autotune.md).
         if cfg.autotune and topo.world_rank == 0 and topo.is_member:
-            self._autotuner = Autotuner(cfg)
+            extended = False
+            if self._size > 1:
+                from .native_controller import native_controller_enabled
+
+                extended = not native_controller_enabled(cfg)
+            self._autotuner = Autotuner(cfg, extended=extended)
         self._plane = None
         if self._size == 1:
             self._negotiator = make_negotiator(1, cfg)
@@ -406,12 +419,29 @@ class Engine:
                     self._plane is None or cfg.reconnect_window_explicit
                 ) else 0.0
                 if use_native:
+                    if cfg.straggler_evict != "off":
+                        LOG.warning(
+                            "HOROVOD_STRAGGLER_EVICT=%s ignored: the "
+                            "native controller keeps its arrival data in "
+                            "C++; set HOROVOD_NATIVE_CONTROLLER=0 for "
+                            "straggler mitigation.", cfg.straggler_evict)
                     self._service = NativeControllerService(
                         self._size, cfg, secret=secret, port=port,
                         bind_host=bind_host, autotuner=self._autotuner,
                         world_id=world_id)
                 else:
                     negotiator = make_negotiator(self._size, cfg)
+                    detector = None
+                    if cfg.straggler_evict != "off":
+                        # Persistent-straggler mitigation: fed from the
+                        # coordinator's arrival attribution; construction
+                        # validates the mode loudly (docs/autotune.md).
+                        # The native service keeps its arrival data in
+                        # C++, so the plane is Python-controller-only.
+                        from ..tune.detector import StragglerDetector
+
+                        detector = StragglerDetector.from_config(
+                            cfg, self._size)
                     self._service = ControllerService(
                         self._size, negotiator, secret=secret, port=port,
                         bind_host=bind_host, autotuner=self._autotuner,
@@ -421,7 +451,9 @@ class Engine:
                         listen_fd=listen_fd,
                         cache_capacity=cfg.cache_capacity,
                         fusion_threshold_bytes=cfg.fusion_threshold_bytes,
-                        reconnect_window_s=window_s)
+                        reconnect_window_s=window_s,
+                        straggler_detector=detector,
+                        codec_min_bytes=cfg.autotune_codec_min_bytes)
                 port = self._service.port
             # The launcher may advertise several controller addresses
             # (comma-separated: every NIC of the controller host); the
@@ -538,6 +570,9 @@ class Engine:
             # interval means nothing consumes the pushes — spawn no
             # thread, dial no connection
             return
+        # Live knob: the tuning plane may retune the interval mid-run
+        # (_apply_tuned_knobs); the loop re-reads it each tick.
+        self._metrics_interval_s = interval
         self._metrics_stop = threading.Event()
         stop = self._metrics_stop
         rank = self._rank
@@ -567,7 +602,8 @@ class Engine:
                     # interval must not be silently lost), then exit. The
                     # engine's bounded join is the time cap — best-effort
                     # by contract, the wire may already be gone.
-                    stopping = stop.wait(interval)
+                    stopping = stop.wait(
+                        max(self._metrics_interval_s, 0.05))
                     try:
                         if client is None:
                             client = BasicClient(addr, secret=secret,
@@ -828,11 +864,20 @@ class Engine:
                     tuned = self._autotuner.observe_cycle(
                         response_list, active_us=active_us)
                     if tuned is not None:
-                        threshold, cycle_ms = tuned
-                        self._negotiator.set_fusion_threshold(threshold)
-                        cycle_s = max(cycle_ms, 0.1) / 1000.0
+                        self._negotiator.set_fusion_threshold(
+                            int(tuned.config["fusion_threshold_bytes"]))
+                        cycle_s = max(
+                            float(tuned.config["cycle_time_ms"]),
+                            0.1) / 1000.0
+                        self._audit_knobs(dict(
+                            tuned.config, action=tuned.action))
                 elif response_list.tuned_cycle_ms is not None:
-                    cycle_s = max(response_list.tuned_cycle_ms, 0.1) / 1000.0
+                    new_cycle_s = max(response_list.tuned_cycle_ms,
+                                      0.1) / 1000.0
+                    if new_cycle_s != cycle_s:
+                        self._audit_knobs({"cycle_time_ms":
+                                           response_list.tuned_cycle_ms})
+                    cycle_s = new_cycle_s
                 if response_list.shutdown:
                     if response_list.abort_reason:
                         # Escalated shutdown (stall deadline): flush with
@@ -981,8 +1026,55 @@ class Engine:
                         in_flight = {name: self._request_of(e)
                                      for name, e in self._pending.items()}
                     cache.accept_response_list(response_list, in_flight)
+        self._apply_tuned_knobs(out)  # list or ack: both carry the map
         self._emit_cache_counters()
         return response_list
+
+    def _apply_tuned_knobs(self, msg) -> None:
+        """Apply the coordinator's piggybacked extended-knob map
+        (docs/autotune.md). Runs on the engine loop thread AFTER the
+        cycle's cache processing: a capacity retune always arrives
+        alongside the generation bump that cleared the cache, so resizing
+        here can never orphan live positions — the next cycle plans its
+        bitvector under the same capacity the coordinator now holds.
+        Idempotent per value; audited on change via timeline AUTOTUNE
+        metadata."""
+        knobs = getattr(msg, "tuned_knobs", None)
+        if not knobs:
+            return
+        changed = {}
+        capacity = knobs.get("cache_capacity")
+        if capacity is not None and self._response_cache is not None and \
+                int(capacity) != self._response_cache.capacity:
+            self._response_cache.capacity = int(capacity)
+            changed["cache_capacity"] = int(capacity)
+        interval = knobs.get("metrics_interval_s")
+        if interval is not None and \
+                float(interval) != self._metrics_interval_s:
+            self._metrics_interval_s = float(interval)
+            changed["metrics_interval_s"] = float(interval)
+        codec = knobs.get("codec")
+        if codec is not None and \
+                codec != (self._applied_knobs.get("codec") or "none"):
+            # audit only: the codec applies as a coordinator-side response
+            # rewrite, never a rank-side request rule (ops/controller.py).
+            # Never-seen == the "none" baseline, so the first extended map
+            # does not fake a codec-change record in every rank's trace.
+            changed["codec"] = codec
+        if changed:
+            self._applied_knobs.update(changed)
+            self._audit_knobs(changed)
+
+    def _audit_knobs(self, record: dict) -> None:
+        """Timeline half of the decision audit (the registry half lives
+        with the policy): one AUTOTUNE metadata record per change."""
+        if self.timeline.enabled:
+            from ..utils.timeline import AUTOTUNE
+
+            try:
+                self.timeline.meta(AUTOTUNE, dict(record))
+            except Exception:  # noqa: BLE001 - audit must never kill a cycle
+                pass
 
     def _emit_cache_counters(self) -> None:
         """Per-cycle bypass observability on the rank-0 timeline: hit/miss
@@ -1269,14 +1361,20 @@ def start_subset_service(subset_ranks) -> None:
     world_id = world_id_of(tuple(subset_ranks), subset_size)
     port = int(os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "0"))
     bind_host = os.environ.get("HOROVOD_CONTROLLER_BIND", "127.0.0.1")
-    autotuner = Autotuner(cfg) if cfg.autotune else None
     use_native = native_controller_enabled(cfg)
+    autotuner = Autotuner(cfg, extended=not use_native) \
+        if cfg.autotune else None
     listen_fd = _adopt_controller_fd(use_native)
     if use_native:  # same decision the members make
         service = NativeControllerService(
             subset_size, cfg, secret=default_secret(), port=port,
             bind_host=bind_host, autotuner=autotuner, world_id=world_id)
     else:
+        detector = None
+        if cfg.straggler_evict != "off":
+            from ..tune.detector import StragglerDetector
+
+            detector = StragglerDetector.from_config(cfg, subset_size)
         service = ControllerService(
             subset_size, make_negotiator(subset_size, cfg),
             secret=default_secret(), port=port, bind_host=bind_host,
@@ -1286,6 +1384,8 @@ def start_subset_service(subset_ranks) -> None:
             listen_fd=listen_fd,
             cache_capacity=cfg.cache_capacity,
             fusion_threshold_bytes=cfg.fusion_threshold_bytes,
+            straggler_detector=detector,
+            codec_min_bytes=cfg.autotune_codec_min_bytes,
             # Same gating as the member-hosted service above: the subset's
             # members resolve their own data plane from this same config,
             # so only a definitely-host-plane world gets the grace window

@@ -142,6 +142,13 @@ class ResponseList:
     responses: List[Response] = field(default_factory=list)
     shutdown: bool = False
     tuned_cycle_ms: Optional[float] = None
+    # Closed-loop tuning plane (docs/autotune.md): the coordinator's
+    # latest extended-knob map ({"cache_capacity": ..,
+    # "metrics_interval_s": .., "codec": ..}), piggybacked like
+    # tuned_cycle_ms so every rank applies retunes without a second wire.
+    # None until the tuner's first extended decision (and always None on
+    # the native controller wire, which predates the field).
+    tuned_knobs: Optional[dict] = None
     stall_warnings: List[str] = field(default_factory=list)
     # True when the coordinator actually RAN its stall check this cycle
     # (the check is interval-gated): an empty warning list is then an
@@ -189,5 +196,8 @@ class CacheHitAck:
     positions: List[int] = field(default_factory=list)
     generation: int = 0
     tuned_cycle_ms: Optional[float] = None
+    # tuning-plane piggyback, mirroring ResponseList.tuned_knobs: a warm
+    # steady state must keep receiving extended-knob retunes too
+    tuned_knobs: Optional[dict] = None
     stall_warnings: List[str] = field(default_factory=list)
     stall_check: bool = False

@@ -406,9 +406,13 @@ class NativeControllerService:
                     for i in range(n):
                         tuned = autotuner.observe(bytes_buf[i], us_buf[i])
                         if tuned is not None:
-                            threshold, cycle_ms = tuned
+                            # the native wire only carries the classic
+                            # pair; extended knobs (cache/codec/interval)
+                            # are Python-controller-only (docs/autotune.md)
                             self._lib.htpu_controller_set_tuning(
-                                handle, threshold, cycle_ms)
+                                handle,
+                                int(tuned.config["fusion_threshold_bytes"]),
+                                float(tuned.config["cycle_time_ms"]))
                     # the C++ buffer holds up to 4096 samples; one
                     # cap-sized batch per tick keeps the steady state
                     # cheap, but the final pass must drain to empty

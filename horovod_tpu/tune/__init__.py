@@ -1,0 +1,48 @@
+"""Closed-loop tuning plane: online knob optimization + straggler action.
+
+The first subsystem that CLOSES the measure→decide→act loop the last four
+PRs built the halves of (docs/autotune.md): the obs plane (PR 5/6)
+measures — cycle-latency histograms, wire bytes, cache hit/miss, per-rank
+arrival-spread blame; this package decides and acts:
+
+* :mod:`.policy` — the pure-Python optimizer behind ``HOROVOD_AUTOTUNE=1``
+  (``ops/autotuner.py`` keeps the native GP as an opt-in backend behind
+  the same interface): bounded coordinate descent over fusion threshold,
+  cycle time, response-cache capacity, codec, and metrics interval, with
+  median-of-window scoring, per-move cooldown, and a best-known-config
+  revert guard. Decisions ride the existing control wire (piggybacked on
+  ``ResponseList``/``CacheHitAck``), fusion/codec retunes bump the
+  response-cache generation (docs/response-cache.md), and every decision
+  is audited (registry counters + knob gauges, JSONL decision log,
+  timeline metadata).
+* :mod:`.detector` — persistent-straggler mitigation: PR 6's per-cycle
+  blame attribution folded over a sliding window with the same two-gated
+  verdict; a persistent dominant rank becomes an eviction advisory to the
+  elastic driver (``HOROVOD_STRAGGLER_EVICT=advisory|enforce|off``;
+  enforce blacklists the slot and relaunches through the PR-2 path).
+"""
+
+from __future__ import annotations
+
+from .detector import StragglerDetector, advise_elastic_driver  # noqa: F401
+from .policy import (  # noqa: F401 - public surface (docs/autotune.md)
+    CODEC_IDS,
+    Decision,
+    Knob,
+    TuningPolicy,
+    audit_decision,
+    default_knobs,
+    parse_fault,
+)
+
+__all__ = [
+    "CODEC_IDS",
+    "Decision",
+    "Knob",
+    "StragglerDetector",
+    "TuningPolicy",
+    "advise_elastic_driver",
+    "audit_decision",
+    "default_knobs",
+    "parse_fault",
+]

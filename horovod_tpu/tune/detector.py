@@ -1,0 +1,173 @@
+"""Persistent-straggler detector: the closed loop's "act" half for ranks.
+
+PR 6 built the diagnosis — the coordinator charges each cycle's arrival
+spread to its last arriver (``horovod_straggler_blame_seconds_total``) and
+``straggler_report`` folds a TWO-GATED dominant-rank verdict out of it.
+Nothing acted on that verdict; the Horovod paper (1802.05799) names
+straggler handling as the hardest operational problem precisely because a
+persistent straggler silently taxes every healthy rank's step time.
+
+This detector folds the same per-cycle attribution stream over a SLIDING
+window and applies the same two gates *persistently*:
+
+* the dominant rank must own more than ``blame_share`` (default 0.5) of
+  the window's blame SECONDS (counts alone would let a rank late by
+  microseconds every cycle outrank one late by 50 ms on a tenth of them —
+  the PR 6 lesson), and
+* the window's mean attributed spread must exceed ``min_spread_s``
+  (below the floor the coordinator is measuring scheduler jitter, and
+  naming a "straggler" would evict a healthy host), and
+* at least ``min_cycles`` cycles were attributed inside the window (a
+  handful of samples is noise, not persistence).
+
+A verdict is surfaced as an EVICTION ADVISORY: counted on the obs
+registry, logged, and pushed best-effort to the elastic driver's health
+service (``("advise_evict", epoch, rank, info)``). The driver decides
+what to do with it — record it (``HOROVOD_STRAGGLER_EVICT=advisory``) or
+blacklist the slot and relaunch through the PR-2 elastic path
+(``enforce``). Refire for the same rank is suppressed until a full window
+has elapsed, so one slow patch produces one advisory, not a storm.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+from ..core import config as _config
+from ..core.logging import LOG
+from ..obs.registry import registry as _metrics
+from ..obs.tracing import DEFAULT_MIN_SPREAD_S
+
+MODES = ("off", "advisory", "enforce")
+
+_ADVISORIES = _metrics().counter(
+    "horovod_straggler_eviction_advisories_total",
+    "Persistent-straggler eviction advisories raised by the coordinator's "
+    "detector", labels=("rank",))
+
+
+class StragglerDetector:
+    """Sliding-window fold of the coordinator's per-cycle attribution.
+
+    Fed inline from the cycle bookkeeping point (``ControllerService``)
+    — one ``observe_cycle(last_rank, spread_s)`` per fully-observed
+    cycle; O(1) amortized, so the hot path pays a deque append and two
+    running sums."""
+
+    def __init__(self, size: int, mode: str = "advisory",
+                 window_s: float = 30.0,
+                 min_spread_s: float = DEFAULT_MIN_SPREAD_S,
+                 min_cycles: int = 20,
+                 blame_share: float = 0.5) -> None:
+        if mode not in MODES:
+            raise ValueError(
+                f"bad HOROVOD_STRAGGLER_EVICT mode {mode!r}; expected one "
+                f"of {'/'.join(MODES)}")
+        self.mode = mode
+        self._size = size
+        self._window_s = max(float(window_s), 0.1)
+        self._min_spread_s = float(min_spread_s)
+        self._min_cycles = max(int(min_cycles), 1)
+        self._blame_share = float(blame_share)
+        self._events: Deque[Tuple[float, int, float]] = deque()
+        self._blame: Dict[int, float] = {}
+        self._spread_sum = 0.0
+        self._last_fire: Dict[int, float] = {}  # rank -> monotonic ts
+        self._fire_counts: Dict[int, int] = {}
+        self.advisories: Dict[int, dict] = {}
+
+    @classmethod
+    def from_config(cls, cfg, size: int) -> "StragglerDetector":
+        return cls(size, mode=cfg.straggler_evict,
+                   window_s=cfg.straggler_window_s,
+                   min_cycles=cfg.straggler_min_cycles)
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self._window_s
+        while self._events and self._events[0][0] < horizon:
+            _, rank, spread = self._events.popleft()
+            self._blame[rank] -= spread
+            self._spread_sum -= spread
+
+    def observe_cycle(self, last_rank: int,
+                      spread_s: float) -> Optional[dict]:
+        """Feed one attributed cycle; returns an advisory dict when the
+        persistent verdict fires for a rank (rate-limited per window)."""
+        now = time.monotonic()
+        self._events.append((now, last_rank, spread_s))
+        self._blame[last_rank] = self._blame.get(last_rank, 0.0) + spread_s
+        self._spread_sum += spread_s
+        self._prune(now)
+        cycles = len(self._events)
+        if cycles < self._min_cycles or self._spread_sum <= 0:
+            return None
+        mean_spread = self._spread_sum / cycles
+        if mean_spread <= self._min_spread_s:
+            return None  # gate 2: sub-floor spreads are scheduler jitter
+        top_rank = max(self._blame, key=self._blame.get)
+        share = self._blame[top_rank] / self._spread_sum
+        if share <= self._blame_share:
+            return None  # gate 1: no dominant owner of the blame seconds
+        last = self._last_fire.get(top_rank)
+        if last is not None and now - last < self._window_s:
+            return None  # already advised for this window
+        self._last_fire[top_rank] = now
+        seq = self._fire_counts.get(top_rank, 0) + 1
+        self._fire_counts[top_rank] = seq
+        # seq distinguishes a REFIRE (the rank is still a straggler one
+        # window later) from a redelivered copy: the elastic driver's
+        # per-rank store overwrites, so without it a persistent straggler
+        # would count exactly once per attempt no matter how long it lasts
+        info = {"rank": int(top_rank), "seq": seq, "blame_share": share,
+                "mean_spread_s": mean_spread, "cycles": cycles,
+                "window_s": self._window_s, "mode": self.mode}
+        self.advisories[int(top_rank)] = info
+        _ADVISORIES.labels(rank=top_rank).inc()
+        LOG.warning(
+            "persistent straggler: rank %d owns %.0f%% of the blame "
+            "seconds over the last %.1fs (%d cycles, mean spread %.1fms) "
+            "— raising an eviction advisory (%s mode)", top_rank,
+            100 * share, self._window_s, cycles, 1e3 * mean_spread,
+            self.mode)
+        advise_elastic_driver(info)
+        return info
+
+
+def advise_elastic_driver(info: dict) -> None:
+    """Best-effort push of an eviction advisory to the elastic driver's
+    health service, on a short-lived daemon thread — the advisory must
+    never add wire latency to the cycle path that detected it, and a
+    missing driver (plain ``runner.run``, no elastic plane) just means
+    nobody can act; the registry counter and log line remain."""
+    port = os.environ.get(_config.HOROVOD_ELASTIC_PORT)
+    if not port:
+        return
+    addr = (os.environ.get(_config.HOROVOD_ELASTIC_ADDR, "127.0.0.1"),
+            int(port))
+    epoch = int(os.environ.get(_config.HOROVOD_ELASTIC_EPOCH, "0"))
+
+    def _push() -> None:
+        from ..runner.network import BasicClient, default_secret
+
+        client = None
+        try:
+            client = BasicClient(addr, secret=default_secret(),
+                                 timeout_s=5.0, attempts=3)
+            client.request(("advise_evict", epoch, info["rank"],
+                            dict(info)))
+        except Exception as exc:  # noqa: BLE001 - advisory only
+            LOG.warning("eviction advisory for rank %s could not reach "
+                        "the elastic driver: %s", info.get("rank"), exc)
+        finally:
+            if client is not None:
+                try:
+                    client.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    threading.Thread(target=_push, name="horovod-evict-advisory",
+                     daemon=True).start()

@@ -1,0 +1,434 @@
+"""Pure-Python online knob optimizer: the closed loop's "decide" half.
+
+The reference ships a coordinator-resident Bayesian ``parameter_manager``
+(``optim/bayesian_optimization``) tuning exactly two knobs — fusion
+threshold and cycle time. This module generalizes that loop to every live
+knob the repo has grown since (response-cache capacity, wire codec,
+metrics interval) with a deliberately simpler optimizer: bounded
+coordinate descent / hill climb over discrete knob ladders, scored by
+median-of-window collective throughput (bytes/µs — the reference's own
+objective, ``parameter_manager.cc:145-171``), with
+
+* a **cooldown** after every move (a just-applied knob reaches the ranks
+  one cycle response later, so the first post-move cycles mix
+  configurations and must not score),
+* a **revert guard**: any move whose measured window regresses past the
+  tolerance rolls back to the best-known config — the property that makes
+  online exploration safe on a production job, and
+* **pinning**: knobs explicitly set via env never move (the reference's
+  ``SetValue(..., fixed=true)`` semantics, ``parameter_manager.cc:329``).
+
+Every decision is audited three ways (docs/autotune.md): knob gauges +
+retune/revert counters on the obs registry, a JSONL decision log
+(``HOROVOD_AUTOTUNE_DECISIONS``, rendered by ``tools/tune_report.py``),
+and — applied by the engine — timeline metadata records.
+
+Stdlib-only at module level (plus ``obs.registry``, itself stdlib-only):
+the policy must be constructible in launcher/tool processes without jax.
+"""
+
+from __future__ import annotations
+
+import statistics
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..obs.registry import registry as _metrics
+
+# Knob names are the shared vocabulary of the whole plane: the policy
+# proposes them, the controller applies them, the decision log and the
+# knob gauges report them.
+KNOB_FUSION = "fusion_threshold_bytes"
+KNOB_CYCLE = "cycle_time_ms"
+KNOB_CACHE = "cache_capacity"
+KNOB_INTERVAL = "metrics_interval_s"
+KNOB_CODEC = "codec"
+
+# Prometheus gauges are numeric; the codec knob reports this id mapping
+# (documented in docs/autotune.md).
+CODEC_IDS = {"none": 0, "int8": 1, "fp8": 2}
+
+_RETUNES = _metrics().counter(
+    "horovod_autotune_retunes_total",
+    "Knob moves applied by the tuning plane", labels=("knob",))
+_REVERTS = _metrics().counter(
+    "horovod_autotune_reverts_total",
+    "Moves rolled back to the best-known config by the revert guard",
+    labels=("knob",))
+_DISCARDS = _metrics().counter(
+    "horovod_autotune_discards_total",
+    "Tolerated-but-not-improving moves rolled back by the hill climb "
+    "(strict acceptance: a kept move must improve)", labels=("knob",))
+_KNOB_GAUGE = _metrics().gauge(
+    "horovod_autotune_knob",
+    "Current value of each tuned knob (codec reported as its id: "
+    "none=0 int8=1 fp8=2)", labels=("knob",))
+
+
+@dataclass
+class Knob:
+    """One bounded knob: a discrete value ladder and a cursor on it.
+
+    ``pinned`` knobs participate in the config map (so appliers, gauges,
+    and logs always see a complete picture) but are never proposed."""
+
+    name: str
+    values: Tuple
+    index: int
+    pinned: bool = False
+
+    @property
+    def current(self):
+        return self.values[self.index]
+
+    def in_bounds(self, direction: int) -> bool:
+        return 0 <= self.index + direction < len(self.values)
+
+
+def _ladder(current, candidates: Sequence) -> Tuple[Tuple, int]:
+    """Sorted numeric ladder with ``current`` spliced in — the policy must
+    START at the live runtime value, or its first 'move' would silently
+    change a knob nobody asked it to."""
+    values = sorted(set(float(c) for c in candidates) | {float(current)})
+    return tuple(values), values.index(float(current))
+
+
+@dataclass
+class Decision:
+    """One applied knob change: a move ("retune"), the guard rolling a
+    regressing move back ("revert"), or the hill climb dropping a
+    tolerated-but-not-improving one ("discard").
+
+    ``config`` is the COMPLETE knob→value map after the decision — the
+    applier (controller service / engine) reads values from it without
+    needing to know which knob moved."""
+
+    action: str  # "retune" | "revert" | "discard"
+    knob: str
+    value: object
+    score: float
+    best_score: float
+    config: Dict[str, object] = field(default_factory=dict)
+
+
+def audit_decision(decision: Decision) -> None:
+    """Registry half of the audit trail (shared by both backends): bump
+    the retune/revert/discard counter and refresh every knob gauge."""
+    fam = {"revert": _REVERTS, "discard": _DISCARDS}.get(
+        decision.action, _RETUNES)
+    fam.labels(knob=decision.knob).inc()
+    for name, value in decision.config.items():
+        if name == KNOB_CODEC:
+            value = CODEC_IDS.get(str(value), -1)
+        _KNOB_GAUGE.labels(knob=name).set(value)
+
+
+def parse_fault(spec: str) -> Optional[Tuple[str, int]]:
+    """``"regress@N"`` → ("regress", N); empty → None; typos fail loudly
+    (the chaos-grammar loudness contract).
+
+    The hook replaces REAL scores with a deterministic synthetic pair —
+    a flat plateau until the Nth retune, a deep regression after it,
+    the plateau again once the guard fired — so the certification is
+    judged on the guard's logic, not on the noise floor of whatever
+    box runs it (CPU-world scores swing 20x under scheduler load; a
+    mere scale factor would let natural regressions fire extra
+    reverts)."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    kind, sep, arg = spec.partition("@")
+    if kind != "regress" or not sep or not arg.isdigit():
+        raise ValueError(
+            f"bad HOROVOD_AUTOTUNE_FAULT spec {spec!r}; expected "
+            f"'regress@N' (force a score regression after the Nth retune "
+            f"so the revert guard must fire exactly once)")
+    return ("regress", int(arg))
+
+
+_FAULT_PLATEAU = 1000.0
+_FAULT_REGRESSED = 200.0
+
+
+class TuningPolicy:
+    """Median-of-window coordinate descent with a revert guard.
+
+    Drive it with one :meth:`observe` call per completed negotiation
+    cycle; it returns a :class:`Decision` whenever the knobs change.
+    State machine per scored window:
+
+    1. Fold the window's per-cycle scores to a median.
+    2. If the previous window's move regressed past ``tolerance`` vs the
+       best-known score: roll back to the best-known config (revert).
+    3. If it merely failed to improve: roll back too (discard) — strict
+       hill-climb acceptance, because keeping tolerated-but-flat moves
+       would let a knob with no measurable effect ping-pong forever,
+       and every fusion/capacity/codec ping is a real change that bumps
+       the response-cache generation.
+    4. Otherwise adopt the config as best, and propose the next
+       in-bounds, un-pinned, not-recently-rejected (knob, direction)
+       move.
+    5. Enter cooldown: the next ``cooldown`` samples are dropped.
+
+    When every candidate move has been rejected the policy idles at the
+    best-known config and re-opens exploration after a backoff that
+    starts at ``reexplore_windows`` quiet windows and doubles (capped)
+    for every exploration round that adopted nothing — online
+    conditions drift, and a move that hurt an hour ago may win now, but
+    a flat landscape must converge toward idle, not churn at a fixed
+    cadence."""
+
+    def __init__(self, knobs: Sequence[Knob], window: int = 5,
+                 cooldown: int = 5, tolerance: float = 0.05,
+                 decision_sink: Optional[Callable[[dict], None]] = None,
+                 fault: str = "", reexplore_windows: int = 3) -> None:
+        if not knobs:
+            raise ValueError("TuningPolicy needs at least one knob")
+        self._knobs: Dict[str, Knob] = {k.name: k for k in knobs}
+        self._order = [k.name for k in knobs]
+        self._window = max(int(window), 1)
+        self._cooldown = max(int(cooldown), 0)
+        self._tolerance = float(tolerance)
+        self._sink = decision_sink
+        self._fault = parse_fault(fault)
+        self._fault_done = False
+        self._reexplore = max(int(reexplore_windows), 1)
+        self._samples: List[float] = []
+        self._cooldown_left = 0
+        self._best_score: Optional[float] = None
+        self._best_config: Dict[str, int] = {}  # name -> ladder index
+        self._last_move: Optional[Tuple[str, int]] = None  # (name, dir)
+        self._rejected: set = set()  # {(name, dir)} since last improvement
+        self._cursor = 0
+        self._idle_windows = 0
+        # Re-explore with exponential backoff: a fully-explored flat
+        # landscape must converge toward idle (each exploration burst is
+        # real knob churn — and cache-generation bumps), not repeat at a
+        # fixed cadence forever. Any adopted improvement resets it.
+        self._backoff = self._reexplore
+        self._improved_since_explore = False
+        self.retunes = 0
+        self.reverts = 0
+        self.discards = 0
+        self._emit({"action": "init", "config": self.config(),
+                    "window": self._window, "cooldown": self._cooldown,
+                    "tolerance": self._tolerance})
+
+    # -- introspection (the Autotuner facade's CSV columns) -------------------
+
+    def config(self) -> Dict[str, object]:
+        return {name: self._knobs[name].current for name in self._order}
+
+    def value(self, name: str):
+        return self._knobs[name].current
+
+    @property
+    def fusion_threshold_bytes(self) -> int:
+        return int(self._knobs[KNOB_FUSION].current)
+
+    @property
+    def cycle_time_ms(self) -> float:
+        return float(self._knobs[KNOB_CYCLE].current)
+
+    @property
+    def best(self) -> dict:
+        best_cfg = {name: self._knobs[name].values[i]
+                    for name, i in self._best_config.items()} \
+            if self._best_config else self.config()
+        return {"config": best_cfg,
+                "score_bytes_per_us": self._best_score,
+                "retunes": self.retunes, "reverts": self.reverts}
+
+    # -- the loop --------------------------------------------------------------
+
+    def observe(self, bytes_processed: float,
+                microseconds: float) -> Optional[Decision]:
+        if bytes_processed <= 0 or microseconds <= 0:
+            return None
+        score = bytes_processed / microseconds
+        if self._fault is not None:
+            # deterministic test hook (see parse_fault): a flat synthetic
+            # plateau, regressed once after the Nth retune until the
+            # guard fires — real (noisy) scores never reach the guard
+            score = _FAULT_REGRESSED if (
+                not self._fault_done and self.retunes >= self._fault[1]
+            ) else _FAULT_PLATEAU
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            return None
+        self._samples.append(score)
+        if len(self._samples) < self._window:
+            return None
+        median = statistics.median(self._samples)
+        self._samples.clear()
+        return self._decide(median)
+
+    def _decide(self, score: float) -> Optional[Decision]:
+        if self._best_score is None:
+            # baseline window: the live config IS the best known so far
+            self._best_score = score
+            self._best_config = self._snapshot()
+            return self._propose(score)
+        if self._last_move is not None:
+            if score < self._best_score * (1.0 - self._tolerance):
+                return self._revert(score)
+            if score <= self._best_score:
+                # Strict acceptance: a kept move must IMPROVE. Keeping
+                # tolerated-but-flat moves let a knob whose effect stays
+                # inside the tolerance band ping-pong forever — and every
+                # fusion/capacity/codec ping was a REAL change that
+                # bumped the response-cache generation, perpetually
+                # clearing the PR-3 warm bypass. Discard instead: restore
+                # best-known, reject the direction, converge to idle.
+                return self._discard(score)
+        if score > self._best_score:
+            self._best_score = score
+            self._best_config = self._snapshot()
+            self._rejected.clear()  # a better region re-opens exploration
+            self._improved_since_explore = True
+            self._backoff = self._reexplore
+        elif self._last_move is None and \
+                self._snapshot() == self._best_config:
+            # Online drift re-anchor: the best-known config ITSELF scores
+            # lower now (workload change, not a failed move — there is no
+            # move to blame). Without this, every future move would be
+            # judged against a stale, unreachable score and revert
+            # forever, freezing the policy out of the new landscape.
+            self._best_score = score
+        self._last_move = None
+        return self._propose(score)
+
+    def _snapshot(self) -> Dict[str, int]:
+        return {name: knob.index for name, knob in self._knobs.items()}
+
+    def _rollback(self, score: float, action: str) -> Decision:
+        """Restore the best-known config and reject the failed direction.
+        ``action`` distinguishes the revert GUARD (the move regressed past
+        tolerance) from a hill-climb discard (tolerated but flat) in every
+        audit surface."""
+        name, direction = self._last_move
+        self._rejected.add((name, direction))
+        for knob_name, index in self._best_config.items():
+            self._knobs[knob_name].index = index
+        self._last_move = None
+        self._cooldown_left = self._cooldown
+        decision = Decision(action=action, knob=name,
+                            value=self._knobs[name].current, score=score,
+                            best_score=self._best_score,
+                            config=self.config())
+        self._audit(decision)
+        return decision
+
+    def _revert(self, score: float) -> Decision:
+        self.reverts += 1
+        self._fault_done = True  # the hook proved the guard; plateau resumes
+        return self._rollback(score, "revert")
+
+    def _discard(self, score: float) -> Decision:
+        self.discards += 1
+        return self._rollback(score, "discard")
+
+    def _propose(self, score: float) -> Optional[Decision]:
+        candidates = []
+        n = len(self._order)
+        for step in range(n):
+            name = self._order[(self._cursor + step) % n]
+            knob = self._knobs[name]
+            if knob.pinned:
+                continue
+            for direction in (1, -1):
+                if knob.in_bounds(direction) and \
+                        (name, direction) not in self._rejected:
+                    candidates.append((name, direction))
+            if candidates:
+                break
+        if not candidates:
+            # fully explored from here: idle at best-known; re-open after
+            # the backoff, doubling it whenever a whole exploration round
+            # adopted nothing (capped — online drift still gets retried)
+            self._idle_windows += 1
+            if self._idle_windows >= self._backoff:
+                self._idle_windows = 0
+                if not self._improved_since_explore:
+                    self._backoff = min(self._backoff * 2, 96)
+                self._improved_since_explore = False
+                self._rejected.clear()
+            return None
+        self._idle_windows = 0
+        name, direction = candidates[0]
+        self._cursor = (self._order.index(name) + 1) % n
+        knob = self._knobs[name]
+        knob.index += direction
+        self._last_move = (name, direction)
+        self._cooldown_left = self._cooldown
+        self.retunes += 1
+        decision = Decision(action="retune", knob=name, value=knob.current,
+                            score=score, best_score=self._best_score,
+                            config=self.config())
+        self._audit(decision)
+        return decision
+
+    def _audit(self, decision: Decision) -> None:
+        audit_decision(decision)
+        self._emit({"action": decision.action, "knob": decision.knob,
+                    "value": decision.value, "score": decision.score,
+                    "best_score": decision.best_score,
+                    "config": decision.config})
+
+    def _emit(self, record: dict) -> None:
+        if self._sink is not None:
+            self._sink(record)
+
+
+def default_knobs(cfg, extended: bool = False) -> List[Knob]:
+    """The live knob set for a Config (docs/autotune.md knob table).
+
+    The classic pair is always present (pinned when its env was set
+    explicitly). ``extended`` adds the Python-controller-only knobs —
+    response-cache capacity, codec, metrics interval — each gated on its
+    subsystem actually being active and its own pin rules; the native
+    controller wire cannot carry them (the cache-bit / metrics-RPC
+    degrade pattern)."""
+    knobs: List[Knob] = []
+    mib = 1024 * 1024
+    values, index = _ladder(cfg.fusion_threshold_bytes,
+                            [m * mib for m in (1, 2, 4, 8, 16, 32, 64, 128)])
+    knobs.append(Knob(KNOB_FUSION, values, index,
+                      pinned=cfg.fusion_threshold_explicit))
+    values, index = _ladder(cfg.cycle_time_ms,
+                            [0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0])
+    knobs.append(Knob(KNOB_CYCLE, values, index,
+                      pinned=cfg.cycle_time_explicit))
+    if extended and cfg.cache_capacity > 0:
+        values, index = _ladder(cfg.cache_capacity,
+                                [128, 256, 512, 1024, 2048, 4096])
+        knobs.append(Knob(KNOB_CACHE, values, index,
+                          pinned=cfg.cache_capacity_explicit))
+    if extended and cfg.metrics_port > 0:
+        # present (pinned) even when the interval was set explicitly, so
+        # the config map / gauges / decision log can distinguish "pinned
+        # at X" from "no metrics plane to manage"; absent entirely when
+        # the exposition server is off — there is no knob to report
+        values, index = _ladder(cfg.metrics_interval_s,
+                                [0.5, 1.0, 2.0, 5.0, 10.0])
+        knobs.append(Knob(KNOB_INTERVAL, values, index,
+                          pinned=cfg.metrics_interval_explicit))
+    if extended:
+        # Lossy knob: pinned to the session default unless the operator
+        # explicitly allowlisted candidates (HOROVOD_AUTOTUNE_CODECS) —
+        # the tuner must never trade training numerics for wire bytes
+        # without consent. Typos fail loudly (the chaos-grammar
+        # contract): silently dropping "in8" would pin the knob while
+        # the operator believes they consented to int8 exploration.
+        unknown = [c for c in cfg.autotune_codecs if c not in CODEC_IDS]
+        if unknown:
+            raise ValueError(
+                f"bad HOROVOD_AUTOTUNE_CODECS entry "
+                f"{'/'.join(unknown)!r}; known codecs: "
+                f"{'/'.join(sorted(CODEC_IDS))}")
+        current = "none"
+        ladder = [current] + [c for c in cfg.autotune_codecs
+                              if c != current]
+        knobs.append(Knob(KNOB_CODEC, tuple(ladder), 0,
+                          pinned=len(ladder) == 1))
+    return knobs

@@ -32,6 +32,10 @@ CYCLE_NAME = "CYCLE_START"
 # merge tool uses to fold per-rank files onto one corrected timebase.
 TRACE_META = "horovod_trace_meta"
 CLOCK_SYNC = "horovod_clock_sync"
+# Closed-loop tuning plane (docs/autotune.md): one AUTOTUNE metadata
+# record per applied knob change on each recording rank, so a trace
+# shows WHEN the world's knobs moved next to the cycles they reshaped.
+AUTOTUNE = "horovod_autotune"
 
 
 def rank_timeline_path(path: str, rank: int) -> str:

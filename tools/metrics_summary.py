@@ -72,15 +72,32 @@ def _render_family(name: str, fam: dict, out) -> None:
         out.write(f"  {name + label_s:<58} {fam['type']:<9} {detail}\n")
 
 
+# Tuning-plane families (docs/autotune.md) get their own section: the
+# current knob gauges, retune/revert counters, and eviction advisories
+# are the "is the closed loop doing anything?" glance, and burying them
+# in the alphabetical world listing hid exactly that.
+TUNING_PREFIXES = ("horovod_autotune_", "horovod_straggler_evict")
+
+
 def _render_section(title: str, families: Dict[str, dict], prefix: str,
-                    out) -> None:
-    names = [n for n in sorted(families) if n.startswith(prefix)]
+                    out, skip: tuple = ()) -> None:
+    names = [n for n in sorted(families) if n.startswith(prefix)
+             and not n.startswith(skip)]
     out.write(f"{title} ({len(names)} families)\n")
     if not names:
         out.write("  (none match)\n")
     for name in names:
         _render_family(name, families[name], out)
     out.write("\n")
+
+
+def _render_tuning_section(families: Dict[str, dict], prefix: str,
+                           out) -> None:
+    tuning = {n: f for n, f in families.items()
+              if n.startswith(TUNING_PREFIXES) and n.startswith(prefix)}
+    if not tuning:
+        return  # no tuning plane in this snapshot: no empty section
+    _render_section("tuning plane", tuning, prefix, out)
 
 
 def main(argv=None) -> int:
@@ -106,7 +123,9 @@ def main(argv=None) -> int:
         # a bare metrics_snapshot() families dict: one local section
         world, ranks = doc, {}
 
-    _render_section("world", world, args.family, sys.stdout)
+    _render_tuning_section(world, args.family, sys.stdout)
+    _render_section("world", world, args.family, sys.stdout,
+                    skip=TUNING_PREFIXES)
     # JSON round-trips rank keys as strings; accept either
     by_rank = {int(k): v for k, v in ranks.items()}
     wanted = sorted(by_rank) if args.all else (

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Render a tuning-plane JSONL decision log (docs/autotune.md).
+
+    HOROVOD_AUTOTUNE_DECISIONS=/tmp/decisions.jsonl python train.py
+    python tools/tune_report.py /tmp/decisions.jsonl
+
+One line per decision, as written by the policy
+(``horovod_tpu/tune/policy.py``): ``init`` records the starting config
+and loop parameters, ``retune`` an applied knob move, ``revert`` a
+rollback to the best-known config. The report prints the decision
+history, per-knob move/revert counts, the score trajectory, and the
+final config — then one machine-readable JSON summary as the LAST line
+(the same final-line contract as tools/trace_merge.py and
+tools/straggler_report.py). Stdlib-only: runs on a workstation without
+the training environment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def load_decisions(path) -> List[dict]:
+    fh = sys.stdin if path == "-" else open(path, encoding="utf-8")
+    with fh:
+        records = []
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError as exc:
+                raise SystemExit(
+                    f"{path}:{lineno}: not a JSONL decision record: {exc}")
+    return records
+
+
+def summarize(records: List[dict]) -> dict:
+    per_knob: Dict[str, Dict[str, int]] = {}
+    scores = []
+    final_config = None
+    init = None
+    for rec in records:
+        action = rec.get("action")
+        if action == "init":
+            init = rec
+            final_config = rec.get("config")
+            continue
+        if action not in ("retune", "revert", "discard"):
+            continue
+        knob = rec.get("knob", "?")
+        slot = per_knob.setdefault(knob, {"retunes": 0, "reverts": 0,
+                                          "discards": 0})
+        slot[action + "s"] += 1
+        if "score" in rec:
+            scores.append(rec["score"])
+        final_config = rec.get("config", final_config)
+    return {
+        "decisions": sum(v["retunes"] + v["reverts"] + v["discards"]
+                         for v in per_knob.values()),
+        "retunes": sum(v["retunes"] for v in per_knob.values()),
+        "reverts": sum(v["reverts"] for v in per_knob.values()),
+        "discards": sum(v["discards"] for v in per_knob.values()),
+        "per_knob": per_knob,
+        "initial_config": (init or {}).get("config"),
+        "final_config": final_config,
+        "best_score": max((r.get("best_score", 0.0) for r in records
+                           if r.get("action") in ("retune", "revert")),
+                          default=None),
+        "score_first": scores[0] if scores else None,
+        "score_last": scores[-1] if scores else None,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a HOROVOD_AUTOTUNE_DECISIONS JSONL log")
+    ap.add_argument("path", help="decision log file, or - for stdin")
+    ap.add_argument("--history", action="store_true",
+                    help="also print every decision line")
+    args = ap.parse_args(argv)
+
+    records = load_decisions(args.path)
+    if not records:
+        print("empty decision log", file=sys.stderr)
+        print(json.dumps({"decisions": 0}))
+        return 0
+    summary = summarize(records)
+
+    if args.history:
+        for rec in records:
+            action = rec.get("action", "?")
+            if action == "init":
+                print(f"  init    config={rec.get('config')}")
+            else:
+                print(f"  {action:<7} {rec.get('knob')} -> "
+                      f"{rec.get('value')!r}  score={rec.get('score'):.4g} "
+                      f"best={rec.get('best_score'):.4g}")
+        print()
+    print(f"decisions: {summary['decisions']} "
+          f"({summary['retunes']} retunes, {summary['reverts']} reverts, "
+          f"{summary['discards']} discards)")
+    for knob, counts in sorted(summary["per_knob"].items()):
+        print(f"  {knob:<28} retunes={counts['retunes']} "
+              f"reverts={counts['reverts']} "
+              f"discards={counts['discards']}")
+    if summary["best_score"] is not None:
+        print(f"best score: {summary['best_score']:.6g} bytes/us")
+    print(f"initial config: {summary['initial_config']}")
+    print(f"final config:   {summary['final_config']}")
+    print(json.dumps(summary, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -463,6 +463,15 @@ def _parse_args(argv=None):
                              "— docs/autotune.md). Governs the eager "
                              "control plane; SPMD steps have no cycles "
                              "to tune.")
+    parser.add_argument("--grad-sentry", default="",
+                        choices=["", "off", "warn", "skip", "zero",
+                                 "abort"],
+                        help="arm the gradient sentry for this run "
+                             "(HOROVOD_GRAD_SENTRY=<policy>, "
+                             "docs/integrity.md): reduced gradients are "
+                             "screened for NaN/Inf on the eager plane and "
+                             "guarded in the compiled SPMD step; trip "
+                             "counters land in the BENCH json")
     parser.add_argument("--_measure", action="store_true",
                         help=argparse.SUPPRESS)  # internal: child mode
     parser.add_argument("--warm-init-cache", action="store_true",
@@ -526,7 +535,8 @@ def _supervise(args) -> None:
         (["--int8-allreduce"] if args.int8_allreduce else []) + \
         (["--timeline-dir", args.timeline_dir] if args.timeline_dir
          else []) + \
-        (["--autotune"] if args.autotune else [])
+        (["--autotune"] if args.autotune else []) + \
+        (["--grad-sentry", args.grad_sentry] if args.grad_sentry else [])
     import signal
     import subprocess as sp
 
@@ -654,6 +664,16 @@ def main() -> None:
         os.environ.setdefault("HOROVOD_TIMELINE_MARK_CYCLES", "1")
         _log(f"timeline capture -> {os.environ['HOROVOD_TIMELINE']} "
              f"(per-rank; merge with tools/trace_merge.py)")
+
+    if args.grad_sentry:
+        # Data-plane integrity plane (docs/integrity.md): like --autotune,
+        # BEFORE hvd.init() reads the config (and before the SPMD step
+        # traces — the in-program guard reads the policy at trace time);
+        # setdefault so an operator's explicit pin wins.
+        os.environ.setdefault("HOROVOD_GRAD_SENTRY", args.grad_sentry)
+        _log(f"grad sentry armed: "
+             f"HOROVOD_GRAD_SENTRY={os.environ['HOROVOD_GRAD_SENTRY']} "
+             f"(trip counters land in the BENCH json)")
 
     if args.autotune:
         # Closed-loop tuning plane (docs/autotune.md): like --timeline-dir,
@@ -842,6 +862,8 @@ def main() -> None:
         provenance["fp16_allreduce"] = True
     if args.int8_allreduce:
         provenance["int8_allreduce"] = True
+    if args.grad_sentry:
+        provenance["grad_sentry"] = args.grad_sentry
 
     for i in range(args.num_iters):
         t0 = time.perf_counter()
@@ -886,6 +908,20 @@ def main() -> None:
         "vs_baseline": vs_baseline,
         "captured_at": round(time.time(), 1),
     })
+    if args.grad_sentry:
+        # integrity-plane audit beside the number (docs/integrity.md):
+        # eager-plane trips/checks plus the compiled step's guarded
+        # lowerings, straight off the metrics registry
+        snap = hvd.metrics_snapshot()
+
+        def _total(family):
+            fam = snap.get(family)
+            return sum(s["value"] for s in fam["samples"]) if fam else 0
+
+        result["sentry_trips"] = _total("horovod_sentry_trips_total")
+        result["sentry_checks"] = _total("horovod_sentry_checks_total")
+        result["sentry_spmd_guards"] = _total(
+            "horovod_sentry_spmd_guards_total")
     # cost_analysis() reports the per-device SPMD program's flops — and for
     # a lax.scan program it must count the loop BODY once, not times the
     # trip count, or mfu/tflops inflate by scan_batches. One body == one

@@ -65,7 +65,16 @@ if _platform:
     del _jax, _live
 del _os, _platform
 
-from . import callbacks, checkpoint, elastic, obs, parallel, runner, tune
+from . import (
+    callbacks,
+    checkpoint,
+    elastic,
+    integrity,
+    obs,
+    parallel,
+    runner,
+    tune,
+)
 from .obs import metrics_snapshot, straggler_report
 from .basics import (
     cross_rank,
@@ -82,7 +91,9 @@ from .basics import (
     size,
 )
 from .core.status import (
+    ConsensusError,
     HorovodInternalError,
+    NonFiniteGradError,
     NotInitializedError,
     RanksAbortedError,
 )
@@ -136,4 +147,5 @@ __all__ = [
     "broadcast_parameters", "broadcast_optimizer_state",
     "broadcast_global_variables", "broadcast_object",
     "HorovodInternalError", "NotInitializedError", "RanksAbortedError",
+    "ConsensusError", "NonFiniteGradError", "integrity",
 ]

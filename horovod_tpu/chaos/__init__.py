@@ -14,7 +14,8 @@ Spec grammar (comma-separated clauses)::
     HOROVOD_CHAOS="drop@rank1:msg12,delay@rank0:50ms:every7,seed:7"
 
     clause   := kind "@" scope { ":" arg }    |  "seed" ":" INT
-    kind     := drop | delay | corrupt | close | refuse
+    kind     := drop | delay | corrupt | close | refuse    (control wire)
+              | nan | flipbits                             (data plane)
     scope    := "rank" INT   (that rank's controller client only)
               | "all"        (every rank)
               | "relaunch"   (refuse's ONLY scope: reconnect attempts,
@@ -42,12 +43,28 @@ Fault semantics, all at the frame boundary of the rank's controller client:
                 fail at connect time (exercises the exponential backoff;
                 N larger than the retry budget forces escalation).
 
-Determinism: faults are keyed by (rank, request ordinal). The ordinal
-counts LOGICAL requests on the rank's controller client — retries of a
-faulted request do not advance it, so a replay under the same spec and the
-same request stream injects bit-identical faults. Probabilistic triggers
-draw from ``random.Random(seed ^ rank)`` exactly once per ordinal, so they
-replay too.
+Data-plane faults (docs/integrity.md), at the host-side fused-buffer
+boundary of the engine's allreduce execution — the ground truth the
+integrity plane (grad sentry + consensus verification) is certified
+against:
+
+* ``nan``      — the rank's LOCAL input fused buffer is poisoned with a
+                 NaN before the reduce (float batches only): a genuinely
+                 non-finite gradient entering the collective, which the
+                 sum propagates to every rank — the sentry's quarry.
+* ``flipbits`` — one low mantissa bit of the rank's RECEIVED reduced
+                 buffer is flipped after the reduce: a silent, finite,
+                 single-rank divergence (the host-memory SDC class) that
+                 only cross-rank consensus digests can see.
+
+Determinism: control-wire faults are keyed by (rank, request ordinal) —
+LOGICAL requests on the rank's controller client; retries of a faulted
+request do not advance it, so a replay under the same spec and the same
+request stream injects bit-identical faults. Data-plane faults are keyed
+by (rank, allreduce-batch ordinal) — batches execute in negotiated order,
+identical on every rank, so the two ordinal domains are independently
+replay-stable. Probabilistic triggers draw from a seeded per-domain RNG
+exactly once per ordinal, so they replay too.
 """
 
 from __future__ import annotations
@@ -73,6 +90,14 @@ class ChaosSpecError(ValueError):
     """A malformed HOROVOD_CHAOS spec must fail LOUDLY at client
     construction: a typo'd fault plan silently injecting nothing would
     certify nothing."""
+
+
+# Fault kinds by injection domain: wire kinds fire on the controller
+# client's request ordinals, data kinds on the engine's allreduce-batch
+# ordinals (docs/integrity.md). A rule's kind decides which hooks can
+# ever fire it — the two domains never cross-consume armings.
+WIRE_KINDS = ("drop", "delay", "corrupt", "close", "refuse")
+DATA_KINDS = ("nan", "flipbits")
 
 
 @dataclass
@@ -140,7 +165,7 @@ def parse_chaos_spec(spec: str) -> ChaosPlan:
         kind, rest = clause.split("@", 1)
         toks = rest.split(":")
         scope, args = toks[0], toks[1:]
-        if kind not in ("drop", "delay", "corrupt", "close", "refuse"):
+        if kind not in WIRE_KINDS + DATA_KINDS:
             raise ChaosSpecError(f"unknown fault kind {kind!r} in {clause!r}")
         rule = FaultRule(kind=kind, rank=None)
         if kind == "refuse":
@@ -187,7 +212,7 @@ def parse_chaos_spec(spec: str) -> ChaosPlan:
                     raise ChaosSpecError(f"too many args in {clause!r}")
                 _parse_trigger(rule, args[1] if len(args) > 1 else "every1",
                                clause)
-            else:  # drop | corrupt | close
+            else:  # drop | corrupt | close | nan | flipbits
                 if len(args) != 1:
                     raise ChaosSpecError(
                         f"{kind} takes exactly one trigger arg in {clause!r}")
@@ -214,51 +239,83 @@ class ChaosInjector:
     * ``on_recv_frame(body) -> body`` — drop / corrupt faults, after the
       body read and before HMAC verification.
 
+    Data-plane hooks (called by ``ops.engine`` at the host-side
+    fused-buffer boundary, single engine-loop thread — ordinals count
+    ALLREDUCE batches in negotiated execution order):
+
+    * ``begin_batch()`` — once per allreduce batch; advances the data
+      ordinal and arms this ordinal's data faults.
+    * ``on_reduce_input(buf) -> buf``  — nan faults, the local input
+      buffer before the reduce (returns a poisoned COPY; the caller's
+      array is never mutated).
+    * ``on_reduce_output(buf) -> buf`` — flipbits faults, the received
+      reduced buffer after the reduce.
+
     ``events`` records every fired fault as ``(kind, ordinal)`` — the
     proof, in tests and the dryrun certification, that the plan actually
-    executed."""
+    executed (wire kinds carry the request ordinal, data kinds the batch
+    ordinal; the kind disambiguates)."""
 
     def __init__(self, plan: ChaosPlan, rank: int) -> None:
         self.plan = plan
         self.rank = rank
         self.ordinal = 0
+        self.data_ordinal = 0
         self.events: List[Tuple[str, int]] = []
         self._rules = [r for r in plan.rules
                        if r.rank is None or r.rank == rank]
         self._rng = random.Random(plan.seed ^ (rank + 1) * 0x9E3779B1)
+        # independent draw stream per domain: adding a data clause must
+        # not shift the wire clauses' probabilistic replay (and vice
+        # versa)
+        self._data_rng = random.Random(plan.seed ^ (rank + 1) * 0x85EBCA6B)
         self._armed: dict = {}
+        self._armed_data: dict = {}
         self._fired_once: set = set()
         self._episode_refusals: dict = {}
 
+    def has_data_rules(self) -> bool:
+        """Whether any clause targets the data plane at this rank — the
+        engine only threads the batch hooks through when one does."""
+        return any(r.kind in DATA_KINDS for r in self._rules)
+
     def _fire(self, kind: str) -> Optional[FaultRule]:
         """Consume this ordinal's armed fault of ``kind``, if any."""
-        rule = self._armed.pop(kind, None)
+        armed = self._armed_data if kind in DATA_KINDS else self._armed
+        ordinal = self.data_ordinal if kind in DATA_KINDS else self.ordinal
+        rule = armed.pop(kind, None)
         if rule is not None:
-            self.events.append((kind, self.ordinal))
+            self.events.append((kind, ordinal))
             _CHAOS_INJECTIONS.labels(kind=kind).inc()
         return rule
+
+    @staticmethod
+    def _arm(rules, armed: dict, ordinal: int, rng, fired_once: set,
+             kinds: tuple) -> None:
+        for rule in rules:
+            if rule.kind == "refuse" or rule.kind not in kinds:
+                continue  # refuse is connection-scoped, not ordinal-scoped
+            if rule.ordinal is not None:
+                hit = (rule.ordinal == ordinal
+                       and id(rule) not in fired_once)
+                if hit:
+                    fired_once.add(id(rule))
+            elif rule.every is not None:
+                hit = ordinal % rule.every == 0
+            else:
+                # exactly one draw per (rule, ordinal): replay-stable
+                hit = rng.random() < (rule.prob or 0.0)
+            if hit:
+                # one fault per kind per ordinal; first clause wins
+                armed.setdefault(rule.kind, rule)
 
     # -- lifecycle hooks ------------------------------------------------------
 
     def begin_request(self) -> None:
         self.ordinal += 1
         self._armed = {}
-        for rule in self._rules:
-            if rule.kind == "refuse":
-                continue  # connection-scoped, not ordinal-scoped
-            if rule.ordinal is not None:
-                hit = (rule.ordinal == self.ordinal
-                       and id(rule) not in self._fired_once)
-                if hit:
-                    self._fired_once.add(id(rule))
-            elif rule.every is not None:
-                hit = self.ordinal % rule.every == 0
-            else:
-                # exactly one draw per (rule, ordinal): replay-stable
-                hit = self._rng.random() < (rule.prob or 0.0)
-            if hit:
-                # one fault per kind per ordinal; first clause wins
-                self._armed.setdefault(rule.kind, rule)
+        self._arm(self._rules, self._armed, self.ordinal, self._rng,
+                  self._fired_once, WIRE_KINDS)
 
     def on_connect(self, reconnecting: bool) -> None:
         if not reconnecting:
@@ -318,6 +375,50 @@ class ChaosInjector:
         if rule is not None:
             body = (bytes([body[0] ^ 0x01]) + body[1:]) if body else b"\x00"
         return body
+
+    # -- data-plane hooks (docs/integrity.md) ---------------------------------
+
+    def begin_batch(self) -> None:
+        """Once per allreduce batch on the engine loop; arms this batch
+        ordinal's data faults."""
+        self.data_ordinal += 1
+        self._armed_data = {}
+        self._arm(self._rules, self._armed_data, self.data_ordinal,
+                  self._data_rng, self._fired_once, DATA_KINDS)
+
+    def on_reduce_input(self, buf):
+        """nan fault: poison element 0 of the LOCAL input buffer before
+        the reduce. Float batches only — a NaN cannot enter an integer
+        wire, and firing an event for an injection that could not happen
+        would break the events-are-proof contract (the armed rule simply
+        lapses at the next batch)."""
+        import numpy as np
+
+        if "nan" not in self._armed_data or \
+                not np.issubdtype(buf.dtype, np.floating):
+            return buf
+        self._fire("nan")
+        poisoned = np.array(buf, copy=True)
+        poisoned.reshape(-1)[0] = np.nan
+        return poisoned
+
+    def on_reduce_output(self, buf):
+        """flipbits fault: flip the lowest bit of the first byte of the
+        RECEIVED reduced buffer — for little-endian floats a low mantissa
+        bit, so the value stays finite and the divergence is exactly the
+        silent kind only consensus digests can see."""
+        rule = self._fire("flipbits")
+        if rule is None:
+            return buf
+        import numpy as np
+
+        raw = bytearray(buf.tobytes())
+        if raw:
+            raw[0] ^= 0x01
+        # copy: frombuffer views are read-only, and the engine's callers
+        # get writable results by contract (see _run_allreduce)
+        return np.frombuffer(bytes(raw),
+                             dtype=buf.dtype).reshape(buf.shape).copy()
 
 
 def injector_from_env(rank: Optional[int] = None) -> Optional[ChaosInjector]:

@@ -14,6 +14,8 @@ Run directly::
 
     python -m horovod_tpu.chaos.matrix            # default single-fault grid
     python -m horovod_tpu.chaos.matrix --spec "drop@rank1:every3"
+    python -m horovod_tpu.chaos.matrix --data-plane   # integrity grid
+                                                      # (docs/integrity.md)
 """
 
 from __future__ import annotations
@@ -36,6 +38,22 @@ DEFAULT_SPECS = [
 # A fault budget no reconnect can satisfy: the rank must escalate into a
 # structured abort, and its healthy peer must see RanksAbortedError.
 ESCALATION_SPEC = "close@rank1:msg6,refuse@relaunch:999"
+
+# Data-plane integrity grid (docs/integrity.md): fault kind x policy.
+# Every cell must resolve as healed (skip/zero neutralized the poisoned
+# batch with bit-exact results elsewhere; warn surfaced it and kept
+# going) or escalated (a structured NonFiniteGradError/ConsensusError
+# INSIDE the deadline) — never a hang. The poisoned batch ordinal is
+# pinned (msg3) so healed cells can assert exact final values.
+DATA_POISON_ORDINAL = 3
+DATA_GRID = [
+    # (chaos spec, sentry policy, consensus interval, expected outcome)
+    (f"nan@rank1:msg{DATA_POISON_ORDINAL}", "skip", 0, "healed"),
+    (f"nan@rank1:msg{DATA_POISON_ORDINAL}", "zero", 0, "healed"),
+    (f"nan@rank1:msg{DATA_POISON_ORDINAL}", "warn", 0, "healed"),
+    (f"nan@rank1:msg{DATA_POISON_ORDINAL}", "abort", 0, "escalated"),
+    (f"flipbits@rank1:msg{DATA_POISON_ORDINAL}", "off", 1, "escalated"),
+]
 
 
 def _matrix_fn(steps: int, expect_escalation: bool):
@@ -82,6 +100,155 @@ def _matrix_fn(steps: int, expect_escalation: bool):
     hvd.shutdown()
     return {"rank": rank, "outcome": "healed", "events": events,
             "reconnects": reconnects, "hit_cycles": stats["hit_cycles"]}
+
+
+def _data_matrix_fn(steps: int, policy: str, poison_ordinal: int,
+                    expect_escalation: bool):
+    """Per-rank body for one data-plane integrity cell (shipped by value
+    through runner.run's driver): one allreduce per step with
+    step-dependent values, so the driver can pin what a healed world's
+    final accumulator must be bit-exactly."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops.engine import get_engine
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    w = 0.0
+    try:
+        for step in range(steps):
+            out = hvd.allreduce(
+                np.full((16,), float(rank + step + 1), np.float32),
+                average=False, name="chaos.data")
+            w += float(np.asarray(out)[0])
+            clean = float(sum(r + step + 1 for r in range(size)))
+            if step + 1 == poison_ordinal:
+                # the poisoned batch: skip/zero hand back zeros, warn
+                # hands the NaN through — anything else here means the
+                # fault did not fire where the spec said it would
+                continue
+            # bit-exact-or-escalate everywhere else: small integers sum
+            # exactly in float32
+            np.testing.assert_array_equal(np.asarray(out), clean)
+    except hvd.NonFiniteGradError as exc:
+        assert expect_escalation, f"unexpected sentry abort: {exc}"
+        return {"rank": rank, "outcome": "escalated",
+                "error_type": "NonFiniteGradError", "step": exc.step}
+    except hvd.ConsensusError as exc:
+        assert expect_escalation, f"unexpected consensus abort: {exc}"
+        return {"rank": rank, "outcome": "escalated",
+                "error_type": "ConsensusError",
+                "consensus_ranks": exc.ranks}
+    except hvd.HorovodInternalError as exc:
+        assert expect_escalation, f"unexpected world failure: {exc}"
+        return {"rank": rank, "outcome": "escalated",
+                "error_type": type(exc).__name__, "error": str(exc)[:300]}
+    stats = get_engine().integrity_stats()
+    hvd.shutdown()
+    return {"rank": rank, "outcome": "healed", "w": w,
+            "sentry": stats["sentry"],
+            "chaos_events": stats["data_chaos_events"]}
+
+
+def run_data_cell(spec: str, policy: str, consensus_interval: int,
+                  expect: str,
+                  native_core: Optional[int] = None,
+                  np_: int = 2, steps: int = 6,
+                  timeout_s: float = 120.0,
+                  deadline_s: float = 60.0) -> Dict:
+    """Run one data-plane integrity cell; classification mirrors
+    ``run_cell``: healed / escalated / late-escalation / hang — plus the
+    healed cells' EXACTNESS contract: under skip/zero the final
+    accumulator must equal the clean world's minus the poisoned batch
+    (the step it fed was a collective no-op), and the sentry's verdict
+    ordinal must be identical on every rank."""
+    from horovod_tpu.runner import run
+    from horovod_tpu.runner.launcher import LaunchError
+    from horovod_tpu.runner.run_api import WorkerFailedError, WorkerLostError
+
+    env = {
+        "HOROVOD_CHAOS": spec,
+        "HOROVOD_GRAD_SENTRY": policy,
+        "HOROVOD_CONSENSUS_INTERVAL_STEPS": str(consensus_interval),
+        "HOROVOD_NATIVE_CONTROLLER": "0",  # verdict RPC + digest wire
+        "HOROVOD_PLATFORM": "cpu",
+        "HOROVOD_CYCLE_TIME": "2",
+        "HOROVOD_STALL_WARNING_TIME": "2",
+        "HOROVOD_STALL_SHUTDOWN_TIME_S": "4",
+    }
+    if native_core is not None:
+        env["HOROVOD_NATIVE_CORE"] = str(native_core)
+    expect_escalation = expect == "escalated"
+    t0 = time.monotonic()
+    import os
+
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        results = run(_data_matrix_fn,
+                      args=(steps, policy, DATA_POISON_ORDINAL,
+                            expect_escalation),
+                      np=np_, timeout_s=timeout_s, start_timeout_s=120.0)
+        if any(r.get("outcome") == "escalated" for r in results):
+            cell = {"outcome": "escalated", "results": results}
+        else:
+            cell = {"outcome": "healed", "results": results}
+            size = np_
+            clean = sum(sum(r + s + 1 for r in range(size))
+                        for s in range(steps))
+            poisoned_contrib = sum(
+                r + DATA_POISON_ORDINAL for r in range(size))
+            if "nan@" not in spec:
+                # no sentry-visible poison: full-exactness contract. A
+                # flipbits cell WITHOUT consensus lands here too and
+                # honestly classifies wrong-results — that silent
+                # corruption is exactly what consensus exists to catch.
+                for r in results:
+                    if r["w"] != clean:
+                        cell["outcome"] = "wrong-results"
+                        cell["error"] = (
+                            f"rank {r['rank']} w={r['w']} != {clean}")
+            elif policy in ("skip", "zero"):
+                want = clean - poisoned_contrib
+                for r in results:
+                    if r["w"] != want:
+                        cell["outcome"] = "wrong-results"
+                        cell["error"] = (
+                            f"rank {r['rank']} w={r['w']} != {want}")
+                # the verdicts must be collective: identical action on
+                # the identical batch ordinal on EVERY rank
+                trips = {tuple(map(tuple, r["sentry"]["trips"]))
+                         for r in results}
+                if len(trips) != 1 or not trips or \
+                        next(iter(trips)) != (
+                            (DATA_POISON_ORDINAL, policy, "nan"),):
+                    cell["outcome"] = "desynced-verdict"
+                    cell["error"] = f"trips diverged: {trips}"
+    except WorkerFailedError as exc:
+        cell = {"outcome": _classify_worker_failure(exc),
+                "error": str(exc)[:500]}
+    except (WorkerLostError, LaunchError) as exc:
+        cell = {"outcome": "escalated", "error": str(exc)[:500]}
+    except TimeoutError as exc:
+        cell = {"outcome": "hang", "error": str(exc)[:500]}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    cell["spec"] = spec
+    cell["policy"] = policy
+    cell["consensus_interval"] = consensus_interval
+    cell["elapsed_s"] = round(time.monotonic() - t0, 2)
+    if cell["outcome"] == "escalated" and cell["elapsed_s"] > deadline_s:
+        cell["outcome"] = "late-escalation"
+    cell["native_core"] = native_core
+    return cell
 
 
 def _classify_worker_failure(exc) -> str:
@@ -186,7 +353,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="accept escalated outcomes for heal cells "
                              "(the native controller's binary wire has no "
                              "request dedup, so faults escalate by design)")
+    parser.add_argument("--data-plane", action="store_true",
+                        help="run the data-plane integrity grid instead: "
+                             "fault kind (nan/flipbits) x sentry policy / "
+                             "consensus cells, each asserting "
+                             "healed-by-skip / zeroed / "
+                             "escalated-in-deadline (docs/integrity.md)")
     args = parser.parse_args(argv)
+    if args.data_plane:
+        failed = 0
+        for spec, policy, consensus, expect in DATA_GRID:
+            cell = run_data_cell(spec, policy, consensus, expect,
+                                 np_=args.np_, steps=args.steps)
+            ok = cell["outcome"] == expect
+            if not ok:
+                failed += 1
+            label = f"{spec} sentry={policy}" + (
+                f" consensus={consensus}" if consensus else "")
+            print(f"data-cell {'OK ' if ok else 'BAD'} "
+                  f"outcome={cell['outcome']:<15} "
+                  f"{cell['elapsed_s']:6.1f}s  {label}", flush=True)
+            if not ok:
+                print(f"  {cell.get('error', '')}", flush=True)
+        return 1 if failed else 0
     if not args.allow_escalation:
         from horovod_tpu.core.config import Config
         from horovod_tpu.ops.native_controller import (

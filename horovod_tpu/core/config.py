@@ -182,6 +182,21 @@ HOROVOD_RECONNECT_ATTEMPTS = "HOROVOD_RECONNECT_ATTEMPTS"
 HOROVOD_RECONNECT_BACKOFF = "HOROVOD_RECONNECT_BACKOFF_S"
 HOROVOD_RECONNECT_MAX_BACKOFF = "HOROVOD_RECONNECT_MAX_BACKOFF_S"
 
+# --- data-plane integrity plane (horovod_tpu.integrity; ours) ----------------
+# Collective numerical-health sentry over reduced gradients
+# (docs/integrity.md): off (default) / warn / skip / zero / abort. The
+# verdict is itself collective (a one-element finite-bit exchange over the
+# controller wire), so skip/zero decisions are bit-identical on every rank
+# and can never desync the world. Unknown values fail loudly at engine
+# construction.
+HOROVOD_GRAD_SENTRY = "HOROVOD_GRAD_SENTRY"
+# Cross-rank consensus verification cadence: every N fused allreduce
+# batches each rank digests its post-allreduce gradients and piggybacks
+# the digest on the next negotiation message; the coordinator compares
+# and a mismatch escalates as a structured ConsensusError instead of
+# training on silently diverged state. 0 (default) disables.
+HOROVOD_CONSENSUS_INTERVAL = "HOROVOD_CONSENSUS_INTERVAL_STEPS"
+
 # --- observability plane (horovod_tpu.obs; ours, docs/metrics.md) ------------
 # HTTP exposition of the metrics registry on rank 0: Prometheus text at
 # /metrics, JSON snapshot at /metrics.json, loopback-bound. 0 or unset =
@@ -263,6 +278,9 @@ class Config:
     straggler_evict: str = "off"
     straggler_window_s: float = 30.0
     straggler_min_cycles: int = 20
+    # data-plane integrity plane (docs/integrity.md)
+    grad_sentry: str = "off"
+    consensus_interval_steps: int = 0
     # True when HOROVOD_CACHE_CAPACITY was set explicitly: the tuner then
     # treats the capacity knob as pinned (same contract as
     # fusion_threshold_explicit below).
@@ -337,6 +355,10 @@ class Config:
             straggler_window_s=_env_float(HOROVOD_STRAGGLER_WINDOW, 30.0),
             straggler_min_cycles=max(
                 _env_int(HOROVOD_STRAGGLER_MIN_CYCLES, 20), 1),
+            grad_sentry=(os.environ.get(HOROVOD_GRAD_SENTRY, "off")
+                         .strip().lower() or "off"),
+            consensus_interval_steps=max(
+                _env_int(HOROVOD_CONSENSUS_INTERVAL, 0), 0),
             cache_capacity_explicit=bool(
                 os.environ.get(HOROVOD_CACHE_CAPACITY)),
             start_timeout_s=_env_float(

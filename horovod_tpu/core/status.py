@@ -64,6 +64,15 @@ class Status:
         if self.type in (StatusType.OK, StatusType.IN_PROGRESS):
             return
         reason = self.reason or self.type.name
+        # Integrity-plane verdicts first (docs/integrity.md): their tags
+        # are more specific than the aborted-ranks tag a consensus reason
+        # may also carry for the elastic driver's benefit.
+        consensus = parse_consensus(reason)
+        if consensus is not None:
+            raise ConsensusError(consensus[0], consensus[1], reason)
+        nonfinite = parse_nonfinite(reason)
+        if nonfinite is not None:
+            raise NonFiniteGradError(nonfinite[0], nonfinite[1], reason)
         ranks = parse_aborted_ranks(reason)
         if ranks is not None:
             raise RanksAbortedError(ranks, reason)
@@ -129,6 +138,44 @@ class RanksAbortedError(HorovodInternalError):
         self.ranks = sorted(set(ranks))
 
 
+class NonFiniteGradError(HorovodInternalError):
+    """A reduced gradient carried NaN/Inf and the numerical-health sentry
+    runs with ``HOROVOD_GRAD_SENTRY=abort`` (docs/integrity.md).
+
+    The verdict behind it is collective (a finite-bit exchange over the
+    controller wire), so every rank raises this on the SAME step ordinal
+    — the structured alternative to letting a poisoned step reach the
+    optimizer state of every rank. ``step`` is the sentry's batch ordinal
+    (1-based, identical across ranks); ``tensor_names`` the non-finite
+    tensors of that batch. Subclasses ``HorovodInternalError`` so the
+    elastic driver's world-fault classification relaunches through the
+    PR-2 path."""
+
+    def __init__(self, step: int, tensor_names: List[str],
+                 message: str) -> None:
+        super().__init__(message)
+        self.step = step
+        self.tensor_names = list(tensor_names)
+
+
+class ConsensusError(HorovodInternalError):
+    """Cross-rank consensus verification failed: after an allreduce that
+    must leave every rank bit-identical, the ranks' post-allreduce
+    digests disagreed (docs/integrity.md) — the silent-data-corruption
+    class (host bit flips, rank desync) that otherwise trains forever on
+    diverged state. ``ranks`` names the outlier ranks (judged against the
+    coordinator's authoritative combine digest on the host data plane,
+    majority vote elsewhere); ``tensor_names`` the tensors whose digests
+    diverged. Subclasses ``HorovodInternalError`` so existing handlers —
+    and the elastic relaunch-and-restore path — keep working."""
+
+    def __init__(self, ranks: List[int], tensor_names: List[str],
+                 message: str) -> None:
+        super().__init__(message)
+        self.ranks = sorted(set(ranks))
+        self.tensor_names = list(tensor_names)
+
+
 # Machine-parseable tag embedded in abort reasons so every layer the
 # message travels through (status flush, watch-channel push, engine-loop
 # rewrap) preserves attribution. format/parse are the single source of
@@ -168,6 +215,54 @@ def parse_aborted_ranks(message: str,
     if m is not None:
         return [int(m.group(1))]
     return None
+
+
+# Integrity-plane tags (docs/integrity.md), same contract as the
+# aborted-ranks tag: format/parse are the single source of truth for the
+# wire text, so the verdict survives every rewrap between the controller
+# and the waiter that finally raises.
+_CONSENSUS_TAG_RE = re.compile(
+    r"\[consensus mismatch: ranks ([0-9][0-9,\s]*)\]"
+    r"(?: \[tensors: ([^\]]*)\])?")
+_NONFINITE_TAG_RE = re.compile(
+    r"\[non-finite grad: step (\d+)\](?: \[tensors: ([^\]]*)\])?")
+
+
+def format_consensus(ranks, tensor_names) -> str:
+    """Render the structured consensus-mismatch tag."""
+    tag = "[consensus mismatch: ranks " + ", ".join(
+        str(r) for r in sorted(set(ranks))) + "]"
+    if tensor_names:
+        tag += " [tensors: " + ", ".join(tensor_names) + "]"
+    return tag
+
+
+def parse_consensus(message: str):
+    """``(ranks, tensor_names)`` from a consensus-mismatch reason, or
+    None when the message carries no consensus tag."""
+    m = _CONSENSUS_TAG_RE.search(message)
+    if m is None:
+        return None
+    ranks = [int(tok) for tok in m.group(1).replace(",", " ").split()]
+    names = [n.strip() for n in (m.group(2) or "").split(",") if n.strip()]
+    return sorted(set(ranks)), names
+
+
+def format_nonfinite(step: int, tensor_names) -> str:
+    """Render the structured non-finite-gradient tag."""
+    tag = f"[non-finite grad: step {step}]"
+    if tensor_names:
+        tag += " [tensors: " + ", ".join(tensor_names) + "]"
+    return tag
+
+
+def parse_nonfinite(message: str):
+    """``(step, tensor_names)`` from a sentry-abort reason, or None."""
+    m = _NONFINITE_TAG_RE.search(message)
+    if m is None:
+        return None
+    names = [n.strip() for n in (m.group(2) or "").split(",") if n.strip()]
+    return int(m.group(1)), names
 
 
 def failure_record(exc: BaseException, traceback_str: str) -> dict:

@@ -136,6 +136,15 @@ class State:
         self._commit_no += 1
         _maybe_inject_fault(self._commit_no)
         self._committed = self._snapshot()
+        # Consensus verification of the recovery point itself
+        # (docs/integrity.md): every rank folds the committed tree's
+        # digest into its live consensus window, so relaunch-and-restore
+        # can never resume from state the ranks did not actually agree
+        # on. No-op when HOROVOD_CONSENSUS_INTERVAL_STEPS is unset or no
+        # engine is running.
+        from ..integrity.consensus import observe_commit
+
+        observe_commit(self._committed, self._commit_no)
         if basics.rank() == 0:
             self._push_commit()
 

@@ -1,0 +1,38 @@
+"""Data-plane integrity plane (docs/integrity.md).
+
+Three coupled pieces guarding the invariant the rest of the system only
+assumes — that after every allreduce all ranks hold bit-identical
+reduced gradients and therefore bit-identical parameters:
+
+* :mod:`.sentry` — the collective numerical-health sentry
+  (``HOROVOD_GRAD_SENTRY=off|warn|skip|zero|abort``) over reduced
+  gradients, on both the eager fused-buffer flushes and guarded SPMD
+  reductions; verdicts are themselves collective, so skip/zero
+  decisions can never desync the world.
+* :mod:`.consensus` — cross-rank digest verification every
+  ``HOROVOD_CONSENSUS_INTERVAL_STEPS`` fused batches (and of
+  ``elastic.State`` on commit), escalating mismatches as structured
+  :class:`~horovod_tpu.core.status.ConsensusError` through the elastic
+  relaunch-and-restore path.
+* data-plane chaos (``horovod_tpu.chaos``: ``nan@rankN:everyK`` /
+  ``flipbits@rankN:everyK``) injected at the host-side fused-buffer
+  boundary — the verifiable ground truth for both checks.
+"""
+
+from __future__ import annotations
+
+from ..core.status import ConsensusError, NonFiniteGradError
+from .consensus import (
+    ConsensusAuthority,
+    ConsensusJudge,
+    DigestAccumulator,
+    observe_commit,
+    tree_digest,
+)
+from .sentry import POLICIES, GradSentry, spmd_guard, validate_policy
+
+__all__ = [
+    "ConsensusAuthority", "ConsensusError", "ConsensusJudge",
+    "DigestAccumulator", "GradSentry", "NonFiniteGradError", "POLICIES",
+    "observe_commit", "spmd_guard", "tree_digest", "validate_policy",
+]

@@ -1,0 +1,314 @@
+"""Cross-rank consensus verification of post-allreduce state.
+
+"All ranks hold bit-identical averaged gradients after every allreduce"
+is the invariant everything else in synchronous data parallelism rests
+on (1802.05799) — and nothing used to check it. Every
+``HOROVOD_CONSENSUS_INTERVAL_STEPS`` fused allreduce batches each rank
+digests the post-allreduce bytes it actually received (and, on commit,
+its ``elastic.State`` tree) and piggybacks the digest window on its next
+negotiation message (``RequestList``/``CacheRequest`` — the PR-3
+cache-bit precedent for growing the cycle wire). The coordinator
+compares:
+
+* on the host data plane it holds an AUTHORITY digest — the combined
+  buffer it framed for every rank — so a mismatch names the exact
+  outlier rank even in a 2-rank world;
+* elsewhere (XLA data plane, windows carrying state items) it falls
+  back to majority vote across ranks; with no majority every
+  disagreeing rank is named.
+
+A mismatch escalates through the controller's abort machinery as a
+structured :class:`core.status.ConsensusError` (ranks, tensor names) —
+relaunch-and-restore through the elastic plane beats training on
+silently diverged state. The native C++ controller wire predates the
+digest field and degrades deterministically to local-only digesting
+with a one-time warning, exactly like metrics/clock-sync did.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.logging import LOG
+from ..obs.registry import registry as _metrics
+
+# Observability plane (docs/metrics.md): windows emitted by this rank,
+# windows judged by the coordinator, and mismatches per outlier rank.
+_CONSENSUS_WINDOWS = _metrics().counter(
+    "horovod_consensus_windows_total",
+    "Digest windows this rank emitted to the coordinator")
+_CONSENSUS_CHECKS = _metrics().counter(
+    "horovod_consensus_checks_total",
+    "Digest windows the coordinator compared across all ranks")
+_CONSENSUS_MISMATCHES = _metrics().counter(
+    "horovod_consensus_mismatches_total",
+    "Consensus mismatches, labelled by the outlier rank",
+    labels=("rank",))
+
+# Digest item kinds: "batch" items compare positionally against the
+# coordinator's authority stream; "state" items (elastic.State commits)
+# only exist rank-side and compare rank-vs-rank.
+BATCH = "batch"
+STATE = "state"
+
+
+def digest_bytes(*chunks: bytes) -> str:
+    """16-hex-char blake2b — collision odds are irrelevant at gradient
+    cadence, wire size is not (the digest rides every Nth cycle)."""
+    h = hashlib.blake2b(digest_size=8)
+    for chunk in chunks:
+        h.update(chunk)
+    return h.hexdigest()
+
+
+def tree_digest(tree) -> str:
+    """Deterministic digest of a committed state pytree: per-leaf bytes +
+    dtype/shape, folded in flatten order (tree_flatten sorts dict keys,
+    so identical trees digest identically on every rank)."""
+    import jax
+
+    h = hashlib.blake2b(digest_size=8)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    h.update(str(treedef).encode())
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            a = np.asarray(leaf)
+            h.update(str((a.dtype, a.shape)).encode())
+            h.update(np.ascontiguousarray(a).tobytes())
+        else:
+            h.update(repr(leaf).encode())
+    return h.hexdigest()
+
+
+class DigestAccumulator:
+    """Rank-side half: folds executed allreduce batches (and external
+    state commits) into digest windows of ``interval`` batches; completed
+    windows are drained by the engine onto the next cycle message.
+
+    A window tuple on the wire::
+
+        (ordinal, [(kind, names, hexdigest), ...])
+
+    Batches land in negotiated execution order — identical on every rank
+    — so window N's item list is positionally comparable across ranks."""
+
+    def __init__(self, interval: int) -> None:
+        if interval < 1:
+            raise ValueError(
+                f"HOROVOD_CONSENSUS_INTERVAL_STEPS must be >= 1 to arm "
+                f"consensus verification (got {interval})")
+        self.interval = interval
+        self._ordinal = 0
+        self._batches = 0
+        self._items: List[Tuple[str, Tuple[str, ...], str]] = []
+        self._pending: List[tuple] = []
+        self.windows_emitted = 0
+
+    def observe_batch(self, names: Sequence[str], results) -> None:
+        """Digest one reduced allreduce batch (pre-sentry: the bytes as
+        received — a sentry rewrite is collective and would only mask the
+        divergence this plane exists to catch)."""
+        blobs = [np.ascontiguousarray(np.asarray(r)).tobytes()
+                 for r in results]
+        self._items.append(
+            (BATCH, tuple(names), digest_bytes(*blobs)))
+        self._batches += 1
+        if self._batches >= self.interval:
+            self._close_window()
+
+    def observe_state(self, name: str, hexdigest: str) -> None:
+        """External item (elastic.State commit): joins the current window
+        without advancing the batch count, so window boundaries stay
+        aligned with the coordinator's authority stream."""
+        self._items.append((STATE, (name,), hexdigest))
+
+    def _close_window(self) -> None:
+        self._ordinal += 1
+        self._pending.append((self._ordinal, list(self._items)))
+        self._items = []
+        self._batches = 0
+        self.windows_emitted += 1
+        _CONSENSUS_WINDOWS.inc()
+
+    def drain(self) -> Optional[List[tuple]]:
+        """Completed windows to piggyback on the next cycle message (None
+        when nothing is pending — the common case, keeping the wire
+        untouched between windows)."""
+        if not self._pending:
+            return None
+        out, self._pending = self._pending, []
+        return out
+
+
+class ConsensusAuthority:
+    """Coordinator-side authority stream: digests of the combined buffers
+    the host-plane payload exchange framed — the value every rank SHOULD
+    have received. Window boundaries mirror the rank accumulators (every
+    ``interval`` allreduce combines), and every item carries the batch's
+    tensor names: the judge only trusts an authority item whose names
+    match the rank item at the same position, so a world where SOME
+    batches bypass the payload exchange (device-plane reductions beside
+    host-path fallbacks) can never be judged against the wrong batches —
+    unmatched positions fall back to the rank-majority compare.
+    Thread-safe: payload combines run on handler threads."""
+
+    def __init__(self, interval: int) -> None:
+        self.interval = interval
+        self._lock = threading.Lock()
+        self._ordinal = 0
+        self._batches = 0
+        self._items: List[Tuple[Tuple[str, ...], str]] = []
+        self.windows: Dict[int, List[Tuple[Tuple[str, ...], str]]] = {}
+
+    def observe_combine(self, names, combined: bytes) -> None:
+        with self._lock:
+            self._items.append((tuple(names), digest_bytes(combined)))
+            self._batches += 1
+            if self._batches >= self.interval:
+                self._ordinal += 1
+                self.windows[self._ordinal] = self._items
+                self._items = []
+                self._batches = 0
+                # bounded memory: judged windows are popped by the judge;
+                # keep a sliding guard against a world that never ships
+                # digests (consensus off on the ranks)
+                stale = self._ordinal - 64
+                self.windows.pop(stale, None)
+
+    def take(self, ordinal: int):
+        with self._lock:
+            return self.windows.pop(ordinal, None)
+
+
+class ConsensusJudge:
+    """Coordinator-side comparison: one verdict per (window ordinal) once
+    every rank's digest arrived. Authority compare per batch position
+    when the authority saw the same number of batches; rank-majority
+    otherwise (XLA data plane, or windows carrying state items)."""
+
+    # A window still short of the full rank set after this many NEWER
+    # windows piled up will never complete: one rank's interval knob
+    # drifted and it ships digests on a different cadence (or never).
+    MAX_PENDING = 64
+
+    def __init__(self, size: int,
+                 authority: Optional[ConsensusAuthority] = None) -> None:
+        self._size = size
+        self._authority = authority
+        self._pending: Dict[int, Dict[int, list]] = {}
+        self._stale_warned = False
+        self.mismatches = 0
+
+    def submit(self, rank: int, windows: List[tuple]
+               ) -> Optional[Tuple[List[int], List[str]]]:
+        """Feed one rank's drained windows; returns ``(outlier_ranks,
+        tensor_names)`` on the first mismatching window, else None."""
+        verdict = None
+        for ordinal, items in windows:
+            slot = self._pending.setdefault(int(ordinal), {})
+            slot[int(rank)] = list(items)
+            if len(slot) < self._size:
+                continue
+            del self._pending[int(ordinal)]
+            _CONSENSUS_CHECKS.inc()
+            bad = self._judge(int(ordinal), slot)
+            if bad is not None and verdict is None:
+                verdict = bad
+        # Bounded memory + a loud diagnosis for the reverse desync of the
+        # one _judge_consensus warns about: a rank that never (or on a
+        # different cadence) ships digests leaves every window one short
+        # — verification silently never runs while the operator believes
+        # it does, and pending windows pile up for the life of the job.
+        while len(self._pending) > self.MAX_PENDING:
+            stale = min(self._pending)
+            short = self._pending.pop(stale)
+            if not self._stale_warned:
+                self._stale_warned = True
+                missing = sorted(set(range(self._size)) - set(short))
+                LOG.warning(
+                    "consensus: window %d never received digests from "
+                    "rank(s) %s and was dropped unjudged; "
+                    "HOROVOD_CONSENSUS_INTERVAL_STEPS must resolve "
+                    "identically on every rank — cross-rank "
+                    "verification is NOT running.",
+                    stale, ", ".join(map(str, missing)))
+        return verdict
+
+    def _judge(self, ordinal: int, slot: Dict[int, list]
+               ) -> Optional[Tuple[List[int], List[str]]]:
+        ranks = sorted(slot)
+        lengths = {len(slot[r]) for r in ranks}
+        if len(lengths) != 1:
+            # structurally diverged windows: the ranks did not even agree
+            # on what executed — name everyone, there is no arbiter
+            return ranks, []
+        n_items = lengths.pop()
+        authority = {}
+        if self._authority is not None:
+            auth_items = self._authority.take(ordinal)
+            batch_positions = [i for i in range(n_items)
+                               if slot[ranks[0]][i][0] == BATCH]
+            if auth_items is not None and \
+                    len(auth_items) == len(batch_positions):
+                # trust an authority item ONLY when its batch names match
+                # the rank item at that position: in a mixed data-plane
+                # world some rank batches never rode the payload exchange
+                # and the two streams slip out of phase — an unmatched
+                # position must fall to the rank-majority compare, never
+                # be judged against the wrong batch's digest
+                for pos, (auth_names, auth_digest) in zip(
+                        batch_positions, auth_items):
+                    if tuple(slot[ranks[0]][pos][1]) == auth_names:
+                        authority[pos] = auth_digest
+        outliers: set = set()
+        names: List[str] = []
+        for i in range(n_items):
+            values = {r: slot[r][i][2] for r in ranks}
+            item_names = list(slot[ranks[0]][i][1])
+            if i in authority:
+                ref = authority[i]
+            else:
+                # majority vote; a tie (2-rank world off the host plane)
+                # has no arbiter — every disagreeing rank is named
+                counts: Dict[str, int] = {}
+                for v in values.values():
+                    counts[v] = counts.get(v, 0) + 1
+                ref, ref_n = max(counts.items(), key=lambda kv: kv[1])
+                if ref_n <= len(ranks) // 2 and len(counts) > 1:
+                    outliers.update(values)
+                    names.extend(item_names)
+                    continue
+            bad = [r for r, v in values.items() if v != ref]
+            if bad:
+                outliers.update(bad)
+                names.extend(item_names)
+        if not outliers:
+            return None
+        for r in sorted(outliers):
+            _CONSENSUS_MISMATCHES.labels(rank=r).inc()
+        self.mismatches += 1
+        # dedup names, preserve order
+        seen: set = set()
+        names = [n for n in names if not (n in seen or seen.add(n))]
+        return sorted(outliers), names
+
+
+def observe_commit(tree, commit_no: int) -> None:
+    """elastic.State hook: fold a committed tree's digest into the live
+    engine's consensus window (no-op when consensus is off or no engine
+    is running — worlds outside run_elastic keep committing locally)."""
+    from ..ops import engine as _engine_mod
+
+    eng = _engine_mod._engine
+    acc = getattr(eng, "_consensus_acc", None) if eng is not None else None
+    if acc is None:
+        return
+    try:
+        acc.observe_state(f"elastic.state.commit.{commit_no}",
+                          tree_digest(tree))
+    except Exception as exc:  # noqa: BLE001 - audit must not kill a commit
+        LOG.warning("consensus: state-commit digest failed: %s", exc)

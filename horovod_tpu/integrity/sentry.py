@@ -1,0 +1,276 @@
+"""Collective numerical-health sentry over reduced gradients.
+
+Synchronous data parallelism's core invariant (1802.05799 §2) is that
+after every allreduce all ranks hold identical averaged gradients; a
+single NaN/Inf entering that exchange poisons the optimizer state of
+every rank forever. The sentry (``HOROVOD_GRAD_SENTRY``) screens every
+reduced allreduce batch on the eager plane (``ops.engine``) and every
+guarded SPMD reduction (``ops.spmd``) and applies one of four policies:
+
+* ``warn``  — log + count, hand the values through unchanged.
+* ``skip``  — zero EVERY tensor of the poisoned batch, so the optimizer
+              step it feeds is a no-op (the reference-world idiom for
+              "discard the step": ``params += lr * 0``).
+* ``zero``  — zero only the non-finite tensors of the batch; finite
+              siblings keep their values.
+* ``abort`` — raise a structured :class:`core.status.NonFiniteGradError`
+              through the PR-2 elastic abort path.
+
+The verdict is COLLECTIVE: each rank ships its per-tensor finite bits
+through a one-element controller rendezvous (OR across ranks, see
+``ControllerService``'s ``sentry`` request) before applying the policy,
+so skip/zero decisions are bit-identical on every rank and can never
+desync the world — a rank whose local copy alone went bad (host bit
+flip) is handled exactly like a NaN every rank can see. Where the
+exchange is unavailable (size-1 worlds, the native controller's binary
+wire, which predates the RPC) the sentry degrades deterministically to
+the local verdict with a one-time warning — NaN propagates through a
+sum, so the local views agree for every fault the reduction itself can
+carry.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.logging import LOG
+from ..obs.registry import registry as _metrics
+
+POLICIES = ("off", "warn", "skip", "zero", "abort")
+
+# Observability plane (docs/metrics.md): trips are the operational
+# signal ("is the data plane numerically healthy?"), checks make the
+# clean-world zero-false-positive claim falsifiable (trips==0 is only
+# meaningful when checks>0).
+_SENTRY_TRIPS = _metrics().counter(
+    "horovod_sentry_trips_total",
+    "Non-finite reduced batches caught by the gradient sentry",
+    labels=("policy", "kind"))
+_SENTRY_CHECKS = _metrics().counter(
+    "horovod_sentry_checks_total",
+    "Reduced allreduce batches screened by the gradient sentry")
+
+
+def validate_policy(policy: str) -> str:
+    """A typo'd sentry policy silently checking nothing would certify
+    nothing: unknown values fail LOUDLY at construction."""
+    if policy not in POLICIES:
+        raise ValueError(
+            f"unknown HOROVOD_GRAD_SENTRY policy {policy!r}; expected one "
+            f"of {'|'.join(POLICIES)}")
+    return policy
+
+
+def _local_bad(arr, probe=None) -> Tuple[bool, str]:
+    """(non-finite?, kind) of one reduced tensor. Integer/bool dtypes are
+    finite by construction. ``probe`` (the XLA plane's device-side
+    census, ``XlaDataPlane.nonfinite_counts``) screens device-resident
+    results by syncing two scalars; numpy results — and plane-less
+    worlds — check host-side."""
+    dtype = np.dtype(arr.dtype)
+    if not np.issubdtype(dtype, np.floating):
+        return False, ""
+    if probe is not None and not isinstance(arr, np.ndarray):
+        n_nan, n_inf = probe(arr)
+        if n_nan:
+            return True, "nan"
+        if n_inf:
+            return True, "inf"
+        return False, ""
+    a = np.asarray(arr)
+    if np.isnan(a).any():
+        return True, "nan"
+    if not np.isfinite(a).all():
+        return True, "inf"
+    return False, ""
+
+
+def _zero_like(arr):
+    """Zero replacement preserving the result's array flavor (the engine
+    hands device results to the finalizer, which expects jax arrays)."""
+    if isinstance(arr, np.ndarray):
+        return np.zeros_like(arr)
+    try:
+        import jax.numpy as jnp
+
+        return jnp.zeros_like(arr)
+    except Exception:  # noqa: BLE001 - non-jax exotic array: numpy wins
+        return np.zeros_like(np.asarray(arr))
+
+
+def pack_bits(bits: Sequence[bool]) -> bytes:
+    """Per-tensor bad bits -> bytes for the verdict exchange."""
+    out = bytearray((len(bits) + 7) // 8)
+    for i, bit in enumerate(bits):
+        if bit:
+            out[i // 8] |= 1 << (i % 8)
+    return bytes(out)
+
+
+def unpack_bits(data: bytes, n: int) -> List[bool]:
+    return [bool(data[i // 8] >> (i % 8) & 1) for i in range(n)]
+
+
+def or_bits(blobs: Sequence[bytes]) -> bytes:
+    """The rendezvous combine: a tensor is bad if ANY rank saw it bad."""
+    width = max(len(b) for b in blobs)
+    out = bytearray(width)
+    for blob in blobs:
+        for i, byte in enumerate(blob):
+            out[i] |= byte
+    return bytes(out)
+
+
+class GradSentry:
+    """Per-engine sentry state: the batch ordinal (1-based; batches
+    execute in negotiated order, so ordinal N names the SAME batch on
+    every rank), the verdict exchange, and the audit trail.
+
+    ``exchange(ordinal, bits) -> bits`` performs the collective OR; None
+    degrades to the local verdict (size-1 worlds / native wire).
+    ``on_trip(record)`` is the timeline hook (one metadata record per
+    trip)."""
+
+    def __init__(self, policy: str,
+                 exchange: Optional[Callable[[int, bytes], bytes]] = None,
+                 on_trip: Optional[Callable[[dict], None]] = None,
+                 probe: Optional[Callable] = None) -> None:
+        self.policy = validate_policy(policy)
+        self._exchange = exchange
+        self._on_trip = on_trip
+        self._probe = probe
+        self.ordinal = 0
+        self.trips: List[Tuple[int, str, str]] = []  # (ordinal, action, kind)
+
+    def screen_batch(self, names: Sequence[str], results: List):
+        """Screen one reduced allreduce batch; returns the (possibly
+        policy-modified) results. Raises ``NonFiniteGradError`` under
+        ``abort``. Must be called for EVERY allreduce batch while armed —
+        the verdict exchange is a rendezvous, and a rank that skipped one
+        would wedge the world (the same every-rank-every-cycle contract
+        the negotiation itself relies on)."""
+        if self.policy == "off":
+            return results
+        self.ordinal += 1
+        _SENTRY_CHECKS.inc()
+        local = [_local_bad(r, self._probe) for r in results]
+        bits = [bad for bad, _ in local]
+        if self._exchange is not None:
+            bits = unpack_bits(
+                self._exchange(self.ordinal, pack_bits(bits)), len(bits))
+        if not any(bits):
+            return results
+        bad_names = [n for n, bad in zip(names, bits) if bad]
+        # kind: nan wins over inf for the label; a tensor bad only on a
+        # PEER rank (collective bit set, local clean) reports as "peer" —
+        # the local arrays cannot say which flavor the peer saw
+        kinds = {k for (bad, k), bit in zip(local, bits) if bit and k}
+        kind = "nan" if "nan" in kinds else ("inf" if kinds else "peer")
+        action = self.policy
+        _SENTRY_TRIPS.labels(policy=self.policy, kind=kind).inc()
+        self.trips.append((self.ordinal, action, kind))
+        record = {"step": self.ordinal, "policy": self.policy,
+                  "kind": kind, "tensors": list(bad_names)}
+        if self._on_trip is not None:
+            try:
+                self._on_trip(record)
+            except Exception:  # noqa: BLE001 - audit must not kill a batch
+                pass
+        if self.policy == "warn":
+            LOG.warning(
+                "grad sentry: non-finite (%s) reduced values in %s at "
+                "step %d; HOROVOD_GRAD_SENTRY=warn hands them through",
+                kind, bad_names, self.ordinal)
+            return results
+        if self.policy == "abort":
+            from ..core.status import NonFiniteGradError, format_nonfinite
+
+            reason = (
+                f"grad sentry: non-finite ({kind}) reduced values at "
+                f"step {self.ordinal}; HOROVOD_GRAD_SENTRY=abort. "
+                f"{format_nonfinite(self.ordinal, bad_names)}")
+            LOG.error("%s", reason)
+            raise NonFiniteGradError(self.ordinal, bad_names, reason)
+        if self.policy == "skip":
+            LOG.warning(
+                "grad sentry: non-finite (%s) values in %s at step %d; "
+                "zeroing the WHOLE batch (skip) — the step it feeds is a "
+                "no-op on every rank", kind, bad_names, self.ordinal)
+            return [_zero_like(r) for r in results]
+        # zero: null only the non-finite tensors
+        LOG.warning(
+            "grad sentry: non-finite (%s) values at step %d; zeroing "
+            "only %s (zero)", kind, self.ordinal, bad_names)
+        return [_zero_like(r) if bad else r
+                for r, bad in zip(results, bits)]
+
+    def stats(self) -> dict:
+        return {"policy": self.policy, "checks": self.ordinal,
+                # whether verdicts actually fold across ranks: a local-
+                # only degrade (native wire, size-1) reads False, so a
+                # test asserting collectivity cannot pass on a silently
+                # unwired exchange
+                "collective": self._exchange is not None,
+                "trips": list(self.trips)}
+
+
+# -- SPMD guard (ops.spmd) ----------------------------------------------------
+
+# Trace-time counter, like the other SPMD families (docs/metrics.md):
+# guarded LOWERINGS, not runtime trips — inside a compiled program the
+# verdict lives on-device, and the policy applies as pure jnp ops.
+_SENTRY_SPMD = _metrics().counter(
+    "horovod_sentry_spmd_guards_total",
+    "SPMD reductions lowered with the gradient sentry guard "
+    "(per trace, not per step)", labels=("policy",))
+
+_spmd_abort_warned = False
+
+
+def spmd_guard(out, operand, axis_name, policy: str):
+    """In-program sentry for the SPMD reduction paths (docs/integrity.md).
+
+    The verdict is collective BY CONSTRUCTION: the bad count of the local
+    operand is psum-med alongside the data, and the reduced output is
+    identical on every rank, so every rank computes the identical verdict
+    and the where-policy below is bit-identical — no exchange needed.
+    Policies map to tensor granularity (one call == one tensor): ``skip``
+    and ``zero`` both zero this tensor on a trip; ``warn`` prints from
+    the device (``jax.debug.print``); ``abort`` cannot raise from inside
+    a compiled program and deterministically degrades to ``skip`` with a
+    one-time trace-time warning."""
+    global _spmd_abort_warned
+    validate_policy(policy)
+    if policy == "off":
+        return out
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops.spmd import _axes
+
+    _SENTRY_SPMD.labels(policy=policy).inc()
+    if not jnp.issubdtype(out.dtype, jnp.floating):
+        return out
+    local_bad = (~jnp.isfinite(operand)).sum()
+    world_bad = local_bad
+    for a in _axes(axis_name):
+        world_bad = lax.psum(world_bad, a)
+    bad = world_bad + (~jnp.isfinite(out)).sum()
+    if policy == "warn":
+        def _say(n):
+            jax.debug.print(
+                "grad sentry (spmd): {n} non-finite elements in a "
+                "guarded reduction (HOROVOD_GRAD_SENTRY=warn)", n=n)
+        lax.cond(bad > 0, _say, lambda n: None, bad)
+        return out
+    if policy == "abort" and not _spmd_abort_warned:
+        _spmd_abort_warned = True
+        LOG.warning(
+            "HOROVOD_GRAD_SENTRY=abort cannot raise from inside a "
+            "compiled SPMD program; degrading to skip (zeroed tensor) "
+            "there — the eager plane keeps the structured abort.")
+    # skip / zero / (degraded) abort: tensor-granularity null
+    return jnp.where(bad > 0, jnp.zeros_like(out), out)

@@ -421,7 +421,16 @@ class _Rendezvous:
         self._aborted: Optional[BaseException] = None
 
     def submit(self, key: Any, rank: int, item: Any,
-               compute: Callable[[Dict[int, Any]], Any]) -> Any:
+               compute: Callable[[Dict[int, Any]], Any],
+               timeout_s: Optional[float] = None,
+               timeout_hint: str = "") -> Any:
+        """``timeout_s`` bounds the wait for the other participants:
+        rendezvous whose counterpart submissions are CONDITIONAL on every
+        rank's config (the sentry verdict exchange) must fail loudly with
+        ``timeout_hint`` naming the diagnosis instead of wedging a world
+        whose configs drifted — cycles/payloads keep the unbounded wait
+        (their participation is the protocol itself, and rank death
+        already aborts them)."""
         with self._cond:
             if self._aborted is not None:
                 raise RuntimeError(str(self._aborted)) from self._aborted
@@ -438,8 +447,16 @@ class _Rendezvous:
                 self._delivered[key] = 0
                 self._cond.notify_all()
             else:
-                self._cond.wait_for(
-                    lambda: key in self._results or self._aborted is not None)
+                arrived = self._cond.wait_for(
+                    lambda: key in self._results or self._aborted is not None,
+                    timeout=timeout_s)
+                if not arrived and key not in self._results and \
+                        self._aborted is None:
+                    missing = sorted(set(range(self._size)) - set(slot))
+                    raise RuntimeError(
+                        f"rendezvous {key!r} timed out after "
+                        f"{timeout_s:.0f}s waiting for ranks "
+                        f"{', '.join(map(str, missing))}. {timeout_hint}")
             if key not in self._results:
                 raise RuntimeError(str(self._aborted)) from self._aborted
             kind, result = self._results[key]
@@ -590,7 +607,8 @@ class ControllerService:
                  fusion_threshold_bytes: Optional[int] = None,
                  reconnect_window_s: Optional[float] = None,
                  straggler_detector=None,
-                 codec_min_bytes: int = 4096) -> None:
+                 codec_min_bytes: int = 4096,
+                 consensus_interval_steps: Optional[int] = None) -> None:
         self._negotiator = negotiator
         self._world_id = world_id
         # Self-healing grace (docs/chaos.md): a rank-bound connection that
@@ -632,6 +650,34 @@ class ControllerService:
         self._fusion_threshold = fusion_threshold_bytes
         self._stall_escalation = StallEscalation(
             stall_shutdown_s, warning_interval_s=stall_warning_s)
+        # Data-plane integrity plane (docs/integrity.md): the sentry
+        # verdict rendezvous (one OR-fold of per-tensor finite bits per
+        # screened batch) always exists — it is two dict slots until a
+        # sentry-armed rank dials in. Consensus compare state only when
+        # the cadence knob arms it; None → same env default the engine's
+        # Config resolves, parsed in one place (the reconnect_window
+        # pattern above).
+        if consensus_interval_steps is None:
+            from ..core.config import Config
+
+            consensus_interval_steps = \
+                Config.from_env().consensus_interval_steps
+        self._sentry_rv = _Rendezvous(size)
+        self._consensus_judge = None
+        self._consensus_authority = None
+        if consensus_interval_steps > 0:
+            from ..integrity.consensus import (
+                ConsensusAuthority,
+                ConsensusJudge,
+            )
+
+            # the authority digests host-plane combines as they happen —
+            # it must be live BEFORE the first rank digest arrives (a
+            # window's digest ships one cycle after its batches ran)
+            self._consensus_authority = ConsensusAuthority(
+                consensus_interval_steps)
+            self._consensus_judge = ConsensusJudge(
+                size, authority=self._consensus_authority)
         self._cycles = _Rendezvous(size)
         self._payloads = _Rendezvous(size)
         self._cycle_no = 0
@@ -754,6 +800,7 @@ class ControllerService:
                            f"{format_aborted_ranks([rank])}")
         self._cycles.abort(exc)  # first abort wins inside the rendezvous
         self._payloads.abort(exc)
+        self._sentry_rv.abort(exc)  # a parked verdict can never complete
         with self._lock:
             if self._watch_reason is None:
                 self._watch_reason = str(exc)
@@ -916,8 +963,43 @@ class ControllerService:
             return self._payloads.submit(
                 ("payload", cycle_no, idx), rank, data,
                 lambda slot: Preserialized(
-                    self._service.wire.frame(_combine(resp, slot))))
+                    self._service.wire.frame(
+                        self._combine_payload(resp, slot))))
+        if kind == "sentry":
+            # Gradient-sentry verdict exchange (docs/integrity.md): one
+            # OR-fold of per-tensor finite bits per screened batch, so
+            # skip/zero decisions are bit-identical on every rank. The
+            # batch ordinal keys the rendezvous — batches execute in
+            # negotiated order, so ordinal N is the same batch everywhere.
+            from ..integrity.sentry import or_bits
+
+            _, _, ordinal, bits = req
+            # Bounded wait: a rank whose HOROVOD_GRAD_SENTRY drifted to
+            # "off" never submits, and the default config has no stall
+            # deadline to break the wedge — convert it into a loud,
+            # structured failure instead (the typos-fail-loudly bar).
+            return self._sentry_rv.submit(
+                ("sentry", ordinal), rank, bits,
+                lambda slot: or_bits(list(slot.values())),
+                timeout_s=60.0,
+                timeout_hint=(
+                    "HOROVOD_GRAD_SENTRY must resolve identically on "
+                    "every rank — a disarmed rank never joins the "
+                    "verdict exchange."))
         raise ValueError(f"unknown controller request {kind!r}")
+
+    def _combine_payload(self, resp: Response,
+                         slot: Dict[int, bytes]) -> bytes:
+        """Host-plane combine, with the consensus authority fed on the
+        way out: the combined allreduce buffer is the value every rank
+        SHOULD receive — digesting it here is what lets a mismatch name
+        the exact outlier rank instead of "someone" (docs/integrity.md)."""
+        combined = _combine(resp, slot)
+        if self._consensus_authority is not None and \
+                resp.response_type == ResponseType.ALLREDUCE:
+            self._consensus_authority.observe_combine(resp.tensor_names,
+                                                      combined)
+        return combined
 
     def _current_cycle(self, rank: int) -> int:
         # Each rank participates in every cycle exactly once, in order; a
@@ -990,8 +1072,50 @@ class ControllerService:
                 out.setdefault(req.tensor_name, req)
         return out
 
+    def _judge_consensus(self, slot: Dict[int, Any]):
+        """Feed every rank's piggybacked digest windows to the judge
+        (both message types carry the field); returns the first
+        ``(outlier_ranks, tensor_names)`` verdict, or None."""
+        verdict = None
+        for rank in sorted(slot):
+            windows = getattr(slot[rank], "integrity_digest", None)
+            if not windows:
+                continue
+            if self._consensus_judge is None:
+                if not getattr(self, "_consensus_warned", False):
+                    self._consensus_warned = True
+                    LOG.warning(
+                        "rank %d ships consensus digests but the "
+                        "coordinator's judge is disarmed; "
+                        "HOROVOD_CONSENSUS_INTERVAL_STEPS must resolve "
+                        "identically on every rank", rank)
+                continue
+            v = self._consensus_judge.submit(rank, windows)
+            if v is not None and verdict is None:
+                verdict = v
+        return verdict
+
+    def _escalate_world(self, response_list: ResponseList,
+                        reason: str) -> None:
+        """Shared escalation teardown (stall deadline and consensus
+        mismatch both ride it): latch shutdown + the structured reason on
+        this cycle's response, stop the negotiator, and unpark every
+        channel a dying world could leave blocked — the watch push and
+        any half-filled sentry-verdict rendezvous. Callers construct
+        their own ERROR responses first (the two paths differ there)."""
+        LOG.error("%s", reason)
+        response_list.shutdown = True
+        response_list.abort_reason = reason
+        self._negotiator.request_shutdown()
+        with self._lock:
+            if self._watch_reason is None:
+                self._watch_reason = reason
+        self._watch_event.set()
+        self._sentry_rv.abort(RuntimeError(reason))
+
     def _run_cycle(self, slot: Dict[int, Any],
                    key: Any = None) -> Preserialized:
+        consensus_verdict = self._judge_consensus(slot)
         slot, hit_positions = self._expand_cache_cycle(slot)
         if hit_positions is not None:
             # All-ranks cache hit: replay the cached fused responses —
@@ -1032,19 +1156,39 @@ class ControllerService:
             # including the ranks that never submitted them — to fail its
             # outstanding work naming the missing ranks.
             names, _missing, reason = escalation
-            LOG.error("%s", reason)
             response_list.responses = list(response_list.responses) + [
                 Response(ResponseType.ERROR, tensor_names=[name],
                          error_message=reason) for name in names]
-            response_list.shutdown = True
-            response_list.abort_reason = reason
-            self._negotiator.request_shutdown()
-            with self._lock:
-                if self._watch_reason is None:
-                    self._watch_reason = reason
-            # Unpark watch channels too: a rank blocked inside a compiled
-            # device collective cannot read this cycle response.
-            self._watch_event.set()
+            self._escalate_world(response_list, reason)
+        if consensus_verdict is not None:
+            # Consensus escalation (docs/integrity.md), the stall
+            # escalation's shape: the world holds PROVABLY diverged state,
+            # so executing further data collectives would train on
+            # garbage — this cycle's data responses become ERRORs carrying
+            # the structured reason (every in-flight handle raises
+            # ConsensusError), and the shutdown+abort_reason pair tears
+            # the world down through the same path a stall does. The
+            # aborted-ranks tag rides along so the elastic driver
+            # blacklists the diverged slot on relaunch-and-restore.
+            from ..core.status import format_consensus
+
+            bad_ranks, bad_names = consensus_verdict
+            reason = (
+                f"cross-rank consensus verification failed: post-allreduce "
+                f"state diverged on rank(s) "
+                f"{', '.join(map(str, bad_ranks))}; relaunching beats "
+                f"training on silently corrupted state. "
+                f"{format_consensus(bad_ranks, bad_names)} "
+                f"{SHUT_DOWN_ERROR} {format_aborted_ranks(bad_ranks)}")
+            response_list.responses = [
+                r for r in response_list.responses
+                if r.response_type == ResponseType.ERROR
+            ] + [Response(ResponseType.ERROR,
+                          tensor_names=list(r.tensor_names),
+                          error_message=reason)
+                 for r in response_list.responses
+                 if r.response_type != ResponseType.ERROR]
+            self._escalate_world(response_list, reason)
         if response_list.shutdown:
             # Clean coordinated shutdown: connection drops after this cycle
             # are expected teardown, not rank deaths.
@@ -1416,6 +1560,10 @@ class ControllerClient:
     # The Python service answers "clock_probe" (docs/tracing.md); the
     # engine reads this to decide whether a ClockSync thread can run.
     clock_sync_supported = True
+    # The Python service answers "sentry" verdict exchanges
+    # (docs/integrity.md); the native client's binary wire predates the
+    # RPC and the sentry degrades to local verdicts there (warned once).
+    sentry_exchange_supported = True
 
     def __init__(self, addr,  # (host, port) or {intf: (host, port)}
                  secret: Optional[bytes] = None,
@@ -1529,6 +1677,13 @@ class ControllerClient:
     def payload(self, rank: int, response_idx: int, data: bytes) -> bytes:
         return self._client.request(
             ("payload", rank, self._last_cycle, response_idx, data))
+
+    def sentry(self, rank: int, ordinal: int, bits: bytes) -> bytes:
+        """Gradient-sentry verdict exchange (docs/integrity.md): OR-fold
+        this batch's per-tensor finite bits across every rank. Rides the
+        cycle connection — the engine loop runs batches sequentially, so
+        the request/response sequencing stays strict like payload()."""
+        return self._client.request(("sentry", rank, ordinal, bits))
 
     def watch(self, on_abort: Callable[[str], None]) -> None:
         """Failure-push channel for ranks that can block OUTSIDE the

@@ -94,11 +94,19 @@ class Request:
 
 @dataclass
 class RequestList:
-    """Everything one rank submits in one cycle (``message.h:99-127``)."""
+    """Everything one rank submits in one cycle (``message.h:99-127``).
+
+    ``integrity_digest`` piggybacks the rank's completed consensus digest
+    windows (docs/integrity.md) — ``[(ordinal, [(kind, names, hex)])]``
+    or None between windows — on the cycle it was already paying for,
+    the same wire-growth precedent as the PR-3 cache bits. The native
+    controller wire predates the field (deterministic local-only
+    degrade)."""
 
     rank: int
     requests: List[Request] = field(default_factory=list)
     shutdown: bool = False
+    integrity_digest: Optional[list] = None
 
 
 @dataclass
@@ -181,6 +189,10 @@ class CacheRequest:
     rank: int
     bits: bytes
     generation: int
+    # consensus digest windows (see RequestList.integrity_digest): the
+    # steady-state bypass must keep shipping digests too, or a warm cache
+    # would silently disarm the verification it rides beside
+    integrity_digest: Optional[list] = None
 
 
 @dataclass

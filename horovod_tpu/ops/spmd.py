@@ -51,6 +51,29 @@ def _axes(axis_name: AxisName) -> tuple:
     return (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
 
 
+def _maybe_sentry(out, operand, axis_name):
+    """Numerical-health guard over the SPMD reduction result
+    (docs/integrity.md): when ``HOROVOD_GRAD_SENTRY`` is armed, the
+    non-finite count of the local operand is psum-med alongside the data
+    and the policy applies as pure jnp ops — collective by construction,
+    bit-identical on every rank. The policy is read at TRACE time (env,
+    like every other knob here): a steady training loop re-traces
+    nothing, so flip it before the first step. Only the real-collective
+    paths guard; pre-summed cotangents (vma tracking) never ran a
+    collective here and pass through untouched."""
+    import os
+
+    from ..core import config as _config
+
+    policy = (os.environ.get(_config.HOROVOD_GRAD_SENTRY, "off")
+              .strip().lower() or "off")
+    if policy == "off":
+        return out
+    from ..integrity.sentry import spmd_guard
+
+    return spmd_guard(out, operand, axis_name, policy)
+
+
 def _axis_size(axis_name: AxisName):
     # lax.axis_size exists on every supported JAX: core.jax_compat
     # installs it (from the axis-env frame) on releases that predate it
@@ -126,7 +149,9 @@ def allreduce(x: jax.Array, axis_name: AxisName, average: bool = True) -> jax.Ar
     """
     _SPMD_LOWERINGS.labels(op="allreduce").inc()
     if _varies_over(x, axis_name) or not _vma_tracking_active(axis_name):
-        return lax.pmean(x, axis_name) if average else lax.psum(x, axis_name)
+        out = lax.pmean(x, axis_name) if average \
+            else lax.psum(x, axis_name)
+        return _maybe_sentry(out, x, axis_name)
     return x / _axis_size(axis_name) if average else x
 
 
@@ -205,12 +230,13 @@ def quantized_allreduce(x: jax.Array, axis_name: AxisName,
     if _vma_tracking_active(axis_name) and not _varies_over(x, axis_name):
         # already reduced by the shard_map transpose (see allreduce)
         return x / _axis_size(axis_name) if average else x
-    out = x.astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    out = xf
     for a in _axes(axis_name):
         out = _quantized_axis_sum(out, a, codec)
     if average:
         out = out / _axis_size(axis_name)
-    return out.astype(x.dtype)
+    return _maybe_sentry(out, xf, axis_name).astype(x.dtype)
 
 
 def _quantized_axis_sum(x: jax.Array, axis: str, codec) -> jax.Array:

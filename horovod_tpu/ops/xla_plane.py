@@ -427,6 +427,26 @@ class XlaDataPlane:
             ("trimrows", shape[1:], str(dt), rows, sizes), _build_trim)
         return trim(local)
 
+    def nonfinite_counts(self, arr) -> Tuple[int, int]:
+        """Device-side non-finite census for the gradient sentry
+        (docs/integrity.md): one compiled ``(nan_count, inf_count)``
+        program per dtype, so screening a device-resident reduced batch
+        syncs two scalars instead of pulling the whole buffer to host.
+        Collective-free — safe to run on any rank at any time."""
+        def _build():
+            import jax
+            import jax.numpy as jnp
+
+            def _counts(x):
+                nans = jnp.isnan(x).sum()
+                return nans, (~jnp.isfinite(x)).sum() - nans
+            return jax.jit(_counts)
+
+        fn = self._local_fn(("nonfinite", str(np.dtype(arr.dtype))),
+                            _build)
+        n_nan, n_inf = fn(arr)
+        return int(n_nan), int(n_inf)
+
     def allreduce(self, buf: np.ndarray, codec: str = "none") -> np.ndarray:
         """Sum a flat (possibly fused) buffer across all ranks."""
         wire_dt, out_dt = self._wire_parts(buf.dtype)

@@ -36,6 +36,10 @@ CLOCK_SYNC = "horovod_clock_sync"
 # record per applied knob change on each recording rank, so a trace
 # shows WHEN the world's knobs moved next to the cycles they reshaped.
 AUTOTUNE = "horovod_autotune"
+# Data-plane integrity plane (docs/integrity.md): one INTEGRITY metadata
+# record per sentry trip (step ordinal, policy, kind, tensors), so a
+# trace shows exactly WHICH batch a skip/zero verdict neutralized.
+INTEGRITY = "horovod_integrity"
 
 
 def rank_timeline_path(path: str, rank: int) -> str:

@@ -11,14 +11,25 @@ the root (zero elsewhere). In JAX these arise from the transpose rules of
 resulting semantics against analytic expectations so a regression in the op
 implementations (or a JAX behavior change) is caught.
 
-Global losses are phrased as ``psum(local contribution)`` so "the" loss is
-counted once across the world, matching the reference's single global loss.
+Loss phrasing — the data-parallel convention, deliberately: each shard
+differentiates its LOCAL contribution ``L_i`` to the global loss
+``L = sum_i L_i`` and the collective's own transpose supplies the
+cross-shard fold, exactly how ``DistributedOptimizer`` produces gradients.
+This phrasing is correct under BOTH shard_map tracing regimes: with vma
+typing (newer JAX) the cotangent of an axis-invariant collective output is
+auto-psummed; under legacy tracing (older JAX, or ``check_vma=False``)
+psum's transpose-is-psum supplies the identical fold. The previous
+phrasing — wrapping the loss in an extra ``lax.psum`` to spell out "the"
+global loss — double-counts by the axis size under the legacy transpose
+(each psum transposes to a psum, so the already-folded cotangent gets
+folded again): a real test bug, fixed here, that made all five tests fail
+by exactly a factor of N on pre-vma JAX.
 """
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from horovod_tpu.ops import spmd
@@ -34,15 +45,16 @@ def _run(fn, *args, in_specs, out_specs):
 
 
 def test_allreduce_grad(hvd):
-    """L = psum_i(w_i . allreduce_sum(x)) => dL/dx_j = sum_i w_i, on every
-    shard (allreduce backward == allreduce of cotangents)."""
+    """L = sum_i w_i . allreduce_sum(x) via local contributions
+    L_i = w_i . y => dL/dx_j = sum_i w_i, on every shard (allreduce
+    backward == allreduce of cotangents)."""
     x = jnp.arange(N * 3, dtype=jnp.float32).reshape(N, 3)
     w = jnp.arange(1.0, N + 1)[:, None] * jnp.ones((N, 3))  # shard i -> i+1
 
     def per_shard(x, w):
         def loss(x):
             y = spmd.allreduce(x, DATA_AXIS, average=False)
-            return lax.psum(jnp.vdot(w[0], y), DATA_AXIS)
+            return jnp.vdot(w[0], y)
 
         return jax.grad(loss)(x)
 
@@ -54,13 +66,14 @@ def test_allreduce_grad(hvd):
 
 def test_allreduce_mean_grad(hvd):
     """Average variant: backward divides by the world size
-    (``torch/mpi_ops.py:110-121`` divides the cotangent for average=True)."""
+    (``torch/mpi_ops.py:110-121`` divides the cotangent for average=True).
+    Local contribution L_i = sum(y)/N, so L = sum(y) and dL/dx = 1/N."""
     x = jnp.ones((N, 2), jnp.float32)
 
     def per_shard(x):
         def loss(x):
             y = spmd.allreduce(x, DATA_AXIS, average=True)
-            return lax.psum(y.sum(), DATA_AXIS) / N
+            return y.sum() / N
 
         return jax.grad(loss)(x)
 
@@ -70,9 +83,10 @@ def test_allreduce_mean_grad(hvd):
 
 
 def test_allgather_grad(hvd):
-    """L = psum_i(c_i . allgather(x)) => dL/dx_j = sum_i c_i sliced to
-    shard j's segment (allgather backward == reduce-scatter of cotangents,
-    the local-slice rule of ``test_torch.py:570-611``)."""
+    """L = sum_i c_i . allgather(x) via L_i = c_i . y => dL/dx_j =
+    sum_i c_i sliced to shard j's segment (allgather backward ==
+    reduce-scatter of cotangents, the local-slice rule of
+    ``test_torch.py:570-611``)."""
     k = 2  # rows per shard
     rng = np.random.default_rng(42)
     x = jnp.asarray(rng.standard_normal((N * k, 3)).astype(np.float32))
@@ -81,7 +95,7 @@ def test_allgather_grad(hvd):
     def per_shard(x, c):
         def loss(x):
             y = spmd.allgather(x, DATA_AXIS)  # (N*k, 3) on every shard
-            return lax.psum(jnp.vdot(c[0], y), DATA_AXIS)
+            return jnp.vdot(c[0], y)
 
         return jax.grad(loss)(x)
 
@@ -92,8 +106,8 @@ def test_allgather_grad(hvd):
 
 
 def test_broadcast_grad(hvd):
-    """L = psum_i(c_i . broadcast(x, root)) => dL/dx = sum_i c_i on the
-    root shard, zero elsewhere (``test_torch.py:768-800``)."""
+    """L = sum_i c_i . broadcast(x, root) via L_i = c_i . y => dL/dx =
+    sum_i c_i on the root shard, zero elsewhere (``test_torch.py:768-800``)."""
     root = 2
     x = jnp.ones((N, 4), jnp.float32)
     c = jnp.arange(1.0, N + 1)[:, None] * jnp.ones((N, 4))
@@ -101,7 +115,7 @@ def test_broadcast_grad(hvd):
     def per_shard(x, c):
         def loss(x):
             y = spmd.broadcast(x[0], root, DATA_AXIS)
-            return lax.psum(jnp.vdot(c[0], y), DATA_AXIS)
+            return jnp.vdot(c[0], y)
 
         return jax.grad(loss)(x)
 
@@ -125,7 +139,7 @@ def test_reducescatter_grad(hvd):
     def per_shard(x, c):
         def loss(x):
             y = spmd.reducescatter(x[0], DATA_AXIS)  # (k,) rows per shard
-            return lax.psum(jnp.vdot(c[0], y), DATA_AXIS)
+            return jnp.vdot(c[0], y)
 
         return jax.grad(loss)(x)
 

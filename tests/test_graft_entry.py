@@ -95,6 +95,29 @@ def test_dryrun_chaos_subprocess():
     assert "chaos OK" in result.stderr
 
 
+@pytest.mark.slow
+@pytest.mark.integrity
+def test_dryrun_integrity_subprocess():
+    """The data-plane integrity certification, exactly as the driver
+    invokes it. Slow-tier: the same sentry/consensus machinery is pinned
+    by tests/test_wire_integrity.py's acceptance cells."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    result = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_integrity(); print('OK')"],
+        cwd=root, env=env, capture_output=True, text=True, timeout=420)
+    assert result.returncode == 0, result.stderr
+    assert "OK" in result.stdout
+    assert "integrity OK" in result.stderr
+
+
 def test_init_on_host_cpu_noop_on_cpu():
     """On a CPU default backend the helper defers to plain on-device init
     (None) — there is no separate host backend to shelter compiles on."""

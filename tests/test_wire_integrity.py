@@ -1,0 +1,441 @@
+"""Data-plane integrity plane (docs/integrity.md).
+
+Unit tier: sentry policy semantics and loud validation, the verdict-bit
+wire helpers, data-plane chaos grammar/determinism, the consensus
+accumulator/judge (authority and majority paths, state items), and the
+SPMD in-program guard on the virtual 8-device mesh. Multi-process tier:
+the collective-verdict contract (identical skip decision on the
+identical step ordinal on every rank, bit-exact final state), the
+flipbits→ConsensusError escalation naming the outlier, and the clean
+world's zero-false-positive claim.
+
+Named to sort PAST test_tune.py — the 870 s tier-1 budget truncates the
+suite alphabetically (ROADMAP operational note), so the multi-process
+cells here cost tier-1 nothing; run the battery with ``-m integrity``.
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.chaos import ChaosInjector, ChaosSpecError, parse_chaos_spec
+from horovod_tpu.integrity import (
+    ConsensusAuthority,
+    ConsensusJudge,
+    DigestAccumulator,
+    GradSentry,
+    tree_digest,
+)
+from horovod_tpu.integrity.sentry import or_bits, pack_bits, unpack_bits
+
+pytestmark = pytest.mark.integrity
+
+
+# -- sentry units -------------------------------------------------------------
+
+def test_sentry_policy_validation_is_loud():
+    with pytest.raises(ValueError, match="HOROVOD_GRAD_SENTRY"):
+        GradSentry("skipp")
+
+
+def test_sentry_skip_zeroes_whole_batch():
+    s = GradSentry("skip")
+    out = s.screen_batch(
+        ["a", "b"], [np.array([1.0, np.nan]), np.array([2.0, 3.0])])
+    assert all((np.asarray(r) == 0).all() for r in out)
+    assert s.trips == [(1, "skip", "nan")]
+
+
+def test_sentry_zero_nulls_only_bad_tensors():
+    s = GradSentry("zero")
+    out = s.screen_batch(
+        ["a", "b"], [np.array([np.inf]), np.array([2.0, 3.0])])
+    assert (np.asarray(out[0]) == 0).all()
+    np.testing.assert_array_equal(out[1], [2.0, 3.0])
+    assert s.trips == [(1, "zero", "inf")]
+
+
+def test_sentry_warn_hands_values_through():
+    s = GradSentry("warn")
+    bad = np.array([np.nan, 1.0])
+    out = s.screen_batch(["a"], [bad])
+    assert out[0] is bad
+    assert s.trips == [(1, "warn", "nan")]
+
+
+def test_sentry_abort_raises_structured_error():
+    from horovod_tpu.core.status import NonFiniteGradError
+
+    s = GradSentry("abort")
+    s.screen_batch(["a"], [np.ones(2)])  # clean batch: no trip
+    with pytest.raises(NonFiniteGradError) as exc:
+        s.screen_batch(["a"], [np.array([np.nan])])
+    assert exc.value.step == 2
+    assert exc.value.tensor_names == ["a"]
+
+
+def test_sentry_clean_batches_trip_nothing():
+    s = GradSentry("skip")
+    for i in range(5):
+        out = s.screen_batch(["g"], [np.full(4, float(i))])
+        np.testing.assert_array_equal(out[0], np.full(4, float(i)))
+    assert s.trips == [] and s.ordinal == 5
+
+
+def test_sentry_integer_batches_are_finite_by_construction():
+    s = GradSentry("abort")
+    out = s.screen_batch(["i"], [np.array([1, 2], np.int32)])
+    np.testing.assert_array_equal(out[0], [1, 2])
+    assert s.trips == []
+
+
+def test_sentry_collective_verdict_overrides_clean_local_view():
+    """The collectivity contract in miniature: a rank whose LOCAL copy is
+    clean must still apply the policy when the exchanged verdict says a
+    peer saw the tensor bad — that is exactly the desync the one-element
+    exchange exists to prevent."""
+    def peer_saw_bad(ordinal, bits):
+        return or_bits([bits, pack_bits([True])])
+
+    s = GradSentry("skip", exchange=peer_saw_bad)
+    out = s.screen_batch(["g"], [np.ones(4)])
+    assert (np.asarray(out[0]) == 0).all()
+    assert s.trips == [(1, "skip", "peer")]
+
+
+def test_verdict_bits_roundtrip_and_or():
+    bits = [True, False, True, False, False, False, False, False, True]
+    assert unpack_bits(pack_bits(bits), len(bits)) == bits
+    combined = or_bits([pack_bits([True, False, False]),
+                        pack_bits([False, False, True])])
+    assert unpack_bits(combined, 3) == [True, False, True]
+
+
+# -- data-plane chaos units ---------------------------------------------------
+
+def test_chaos_grammar_accepts_data_kinds():
+    plan = parse_chaos_spec("nan@rank1:msg3,flipbits@rank0:every4,seed:9")
+    assert [r.describe() for r in plan.rules] == [
+        "nan@rank1:msg3", "flipbits@rank0:every4"]
+    with pytest.raises(ChaosSpecError):
+        parse_chaos_spec("nan@rank1")  # missing trigger
+    with pytest.raises(ChaosSpecError):
+        parse_chaos_spec("flipbits@relaunch:1")  # not a refuse scope
+
+
+def test_data_faults_fire_on_batch_ordinals_deterministically():
+    def run():
+        inj = ChaosInjector(
+            parse_chaos_spec("nan@rank0:msg2,flipbits@rank0:msg3"), 0)
+        buf = np.arange(4, dtype=np.float32)
+        events = []
+        for _ in range(4):
+            inj.begin_batch()
+            b = inj.on_reduce_input(buf)
+            o = inj.on_reduce_output(np.array(buf))
+            events.append((bool(np.isnan(b).any()),
+                           not np.array_equal(o, buf)))
+        return events, list(inj.events)
+
+    first, events1 = run()
+    second, events2 = run()
+    assert first == second  # bit-identical replay
+    assert first == [(False, False), (True, False), (False, True),
+                     (False, False)]
+    assert events1 == events2 == [("nan", 2), ("flipbits", 3)]
+
+
+def test_flipbits_stays_finite_and_nan_respects_dtype():
+    inj = ChaosInjector(parse_chaos_spec("flipbits@rank0:every1"), 0)
+    buf = np.arange(1.0, 5.0, dtype=np.float32)
+    inj.begin_batch()
+    out = inj.on_reduce_output(buf)
+    assert not np.array_equal(out, buf)
+    assert np.isfinite(out).all()  # the SILENT corruption class
+    # nan never fires into an integer wire, and records no phantom event
+    inj2 = ChaosInjector(parse_chaos_spec("nan@rank0:every1"), 0)
+    inj2.begin_batch()
+    ints = inj2.on_reduce_input(np.arange(4, dtype=np.int32))
+    np.testing.assert_array_equal(ints, np.arange(4, dtype=np.int32))
+    assert inj2.events == []
+
+
+def test_data_and_wire_ordinal_domains_are_independent():
+    inj = ChaosInjector(
+        parse_chaos_spec("drop@rank0:msg2,nan@rank0:msg2"), 0)
+    assert inj.has_data_rules()
+    # two wire requests, one batch: the wire msg2 arms, the data msg2
+    # must NOT (its domain saw only ordinal 1)
+    inj.begin_request()
+    inj.begin_request()
+    assert "drop" in inj._armed
+    inj.begin_batch()
+    assert "nan" not in inj._armed_data
+
+
+# -- consensus units ----------------------------------------------------------
+
+def test_accumulator_windows_on_interval():
+    acc = DigestAccumulator(2)
+    acc.observe_batch(["a"], [np.ones(4, np.float32)])
+    assert acc.drain() is None  # window incomplete
+    acc.observe_batch(["b"], [np.zeros(4, np.float32)])
+    windows = acc.drain()
+    assert len(windows) == 1
+    ordinal, items = windows[0]
+    assert ordinal == 1 and [i[0] for i in items] == ["batch", "batch"]
+    assert acc.drain() is None  # drained exactly once
+
+
+def test_judge_authority_names_exact_outlier_in_two_rank_world():
+    good = np.ones(8, np.float32)
+    bad = good.copy()
+    bad[0] = np.float32(1.0000001)
+    auth = ConsensusAuthority(1)
+    auth.observe_combine(["g"], good.tobytes())
+    judge = ConsensusJudge(2, authority=auth)
+    a0, a1 = DigestAccumulator(1), DigestAccumulator(1)
+    a0.observe_batch(["g"], [good])
+    a1.observe_batch(["g"], [bad])
+    assert judge.submit(0, a0.drain()) is None
+    assert judge.submit(1, a1.drain()) == ([1], ["g"])
+
+
+def test_judge_ignores_out_of_phase_authority_items():
+    """Mixed data-plane worlds: rank accumulators digest EVERY allreduce
+    batch but the authority only sees host-payload combines, so the two
+    streams can slip out of phase with matching counts. An authority
+    item whose batch names differ from the rank item at that position
+    must be IGNORED (rank-majority instead) — never compared, or a
+    healthy world aborts on digests of the wrong batches."""
+    onchip = np.ones(8, np.float32)  # reduced on-device: authority blind
+    hosted = np.full(8, 2.0, np.float32)
+    auth = ConsensusAuthority(1)
+    # the authority's window 1 carries the HOSTED batch; the ranks'
+    # window 1 carries the ONCHIP batch (different names)
+    auth.observe_combine(["hosted"], hosted.tobytes())
+    judge = ConsensusJudge(2, authority=auth)
+    verdict = None
+    for rank in range(2):
+        acc = DigestAccumulator(1)
+        acc.observe_batch(["onchip"], [onchip])
+        v = judge.submit(rank, acc.drain())
+        verdict = v or verdict
+    assert verdict is None  # ranks agree; the stale authority never votes
+
+
+def test_judge_majority_without_authority():
+    good = np.ones(8, np.float32)
+    bad = np.zeros(8, np.float32)
+    judge = ConsensusJudge(3)
+    verdict = None
+    for rank, arr in enumerate((good, good, bad)):
+        acc = DigestAccumulator(1)
+        acc.observe_batch(["t"], [arr])
+        v = judge.submit(rank, acc.drain())
+        verdict = v or verdict
+    assert verdict == ([2], ["t"])
+
+
+def test_judge_clean_world_no_verdict():
+    good = np.ones(8, np.float32)
+    auth = ConsensusAuthority(1)
+    auth.observe_combine(["g"], good.tobytes())
+    judge = ConsensusJudge(2, authority=auth)
+    for rank in range(2):
+        acc = DigestAccumulator(1)
+        acc.observe_batch(["g"], [good])
+        assert judge.submit(rank, acc.drain()) is None
+    assert judge.mismatches == 0
+
+
+def test_state_commit_items_compare_rank_vs_rank():
+    """elastic.State commit digests join the window as 'state' items;
+    diverged committed trees are named even though the coordinator's
+    authority stream never saw them."""
+    t_good = {"w": np.arange(4, dtype=np.float32), "step": 3}
+    t_bad = {"w": np.arange(4, dtype=np.float32) + 1e-6, "step": 3}
+    judge = ConsensusJudge(2)
+    accs = [DigestAccumulator(1), DigestAccumulator(1)]
+    for acc, tree in zip(accs, (t_good, t_bad)):
+        # the commit lands mid-window; the next batch closes it — the
+        # same deterministic stream position on every rank
+        acc.observe_state("elastic.state.commit.3", tree_digest(tree))
+        acc.observe_batch(["g"], [np.ones(4, np.float32)])
+    assert judge.submit(0, accs[0].drain()) is None
+    verdict = judge.submit(1, accs[1].drain())
+    assert verdict is not None
+    ranks, names = verdict
+    assert names == ["elastic.state.commit.3"]
+    assert ranks == [0, 1]  # a 2-rank tie has no arbiter off-authority
+
+
+def test_tree_digest_is_order_insensitive_and_value_sensitive():
+    t1 = {"a": np.ones(3, np.float32), "b": 7}
+    t2 = {"b": 7, "a": np.ones(3, np.float32)}
+    assert tree_digest(t1) == tree_digest(t2)
+    t2["a"] = t2["a"] + np.float32(1e-7)
+    assert tree_digest(t1) != tree_digest(t2)
+
+
+# -- sentry verdict RPC over the real controller wire -------------------------
+
+def test_sentry_rpc_or_folds_across_ranks_on_the_real_wire():
+    """The end-to-end pin NaN propagation cannot fake: over a REAL
+    ControllerService + ControllerClient pair, a rank whose local view
+    is CLEAN receives the bad bit its peer submitted — the exchange, not
+    the local check, is what makes the verdict collective."""
+    import threading
+
+    from horovod_tpu.core.config import Config
+    from horovod_tpu.ops.controller import (
+        ControllerClient,
+        ControllerService,
+        make_negotiator,
+    )
+
+    secret = b"integrity-test-secret-integrity!"
+    cfg = Config()
+    service = ControllerService(2, make_negotiator(2, cfg), secret=secret,
+                                consensus_interval_steps=0)
+    clients = [ControllerClient(("127.0.0.1", service.port),
+                                secret=secret, rank=r, timeout_s=10.0)
+               for r in range(2)]
+    try:
+        results = {}
+
+        def exchange(rank, bits):
+            results[rank] = clients[rank].sentry(rank, 1, bits)
+
+        threads = [threading.Thread(
+            target=exchange,
+            args=(r, pack_bits([r == 1])))  # only rank 1 sees it bad
+            for r in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert unpack_bits(results[0], 1) == [True], results
+        assert unpack_bits(results[1], 1) == [True], results
+    finally:
+        for c in clients:
+            c.close()
+        service.shutdown()
+
+
+def test_sentry_rpc_config_drift_fails_loudly_not_wedged():
+    """A rank whose HOROVOD_GRAD_SENTRY drifted to off never joins the
+    verdict exchange; the armed rank's rendezvous must surface a loud
+    structured diagnosis within its bound — never a wedge (the repo's
+    hang-free escalation contract)."""
+    from horovod_tpu.ops.controller import _Rendezvous
+
+    # unit-level: the bounded rendezvous itself (fast timeout)
+    rv = _Rendezvous(2)
+    with pytest.raises(RuntimeError, match="GRAD_SENTRY"):
+        rv.submit(("sentry", 1), 0, b"\x00", lambda s: b"\x00",
+                  timeout_s=0.2,
+                  timeout_hint="HOROVOD_GRAD_SENTRY must resolve "
+                               "identically on every rank")
+
+def _spmd_guarded_sum(poison_shard=None):
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops import spmd
+    from horovod_tpu.parallel import DATA_AXIS, data_parallel_mesh
+
+    N = 8
+    x = np.ones((N, 4), np.float32)
+    if poison_shard is not None:
+        x[poison_shard, 0] = np.nan
+    mesh = data_parallel_mesh()
+
+    def per_shard(x):
+        return spmd.allreduce(x, DATA_AXIS, average=False)
+
+    out = jax.jit(shard_map(per_shard, mesh=mesh,
+                            in_specs=(P(DATA_AXIS),),
+                            out_specs=P(DATA_AXIS)))(jnp.asarray(x))
+    return np.asarray(out)
+
+
+def test_spmd_guard_zeroes_poisoned_reduction(hvd, monkeypatch):
+    monkeypatch.setenv("HOROVOD_GRAD_SENTRY", "skip")
+    out = _spmd_guarded_sum(poison_shard=3)
+    # one shard's NaN poisons the sum; the guard's collective verdict
+    # zeroes the tensor identically on every shard
+    assert (out == 0).all()
+
+
+def test_spmd_guard_passes_clean_reduction(hvd, monkeypatch):
+    monkeypatch.setenv("HOROVOD_GRAD_SENTRY", "skip")
+    out = _spmd_guarded_sum()
+    np.testing.assert_array_equal(out, np.full((8, 4), 8.0, np.float32))
+
+
+# -- multi-process tier (sorts past the tier-1 truncation point) --------------
+
+def test_mp_sentry_verdicts_are_collective_and_bit_exact():
+    """THE acceptance pin (ISSUE 8): with ``nan@rank1`` only, rank 0 and
+    rank 1 take the IDENTICAL skip decision on the IDENTICAL step
+    ordinal (no world desync), and the final accumulator is bit-exact to
+    a clean run that excludes the poisoned step."""
+    from horovod_tpu.chaos.matrix import (
+        DATA_POISON_ORDINAL,
+        run_data_cell,
+    )
+
+    cell = run_data_cell(f"nan@rank1:msg{DATA_POISON_ORDINAL}", "skip", 0,
+                         "healed")
+    assert cell["outcome"] == "healed", cell
+    trips = [r["sentry"]["trips"] for r in cell["results"]]
+    assert trips[0] == trips[1] == [
+        (DATA_POISON_ORDINAL, "skip", "nan")], cell
+    # only rank 1 carried the injection (a NaN does propagate through
+    # the sum, so identical LOCAL views would also agree here — the
+    # fail-open regression is pinned by the `collective` flag below plus
+    # test_sentry_rpc_* and the clean-local-view unit)
+    events = {r["rank"]: r["chaos_events"] for r in cell["results"]}
+    assert events[1] and not events[0], cell
+    # every rank's verdict actually rode the exchange: an engine that
+    # silently failed open to local-only verdicts cannot pass this
+    assert all(r["sentry"]["collective"] for r in cell["results"]), cell
+
+
+def test_mp_flipbits_escalates_as_consensus_error_naming_rank():
+    from horovod_tpu.chaos.matrix import (
+        DATA_POISON_ORDINAL,
+        run_data_cell,
+    )
+
+    cell = run_data_cell(f"flipbits@rank1:msg{DATA_POISON_ORDINAL}",
+                         "off", 1, "escalated")
+    assert cell["outcome"] == "escalated", cell
+    named = [r for r in cell.get("results", [])
+             if r.get("error_type") == "ConsensusError"]
+    assert named, cell
+    assert all(r["consensus_ranks"] == [1] for r in named), cell
+
+
+def test_mp_clean_world_zero_false_positives():
+    from horovod_tpu.chaos.matrix import run_data_cell
+
+    cell = run_data_cell("seed:1", "skip", 1, "healed")
+    assert cell["outcome"] == "healed", cell
+    for r in cell["results"]:
+        assert r["sentry"]["trips"] == [], r
+        assert r["sentry"]["checks"] > 0, r
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("cell_idx", [1, 2, 3])
+def test_mp_data_grid_slow(cell_idx):
+    """The remaining fault-kind x policy grid cells (zero / warn /
+    abort); the skip and consensus cells run in tier-1 above."""
+    from horovod_tpu.chaos.matrix import DATA_GRID, run_data_cell
+
+    spec, policy, consensus, expect = DATA_GRID[cell_idx]
+    cell = run_data_cell(spec, policy, consensus, expect)
+    assert cell["outcome"] == expect, cell

@@ -10,8 +10,28 @@
 # HEAL there; the native controller's binary wire has no dedup, so faults
 # escalate by design (--allow-escalation). Extra args are forwarded to
 # horovod_tpu.chaos.matrix (e.g. --spec "drop@rank1:every5" --steps 16).
+#
+# --data-plane runs the data-plane integrity grid instead
+# (docs/integrity.md): nan/flipbits faults x sentry policy / consensus
+# cells, swept over both negotiation cores (the sentry verdict RPC and
+# the digest wire need the Python controller, so only
+# HOROVOD_NATIVE_CORE varies there).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "--data-plane" ]; then
+  shift
+  rc=0
+  for core in 0 1; do
+    echo "=== data plane: HOROVOD_NATIVE_CONTROLLER=0 HOROVOD_NATIVE_CORE=$core ==="
+    if ! JAX_PLATFORMS=cpu HOROVOD_NATIVE_CONTROLLER=0 \
+        HOROVOD_NATIVE_CORE=$core \
+        python -m horovod_tpu.chaos.matrix --data-plane "$@"; then
+      rc=1
+    fi
+  done
+  exit $rc
+fi
 
 rc=0
 for nc in 0 1; do

@@ -78,6 +78,12 @@ def _render_family(name: str, fam: dict, out) -> None:
 # in the alphabetical world listing hid exactly that.
 TUNING_PREFIXES = ("horovod_autotune_", "horovod_straggler_evict")
 
+# Integrity-plane families (docs/integrity.md) likewise: sentry trips and
+# consensus mismatches are the "is the data plane numerically healthy and
+# bit-identical?" glance — zero trips is only meaningful next to a
+# non-zero check count, so the two must read together.
+INTEGRITY_PREFIXES = ("horovod_sentry_", "horovod_consensus_")
+
 
 def _render_section(title: str, families: Dict[str, dict], prefix: str,
                     out, skip: tuple = ()) -> None:
@@ -98,6 +104,16 @@ def _render_tuning_section(families: Dict[str, dict], prefix: str,
     if not tuning:
         return  # no tuning plane in this snapshot: no empty section
     _render_section("tuning plane", tuning, prefix, out)
+
+
+def _render_integrity_section(families: Dict[str, dict], prefix: str,
+                              out) -> None:
+    integrity = {n: f for n, f in families.items()
+                 if n.startswith(INTEGRITY_PREFIXES)
+                 and n.startswith(prefix)}
+    if not integrity:
+        return  # no integrity plane in this snapshot: no empty section
+    _render_section("integrity plane", integrity, prefix, out)
 
 
 def main(argv=None) -> int:
@@ -124,8 +140,9 @@ def main(argv=None) -> int:
         world, ranks = doc, {}
 
     _render_tuning_section(world, args.family, sys.stdout)
+    _render_integrity_section(world, args.family, sys.stdout)
     _render_section("world", world, args.family, sys.stdout,
-                    skip=TUNING_PREFIXES)
+                    skip=TUNING_PREFIXES + INTEGRITY_PREFIXES)
     # JSON round-trips rank keys as strings; accept either
     by_rank = {int(k): v for k, v in ranks.items()}
     wanted = sorted(by_rank) if args.all else (

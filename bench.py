@@ -463,6 +463,15 @@ def _parse_args(argv=None):
                              "— docs/autotune.md). Governs the eager "
                              "control plane; SPMD steps have no cycles "
                              "to tune.")
+    parser.add_argument("--subbuffers", type=int, default=0,
+                        help="generation-ordered sub-buffer flush count "
+                             "for the eager data plane "
+                             "(HOROVOD_FUSION_SUBBUFFERS=N, "
+                             "docs/tensor-fusion.md): >=2 overlaps "
+                             "backprop compute with in-flight allreduce; "
+                             "achieved overlap ratio lands in the BENCH "
+                             "json. Governs the eager control plane; "
+                             "SPMD steps overlap inside XLA.")
     parser.add_argument("--grad-sentry", default="",
                         choices=["", "off", "warn", "skip", "zero",
                                  "abort"],
@@ -536,7 +545,8 @@ def _supervise(args) -> None:
         (["--timeline-dir", args.timeline_dir] if args.timeline_dir
          else []) + \
         (["--autotune"] if args.autotune else []) + \
-        (["--grad-sentry", args.grad_sentry] if args.grad_sentry else [])
+        (["--grad-sentry", args.grad_sentry] if args.grad_sentry else []) + \
+        (["--subbuffers", str(args.subbuffers)] if args.subbuffers else [])
     import signal
     import subprocess as sp
 
@@ -674,6 +684,16 @@ def main() -> None:
         _log(f"grad sentry armed: "
              f"HOROVOD_GRAD_SENTRY={os.environ['HOROVOD_GRAD_SENTRY']} "
              f"(trip counters land in the BENCH json)")
+
+    if args.subbuffers:
+        # Sub-buffer flush pipelining (docs/tensor-fusion.md): like
+        # --grad-sentry, BEFORE hvd.init() reads the config; setdefault
+        # so an operator's explicit pin wins.
+        os.environ.setdefault("HOROVOD_FUSION_SUBBUFFERS",
+                              str(args.subbuffers))
+        _log(f"sub-buffer flush armed: HOROVOD_FUSION_SUBBUFFERS="
+             f"{os.environ['HOROVOD_FUSION_SUBBUFFERS']} (overlap ratio "
+             f"lands in the BENCH json)")
 
     if args.autotune:
         # Closed-loop tuning plane (docs/autotune.md): like --timeline-dir,
@@ -864,6 +884,8 @@ def main() -> None:
         provenance["int8_allreduce"] = True
     if args.grad_sentry:
         provenance["grad_sentry"] = args.grad_sentry
+    if args.subbuffers:
+        provenance["subbuffers"] = args.subbuffers
 
     for i in range(args.num_iters):
         t0 = time.perf_counter()
@@ -922,6 +944,24 @@ def main() -> None:
         result["sentry_checks"] = _total("horovod_sentry_checks_total")
         result["sentry_spmd_guards"] = _total(
             "horovod_sentry_spmd_guards_total")
+    if args.subbuffers:
+        # overlap audit beside the number (docs/tensor-fusion.md): the
+        # eager engine's achieved overlap ratio and pipeline depth. Read
+        # off the LIVE engine only — the SPMD bench loop itself has no
+        # eager cycles, and spinning an engine up just to report zeros
+        # would be a side effect, not provenance.
+        from horovod_tpu.ops import engine as _engine_mod
+
+        eng = _engine_mod._engine
+        ov = eng.overlap_stats() if eng is not None else {
+            "flushes": 0, "inflight_peak": 0, "overlap_seconds": 0.0,
+            "execute_busy_seconds": 0.0}
+        busy = ov["execute_busy_seconds"]
+        result["subbuffer_flushes"] = ov["flushes"]
+        result["flush_inflight_peak"] = ov["inflight_peak"]
+        result["overlap_seconds"] = round(ov["overlap_seconds"], 6)
+        result["overlap_ratio"] = round(
+            ov["overlap_seconds"] / busy, 4) if busy > 0 else 0.0
     # cost_analysis() reports the per-device SPMD program's flops — and for
     # a lax.scan program it must count the loop BODY once, not times the
     # trip count, or mfu/tflops inflate by scan_batches. One body == one

@@ -14,11 +14,20 @@ planes on a 2-process world:
   dispatches into one and pads into the bucketed compile cache.
 
 Usage:  python benchmarks/fusion_bench.py [--tensors 64] [--elems 25000]
-                                          [--rounds 12]
+                                          [--rounds 12] [--subbuffers 1,2,4]
 
-Prints one table row per (plane, threshold) with tensors/s and speedup.
-The driver for each world is this same file re-executed with
-``HOROVOD_RANK`` set (the launcher-env protocol of ``core/topology.py``).
+Prints one table row per (plane, threshold) with tensors/s and speedup,
+then the sub-buffer OVERLAP table (docs/tensor-fusion.md): tensors/s,
+achieved overlap ratio (measured negotiate-while-flushing seconds over
+flush-execute seconds, off the obs registry), and peak in-flight depth
+per ``HOROVOD_FUSION_SUBBUFFERS`` count. Wire bytes in the main table
+are MEASURED per round off the obs registry counters (the single
+accounting definition: ``horovod_eager_wire_bytes_post_total`` on the
+device plane, ``horovod_wire_tx/rx_bytes_total`` on the host TCP plane);
+the analytic model survives only in the codec footer, which has no timed
+world to measure. The driver for each world is this same file
+re-executed with ``HOROVOD_RANK`` set (the launcher-env protocol of
+``core/topology.py``).
 """
 
 from __future__ import annotations
@@ -60,6 +69,15 @@ def _worker() -> None:
     n_elems = int(os.environ["FUSION_BENCH_ELEMS"])
     rounds = int(os.environ["FUSION_BENCH_ROUNDS"])
     hvd.init()
+
+    # Wire-byte measurement off the obs registry (docs/metrics.md): one
+    # accounting definition shared with /metrics and the BENCH json,
+    # instead of this file re-deriving bucket math that can drift.
+    from horovod_tpu.obs import registry as _registry
+
+    def _fam_total(snap, family):
+        fam = snap.get(family)
+        return sum(s["value"] for s in fam["samples"]) if fam else 0
     if os.environ.get("FUSION_BENCH_INPUT") == "jax":
         # device-resident submissions: on the xla plane these ride the
         # on-chip pack→psum→unpack path with zero host transfers
@@ -85,13 +103,30 @@ def _worker() -> None:
 
     one_round("warm0")  # warm the compile cache / connections
     one_round("warm1")
+    snap0 = _registry().snapshot()
     t0 = time.perf_counter()
     for r in range(rounds):
         one_round(str(r))
     dt = time.perf_counter() - t0
+    snap1 = _registry().snapshot()
+    # per-rank wire bytes this run actually moved during the timed
+    # rounds: device plane = estimated on-wire bucket bytes; host plane =
+    # bytes crossing the TCP wire both ways (payloads + cycle metadata —
+    # that IS the host plane's wire)
+    wire = _fam_total(snap1, "horovod_eager_wire_bytes_post_total") - \
+        _fam_total(snap0, "horovod_eager_wire_bytes_post_total")
+    if wire == 0:
+        wire = sum(_fam_total(snap1, f) - _fam_total(snap0, f)
+                   for f in ("horovod_wire_tx_bytes_total",
+                             "horovod_wire_rx_bytes_total"))
+    from horovod_tpu.ops.engine import get_engine
+
+    overlap = get_engine().overlap_stats()
     if hvd.rank() == 0:
         print(json.dumps({"seconds": dt,
-                          "tensors_per_s": rounds * n_tensors / dt}))
+                          "tensors_per_s": rounds * n_tensors / dt,
+                          "wire_bytes_per_round": wire / rounds,
+                          "overlap": overlap}))
     hvd.shutdown()
 
 
@@ -150,7 +185,9 @@ def _wire_bytes_per_round(plane: str, threshold: int, tensors: int,
     return total
 
 
-def _run_world(plane: str, threshold: int, args, tensor_input="numpy") -> dict:
+def _run_world(plane: str, threshold: int, args, tensor_input="numpy",
+               subbuffers: int = 1,
+               force_python_controller: bool = False) -> dict:
     port = _free_port()
     coord = f"127.0.0.1:{_free_port()}" if plane == "xla" else ""
     procs = []
@@ -164,6 +201,7 @@ def _run_world(plane: str, threshold: int, args, tensor_input="numpy") -> dict:
             "HOROVOD_DATA_PLANE": plane,
             "HOROVOD_FUSION_THRESHOLD": str(threshold),
             "HOROVOD_CYCLE_TIME": "1",
+            "HOROVOD_FUSION_SUBBUFFERS": str(subbuffers),
             "FUSION_BENCH_WORKER": "1",
             "FUSION_BENCH_TENSORS": str(args.tensors),
             "FUSION_BENCH_ELEMS": str(args.elems),
@@ -171,6 +209,13 @@ def _run_world(plane: str, threshold: int, args, tensor_input="numpy") -> dict:
             "FUSION_BENCH_JAX_COORD": coord,
             "FUSION_BENCH_INPUT": tensor_input,
         })
+        if subbuffers > 1 or force_python_controller:
+            # the flush pipeline needs the Python controller wire
+            # (ops/engine._arm_flush_pipeline degrade rule); the overlap
+            # table pins it for its subbuffers=1 BASELINE too, so the
+            # speedup column measures sub-buffering alone, not a
+            # native-vs-Python controller swap
+            env["HOROVOD_NATIVE_CONTROLLER"] = "0"
         procs.append(subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
@@ -188,6 +233,10 @@ def main() -> None:
     parser.add_argument("--elems", type=int, default=25_000,
                         help="float32 elements per tensor (~100 KB)")
     parser.add_argument("--rounds", type=int, default=12)
+    parser.add_argument("--subbuffers", default="1,2,4",
+                        help="comma-separated HOROVOD_FUSION_SUBBUFFERS "
+                             "counts for the overlap table (empty skips "
+                             "it; docs/tensor-fusion.md)")
     args = parser.parse_args()
 
     mb = args.tensors * args.elems * 4 / 1e6
@@ -207,10 +256,36 @@ def main() -> None:
                 base = r["tensors_per_s"]
             label = "0" if threshold == 0 else "64MiB"
             name = plane if tensor_input == "numpy" else f"{plane}+jax"
-            wire_mb = _wire_bytes_per_round(plane, threshold, args.tensors,
-                                            args.elems) / 1e6
+            # measured per-rank wire bytes off the obs registry (one
+            # accounting definition with /metrics and the BENCH json)
+            wire_mb = r["wire_bytes_per_round"] / 1e6
             print(f"{name:<10} {label:>10} {r['tensors_per_s']:>10.0f} "
                   f"{r['tensors_per_s'] / base:>7.1f}x {wire_mb:>9.1f}M",
+                  flush=True)
+
+    # Sub-buffer overlap table (docs/tensor-fusion.md): step time and
+    # ACHIEVED overlap ratio — measured negotiate-while-flushing seconds
+    # over flush-execute seconds, straight off the engine's pipeline
+    # counters — per HOROVOD_FUSION_SUBBUFFERS count on the host plane
+    # (the fused threshold; sub-buffering generalizes the single flush).
+    counts = [int(c) for c in args.subbuffers.split(",") if c.strip()]
+    if counts:
+        print(f"\n# sub-buffer overlap (host plane, 64MiB threshold)")
+        print(f"{'subbuffers':>10} {'tensors/s':>10} {'speedup':>8} "
+              f"{'overlap':>8} {'inflight':>8}")
+        base = None
+        for n_sub in counts:
+            r = _run_world("host", 64 * 1024 * 1024, args,
+                           subbuffers=n_sub,
+                           force_python_controller=True)
+            if base is None:
+                base = r["tensors_per_s"]
+            ov = r["overlap"]
+            busy = ov["execute_busy_seconds"]
+            ratio = ov["overlap_seconds"] / busy if busy > 0 else 0.0
+            print(f"{n_sub:>10} {r['tensors_per_s']:>10.0f} "
+                  f"{r['tensors_per_s'] / base:>7.1f}x "
+                  f"{100 * ratio:>6.0f}% {ov['inflight_peak']:>8}",
                   flush=True)
     # codec byte ledger (no timed run: byte accounting is analytic; the
     # timed int8 world needs >=2 jax processes and is covered by

@@ -210,6 +210,15 @@ HOROVOD_METRICS_PORT = "HOROVOD_METRICS_PORT"
 # outright, and world snapshots then carry the calling rank only.
 HOROVOD_METRICS_INTERVAL = "HOROVOD_METRICS_INTERVAL_S"
 
+# Generation-ordered sub-buffer flush (docs/tensor-fusion.md; ours, the
+# T3-style compute/collective overlap on the eager plane): cut each cycle
+# tick's pending queue into up to N arrival-ordered sub-buffers that
+# negotiate and flush independently, keeping >=2 negotiate/execute cycles
+# in flight so cycle k+1's negotiation overlaps cycle k's allreduce.
+# 1 (default) keeps the single-flush barrier bit-exactly; >=2 requires the
+# Python controller wire (the cache-bit / metrics-RPC degrade pattern).
+HOROVOD_FUSION_SUBBUFFERS = "HOROVOD_FUSION_SUBBUFFERS"
+
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:1838
 DEFAULT_CACHE_CAPACITY = 1024  # upstream response_cache.cc default
 DEFAULT_CYCLE_TIME_MS = 5.0  # operations.cc:1846
@@ -251,6 +260,10 @@ class Config:
 
     fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
     cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+    # generation-ordered sub-buffer flush (docs/tensor-fusion.md): 1 keeps
+    # the single-flush barrier; explicit values pin the autotune knob
+    fusion_subbuffers: int = 1
+    fusion_subbuffers_explicit: bool = False
     timeline_path: str = ""
     timeline_mark_cycles: bool = False
     timeline_all_ranks: bool = False
@@ -315,6 +328,10 @@ class Config:
             fusion_threshold_bytes=_env_int(
                 HOROVOD_FUSION_THRESHOLD, DEFAULT_FUSION_THRESHOLD_BYTES),
             cycle_time_ms=_env_float(HOROVOD_CYCLE_TIME, DEFAULT_CYCLE_TIME_MS),
+            fusion_subbuffers=max(
+                _env_int(HOROVOD_FUSION_SUBBUFFERS, 1), 1),
+            fusion_subbuffers_explicit=bool(
+                os.environ.get(HOROVOD_FUSION_SUBBUFFERS)),
             timeline_path=os.environ.get(HOROVOD_TIMELINE, ""),
             timeline_mark_cycles=_env_bool(HOROVOD_TIMELINE_MARK_CYCLES),
             timeline_all_ranks=_env_bool(HOROVOD_TIMELINE_ALL_RANKS),

@@ -55,3 +55,15 @@ def install() -> None:
             return frame if isinstance(frame, int) else frame.size
 
         lax.axis_size = axis_size
+
+    if not hasattr(lax, "pcast"):
+        # vma re-typing only exists where vma tracking does; this shim
+        # only installs on releases WITHOUT it (and jax.shard_map above
+        # forces check_rep=False there), where every value is untyped
+        # and "cast to varying" is the identity by construction. On a
+        # current JAX the real pcast is present and this never installs.
+        def pcast(x, axis_name, *, to):
+            del axis_name, to
+            return x
+
+        lax.pcast = pcast

@@ -102,6 +102,10 @@ class DigestAccumulator:
                 f"HOROVOD_CONSENSUS_INTERVAL_STEPS must be >= 1 to arm "
                 f"consensus verification (got {interval})")
         self.interval = interval
+        # Thread-safe: under sub-buffer flush pipelining the engine's
+        # flush worker observes batches while the loop thread drains
+        # completed windows onto the next cycle message.
+        self._lock = threading.Lock()
         self._ordinal = 0
         self._batches = 0
         self._items: List[Tuple[str, Tuple[str, ...], str]] = []
@@ -114,19 +118,22 @@ class DigestAccumulator:
         divergence this plane exists to catch)."""
         blobs = [np.ascontiguousarray(np.asarray(r)).tobytes()
                  for r in results]
-        self._items.append(
-            (BATCH, tuple(names), digest_bytes(*blobs)))
-        self._batches += 1
-        if self._batches >= self.interval:
-            self._close_window()
+        digest = digest_bytes(*blobs)
+        with self._lock:
+            self._items.append((BATCH, tuple(names), digest))
+            self._batches += 1
+            if self._batches >= self.interval:
+                self._close_window()
 
     def observe_state(self, name: str, hexdigest: str) -> None:
         """External item (elastic.State commit): joins the current window
         without advancing the batch count, so window boundaries stay
         aligned with the coordinator's authority stream."""
-        self._items.append((STATE, (name,), hexdigest))
+        with self._lock:
+            self._items.append((STATE, (name,), hexdigest))
 
     def _close_window(self) -> None:
+        # caller holds self._lock
         self._ordinal += 1
         self._pending.append((self._ordinal, list(self._items)))
         self._items = []
@@ -138,10 +145,11 @@ class DigestAccumulator:
         """Completed windows to piggyback on the next cycle message (None
         when nothing is pending — the common case, keeping the wire
         untouched between windows)."""
-        if not self._pending:
-            return None
-        out, self._pending = self._pending, []
-        return out
+        with self._lock:
+            if not self._pending:
+                return None
+            out, self._pending = self._pending, []
+            return out
 
 
 class ConsensusAuthority:

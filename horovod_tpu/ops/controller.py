@@ -1113,8 +1113,38 @@ class ControllerService:
         self._watch_event.set()
         self._sentry_rv.abort(RuntimeError(reason))
 
+    def _check_flush_ordinals(self, slot: Dict[int, Any],
+                              key: Any) -> None:
+        """Cycle-alignment cross-check (docs/tensor-fusion.md): every
+        message carries the sender's own cycle count, and all ranks
+        joined in one rendezvous must name the SAME cycle. The invariant
+        was always load-bearing (rendezvous keys, sentry ordinals,
+        consensus windows, cache-bit positions all assume it) but only
+        implicit; sub-buffer flushing multiplies cycles per step, so a
+        desynced stream now fails loudly naming the ranks instead of
+        silently misaligning batches. The check is RELATIVE (ranks vs
+        each other), not against the coordinator's own counter: tooling
+        legitimately drives fresh short-lived clients — whose counts
+        restart — against a persistent service, and a symmetric restart
+        is not a desync. None (old/native wires) skips that rank."""
+        del key  # the rendezvous key is coordinator bookkeeping, see above
+        stamped = {rank: o for rank, o in
+                   ((rank, getattr(rl, "flush_ordinal", None))
+                    for rank, rl in slot.items()) if o is not None}
+        if len(set(stamped.values())) <= 1:
+            return
+        detail = ", ".join(f"rank {r} at cycle {o}"
+                           for r, o in sorted(stamped.items()))
+        raise RuntimeError(
+            f"negotiation cycle stream desync: {detail} joined one "
+            f"rendezvous; every rank must join every cycle exactly once "
+            f"and in order — a client that skipped or double-counted a "
+            f"cycle would silently misalign sentry ordinals, consensus "
+            f"windows, and cache-bit positions")
+
     def _run_cycle(self, slot: Dict[int, Any],
                    key: Any = None) -> Preserialized:
+        self._check_flush_ordinals(slot, key)
         consensus_verdict = self._judge_consensus(slot)
         slot, hit_positions = self._expand_cache_cycle(slot)
         if hit_positions is not None:
@@ -1313,7 +1343,8 @@ class ControllerService:
                     self._cache_bump_pending = True
                 self._applied_codec = codec
             extras = {k: knobs[k] for k in
-                      ("cache_capacity", "metrics_interval_s", "codec")
+                      ("cache_capacity", "metrics_interval_s", "codec",
+                       "fusion_subbuffers")
                       if k in knobs}
             if extras:
                 self._tuned_knobs = extras
@@ -1609,6 +1640,38 @@ class ControllerClient:
                 addr, secret, timeout_s, connect_attempts,
                 hello=lambda c: c.request(("hello", rank, world_id)),
                 chaos=self._chaos, on_reconnect=self._reconnect_hello)
+        # Sub-buffer flush pipelining (docs/tensor-fusion.md): a second,
+        # dedicated connection for the DATA-side exchanges (payload /
+        # sentry) so an in-flight flush parked in a coordinator rendezvous
+        # never holds the cycle connection's request lock — without it,
+        # rank A's parked payload(k) and rank B's parked cycle(k+1) can
+        # deadlock each other's send (the classic two-channel inversion).
+        # None until the engine opens it; payload()/sentry() then route
+        # over it and cycle() keeps the main connection to itself, which
+        # also keeps the per-cycle negotiation-byte bracket exact.
+        self._data_client: Optional[BasicClient] = None
+        self._timeout_s = timeout_s
+        self._connect_attempts = connect_attempts
+
+    def open_data_channel(self) -> None:
+        """Dial the flush-pipeline data channel (idempotent). Identified
+        like the cycle connection — a hello binds it to the rank, and the
+        service's supersede rule keeps exactly one connection attributed
+        at any time, so rank-death detection is unaffected. Carries its
+        own chaos injector instance (an independent ordinal domain: the
+        cycle channel's replay determinism must not depend on data-plane
+        interleaving)."""
+        if self._data_client is not None:
+            return
+        from ..chaos import injector_from_env
+
+        data_chaos = injector_from_env(self._rank)
+        self._data_client = connect_with_hello(
+            self._addr, self._secret, self._timeout_s,
+            self._connect_attempts,
+            hello=lambda c: c.request(("hello", self._rank,
+                                       self._world_id)),
+            chaos=data_chaos, on_reconnect=self._reconnect_hello)
 
     def _reconnect_hello(self, client) -> None:
         """Re-identify after a transparent reconnect: the superseding
@@ -1654,10 +1717,18 @@ class ControllerClient:
         if self._rank is None:
             self._rank = rank
             self._arm_reconnect_hello()
-        # Negotiation-byte accounting: cycle() and payload() share one
-        # connection but run sequentially on the engine loop thread, so a
-        # delta bracketed around the request counts ONLY this cycle's
-        # metadata bytes (the number the response cache exists to shrink).
+        # Cycle-alignment stamp (docs/tensor-fusion.md): the client's own
+        # cycle count; the coordinator cross-checks the ranks of one
+        # rendezvous against EACH OTHER so a desynced stream fails
+        # loudly (relative check — see _check_flush_ordinals).
+        if hasattr(request_list, "flush_ordinal"):
+            request_list.flush_ordinal = self._cycle_no
+        # Negotiation-byte accounting: without a data channel, cycle() and
+        # payload() share one connection but run sequentially on the
+        # engine loop thread; with one, payloads ride their own wire — in
+        # both cases a delta bracketed around the request counts ONLY this
+        # cycle's metadata bytes (the number the response cache exists to
+        # shrink).
         wire = self._client._wire
         tx0, rx0 = wire.tx_bytes, wire.rx_bytes
         t0 = time.monotonic()
@@ -1674,16 +1745,29 @@ class ControllerClient:
         self._cycle_no += 1
         return out
 
-    def payload(self, rank: int, response_idx: int, data: bytes) -> bytes:
-        return self._client.request(
-            ("payload", rank, self._last_cycle, response_idx, data))
+    def payload(self, rank: int, response_idx: int, data: bytes,
+                cycle_no: Optional[int] = None) -> bytes:
+        """Host-plane payload exchange. ``cycle_no`` names the negotiation
+        cycle the response batch belongs to; the default (the most
+        recently completed cycle) is only correct when execution is
+        serialized behind negotiation — a pipelined flush captures the
+        ordinal at negotiation time and passes it explicitly."""
+        client = self._data_client or self._client
+        return client.request(
+            ("payload", rank,
+             self._last_cycle if cycle_no is None else cycle_no,
+             response_idx, data))
 
     def sentry(self, rank: int, ordinal: int, bits: bytes) -> bytes:
         """Gradient-sentry verdict exchange (docs/integrity.md): OR-fold
         this batch's per-tensor finite bits across every rank. Rides the
         cycle connection — the engine loop runs batches sequentially, so
-        the request/response sequencing stays strict like payload()."""
-        return self._client.request(("sentry", rank, ordinal, bits))
+        the request/response sequencing stays strict like payload() —
+        unless the flush pipeline opened the data channel, in which case
+        it rides there with the payloads it brackets (a verdict parked in
+        the rendezvous must never hold the cycle connection)."""
+        client = self._data_client or self._client
+        return client.request(("sentry", rank, ordinal, bits))
 
     def watch(self, on_abort: Callable[[str], None]) -> None:
         """Failure-push channel for ranks that can block OUTSIDE the
@@ -1715,4 +1799,11 @@ class ControllerClient:
                 self._client.farewell(("bye", self._rank))
             except Exception:  # noqa: BLE001 - controller may already be gone
                 pass
+        if self._data_client is not None:
+            if detach:
+                try:
+                    self._data_client.farewell(("bye", self._rank))
+                except Exception:  # noqa: BLE001 - same as above
+                    pass
+            self._data_client.close()
         self._client.close()

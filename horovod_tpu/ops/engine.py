@@ -26,6 +26,7 @@ import itertools
 import os
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
@@ -64,6 +65,97 @@ _EXECUTE_SECONDS = _obs_registry().histogram(
     "horovod_execute_seconds",
     "Per-response execution time on the engine loop (dispatch + "
     "host-path data movement; device completion is asynchronous)")
+
+# Generation-ordered sub-buffer flush (docs/tensor-fusion.md): the
+# compute/collective overlap the pipeline actually ACHIEVED, measured —
+# seconds the loop thread spent negotiating cycle k+1 while cycle k's
+# flush was executing on the flush worker. The in-flight gauges make the
+# ">= 2 cycles in flight" claim falsifiable.
+_OVERLAP_SECONDS = _obs_registry().counter(
+    "horovod_overlap_seconds_total",
+    "Seconds of negotiation overlapped with an in-flight sub-buffer "
+    "flush (the measured compute/collective overlap)")
+_FLUSH_INFLIGHT = _obs_registry().gauge(
+    "horovod_flush_inflight",
+    "Sub-buffer flushes currently in flight (negotiated, not yet "
+    "executed to completion)")
+_FLUSH_INFLIGHT_PEAK = _obs_registry().gauge(
+    "horovod_flush_inflight_peak",
+    "Peak in-flight sub-buffer flush depth observed by this engine")
+_SUBBUFFER_FLUSHES = _obs_registry().counter(
+    "horovod_subbuffer_flushes_total",
+    "Sub-buffer flushes dispatched through the overlap pipeline")
+
+
+def cut_generations(entries: List["TensorTableEntry"],
+                    n: int) -> List[List["TensorTableEntry"]]:
+    """Cut one cycle tick's drained submissions into up to ``n``
+    generation-ordered sub-buffers (docs/tensor-fusion.md).
+
+    Chunks are CONTIGUOUS in arrival order — backprop produces gradients
+    last-layer-first, so the earliest arrivals form the first sub-buffer
+    and flush while later generations are still being produced (the
+    T3-style overlap, arXiv 2401.16677). Boundaries fall where the
+    cumulative payload crosses ``total * k / n`` so sub-buffers carry
+    roughly equal bytes; every chunk is non-empty and the concatenation
+    of the chunks is exactly the input (no reordering — the negotiated
+    execution order stays the arrival order, which keeps sentry
+    ordinals, consensus windows, and cache positions aligned)."""
+    if not entries:
+        return []
+    n = max(1, min(int(n), len(entries)))
+    if n == 1:
+        return [list(entries)]
+    sizes = [max(int(getattr(e.array, "nbytes", 0) or 0), 1)
+             for e in entries]
+    total = sum(sizes)
+    out: List[List[TensorTableEntry]] = []
+    cur: List[TensorTableEntry] = []
+    acc = 0
+    for i, (entry, size) in enumerate(zip(entries, sizes)):
+        cur.append(entry)
+        acc += size
+        remaining_entries = len(entries) - i - 1
+        remaining_chunks = n - len(out) - 1
+        if remaining_chunks and (
+                acc * n >= total * (len(out) + 1)
+                or remaining_entries == remaining_chunks):
+            out.append(cur)
+            cur = []
+    if cur:
+        out.append(cur)
+    return out
+
+
+class _FlushClock:
+    """Worker-busy accounting for the overlap measurement: the flush
+    worker brackets every flush with ``mark_start``/``mark_end``, and the
+    loop thread reads ``busy_seconds()`` before/after a negotiation — the
+    delta is EXACTLY the worker-busy time inside that window (the single
+    worker thread makes busy intervals disjoint), i.e. the achieved
+    negotiate-while-flushing overlap."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._busy_since: Optional[float] = None
+        self._busy_total = 0.0
+
+    def mark_start(self) -> None:
+        with self._lock:
+            self._busy_since = time.monotonic()
+
+    def mark_end(self) -> None:
+        with self._lock:
+            if self._busy_since is not None:
+                self._busy_total += time.monotonic() - self._busy_since
+                self._busy_since = None
+
+    def busy_seconds(self) -> float:
+        with self._lock:
+            total = self._busy_total
+            if self._busy_since is not None:
+                total += time.monotonic() - self._busy_since
+            return total
 
 
 @dataclass
@@ -571,6 +663,25 @@ class Engine:
         if injector is not None and injector.has_data_rules():
             self._data_chaos = injector
 
+        # Generation-ordered sub-buffer flush (docs/tensor-fusion.md):
+        # with HOROVOD_FUSION_SUBBUFFERS >= 2 the loop cuts each tick's
+        # pending queue into arrival-ordered sub-buffers and keeps up to
+        # that many negotiate/execute cycles in flight — cycle k+1's
+        # negotiation (a cheap cache-bit vector in steady state) overlaps
+        # cycle k's allreduce on the flush worker. 1 (default) keeps
+        # today's single-flush barrier byte-identically: no worker, no
+        # data channel, the untouched loop body.
+        self._subbuffers = cfg.fusion_subbuffers
+        self._flush_worker: Optional[_DevicePlaneWorker] = None
+        self._flush_clock: Optional[_FlushClock] = None
+        self._inflight: "deque" = deque()
+        self._inflight_peak = 0
+        self._flush_count = 0
+        self._overlap_seconds = 0.0
+        self._pipeline_warned = False
+        if self._subbuffers > 1:
+            self._arm_flush_pipeline()
+
         # XLA-plane failure propagation: a rank blocked inside a compiled
         # collective is beyond the reach of a poisoned control-plane
         # response, so subscribe to the controller's abort push channel and
@@ -722,6 +833,104 @@ class Engine:
             timeline=self.timeline,
             interval_s=self._cfg.clock_sync_interval_s)
         self._clock_sync.start()
+
+    # -- sub-buffer flush pipeline (docs/tensor-fusion.md) --------------------
+
+    def _arm_flush_pipeline(self) -> None:
+        """Build the overlap machinery (idempotent): a serial flush
+        worker — execution keeps the negotiated order, the legality
+        invariant — plus the controller client's dedicated data channel,
+        so a flush parked in a payload/sentry rendezvous never holds the
+        cycle connection (the two-channel deadlock). Degrades
+        deterministically (warned once) where the pipeline cannot run:
+        size-1 worlds negotiate in-process (nothing to overlap) and the
+        native controller's binary wire predates the data-channel hello
+        — the same degrade pattern as the cache-bit and metrics RPCs."""
+        if self._flush_worker is not None:
+            return
+        if self._client is None or self._native_controller:
+            if not self._pipeline_warned:
+                self._pipeline_warned = True
+                LOG.warning(
+                    "HOROVOD_FUSION_SUBBUFFERS=%d ignored: sub-buffer "
+                    "flush pipelining needs the Python controller wire in "
+                    "a multi-process world (size-1 worlds negotiate "
+                    "in-process; set HOROVOD_NATIVE_CONTROLLER=0 "
+                    "otherwise). Keeping the single-flush path.",
+                    self._subbuffers)
+            self._subbuffers = 1
+            return
+        self._client.open_data_channel()
+        self._flush_clock = _FlushClock()
+        self._flush_worker = _DevicePlaneWorker()
+        self._flush_worker._thread.name = "horovod-flush-pipeline"
+
+    def _execute_flush(self, responses: List[Response], span_args,
+                       cycle_no: int) -> None:
+        """Flush-worker body: execute one negotiated sub-buffer's
+        responses in order, bracketing the busy clock the loop thread
+        reads the overlap off."""
+        self._flush_clock.mark_start()
+        try:
+            for idx, resp in enumerate(responses):
+                t_exec = time.monotonic()
+                self._execute(idx, resp, span_args=span_args,
+                              cycle_no=cycle_no)
+                _EXECUTE_SECONDS.observe(time.monotonic() - t_exec)
+        finally:
+            self._flush_clock.mark_end()
+
+    def _reap_flushes(self, block: bool = False) -> None:
+        """Retire completed in-flight flushes in order; ``block=True``
+        waits (abortably, like ``_device_call``) for the oldest one — the
+        depth-cap path. A flush whose body raised re-raises HERE, on the
+        loop thread, so the loop's crash path owns the teardown."""
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        while self._inflight:
+            fut = self._inflight[0]
+            if not fut.done() and not block:
+                break
+            if not fut.done():
+                if self._abort_event.is_set():
+                    raise RuntimeError(
+                        self._abort_reason or SHUT_DOWN_ERROR)
+                try:
+                    fut.result(timeout=0.25)
+                except _FutTimeout:
+                    continue
+            self._inflight.popleft()
+            block = False
+            fut.result()  # re-raise a failed flush into the loop
+        _FLUSH_INFLIGHT.set(len(self._inflight))
+
+    def _abandon_flushes(self, timeout_s: float = 15.0) -> None:
+        """Teardown drain: give in-flight flushes a bounded window to
+        finish (their handles must be marked by the worker, not
+        double-flushed), then abandon — the worker is a daemon and the
+        world is over."""
+        deadline = time.monotonic() + timeout_s
+        while self._inflight:
+            fut = self._inflight.popleft()
+            try:
+                fut.result(timeout=max(0.0, deadline - time.monotonic()))
+            except Exception:  # noqa: BLE001 - teardown: best effort
+                pass
+        _FLUSH_INFLIGHT.set(0)
+
+    def overlap_stats(self) -> Dict[str, Any]:
+        """Sub-buffer flush pipeline counters for tests, the dryrun
+        certification, and bench reporting (zeros when single-flush)."""
+        busy = self._flush_clock.busy_seconds() \
+            if self._flush_clock is not None else 0.0
+        return {
+            "subbuffers": self._subbuffers,
+            "pipelined": self._flush_worker is not None,
+            "flushes": self._flush_count,
+            "overlap_seconds": self._overlap_seconds,
+            "execute_busy_seconds": busy,
+            "inflight_peak": self._inflight_peak,
+        }
 
     def _warn_host_fallback(self, op_name: str, tensor_name: str,
                             array: np.ndarray) -> None:
@@ -893,6 +1102,20 @@ class Engine:
                     new_entries, self._submissions = self._submissions, []
                     for entry in new_entries:
                         self._pending[entry.name] = entry
+                if self._flush_worker is not None:
+                    if not stop:
+                        cycle_s, stop_loop = self._pipelined_tick(
+                            new_entries, cycle_s)
+                        if stop_loop:
+                            break
+                        continue
+                    # The shutdown cycle takes the single-flush path
+                    # below; drain the pipeline first so its payload
+                    # exchanges complete before the drain negotiation
+                    # reaches the coordinator (and so a failed flush
+                    # surfaces through the crash path, not silently).
+                    while self._inflight:
+                        self._reap_flushes(block=True)
                 requests = [self._request_of(e) for e in new_entries]
                 request_list = RequestList(
                     rank=self._rank, requests=requests, shutdown=stop)
@@ -952,9 +1175,18 @@ class Engine:
             self._stop_requested = True  # before the flush: an enqueue
             # racing it must be rejected, not parked on a dead loop
             self._crashed = True  # teardown ordering differs, see finally
+            if self._shutdown_reason is None:
+                # post-mortem ops (get_engine on the stopped singleton)
+                # surface this same structured reason
+                self._shutdown_reason = reason
+            # In-flight sub-buffer flushes first (bounded): their entries
+            # must be marked by the worker OR by the outstanding flush
+            # below, never raced between the two.
+            self._abandon_flushes()
             self._flush_outstanding(Status.unknown_error(reason))
         finally:
             self._stop_requested = True
+            self._abandon_flushes()
             if self._clock_sync is not None:
                 self._clock_sync.stop()
             if self._metrics_stop is not None:
@@ -1004,6 +1236,8 @@ class Engine:
                 # best-effort: a worker blocked in a dead collective never
                 # consumes the sentinel, but it is a daemon thread
                 self._device_worker.stop()
+            if self._flush_worker is not None:
+                self._flush_worker.stop()  # same best-effort contract
             if timeline_safe:
                 self.timeline.close()
             else:
@@ -1011,6 +1245,69 @@ class Engine:
                     "finalizer still completing at shutdown; leaving the "
                     "timeline writer open to avoid a write-after-free")
             self._stopped.set()
+
+    def _pipelined_tick(self, new_entries: List[TensorTableEntry],
+                        cycle_s: float):
+        """One wake tick under the sub-buffer flush pipeline
+        (docs/tensor-fusion.md): cut the drained queue into
+        generation-ordered sub-buffers, negotiate each as its own cycle,
+        and hand execution to the flush worker — so the NEXT sub-buffer's
+        negotiation (a cache-bit vector in steady state) runs while the
+        previous one's allreduce is still in flight. Depth is capped at
+        the sub-buffer count; an idle tick still negotiates one empty
+        cycle (the heartbeat every rank owes every cycle). Returns
+        ``(cycle_s, stop_loop)``."""
+        batches = cut_generations(new_entries, self._subbuffers) or [[]]
+        response_list = None
+        for sub in batches:
+            self._reap_flushes()  # fail fast on a crashed flush
+            while len(self._inflight) >= self._subbuffers:
+                self._reap_flushes(block=True)
+            requests = [self._request_of(e) for e in sub]
+            request_list = RequestList(rank=self._rank, requests=requests,
+                                       shutdown=False)
+            busy0 = self._flush_clock.busy_seconds()
+            response_list = self._cycle_with_cache(request_list, requests,
+                                                   False)
+            # the achieved overlap: flush-worker busy seconds inside this
+            # negotiation's wall window (exact — busy intervals are
+            # disjoint on the single worker thread)
+            overlap = self._flush_clock.busy_seconds() - busy0
+            if overlap > 0:
+                _OVERLAP_SECONDS.inc(overlap)
+                self._overlap_seconds += overlap
+            span_args = self._cycle_span_args(response_list)
+            self._span_args = span_args
+            if response_list.responses:
+                fut = self._flush_worker.submit(
+                    self._execute_flush, list(response_list.responses),
+                    span_args, self._client.last_cycle)
+                self._inflight.append(fut)
+                self._flush_count += 1
+                _SUBBUFFER_FLUSHES.inc()
+                depth = len(self._inflight)
+                _FLUSH_INFLIGHT.set(depth)
+                if depth > self._inflight_peak:
+                    self._inflight_peak = depth
+                    _FLUSH_INFLIGHT_PEAK.set(depth)
+                if self.timeline.enabled:
+                    self.timeline.counter("flush_inflight",
+                                          {"inflight": depth})
+            if response_list.shutdown:
+                break
+        self._metrics_bridge.emit()
+        if response_list.tuned_cycle_ms is not None:
+            new_cycle_s = max(response_list.tuned_cycle_ms, 0.1) / 1000.0
+            if new_cycle_s != cycle_s:
+                self._audit_knobs({"cycle_time_ms":
+                                   response_list.tuned_cycle_ms})
+            cycle_s = new_cycle_s
+        if response_list.shutdown:
+            if response_list.abort_reason:
+                self._shutdown_reason = response_list.abort_reason
+            self._abandon_flushes()
+            return cycle_s, True
+        return cycle_s, False
 
     def _cycle_span_args(self, response_list) -> Optional[dict]:
         """Cross-rank correlation stamps for this cycle's span records
@@ -1113,6 +1410,17 @@ class Engine:
                 float(interval) != self._metrics_interval_s:
             self._metrics_interval_s = float(interval)
             changed["metrics_interval_s"] = float(interval)
+        subbuffers = knobs.get("fusion_subbuffers")
+        if subbuffers is not None and int(subbuffers) != self._subbuffers:
+            # the overlap knob (docs/tensor-fusion.md): arms the pipeline
+            # on first use (flush worker + data channel); the next tick
+            # cuts by the new count. Arming runs on the loop thread —
+            # exactly where a retune lands — so no in-flight flush can
+            # observe a half-built pipeline.
+            self._subbuffers = max(int(subbuffers), 1)
+            if self._subbuffers > 1:
+                self._arm_flush_pipeline()
+            changed["fusion_subbuffers"] = self._subbuffers
         codec = knobs.get("codec")
         if codec is not None and \
                 codec != (self._applied_knobs.get("codec") or "none"):
@@ -1230,9 +1538,21 @@ class Engine:
 
     # -- execution ------------------------------------------------------------
 
-    def _execute(self, idx: int, resp: Response) -> None:
+    def _execute(self, idx: int, resp: Response,
+                 span_args: Optional[dict] = None,
+                 cycle_no: Optional[int] = None) -> None:
         """PerformOperation (``operations.cc:768-1621``) for one response,
-        possibly a fused allreduce batch."""
+        possibly a fused allreduce batch.
+
+        ``span_args``/``cycle_no`` are captured at negotiation time by the
+        flush pipeline — executing on the worker thread, the client's
+        "most recent cycle" may already be a LATER one, so the payload
+        exchange and trace stamps must use the ordinal this response was
+        negotiated under. The single-flush path leaves them None (the
+        live values are correct there, execution being serialized behind
+        negotiation)."""
+        if span_args is None:
+            span_args = self._span_args
         with self._lock:
             if resp.response_type == ResponseType.ERROR:
                 # An escalated stall ERROR targets a tensor only SOME
@@ -1253,7 +1573,7 @@ class Engine:
         for entry in entries:
             # cycle-ordinal + cache-generation stamps: how the same
             # span is found across per-rank trace files (docs/tracing.md)
-            tl.negotiate_end(entry.name, args=self._span_args)
+            tl.negotiate_end(entry.name, args=span_args)
 
         if resp.response_type == ResponseType.ERROR:
             status = Status.precondition_error(resp.error_message)
@@ -1263,18 +1583,21 @@ class Engine:
 
         op_name = _OP_NAMES[entries[0].op]
         for entry in entries:
-            tl.start(entry.name, op_name, args=self._span_args)
+            tl.start(entry.name, op_name, args=span_args)
         try:
             if resp.response_type == ResponseType.ALLREDUCE:
                 results = self._run_allreduce(
-                    idx, entries, getattr(resp, "tensor_codec", "none"))
+                    idx, entries, getattr(resp, "tensor_codec", "none"),
+                    cycle_no=cycle_no)
                 if self._sentry is not None or \
                         self._consensus_acc is not None:
                     results = self._screen_reduced(entries, results)
             elif resp.response_type == ResponseType.ALLGATHER:
-                results = self._run_allgather(idx, entries[0], resp)
+                results = self._run_allgather(idx, entries[0], resp,
+                                              cycle_no=cycle_no)
             else:
-                results = self._run_broadcast(idx, entries[0], resp)
+                results = self._run_broadcast(idx, entries[0], resp,
+                                              cycle_no=cycle_no)
             if self._finalizer_q is not None and any(
                     _is_jax_array(r) for r in results):
                 # Device results are asynchronous dispatches, not completed
@@ -1301,7 +1624,8 @@ class Engine:
                     entry.handle, Status.unknown_error(reason), None)
 
     def _run_allreduce(self, idx: int, entries: List[TensorTableEntry],
-                       codec: str = "none") -> List[np.ndarray]:
+                       codec: str = "none",
+                       cycle_no: Optional[int] = None) -> List[np.ndarray]:
         fused = len(entries) > 1
         tl = self.timeline
         chaos = self._data_chaos
@@ -1387,7 +1711,8 @@ class Engine:
             if self._plane is not None:
                 self._warn_host_fallback("allreduce", entries[0].name, buf)
             raw = self._client.payload(self._rank, idx,
-                                       np.ascontiguousarray(buf).tobytes())
+                                       np.ascontiguousarray(buf).tobytes(),
+                                       cycle_no=cycle_no)
             out = np.frombuffer(raw, dtype=buf.dtype).copy()  # writable
         if chaos is not None:
             # flipbits faults corrupt THIS rank's received reduced buffer
@@ -1411,7 +1736,8 @@ class Engine:
         return results
 
     def _run_allgather(self, idx: int, entry: TensorTableEntry,
-                       resp: Response) -> List[np.ndarray]:
+                       resp: Response,
+                       cycle_no: Optional[int] = None) -> List[np.ndarray]:
         if _is_jax_array(entry.array):
             if self._client is None:
                 # size-1 concat == the (private, snapshot) array itself
@@ -1431,14 +1757,16 @@ class Engine:
         if self._plane is not None:
             self._warn_host_fallback("allgather", entry.name, arr)
         raw = self._client.payload(
-            self._rank, idx, np.ascontiguousarray(arr).tobytes())
+            self._rank, idx, np.ascontiguousarray(arr).tobytes(),
+            cycle_no=cycle_no)
         total_first = sum(resp.tensor_sizes)
         shape = (total_first,) + tuple(arr.shape[1:])
         return [np.frombuffer(raw, dtype=arr.dtype)
                 .reshape(shape).copy()]
 
     def _run_broadcast(self, idx: int, entry: TensorTableEntry,
-                       resp: Response) -> List[np.ndarray]:
+                       resp: Response,
+                       cycle_no: Optional[int] = None) -> List[np.ndarray]:
         root = resp.tensor_sizes[0]
         if _is_jax_array(entry.array):
             if self._client is None:
@@ -1459,7 +1787,8 @@ class Engine:
             self._warn_host_fallback("broadcast", entry.name, arr)
         payload = np.ascontiguousarray(arr).tobytes() \
             if self._rank == root else b""
-        raw = self._client.payload(self._rank, idx, payload)
+        raw = self._client.payload(self._rank, idx, payload,
+                                   cycle_no=cycle_no)
         return [np.frombuffer(raw, dtype=arr.dtype)
                 .reshape(arr.shape).copy()]
 
@@ -1550,7 +1879,19 @@ def get_engine() -> Engine:
     """Lazy singleton start; registers teardown with ``basics.shutdown``."""
     global _engine
     with _engine_lock:
-        if _engine is None or _engine._stopped.is_set():
+        if _engine is not None and _engine._stopped.is_set():
+            # The engine stopped WITHOUT a local ``hvd.shutdown()`` (which
+            # clears the singleton through _shutdown_engine): the world
+            # ended underneath this process — a peer's negotiated
+            # shutdown, or an escalated abort. Surface the reference's
+            # shut-down semantics with the structured reason
+            # (RanksAbortedError parses out of it); silently building a
+            # replacement engine here raced the dying controller and
+            # turned the abort into a bare "connection refused".
+            Status.unknown_error(
+                _engine._shutdown_reason or SHUT_DOWN_ERROR
+            ).raise_if_error()
+        if _engine is None:
             basics._topology()  # raises NotInitializedError when appropriate
             engine = Engine()
             basics._state().engine_shutdown_hooks.append(

@@ -107,6 +107,17 @@ class RequestList:
     requests: List[Request] = field(default_factory=list)
     shutdown: bool = False
     integrity_digest: Optional[list] = None
+    # Sub-buffer flush ordinal (docs/tensor-fusion.md): the client's own
+    # count of negotiation cycles it has joined. Every rank joins every
+    # cycle exactly once and in order — the invariant the whole cycle
+    # bookkeeping (rendezvous keys, sentry ordinals, consensus windows,
+    # cache-bit positions) rests on, and one that generation-ordered
+    # sub-buffer flushing leans on even harder (multiple cycles per step).
+    # The coordinator cross-checks the ranks of one rendezvous against
+    # EACH OTHER (relative — symmetric restarts by fresh tooling clients
+    # stay legal) and a mismatch fails LOUDLY instead of silently
+    # misaligning batches. None on wires that predate the field.
+    flush_ordinal: Optional[int] = None
 
 
 @dataclass
@@ -193,6 +204,9 @@ class CacheRequest:
     # steady-state bypass must keep shipping digests too, or a warm cache
     # would silently disarm the verification it rides beside
     integrity_digest: Optional[list] = None
+    # sub-buffer flush ordinal (see RequestList.flush_ordinal): the warm
+    # steady state keeps the cycle-alignment cross-check too
+    flush_ordinal: Optional[int] = None
 
 
 @dataclass

@@ -278,9 +278,16 @@ class NativeControllerClient:
         self._cycle_no += 1
         return out
 
-    def payload(self, rank: int, response_idx: int, data: bytes) -> bytes:
+    def payload(self, rank: int, response_idx: int, data: bytes,
+                cycle_no=None) -> bytes:
+        """Interface parity with ``ControllerClient.payload``; the native
+        wire never pipelines flushes (the engine degrades
+        HOROVOD_FUSION_SUBBUFFERS to 1 there), so the most recently
+        completed cycle is always the right default."""
         return decode_payload_response(self._client.request_raw(
-            encode_payload(rank, self._last_cycle, response_idx, data)))
+            encode_payload(
+                rank, self._last_cycle if cycle_no is None else cycle_no,
+                response_idx, data)))
 
     def watch(self, on_abort) -> None:
         """Failure-push channel (same contract as

@@ -200,11 +200,40 @@ class XlaDataPlane:
             # a tiled all_gather output is replicated (psum it can); all
             # three bodies end in a collective whose output is identical on
             # every device, so declaring P() replication is sound.
+            #
+            # Buffer donation (docs/tensor-fusion.md, SNIPPETS [1]/[3]):
+            # the fused input bucket is consumed by the reduction — it is
+            # a freshly packed/padded staging buffer every call — so
+            # donating it lets XLA reduce in place instead of holding
+            # input + output buckets live at once. That halves the peak
+            # device footprint of a flush, which is what keeps sub-buffer
+            # churn (several buckets in flight per step) from doubling
+            # device memory. Reduction kinds only: their per-partition
+            # input and output shapes match, so the alias always lands
+            # (asserted by reduce_donation_hlo); a gather's output is
+            # size-times its input and could never alias.
+            donate = (0,) if kind in ("psum", "qpsum", "bcast") else ()
             return jax.jit(jax.shard_map(
                 body, mesh=self._mesh, in_specs=P("hvd"), out_specs=P(),
-                check_vma=False))
+                check_vma=False), donate_argnums=donate)
 
         return self._local_fn((kind,) + key, _build)
+
+    def reduce_donation_hlo(self, n_elems: int, dtype=np.float32,
+                            codec: str = "none") -> str:
+        """Compiled-HLO text of the fused-reduction program for an
+        ``n_elems``-element batch — the donation audit surface: the
+        module header must carry ``input_output_alias`` or the in-place
+        flush silently degraded to copy-in/copy-out (tests and the
+        dryrun scan for it, the docs/compression.md HLO-audit
+        precedent)."""
+        import jax
+
+        bucket = _next_bucket(n_elems)
+        wire_dt, _ = self._wire_parts(np.dtype(dtype))
+        arg = jax.ShapeDtypeStruct((self._size * bucket,), wire_dt,
+                                   sharding=self._shard)
+        return self._reduce_fn(codec).lower(arg).compile().as_text()
 
     def _global_put(self, local):
         """Local shard (numpy or on-device array) → global array sharded

@@ -43,6 +43,7 @@ KNOB_CYCLE = "cycle_time_ms"
 KNOB_CACHE = "cache_capacity"
 KNOB_INTERVAL = "metrics_interval_s"
 KNOB_CODEC = "codec"
+KNOB_SUBBUFFERS = "fusion_subbuffers"
 
 # Prometheus gauges are numeric; the codec knob reports this id mapping
 # (documented in docs/autotune.md).
@@ -404,6 +405,17 @@ def default_knobs(cfg, extended: bool = False) -> List[Knob]:
                                 [128, 256, 512, 1024, 2048, 4096])
         knobs.append(Knob(KNOB_CACHE, values, index,
                           pinned=cfg.cache_capacity_explicit))
+    if extended:
+        # Sub-buffer flush pipelining (docs/tensor-fusion.md): how many
+        # generation-ordered sub-buffers each cycle tick cuts into — the
+        # compute/collective overlap depth. Applied by the ENGINE off the
+        # tuned_knobs piggyback (the metrics-interval pattern); ranks arm
+        # the pipeline on the first >= 2 value. Numerics-neutral (every
+        # tensor's reduction is unchanged, only the batching moves), so
+        # no consent gate like the codec's.
+        values, index = _ladder(cfg.fusion_subbuffers, [1, 2, 4, 8])
+        knobs.append(Knob(KNOB_SUBBUFFERS, values, index,
+                          pinned=cfg.fusion_subbuffers_explicit))
     if extended and cfg.metrics_port > 0:
         # present (pinned) even when the interval was set explicitly, so
         # the config map / gauges / decision log can distinguish "pinned

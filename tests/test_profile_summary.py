@@ -4,6 +4,7 @@ TPU captures it reads xprof's hlo_stats (bound_by / HBM bandwidth per op);
 this CPU test exercises the capture->parse->rank pipeline end to end via
 the raw-trace fallback."""
 
+import importlib.util
 import json
 import os
 import subprocess
@@ -13,7 +14,22 @@ import pytest
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# The e2e tier drives the REAL converter: tools/profile_summary.py's
+# summarize() imports xprof.convert to turn the captured xplane.pb into
+# tables. Without the xprof package (this image ships the jax profiler
+# but not the converter), every capture summarizes to ModuleNotFoundError
+# — the parsing contract is still fully covered by the stubbed-xprof
+# fixture tier below, so the e2e tier gates loudly instead of failing on
+# an environment it cannot run in.
+_NEEDS_XPROF = pytest.mark.skipif(
+    importlib.util.find_spec("xprof") is None,
+    reason="xprof (the profile converter behind tools/profile_summary.py)"
+           " is not installed in this image; the capture->parse pipeline "
+           "cannot run — parsing itself is pinned by the stubbed-xprof "
+           "fixture tier in this file")
 
+
+@_NEEDS_XPROF
 def test_profile_summary_end_to_end(tmp_path):
     prof_dir = str(tmp_path / "prof")
     capture = f"""
@@ -61,6 +77,7 @@ def test_profile_summary_missing_dir(tmp_path):
     assert "xplane.pb" in result.stderr
 
 
+@_NEEDS_XPROF
 def test_profile_summary_uses_newest_session_only(tmp_path):
     """A retried bench leaves several timestamped capture sessions under
     one profile dir; merging them would double-count every op in the

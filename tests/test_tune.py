@@ -207,7 +207,8 @@ def test_default_knobs_gating_and_pins():
     cfg = Config(cache_capacity=1024, metrics_port=9100)
     names = {k.name for k in default_knobs(cfg, extended=True)}
     assert names == {"fusion_threshold_bytes", "cycle_time_ms",
-                     "cache_capacity", "metrics_interval_s", "codec"}
+                     "cache_capacity", "metrics_interval_s", "codec",
+                     "fusion_subbuffers"}
     # classic pair only without the extended (Python-controller) wire
     names = {k.name for k in default_knobs(cfg, extended=False)}
     assert names == {"fusion_threshold_bytes", "cycle_time_ms"}
@@ -221,10 +222,12 @@ def test_default_knobs_gating_and_pins():
     assert by_name["codec"].values == ("none", "int8", "fp8")
     # explicit env values pin their knobs; capacity 0 drops the knob
     cfg3 = Config(cache_capacity=0, fusion_threshold_explicit=True,
-                  cycle_time_explicit=True)
+                  cycle_time_explicit=True,
+                  fusion_subbuffers_explicit=True)
     knobs = default_knobs(cfg3, extended=True)
     assert {k.name for k in knobs} == {"fusion_threshold_bytes",
-                                       "cycle_time_ms", "codec"}
+                                       "cycle_time_ms", "codec",
+                                       "fusion_subbuffers"}
     assert all(k.pinned for k in knobs)
     # the ladder always starts AT the live value
     cfg4 = Config(cycle_time_ms=3.3)

@@ -73,6 +73,7 @@ from . import (
     obs,
     parallel,
     runner,
+    serving,
     tune,
 )
 from .obs import metrics_snapshot, straggler_report

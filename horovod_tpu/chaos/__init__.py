@@ -421,14 +421,21 @@ class ChaosInjector:
                              dtype=buf.dtype).reshape(buf.shape).copy()
 
 
-def injector_from_env(rank: Optional[int] = None) -> Optional[ChaosInjector]:
+def injector_from_env(rank: Optional[int] = None,
+                      env: str = HOROVOD_CHAOS) -> Optional[ChaosInjector]:
     """Build the injector for this process's ``HOROVOD_CHAOS`` spec, or
     None when unset. ``rank`` defaults to ``HOROVOD_RANK``; rank-scoped
     clauses not matching it are filtered out (the injector still exists,
-    carrying 'all'/'relaunch' clauses)."""
+    carrying 'all'/'relaunch' clauses).
+
+    ``env`` names the spec variable: the serving plane's wire reads its
+    faults from ``HOROVOD_SERVING_CHAOS`` (docs/serving.md) so each wire
+    owns an independent ordinal domain — injecting serving faults must
+    never perturb the cycle channel's replay determinism, and vice
+    versa."""
     import os
 
-    spec = os.environ.get(HOROVOD_CHAOS, "")
+    spec = os.environ.get(env, "")
     if not spec:
         return None
     if rank is None:

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import sys
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 # One fault of each kind, aimed at rank 1's controller client. The msgN
 # ordinals land during warmup (negotiation cycles), the everyK clauses
@@ -53,6 +53,22 @@ DATA_GRID = [
     (f"nan@rank1:msg{DATA_POISON_ORDINAL}", "warn", 0, "healed"),
     (f"nan@rank1:msg{DATA_POISON_ORDINAL}", "abort", 0, "escalated"),
     (f"flipbits@rank1:msg{DATA_POISON_ORDINAL}", "off", 1, "escalated"),
+]
+
+
+# Serving-plane grid (docs/serving.md): faults aimed at the serving RPC
+# wire (HOROVOD_SERVING_CHAOS — its own ordinal domain, so the cycle
+# channel's replay stays untouched) plus the kill-mid-batch cell
+# (HOROVOD_SERVING_FAULT through the elastic driver). Heal cells must
+# resolve every request 200-bit-exact with ZERO relaunches (the dedup
+# wire heals drops/delays/closes); the kill cell must relaunch and leave
+# every request either 200-bit-exact or a structured 503 carrying the
+# relaunch epoch — never a hang.
+SERVING_GRID = [
+    ("drop@rank1:msg3,drop@rank1:every7", "", "healed"),
+    ("delay@rank1:40ms:every3", "", "healed"),
+    ("close@rank1:msg4", "", "healed"),
+    ("", "kill@rank1:batch2@epoch0", "recovered"),
 ]
 
 
@@ -262,6 +278,198 @@ def _classify_worker_failure(exc) -> str:
     return "escalated"
 
 
+def _serving_world_fn():
+    """Per-rank body for one serving cell (shipped by value through the
+    elastic driver): a real hvd world (so the negotiation-core sweep
+    means something and the serving RPC demonstrably rides its own
+    connection, never the cycle channel) running the serving loop on a
+    small integer-valued matmul — integer products and sums are exact in
+    float32, so bit-exact is the fault-free contract."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.serving.worker import serve_worker
+
+    hvd.init()
+    warm = hvd.allreduce(np.ones(8, np.float32), average=False,
+                         name="serving.warm")
+    weights = (np.arange(64, dtype=np.float32).reshape(8, 8) % 5) - 2
+    try:
+        stats = serve_worker({"demo": lambda x: x @ weights + 1.0},
+                             jit=False)
+    finally:
+        try:
+            hvd.shutdown()
+        except Exception:  # noqa: BLE001 - a killed peer's world cannot
+            pass  # negotiate shutdown; the abort already attributed it
+    stats["warm"] = float(np.asarray(warm)[0])
+    return stats
+
+
+def serving_expected(x):
+    """Driver-side twin of the cell model (what a 200 must equal)."""
+    import numpy as np
+
+    weights = (np.arange(64, dtype=np.float32).reshape(8, 8) % 5) - 2
+    return x @ weights + 1.0
+
+
+def run_serving_cell(spec: str, fault: str, expect: str,
+                     native_core: Optional[int] = None,
+                     np_: int = 2, requests: int = 10,
+                     timeout_s: float = 240.0,
+                     deadline_s: float = 120.0) -> Dict:
+    """Run one serving cell: a 2-proc elastic serving world under one
+    fault, with a closed-loop client stream against the gateway.
+    Outcomes: ``healed`` (every request 200 bit-exact, zero relaunches),
+    ``recovered`` (the kill relaunched, every request 200-exact or a
+    structured 503 carrying an epoch), ``escalated`` (a heal cell
+    relaunched), ``wrong-results``, ``hang``."""
+    import json
+    import os
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from horovod_tpu.elastic import run_elastic
+    from horovod_tpu.serving import ServingPlane
+
+    env = {
+        "HOROVOD_SERVING_CHAOS": spec,
+        "HOROVOD_SERVING_FAULT": fault,
+        "HOROVOD_PLATFORM": "cpu",
+        "HOROVOD_CYCLE_TIME": "2",
+        "HOROVOD_RECONNECT_ATTEMPTS": "4",
+        "HOROVOD_RECONNECT_BACKOFF_S": "0.05",
+        "HOROVOD_RECONNECT_WINDOW_S": "2",
+        "HOROVOD_STALL_WARNING_TIME": "2",
+        "HOROVOD_STALL_SHUTDOWN_TIME_S": "4",
+    }
+    if native_core is not None:
+        env["HOROVOD_NATIVE_CORE"] = str(native_core)
+    t0 = time.monotonic()
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    plane = ServingPlane(gateway_port=0, batch_max=4, slo_ms=5000.0,
+                         deadline_ms=30000.0, reconnect_window_s=2.0)
+    box: Dict[str, object] = {}
+
+    def _driver() -> None:
+        try:
+            box["results"] = run_elastic(
+                _serving_world_fn, np=np_, min_np=np_, max_restarts=2,
+                backoff_s=0.2, timeout_s=timeout_s,
+                start_timeout_s=120.0, serving_plane=plane,
+                env_extra=dict(env))
+        except BaseException as exc:  # noqa: BLE001 - classified below
+            box["error"] = f"{type(exc).__name__}: {exc}"
+
+    driver = threading.Thread(target=_driver, daemon=True)
+    driver.start()
+    outcomes: List[Tuple] = []
+    try:
+        arm_deadline = time.monotonic() + 90.0
+        while not plane.stats()["armed"]:
+            if time.monotonic() > arm_deadline or "error" in box:
+                cell = {"outcome": "hang",
+                        "error": str(box.get(
+                            "error", "serving world never armed"))}
+                return _finish_serving_cell(cell, spec, fault,
+                                            native_core, t0, deadline_s)
+            time.sleep(0.1)
+        url = f"http://127.0.0.1:{plane.gateway_port}/v1/infer"
+        lock = threading.Lock()
+
+        def _client(i: int) -> None:
+            x = np.full(8, float(i % 7), np.float32)
+            req = urllib.request.Request(
+                url,
+                data=json.dumps({"name": "demo",
+                                 "inputs": x.tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                resp = urllib.request.urlopen(req, timeout=45)
+                out = np.asarray(json.loads(resp.read())["outputs"],
+                                 np.float32)
+                exact = bool(np.array_equal(out, serving_expected(x)))
+                record = (i, 200, exact)
+            except urllib.error.HTTPError as exc:
+                body = json.loads(exc.read() or b"{}")
+                record = (i, exc.code, body.get("epoch"))
+            except Exception as exc:  # noqa: BLE001 - a hang marker
+                record = (i, "exc", f"{type(exc).__name__}: {exc}")
+            with lock:
+                outcomes.append(record)
+
+        clients = [threading.Thread(target=_client, args=(i,))
+                   for i in range(requests)]
+        for thread in clients:
+            thread.start()
+            time.sleep(0.15)
+        for thread in clients:
+            thread.join(timeout=60.0)
+        if any(thread.is_alive() for thread in clients):
+            cell = {"outcome": "hang",
+                    "error": "client requests never resolved",
+                    "responses": sorted(outcomes)}
+            return _finish_serving_cell(cell, spec, fault, native_core,
+                                        t0, deadline_s)
+        epoch = plane.stats()["epoch"]
+    finally:
+        plane.stop()
+        driver.join(timeout=60.0)
+        plane.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if driver.is_alive():
+        cell = {"outcome": "hang", "error": "elastic driver never returned"}
+    elif any(r[1] == "exc" for r in outcomes):
+        cell = {"outcome": "hang",
+                "error": f"unresolved requests: "
+                         f"{[r for r in outcomes if r[1] == 'exc']}"}
+    elif any(r[1] == 200 and r[2] is not True for r in outcomes):
+        cell = {"outcome": "wrong-results",
+                "error": f"inexact 200s: "
+                         f"{[r for r in outcomes if r[1] == 200 and r[2] is not True]}"}
+    elif fault:
+        structured = all(r[1] == 200 or (r[1] == 503 and r[2] is not None)
+                         for r in outcomes)
+        cell = {"outcome": "recovered" if epoch >= 1 and structured
+                else "escalated",
+                "responses": sorted(outcomes)}
+    else:
+        all_served = all(r[1] == 200 for r in outcomes)
+        cell = {"outcome": "healed" if all_served and epoch == 0
+                else "escalated",
+                "responses": sorted(outcomes)}
+    if "error" in box and cell["outcome"] in ("healed", "recovered"):
+        cell = {"outcome": "escalated", "error": str(box["error"])}
+    return _finish_serving_cell(cell, spec, fault, native_core, t0,
+                                deadline_s)
+
+
+def _finish_serving_cell(cell: Dict, spec: str, fault: str,
+                         native_core: Optional[int], t0: float,
+                         deadline_s: float) -> Dict:
+    cell["spec"] = spec
+    cell["fault"] = fault
+    cell["native_core"] = native_core
+    cell["elapsed_s"] = round(time.monotonic() - t0, 2)
+    if cell["outcome"] == "recovered" and cell["elapsed_s"] > deadline_s:
+        # recovery that only lands because some teardown timer fired is a
+        # wedge, not a recovery (the run_cell late-escalation contract)
+        cell["outcome"] = "late-recovery"
+    return cell
+
+
 def run_cell(spec: str,
              native_controller: Optional[int] = None,
              native_core: Optional[int] = None,
@@ -359,7 +567,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "consensus cells, each asserting "
                              "healed-by-skip / zeroed / "
                              "escalated-in-deadline (docs/integrity.md)")
+    parser.add_argument("--serving", action="store_true",
+                        help="run the serving-plane grid instead "
+                             "(docs/serving.md): drop/delay/close on the "
+                             "serving RPC must heal with every request "
+                             "200-bit-exact, kill-rank-mid-batch must "
+                             "relaunch with every request 200 or a "
+                             "structured 503 — never a hang")
     args = parser.parse_args(argv)
+    if args.serving:
+        failed = 0
+        for spec, fault, expect in SERVING_GRID:
+            cell = run_serving_cell(spec, fault, expect, np_=args.np_)
+            ok = cell["outcome"] == expect
+            if not ok:
+                failed += 1
+            label = spec or fault
+            print(f"serving-cell {'OK ' if ok else 'BAD'} "
+                  f"outcome={cell['outcome']:<13} "
+                  f"{cell['elapsed_s']:6.1f}s  {label}", flush=True)
+            if not ok:
+                print(f"  {cell.get('error', cell.get('responses', ''))}",
+                      flush=True)
+        return 1 if failed else 0
     if args.data_plane:
         failed = 0
         for spec, policy, consensus, expect in DATA_GRID:

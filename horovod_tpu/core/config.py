@@ -210,6 +210,42 @@ HOROVOD_METRICS_PORT = "HOROVOD_METRICS_PORT"
 # outright, and world snapshots then carry the calling rank only.
 HOROVOD_METRICS_INTERVAL = "HOROVOD_METRICS_INTERVAL_S"
 
+# --- inference serving plane (horovod_tpu.serving; ours, docs/serving.md) ----
+# The driver-resident ServingPlane exports its coordinator RPC endpoint to
+# the worker ranks through these (run_elastic merges plane.env() into every
+# attempt's environment; see serving/plane.py). The secret rides the env
+# exactly like HOROVOD_SECRET_KEY does from the launcher.
+HOROVOD_SERVING_ADDR = "HOROVOD_SERVING_ADDR"
+HOROVOD_SERVING_PORT = "HOROVOD_SERVING_PORT"
+HOROVOD_SERVING_SECRET = "HOROVOD_SERVING_SECRET"
+# Gateway defaults (driver-side; constructor args win over env): max live
+# requests admitted to the queue, the SLO budget admission rejects past
+# (429 + Retry-After), and the per-request completion deadline (503 once
+# exceeded — never a hang).
+HOROVOD_SERVING_QUEUE_MAX = "HOROVOD_SERVING_QUEUE_MAX"
+HOROVOD_SERVING_SLO_MS = "HOROVOD_SERVING_SLO_MS"
+HOROVOD_SERVING_DEADLINE_MS = "HOROVOD_SERVING_DEADLINE_MS"
+# Micro-batcher knobs, both on the autotune ladder (docs/serving.md):
+# largest packed batch, and either an explicit comma-separated list of
+# padding-bucket edges (pins the edges knob) or the default geometric
+# ladder derived from HOROVOD_SERVING_EDGE_RATIO (default 2).
+HOROVOD_SERVING_BATCH_MAX = "HOROVOD_SERVING_BATCH_MAX"
+HOROVOD_SERVING_BUCKET_EDGES = "HOROVOD_SERVING_BUCKET_EDGES"
+HOROVOD_SERVING_EDGE_RATIO = "HOROVOD_SERVING_EDGE_RATIO"
+# Closed-loop tuning of the two batcher knobs (numerics-neutral — padding
+# and packing never change any request's row values — so no consent gate
+# like the codec's). Off by default.
+HOROVOD_SERVING_AUTOTUNE = "HOROVOD_SERVING_AUTOTUNE"
+# Deterministic fault injection for the serving wire (docs/chaos.md): the
+# control-wire chaos grammar (drop/delay/corrupt/close/refuse), keyed by
+# the serving worker's request ordinals — its own injection domain, so
+# serving faults never perturb HOROVOD_CHAOS replay on the cycle channel.
+HOROVOD_SERVING_CHAOS = "HOROVOD_SERVING_CHAOS"
+# Kill-mid-batch hook ("kill@rankN:batchM[@epochE]"): the named rank
+# os._exits right before reporting its Mth batch result in epoch E
+# (default 0) — the serving twin of HOROVOD_ELASTIC_FAULT.
+HOROVOD_SERVING_FAULT = "HOROVOD_SERVING_FAULT"
+
 # Generation-ordered sub-buffer flush (docs/tensor-fusion.md; ours, the
 # T3-style compute/collective overlap on the eager plane): cut each cycle
 # tick's pending queue into up to N arrival-ordered sub-buffers that

@@ -159,7 +159,8 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                 heartbeat_miss_limit: int = 5,
                 slot_fail_limit: int = 2,
                 stall_shutdown_s: float = 30.0,
-                straggler_evict: Optional[str] = None) -> List[Any]:
+                straggler_evict: Optional[str] = None,
+                serving_plane=None) -> List[Any]:
     """Fault-tolerant ``runner.run``: relaunch on worker death.
 
     ``np`` slots are launched initially; a slot that fails
@@ -180,7 +181,18 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
     records and counts advisories the coordinator pushes; under
     ``enforce`` an advisory additionally tears the world down, blacklists
     the named slot outright, and relaunches the survivors — the same
-    PR-2 path a dead rank takes."""
+    PR-2 path a dead rank takes.
+
+    ``serving_plane`` wires a driver-resident
+    :class:`~horovod_tpu.serving.plane.ServingPlane` through the elastic
+    lifecycle (docs/serving.md failover matrix): every attempt's
+    environment carries ``plane.env()`` (service address + secret, so
+    the worker ranks' serving loops find the coordinator),
+    ``plane.begin_epoch`` targets each attempt before launch, and a
+    failed attempt's ``plane.world_down`` drains or structurally errors
+    every in-flight ticket — requests issued DURING a relaunch either
+    complete after the plane re-arms or fail with a structured 503
+    carrying the relaunch epoch, never a hang."""
     from ..tune.detector import MODES
 
     if not 1 <= min_np <= np:
@@ -227,6 +239,12 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                 # and its advisories come back over this driver's service
                 merged_env.setdefault(_config.HOROVOD_STRAGGLER_EVICT,
                                       evict_mode)
+            if serving_plane is not None:
+                # serving coordinator endpoint + secret, and the epoch
+                # target the plane arms against (stale-epoch zombies are
+                # refused at shello)
+                merged_env.update(serving_plane.env())
+                serving_plane.begin_epoch(epoch, world)
             if env_extra:
                 merged_env.update(env_extra)
             seen_advisories: Dict[int, Any] = {}  # rank -> last seq seen
@@ -278,6 +296,13 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                 # Deliberately NOT a bare RuntimeError: an arbitrary
                 # internal error is a deterministic bug that must fail
                 # fast, not burn max_restarts x timeout_s retrying.
+                if serving_plane is not None:
+                    # drain first, classify second: in-flight tickets must
+                    # resolve (requeue or structured 503) no matter how
+                    # the attempt's failure is ultimately classified
+                    serving_plane.world_down(
+                        f"elastic attempt {epoch} failed "
+                        f"({type(exc).__name__}: {exc})")
                 if isinstance(exc, WorkerFailedError) and \
                         not _is_world_fault(exc):
                     # user-code exception, not a world fault: fail fast

@@ -4,9 +4,12 @@ The subsystem docs live in docs/metrics.md; the pieces:
 
 * :mod:`.registry` — process-local counters/gauges/mergeable histograms
   plus ``merge_snapshots`` (the pointwise world fold);
+* :mod:`.httpd` — the shared stdlib loopback HTTP machinery (server
+  thread lifecycle, route table, content-type handling) the metrics
+  endpoint and the serving gateway both ride;
 * :mod:`.exposition` — Prometheus text + JSON rendering, the loopback
-  HTTP server (``HOROVOD_METRICS_PORT``), and the ``parse_prometheus``
-  format-lint helper;
+  HTTP server (``HOROVOD_METRICS_PORT``) as a route set on it, and the
+  ``parse_prometheus`` format-lint helper;
 * :mod:`.bridge` — registry deltas as ``Timeline.counter`` tracks so the
   existing Chrome-tracing tooling keeps working;
 * :mod:`.tracing` — the distributed-tracing half (docs/tracing.md):

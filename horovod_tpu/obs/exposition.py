@@ -28,9 +28,9 @@ from __future__ import annotations
 
 import json
 import re
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
+
+from .httpd import HttpResponse, LoopbackHTTPD
 
 # -- Prometheus text rendering -------------------------------------------------
 
@@ -214,8 +214,33 @@ def _split_labels(inner: str):
 # -- HTTP server ---------------------------------------------------------------
 
 
+def metrics_routes(provider: Callable[[], dict]):
+    """The metrics endpoint as an ``obs.httpd`` route set: Prometheus
+    text at ``GET /metrics`` (rendered from the provider's merged world
+    view), the full structured snapshot at ``GET /metrics.json``. Shared
+    verbatim by the standalone ``MetricsServer`` and the serving
+    gateway's co-hosted metrics surface (docs/serving.md) — one
+    implementation, two route sets."""
+
+    def _metrics(_query, _headers, _body) -> HttpResponse:
+        doc = provider()
+        world = doc["world"] if isinstance(doc, dict) and "world" in doc \
+            else doc
+        return HttpResponse(
+            200, "text/plain; version=0.0.4; charset=utf-8",
+            render_prometheus(world).encode())
+
+    def _metrics_json(_query, _headers, _body) -> HttpResponse:
+        return HttpResponse(200, "application/json",
+                            json.dumps(provider()).encode())
+
+    return {("GET", "/metrics"): _metrics,
+            ("GET", "/metrics.json"): _metrics_json}
+
+
 class MetricsServer:
-    """Loopback HTTP exposition of a snapshot provider.
+    """Loopback HTTP exposition of a snapshot provider (an
+    ``obs.httpd.LoopbackHTTPD`` carrying the ``metrics_routes`` set).
 
     ``provider()`` returns ``{"world": families, "ranks": {rank:
     families}}`` (the ``metrics_snapshot(world=True)`` shape); scrapes
@@ -223,46 +248,15 @@ class MetricsServer:
 
     def __init__(self, port: int, provider: Callable[[], dict],
                  bind_host: str = "127.0.0.1") -> None:
-        outer = self
-
-        class _Handler(BaseHTTPRequestHandler):
-            def do_GET(self) -> None:  # noqa: N802 - stdlib handler name
-                try:
-                    if self.path.split("?")[0] == "/metrics":
-                        body = render_prometheus(
-                            outer._provider()["world"]).encode()
-                        ctype = "text/plain; version=0.0.4; charset=utf-8"
-                    elif self.path.split("?")[0] == "/metrics.json":
-                        body = json.dumps(outer._provider()).encode()
-                        ctype = "application/json"
-                    else:
-                        self.send_error(404, "try /metrics or /metrics.json")
-                        return
-                except Exception as exc:  # noqa: BLE001 - surface, not hang
-                    self.send_error(500, f"snapshot failed: {exc}")
-                    return
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *args) -> None:  # scrapes are not news
-                pass
-
         self._provider = provider
-        self._server = ThreadingHTTPServer((bind_host, port), _Handler)
-        self._server.daemon_threads = True
-        self.port = self._server.server_address[1]
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="horovod-metrics-http",
-            daemon=True)
-        self._thread.start()
+        self._httpd = LoopbackHTTPD("horovod-metrics", port,
+                                    metrics_routes(provider),
+                                    bind_host=bind_host)
+        self.port = self._httpd.port
 
     def close(self) -> None:
         global _server
-        self._server.shutdown()
-        self._server.server_close()
+        self._httpd.close()
         if _server is self:
             _server = None
 
@@ -274,8 +268,13 @@ def serve(port: int, provider: Callable[[], dict]) -> MetricsServer:
     """Start (and register as the process's) exposition server. The env
     gate — ``HOROVOD_METRICS_PORT`` 0/unset means never call this — lives
     with the caller (``basics.init``); here ``port`` may legitimately be
-    0 for an ephemeral test port."""
+    0 for an ephemeral test port. A previously registered server is
+    closed first: re-init must never leak the old serve thread and
+    socket behind the new registration (the duplicate-server shutdown
+    ordering the shared helper exists to fix)."""
     global _server
+    if _server is not None:
+        _server.close()
     server = MetricsServer(port, provider)
     _server = server
     return server

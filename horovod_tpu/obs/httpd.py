@@ -1,0 +1,196 @@
+"""Shared stdlib loopback HTTP machinery (docs/metrics.md, docs/serving.md).
+
+Both HTTP surfaces this repo exposes — the rank-0 metrics endpoint
+(``obs.exposition``) and the rank-0 inference gateway
+(``serving.gateway``) — are the same machine: a loopback-bound
+``ThreadingHTTPServer`` on a daemon thread, an exact-path route table,
+content-type handling, and a close that shuts the serve loop down BEFORE
+releasing the socket. This module is that machine, factored out while
+there was still one caller so the two planes cannot drift: the metrics
+endpoint is two GET routes, the gateway is those two plus its own.
+
+The helper also owns the shutdown-ordering fix the old in-module server
+needed: ``close()`` stops the serve loop (``shutdown()`` blocks until the
+loop exits), only then closes the listening socket, then joins the
+thread — and it is idempotent, so a server that is both globally
+registered and owned by a caller can be closed from either side without
+a second close racing a half-torn-down loop.
+
+Stdlib-only, like everything on the obs plane: importable in launcher
+and tooling processes that never load jax.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Tuple
+from urllib.parse import parse_qs
+
+
+@dataclass
+class HttpResponse:
+    """One handler's answer. ``headers`` are extras (Content-Type and
+    Content-Length are emitted from the dedicated fields)."""
+
+    status: int = 200
+    content_type: str = "text/plain; charset=utf-8"
+    body: bytes = b""
+    headers: Dict[str, str] = field(default_factory=dict)
+
+
+class HttpError(Exception):
+    """Structured non-200 a route raises on purpose (admission rejects,
+    malformed requests). ``headers`` carry e.g. ``Retry-After``; the body
+    is rendered by the route's error convention (the gateway sends JSON),
+    or falls back to the plain message."""
+
+    def __init__(self, status: int, message: str,
+                 headers: Dict[str, str] | None = None,
+                 content_type: str = "text/plain; charset=utf-8",
+                 body: bytes | None = None) -> None:
+        super().__init__(message)
+        self.status = int(status)
+        self.message = message
+        self.headers = dict(headers or {})
+        self.content_type = content_type
+        self.body = body
+
+    def to_response(self) -> HttpResponse:
+        body = self.body if self.body is not None \
+            else (self.message + "\n").encode()
+        return HttpResponse(self.status, self.content_type, body,
+                            dict(self.headers))
+
+
+# route: (method, exact path) -> handler(query, headers, body) -> HttpResponse
+RouteHandler = Callable[[Dict[str, list], Dict[str, str], bytes],
+                        HttpResponse]
+
+
+class LoopbackHTTPD:
+    """Exact-path routed loopback HTTP server on a daemon thread.
+
+    ``routes`` maps ``(method, path)`` to a handler; the path is matched
+    with the query string stripped and the parsed query passed through.
+    Unknown paths get a 404 listing the served routes; a handler raising
+    ``HttpError`` answers with its structured status/headers; any other
+    exception answers 500 with the message (surface, never hang the
+    scraper/client). Request logging is silenced — scrapes and serving
+    traffic are not news."""
+
+    def __init__(self, name: str, port: int,
+                 routes: Dict[Tuple[str, str], RouteHandler],
+                 bind_host: str = "127.0.0.1") -> None:
+        outer = self
+        self._routes = dict(routes)
+        known = sorted({p for _, p in self._routes})
+
+        class _Handler(BaseHTTPRequestHandler):
+            # one keep-alive connection serves many requests (the bench's
+            # closed-loop clients reuse theirs)
+            protocol_version = "HTTP/1.1"
+
+            def _dispatch(self, method: str) -> None:
+                path, _, query_s = self.path.partition("?")
+                handler = outer._routes.get((method, path))
+                if handler is None:
+                    self._answer(HttpResponse(
+                        404, body=(f"no route for {method} {path}; "
+                                   f"try {', '.join(known)}\n").encode()))
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0) or 0)
+                    body = self.rfile.read(length) if length else b""
+                    resp = handler(parse_qs(query_s),
+                                   dict(self.headers.items()), body)
+                except HttpError as exc:
+                    resp = exc.to_response()
+                except Exception as exc:  # noqa: BLE001 - surface, not hang
+                    resp = HttpResponse(
+                        500, body=f"handler failed: {exc}\n".encode())
+                self._answer(resp)
+
+            def _answer(self, resp: HttpResponse) -> None:
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.content_type)
+                self.send_header("Content-Length", str(len(resp.body)))
+                for key, value in resp.headers.items():
+                    self.send_header(key, str(value))
+                self.end_headers()
+                self.wfile.write(resp.body)
+
+            def do_GET(self) -> None:  # noqa: N802 - stdlib handler names
+                self._dispatch("GET")
+
+            def do_POST(self) -> None:  # noqa: N802
+                self._dispatch("POST")
+
+            def log_message(self, *args) -> None:
+                pass
+
+            # Track live connections: under HTTP/1.1 keep-alive each
+            # handler thread loops independently of serve_forever, so a
+            # close() that only stopped the accept loop would leave
+            # already-connected clients being answered by a torn-down
+            # server (stale provider state) indefinitely.
+            def setup(self) -> None:
+                super().setup()
+                with outer._conns_lock:
+                    outer._conns.add(self.connection)
+
+            def finish(self) -> None:
+                try:
+                    super().finish()
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(self.connection)
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            # A closed-loop client fleet (the serving bench) dials many
+            # connections at once; the stdlib default backlog of 5
+            # overflows and the kernel drops SYNs, adding 1 s retransmit
+            # spikes to p99 — the same fix BasicService carries.
+            request_queue_size = 128
+
+        self.name = name
+        self._conns_lock = threading.Lock()
+        self._conns: set = set()
+        self._server = _Server((bind_host, port), _Handler)
+        self.port = self._server.server_address[1]
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name=f"{name}-http",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        """Ordered, idempotent teardown: stop the serve loop first
+        (``shutdown()`` blocks until the loop exits), release the listen
+        socket, then cut every live keep-alive connection so their
+        handler threads exit too — a closed server must stop ANSWERING,
+        not just stop accepting (re-registration on a fixed port would
+        otherwise leave old clients pinned to the torn-down instance)."""
+        import socket as _socket
+
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._server.shutdown()
+        self._server.server_close()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._thread.join(timeout=2.0)

@@ -1,0 +1,41 @@
+"""Multi-tenant inference serving plane (docs/serving.md).
+
+The repo's first post-training workload: a rank-0 request **gateway**
+(stdlib HTTP, the ``obs.httpd`` machinery the metrics endpoint shares)
+feeding a **continuous micro-batcher** whose packed batches broadcast to
+every rank of an SPMD world over the authenticated control wire — with
+deadline-aware admission (429/503 + ``Retry-After``), end-to-end
+instrumentation on the obs registry (``horovod_serving_*``), the batcher
+knobs on the autotune ladder, and elastic failover wired through the
+PR-2 driver (``run_elastic(serving_plane=...)``).
+
+Pieces:
+
+* :mod:`.batcher` — tickets, padding buckets (PR-3 identity convention),
+  continuous FIFO packing;
+* :mod:`.plane` — the driver-resident coordinator: dispatch broadcast,
+  result rendezvous with cross-rank digest verification, epochs,
+  admission;
+* :mod:`.gateway` — the HTTP front door (co-hosting ``/metrics``);
+* :mod:`.worker` — the rank-side loop: pull, run the pre-compiled
+  forward step, report.
+
+Stdlib + numpy at module level (jax only inside ``serve_worker`` when
+``jit=True``): importable in driver and tooling processes.
+"""
+
+from __future__ import annotations
+
+from .batcher import (  # noqa: F401 - public surface
+    MicroBatcher,
+    Ticket,
+    bucket_key,
+    derive_edges,
+    pad_to_edge,
+)
+from .plane import AdmissionError, ServingPlane  # noqa: F401
+from .worker import (  # noqa: F401
+    ServingAbortedError,
+    parse_serving_fault,
+    serve_worker,
+)

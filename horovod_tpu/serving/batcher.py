@@ -1,0 +1,308 @@
+"""Continuous micro-batcher: tickets, padding buckets, FIFO packing.
+
+The serving plane's queueing core (docs/serving.md). Requests become
+:class:`Ticket`\\ s keyed by the PR-3 response-cache identity convention —
+``(name, dtype, shape)`` of one example (``bucket_key`` mirrors
+``ops.response_cache.request_identity``) — so only requests a single
+compiled forward step can serve together ever share a batch. Packing is
+*continuous*: a batch is cut the moment a dispatch slot is free and any
+ticket is queued, never waiting to fill (the 1802.05799 lesson applied to
+serving — latency floors come from synchronization you didn't need). The
+cut batch is padded up to the nearest bucket edge so the per-bucket
+compile cache stays bounded; the fill ratio of every cut batch is
+recorded on the obs registry.
+
+Mechanism only: admission policy (SLO budget, queue caps, 429/503) lives
+with the :class:`~horovod_tpu.serving.plane.ServingPlane`, which also
+owns epochs and dispatch. Stdlib + numpy: importable in driver and
+tooling processes that never load jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..obs.registry import registry as _metrics
+
+# Observability plane (docs/metrics.md, "serving plane" section).
+_QUEUE_DEPTH = _metrics().gauge(
+    "horovod_serving_queue_depth",
+    "Live tickets queued in the serving micro-batcher (admitted, not yet "
+    "dispatched)")
+_BATCHES = _metrics().counter(
+    "horovod_serving_batches_total",
+    "Micro-batches cut by the continuous batcher")
+_FILL = _metrics().histogram(
+    "horovod_serving_batch_fill_ratio",
+    "Real rows over padded rows of every cut batch (1.0 = no padding "
+    "waste)",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+
+
+def bucket_key(name: str, dtype, shape) -> Tuple:
+    """Padding-bucket identity of ONE example: ``(name, dtype, shape)``,
+    the PR-3 response-cache identity convention (tensor name, dtype,
+    shape fix the payload; see ``ops.response_cache.request_identity``).
+    Requests batch together iff their keys are equal — the packed batch
+    is then ``(padded_n,) + shape`` and one compiled step serves it."""
+    return (str(name), str(np.dtype(dtype)), tuple(int(d) for d in shape))
+
+
+def derive_edges(batch_max: int, ratio: float = 2.0,
+                 explicit: Optional[Tuple[int, ...]] = None
+                 ) -> Tuple[int, ...]:
+    """Effective padding-bucket edges: the explicit list when given, else
+    the geometric ladder 1, r, r^2, ... — always clipped to
+    ``batch_max`` and always ending exactly there, so every cut batch
+    pads to a member of a bounded set (the compile-cache bound)."""
+    batch_max = max(int(batch_max), 1)
+    if explicit:
+        edges = sorted({int(e) for e in explicit if 0 < int(e) <= batch_max})
+    else:
+        ratio = max(float(ratio), 1.5)
+        edges, edge = [], 1.0
+        while int(edge) < batch_max:
+            edges.append(int(edge))
+            edge = max(edge * ratio, edge + 1)
+    return tuple(sorted(set(edges) | {batch_max}))
+
+
+def pad_to_edge(n: int, edges: Tuple[int, ...]) -> int:
+    """Smallest edge >= n (callers never cut past the largest edge)."""
+    for edge in edges:
+        if n <= edge:
+            return edge
+    return edges[-1]
+
+
+class Ticket:
+    """One admitted request: input example, deadline, completion state.
+
+    State transitions are one-way and race-safe: exactly one of
+    ``complete`` / ``fail`` / ``claim_timeout`` wins; the losers see
+    False and drop their outcome (a result arriving after the gateway
+    thread already answered 503 is discarded, never a second answer)."""
+
+    __slots__ = ("key", "array", "t0", "deadline", "_lock", "_event",
+                 "state", "output", "status", "error", "epoch",
+                 "retry_after_s")
+
+    def __init__(self, key: Tuple, array: np.ndarray,
+                 deadline_s: float) -> None:
+        self.key = key
+        self.array = array
+        self.t0 = time.monotonic()
+        self.deadline = self.t0 + max(float(deadline_s), 0.001)
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self.state = "queued"  # queued|dispatched|done|failed|timeout
+        self.output: Optional[np.ndarray] = None
+        self.status = 0
+        self.error: Optional[str] = None
+        self.epoch: Optional[int] = None
+        self.retry_after_s: Optional[float] = None
+
+    @property
+    def closed(self) -> bool:
+        return self.state in ("done", "failed", "timeout")
+
+    def complete(self, output: np.ndarray) -> bool:
+        with self._lock:
+            if self.closed:
+                return False  # the loser's outcome is dropped whole:
+                # a late result must not touch the 503 already answered
+            self.state = "done"
+            self.status = 200
+            self.output = output
+        self._event.set()
+        return True
+
+    def fail(self, status: int, error: str, epoch: Optional[int] = None,
+             retry_after_s: Optional[float] = None) -> bool:
+        with self._lock:
+            if self.closed:
+                return False
+            self.state = "failed"
+            self.status, self.error = int(status), error
+            self.epoch, self.retry_after_s = epoch, retry_after_s
+        self._event.set()
+        return True
+
+    def claim_timeout(self, epoch: Optional[int] = None) -> bool:
+        """The gateway thread claims its own ticket after the deadline
+        passed unanswered; a late result then finds the ticket closed."""
+        with self._lock:
+            if self.closed:
+                return False
+            self.state = "timeout"
+            self.status, self.epoch = 503, epoch
+            self.error = "deadline exceeded"
+        self._event.set()
+        return True
+
+    def mark_dispatched(self) -> None:
+        """Queued -> dispatched, unless a deadline claim already closed
+        the ticket (the loser of that race simply packs a row nobody is
+        waiting for)."""
+        with self._lock:
+            if not self.closed:
+                self.state = "dispatched"
+
+    def reopen(self) -> bool:
+        """Back to the queue after an elastic drain (plane only; forward
+        steps are stateless, so re-dispatch cannot double-apply). False
+        when a concurrent deadline claim closed the ticket first."""
+        with self._lock:
+            if self.closed:
+                return False
+            self.state = "queued"
+            return True
+
+    def wait(self, timeout_s: float) -> bool:
+        return self._event.wait(timeout=timeout_s)
+
+
+class MicroBatcher:
+    """Per-bucket FIFO queues + continuous cut.
+
+    ``next_batch`` blocks until any live ticket is queued (or the
+    timeout lapses) and cuts up to ``batch_max`` tickets from the bucket
+    holding the OLDEST queued head — cross-bucket fairness is strict
+    arrival order, so a hot bucket cannot starve a cold one."""
+
+    def __init__(self, batch_max: int = 8,
+                 edges: Optional[Tuple[int, ...]] = None,
+                 edge_ratio: float = 2.0) -> None:
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: "OrderedDict[Tuple, Deque[Ticket]]" = OrderedDict()
+        self._depth = 0
+        self._batch_max = max(int(batch_max), 1)
+        self._edge_ratio = float(edge_ratio)
+        self._explicit_edges = tuple(edges) if edges else None
+
+    # -- knob surface (the autotune appliers; docs/serving.md) ---------------
+
+    @property
+    def batch_max(self) -> int:
+        return self._batch_max
+
+    def set_batch_max(self, n: int) -> None:
+        with self._lock:
+            self._batch_max = max(int(n), 1)
+
+    def set_edge_ratio(self, ratio: float) -> None:
+        with self._lock:
+            self._edge_ratio = float(ratio)
+
+    def edges(self) -> Tuple[int, ...]:
+        return derive_edges(self._batch_max, self._edge_ratio,
+                            self._explicit_edges)
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    # -- queue mechanics ------------------------------------------------------
+
+    def enqueue(self, ticket: Ticket, front: bool = False) -> None:
+        with self._lock:
+            queue = self._queues.get(ticket.key)
+            if queue is None:
+                queue = self._queues[ticket.key] = deque()
+            if front:
+                queue.appendleft(ticket)
+            else:
+                queue.append(ticket)
+            self._depth += 1
+            _QUEUE_DEPTH.set(self._depth)
+            self._cond.notify_all()
+
+    def requeue(self, tickets: List[Ticket]) -> None:
+        """Front-requeue in original arrival order (the elastic drain:
+        re-dispatch after re-arm must not jump the line both ways)."""
+        for ticket in reversed(tickets):
+            if ticket.reopen():
+                self.enqueue(ticket, front=True)
+
+    def _drop_closed_head(self, queue: Deque[Ticket]) -> None:
+        while queue and queue[0].closed:
+            queue.popleft()
+            self._depth -= 1
+
+    def next_batch(self, timeout_s: float = 0.2
+                   ) -> Optional[Tuple[Tuple, List[Ticket], int]]:
+        """Cut the next batch: ``(key, tickets, padded_n)``; None when
+        nothing live is queued within ``timeout_s``. Closed tickets
+        (deadline claims) are skimmed off, never packed."""
+        deadline = time.monotonic() + max(float(timeout_s), 0.0)
+        with self._lock:
+            while True:
+                oldest_key, oldest_t0 = None, None
+                for key, queue in list(self._queues.items()):
+                    self._drop_closed_head(queue)
+                    if not queue:
+                        # emptied buckets are removed, not kept: raw
+                        # tensor shapes are client-controlled, so
+                        # retained empties would grow (and be rescanned
+                        # on every cut) forever in the one process that
+                        # must stay up across relaunches
+                        del self._queues[key]
+                        continue
+                    if oldest_t0 is None or queue[0].t0 < oldest_t0:
+                        oldest_key, oldest_t0 = key, queue[0].t0
+                if oldest_key is not None:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    _QUEUE_DEPTH.set(self._depth)
+                    return None
+                self._cond.wait(timeout=remaining)
+            queue = self._queues[oldest_key]
+            tickets: List[Ticket] = []
+            while queue and len(tickets) < self._batch_max:
+                ticket = queue.popleft()
+                self._depth -= 1
+                if not ticket.closed:
+                    tickets.append(ticket)
+            if not queue:
+                del self._queues[oldest_key]
+            _QUEUE_DEPTH.set(self._depth)
+            edges = derive_edges(self._batch_max, self._edge_ratio,
+                                 self._explicit_edges)
+        if not tickets:  # every popped ticket was already closed
+            return None
+        padded = pad_to_edge(len(tickets), edges)
+        _BATCHES.inc()
+        _FILL.observe(len(tickets) / padded)
+        return oldest_key, tickets, padded
+
+    def drain(self) -> List[Ticket]:
+        """Remove and return every live queued ticket (plane teardown /
+        world-down bookkeeping)."""
+        with self._lock:
+            out: List[Ticket] = []
+            for queue in self._queues.values():
+                while queue:
+                    ticket = queue.popleft()
+                    if not ticket.closed:
+                        out.append(ticket)
+            self._queues.clear()
+            self._depth = 0
+            _QUEUE_DEPTH.set(0)
+            return out
+
+    def pack(self, tickets: List[Ticket], padded: int) -> np.ndarray:
+        """Stack ticket examples into the padded batch array (zeros rows
+        past the real count — sliced off again at completion, so padding
+        is numerics-neutral by construction)."""
+        _, dtype, shape = tickets[0].key
+        batch = np.zeros((padded,) + tuple(shape), dtype=np.dtype(dtype))
+        for i, ticket in enumerate(tickets):
+            batch[i] = ticket.array
+        return batch

@@ -1,0 +1,173 @@
+"""Rank-0 HTTP request gateway (docs/serving.md).
+
+The front door of the serving plane: a loopback ``obs.httpd`` server
+co-hosting the gateway routes AND the metrics route set (one HTTP
+implementation, two route sets — the factoring the metrics endpoint and
+this gateway share by construction):
+
+* ``POST /v1/infer`` — one request = ONE example. JSON
+  (``{"name": ..., "inputs": [...], "dtype": "float32"}``) or a raw
+  tensor body (``application/octet-stream`` with ``X-Tensor-Name``,
+  ``X-Tensor-Dtype``, ``X-Tensor-Shape: 4,8`` headers). The response
+  mirrors the request's encoding; every response carries
+  ``X-Serving-Epoch``.
+* ``GET /v1/healthz`` — plane state (armed, epoch, queue depth, knobs).
+* ``GET /metrics`` / ``/metrics.json`` — this (driver) process's
+  registry, where every ``horovod_serving_*`` family lives.
+
+Status contract (the SLO semantics table in docs/serving.md): 200 with
+the output row; 400 malformed; 429 + ``Retry-After`` when admission's
+queue-wait estimate exceeds the SLO budget; 503 + ``Retry-After`` with
+the relaunch epoch in the body while no world is attached, when the
+queue hits its hard cap, or when the deadline passes unanswered — the
+gateway thread claims its own ticket at the deadline, so a request can
+NEVER outwait its budget no matter what the world is doing.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..obs.httpd import HttpError, HttpResponse
+from ..obs.registry import registry as _metrics
+from .plane import AdmissionError, healthz_doc
+
+_REQUESTS = _metrics().counter(
+    "horovod_serving_requests_total",
+    "Gateway requests by final HTTP status code", labels=("code",))
+_LATENCY = _metrics().histogram(
+    "horovod_serving_latency_seconds",
+    "Ticket-to-response latency of served (200) requests")
+
+
+def _header(headers: Dict[str, str], name: str,
+            default: Optional[str] = None) -> Optional[str]:
+    for key, value in headers.items():
+        if key.lower() == name.lower():
+            return value
+    return default
+
+
+class Gateway:
+    """HTTP front door bound to one :class:`ServingPlane`."""
+
+    def __init__(self, plane, port: int = 0,
+                 bind_host: str = "127.0.0.1") -> None:
+        from ..obs.exposition import metrics_routes
+        from ..obs.httpd import LoopbackHTTPD
+        from ..obs.registry import registry
+
+        self._plane = plane
+        routes = {
+            ("POST", "/v1/infer"): self._infer,
+            ("GET", "/v1/healthz"): self._healthz,
+        }
+        routes.update(metrics_routes(lambda: registry().snapshot()))
+        self._httpd = LoopbackHTTPD("horovod-serving-gateway", port,
+                                    routes, bind_host=bind_host)
+        self.port = self._httpd.port
+
+    def close(self) -> None:
+        self._httpd.close()
+
+    # -- routes ---------------------------------------------------------------
+
+    def _healthz(self, _query, _headers, _body):
+        return HttpResponse(200, "application/json",
+                            healthz_doc(self._plane))
+
+    def _error(self, status: int, message: str, epoch: int,
+               retry_after_s: Optional[float] = None):
+        headers = {"X-Serving-Epoch": str(epoch)}
+        if retry_after_s is not None:
+            headers["Retry-After"] = str(max(int(round(retry_after_s)), 1))
+        body = json.dumps({"error": message, "epoch": epoch,
+                           "retry_after_s": retry_after_s}).encode()
+        _REQUESTS.labels(code=str(status)).inc()
+        return HttpError(status, message, headers=headers,
+                         content_type="application/json", body=body)
+
+    def _parse(self, query, headers, body):
+        """(name, example array, raw?) or raise 400."""
+        ctype = (_header(headers, "Content-Type", "") or "").lower()
+        try:
+            if "octet-stream" in ctype:
+                name = _header(headers, "X-Tensor-Name") or \
+                    (query.get("name") or [None])[0]
+                if not name:
+                    raise ValueError("raw tensor body needs X-Tensor-Name "
+                                     "(or ?name=)")
+                dtype = np.dtype(_header(headers, "X-Tensor-Dtype",
+                                         "float32"))
+                shape_s = _header(headers, "X-Tensor-Shape", "")
+                shape = tuple(int(d) for d in shape_s.split(",")
+                              if d.strip() != "")
+                array = np.frombuffer(body, dtype=dtype)
+                if shape:
+                    array = array.reshape(shape)
+                return str(name), array, True
+            doc = json.loads(body.decode() or "{}")
+            name = doc.get("name")
+            if not name or "inputs" not in doc:
+                raise ValueError('JSON body needs "name" and "inputs"')
+            array = np.asarray(doc["inputs"],
+                               dtype=np.dtype(doc.get("dtype", "float32")))
+            return str(name), array, False
+        except ValueError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - malformed input is a 400
+            raise ValueError(f"malformed request body: {exc}") from exc
+
+    def _infer(self, query, headers, body):
+        plane = self._plane
+        try:
+            name, array, raw = self._parse(query, headers, body)
+        except ValueError as exc:
+            raise self._error(400, str(exc), plane.current_epoch)
+        deadline_ms = _header(headers, "X-Serving-Deadline-Ms")
+        try:
+            deadline_s = (float(deadline_ms) / 1e3 if deadline_ms
+                          else plane.default_deadline_s)
+        except ValueError:
+            # malformed input is the client's 400, not a 500
+            raise self._error(400, f"malformed X-Serving-Deadline-Ms "
+                                   f"{deadline_ms!r}",
+                              plane.current_epoch)
+        try:
+            ticket = plane.submit(name, array, deadline_s=deadline_s)
+        except AdmissionError as exc:
+            raise self._error(exc.status, exc.message, exc.epoch,
+                              exc.retry_after_s)
+        # Wait out OUR deadline, then claim the ticket ourselves: the
+        # never-a-hang guarantee lives in this thread, not in the world.
+        ticket.wait(max(ticket.deadline - time.monotonic(), 0.0) + 0.05)
+        if not ticket.closed:
+            ticket.claim_timeout(epoch=plane.current_epoch)
+        if ticket.state != "done":
+            raise self._error(ticket.status or 503,
+                              ticket.error or "request failed",
+                              ticket.epoch if ticket.epoch is not None
+                              else plane.current_epoch,
+                              ticket.retry_after_s)
+        output = ticket.output
+        latency = time.monotonic() - ticket.t0
+        _REQUESTS.labels(code="200").inc()
+        _LATENCY.observe(latency)
+        epoch_headers = {"X-Serving-Epoch": str(plane.current_epoch)}
+        if raw:
+            out = np.ascontiguousarray(output)
+            epoch_headers.update({
+                "X-Tensor-Dtype": str(out.dtype),
+                "X-Tensor-Shape": ",".join(str(d) for d in out.shape),
+            })
+            return HttpResponse(200, "application/octet-stream",
+                                out.tobytes(), epoch_headers)
+        return HttpResponse(
+            200, "application/json",
+            json.dumps({"outputs": np.asarray(output).tolist(),
+                        "epoch": plane.current_epoch}).encode(),
+            epoch_headers)

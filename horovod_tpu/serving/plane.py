@@ -1,0 +1,629 @@
+"""Driver-resident serving coordinator: dispatch, epochs, admission.
+
+The :class:`ServingPlane` is the rank-0-side half of the serving plane
+(docs/serving.md). It lives in the DRIVER process — the same process
+that runs ``run_elastic`` — so it survives world relaunches: the HTTP
+gateway stays up and answers structured 503s (carrying the relaunch
+epoch) while a failed world is being rebuilt, instead of presenting
+clients with a vanished port.
+
+Pieces, all on the existing control-plane machinery:
+
+* a ``BasicService`` (the authenticated HMAC wire every other plane
+  rides) serving the worker RPCs — ``shello`` / ``infer`` / ``result``;
+* the :class:`~horovod_tpu.serving.batcher.MicroBatcher` feeding it;
+* the :class:`~horovod_tpu.serving.gateway.Gateway` HTTP front door
+  (built on ``obs.httpd``, co-hosting the metrics route set).
+
+Dispatch is a broadcast: the first rank to ask for ordinal ``k`` cuts
+the batch and the resulting ``("batch", k, bucket, n_real, payload)``
+frame is stored and served VERBATIM to every rank (framed once — the
+``Preserialized`` idiom), so all ranks execute the identical packed
+batch. Completion is a rendezvous: every rank reports the batch digest
+(rank 0 also the output payload); tickets only complete when the
+digests agree, and a divergence escalates instead of serving silently
+wrong bytes (the PR-8 integrity bar).
+
+Failure contract (the PR-2 interplay): ``run_elastic(serving_plane=...)``
+calls :meth:`begin_epoch` before every attempt and :meth:`world_down`
+when one fails. ``world_down`` drains every in-flight ticket — requeue
+when the deadline still allows a post-relaunch retry (forward steps are
+stateless, so re-dispatch cannot double-apply), structured 503
+otherwise — and never leaves a ticket hanging: a ticket the plane
+forgets is still bounded by its gateway thread's own deadline claim.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import config as _config
+from ..core.config import _env_bool, _env_float, _env_int
+from ..obs.registry import registry as _metrics
+from ..runner.network import BasicService, Preserialized, make_secret
+from .batcher import MicroBatcher, Ticket, bucket_key
+
+_EPOCH_GAUGE = _metrics().gauge(
+    "horovod_serving_relaunch_epoch",
+    "Elastic epoch the serving plane currently targets (bumped by every "
+    "relaunch; 503s during a relaunch carry it)")
+_ARMS = _metrics().counter(
+    "horovod_serving_rearms_total",
+    "Times the plane (re-)armed: every member rank of the current epoch "
+    "checked in and dispatch (re-)opened")
+_WORLD_DOWNS = _metrics().counter(
+    "horovod_serving_world_downs_total",
+    "Times the serving world went down under the plane (rank death, "
+    "elastic relaunch, digest divergence)")
+_MISMATCHES = _metrics().counter(
+    "horovod_serving_digest_mismatches_total",
+    "Result rendezvous where per-rank output digests diverged (the batch "
+    "failed structurally and the world was torn down, never served)")
+_DISPATCHED = _metrics().counter(
+    "horovod_serving_dispatched_batches_total",
+    "Batches broadcast to the serving world")
+
+# A requeued ticket needs this much deadline headroom to be worth
+# re-dispatching after a relaunch; anything tighter fails 503 at drain.
+_REQUEUE_MARGIN_S = 0.25
+
+# A result rendezvous that outlives this bound with the world still
+# nominally up is a wedge: fail structurally instead of parking forever
+# (the never-a-hang bar; world_down unparks the normal failure paths).
+_RESULT_RENDEZVOUS_TIMEOUT_S = 120.0
+
+
+class AdmissionError(Exception):
+    """Structured admission reject: the gateway renders it as the HTTP
+    status + ``Retry-After`` + JSON body (429 = queue past the SLO
+    budget; 503 = no world / queue hard cap / shutting down)."""
+
+    def __init__(self, status: int, message: str, epoch: int,
+                 retry_after_s: float) -> None:
+        super().__init__(message)
+        self.status = int(status)
+        self.message = message
+        self.epoch = int(epoch)
+        self.retry_after_s = float(retry_after_s)
+
+
+class _Inflight:
+    """One broadcast batch awaiting its result rendezvous."""
+
+    __slots__ = ("key", "tickets", "n_real", "t_cut", "votes", "payload",
+                 "errors", "event", "fail_msg")
+
+    def __init__(self, key: Tuple, tickets: List[Ticket],
+                 n_real: int) -> None:
+        self.key = key
+        self.tickets = tickets
+        self.n_real = n_real
+        self.t_cut = time.monotonic()
+        self.votes: Dict[int, Optional[str]] = {}
+        self.payload: Optional[np.ndarray] = None
+        self.errors: Dict[int, str] = {}
+        self.event = threading.Event()
+        self.fail_msg: Optional[str] = None
+
+
+class ServingPlane:
+    """Rank-0 request gateway + continuous micro-batching coordinator.
+
+    Construct in the driver process, pass to
+    ``run_elastic(serving_plane=...)`` (or export :meth:`env` into a
+    plain ``runner.run`` world), point clients at
+    ``http://127.0.0.1:{gateway_port}/v1/infer``, and :meth:`close` when
+    done. Constructor arguments win over their ``HOROVOD_SERVING_*``
+    environment defaults."""
+
+    def __init__(self, gateway_port: Optional[int] = 0,
+                 service_port: int = 0,
+                 secret: Optional[str] = None,
+                 batch_max: Optional[int] = None,
+                 bucket_edges: Optional[Tuple[int, ...]] = None,
+                 edge_ratio: Optional[float] = None,
+                 queue_max: Optional[int] = None,
+                 slo_ms: Optional[float] = None,
+                 deadline_ms: Optional[float] = None,
+                 autotune: Optional[bool] = None,
+                 reconnect_window_s: Optional[float] = None,
+                 world_id: str = "") -> None:
+        import os
+
+        if batch_max is None:
+            batch_max = _env_int(_config.HOROVOD_SERVING_BATCH_MAX, 8)
+        batch_max_explicit = bool(
+            os.environ.get(_config.HOROVOD_SERVING_BATCH_MAX))
+        if bucket_edges is None:
+            raw = os.environ.get(_config.HOROVOD_SERVING_BUCKET_EDGES, "")
+            bucket_edges = tuple(
+                int(e) for e in raw.split(",") if e.strip()) or None
+        edges_explicit = bucket_edges is not None
+        if edge_ratio is None:
+            edge_ratio = _env_float(_config.HOROVOD_SERVING_EDGE_RATIO, 2.0)
+        self._queue_max = queue_max if queue_max is not None else \
+            _env_int(_config.HOROVOD_SERVING_QUEUE_MAX, 256)
+        self._slo_s = (slo_ms if slo_ms is not None else
+                       _env_float(_config.HOROVOD_SERVING_SLO_MS,
+                                  2000.0)) / 1e3
+        self.default_deadline_s = (
+            deadline_ms if deadline_ms is not None else
+            _env_float(_config.HOROVOD_SERVING_DEADLINE_MS, 10000.0)) / 1e3
+        if autotune is None:
+            autotune = _env_bool(_config.HOROVOD_SERVING_AUTOTUNE)
+        if reconnect_window_s is None:
+            reconnect_window_s = _env_float(
+                _config.HOROVOD_RECONNECT_WINDOW, 5.0)
+        self._window_s = max(float(reconnect_window_s), 0.0)
+        self._world_id = world_id
+
+        self._batcher = MicroBatcher(batch_max=max(int(batch_max), 1),
+                                     edges=bucket_edges,
+                                     edge_ratio=float(edge_ratio))
+        self._cond = threading.Condition()
+        self._epoch = 0
+        self._world: Optional[int] = None
+        self._hellos: set = set()
+        self._armed = False
+        self._down_reason: Optional[str] = None
+        self._stopping = False
+        self._dispatch: Dict[int, bytes] = {}
+        self._inflight: Dict[int, _Inflight] = {}
+        self._next_ordinal = 0
+        self._cutting = False
+        self._conn_ranks: Dict[int, int] = {}
+        self._rank_conns: Dict[int, int] = {}
+        self._pending_reconnect: Dict[int, float] = {}
+        self._ema_batch_s: Optional[float] = None
+        self._dispatched_total = 0
+        self._max_batch_real = 0
+
+        self._policy = None
+        if autotune:
+            from ..tune.policy import TuningPolicy, serving_knobs
+
+            self._policy = TuningPolicy(
+                serving_knobs(self._batcher.batch_max, float(edge_ratio),
+                              batch_max_explicit=batch_max_explicit,
+                              edges_explicit=edges_explicit),
+                window=5, cooldown=2)
+
+        self._secret_hex = secret or make_secret()
+        self._service = BasicService(
+            "horovod-serving", self._handle,
+            secret=bytes.fromhex(self._secret_hex), port=service_port,
+            on_disconnect=self._on_disconnect)
+        self.service_port = self._service.port
+        self._gateway = None
+        if gateway_port is not None:
+            from .gateway import Gateway
+
+            self._gateway = Gateway(self, port=gateway_port)
+
+    # -- public surface -------------------------------------------------------
+
+    @property
+    def gateway_port(self) -> Optional[int]:
+        return self._gateway.port if self._gateway is not None else None
+
+    @property
+    def secret(self) -> bytes:
+        return bytes.fromhex(self._secret_hex)
+
+    def env(self) -> Dict[str, str]:
+        """The worker-side environment block: merged into every elastic
+        attempt by ``run_elastic(serving_plane=...)`` (the secret rides
+        the env exactly like the launcher's HOROVOD_SECRET_KEY)."""
+        return {
+            _config.HOROVOD_SERVING_ADDR: "127.0.0.1",
+            _config.HOROVOD_SERVING_PORT: str(self.service_port),
+            _config.HOROVOD_SERVING_SECRET: self._secret_hex,
+            _config.HOROVOD_SERVING_BATCH_MAX:
+                str(self._batcher.batch_max),
+            # the EFFECTIVE padding edges, so worker warmup pre-compiles
+            # the shapes live batches will actually present (a warmed
+            # default ladder under non-default edges would pay the
+            # compile on the first live request — the exact SLO hit
+            # warmup exists to prevent)
+            _config.HOROVOD_SERVING_BUCKET_EDGES:
+                ",".join(str(e) for e in self._batcher.edges()),
+        }
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "armed": self._armed,
+                "epoch": self._epoch,
+                "world": self._world,
+                "queue_depth": self._batcher.depth,
+                "inflight": len(self._inflight),
+                "dispatched_total": self._dispatched_total,
+                "max_batch_real": self._max_batch_real,
+                "batch_max": self._batcher.batch_max,
+                "edges": list(self._batcher.edges()),
+                "ema_batch_s": self._ema_batch_s,
+                "stopping": self._stopping,
+                "down_reason": self._down_reason,
+            }
+
+    def config_snapshot(self) -> dict:
+        """Live knob values (the gateway's /v1/healthz and tests)."""
+        return {"serving_batch_max": self._batcher.batch_max,
+                "serving_bucket_edges": list(self._batcher.edges()),
+                "queue_max": self._queue_max,
+                "slo_ms": self._slo_s * 1e3}
+
+    def set_batch_max(self, n: int) -> None:
+        self._batcher.set_batch_max(n)
+
+    @property
+    def current_epoch(self) -> int:
+        return self._epoch
+
+    # -- admission (the gateway's entry point) --------------------------------
+
+    def submit(self, name: str, array: np.ndarray,
+               deadline_s: Optional[float] = None) -> Ticket:
+        """Admit one request or raise :class:`AdmissionError`.
+
+        Deadline-aware: 503 when no world is attached (carrying the
+        relaunch epoch) or the queue hit its hard cap; 429 + Retry-After
+        when the estimated queue wait exceeds the SLO budget — shedding
+        at the door beats admitting work that will only time out."""
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        with self._cond:
+            epoch = self._epoch
+            if self._stopping:
+                raise AdmissionError(503, "serving plane shutting down",
+                                     epoch, 1.0)
+            if not self._armed:
+                reason = self._down_reason or "no serving world attached"
+                raise AdmissionError(
+                    503, f"serving world relaunching ({reason})", epoch,
+                    1.0)
+            depth = self._batcher.depth
+            if depth >= self._queue_max:
+                raise AdmissionError(
+                    503, f"queue full ({depth} >= {self._queue_max})",
+                    epoch, max(self._est_wait_s(depth), 0.5))
+            est = self._est_wait_s(depth)
+            if est > self._slo_s:
+                raise AdmissionError(
+                    429, f"estimated queue wait {est * 1e3:.0f}ms exceeds "
+                         f"the {self._slo_s * 1e3:.0f}ms SLO budget",
+                    epoch, est)
+            ticket = Ticket(bucket_key(name, array.dtype, array.shape),
+                            array, deadline_s)
+            self._batcher.enqueue(ticket)
+            return ticket
+
+    def _est_wait_s(self, depth: int) -> float:
+        """Estimated QUEUE wait (batches ahead x EMA batch time) — the
+        request's own service time is excluded, so an empty queue always
+        admits and only backlog trips the SLO budget."""
+        per_batch = self._ema_batch_s if self._ema_batch_s is not None \
+            else 0.05
+        return (depth / max(self._batcher.batch_max, 1)) * per_batch
+
+    # -- elastic interplay (docs/serving.md failover matrix) ------------------
+
+    def begin_epoch(self, epoch: int, world: int) -> None:
+        """Target a (re)launched world: called by ``run_elastic`` before
+        every attempt. Supersedes any half-torn-down predecessor state,
+        then waits for all ``world`` ranks' shellos to re-arm."""
+        with self._cond:
+            if self._armed or self._inflight or self._dispatch:
+                self._world_down_locked(f"superseded by epoch {epoch}")
+            self._epoch = int(epoch)
+            self._world = int(world)
+            self._hellos.clear()
+            self._down_reason = None
+            _EPOCH_GAUGE.set(self._epoch)
+            self._cond.notify_all()
+
+    def world_down(self, reason: str) -> None:
+        """The current world failed (elastic attempt error, rank death).
+        Idempotent; drains or structurally errors every in-flight
+        ticket — never a hang."""
+        with self._cond:
+            self._world_down_locked(reason)
+
+    def _world_down_locked(self, reason: str) -> None:
+        if not self._armed and not self._inflight and not self._dispatch \
+                and self._down_reason is not None:
+            return  # already down with a recorded reason (idempotent)
+        _WORLD_DOWNS.inc()
+        self._armed = False
+        self._down_reason = reason
+        self._hellos.clear()
+        self._conn_ranks.clear()
+        self._rank_conns.clear()
+        self._pending_reconnect.clear()
+        epoch = self._epoch
+        now = time.monotonic()
+        requeue: List[Ticket] = []
+        for inf in self._inflight.values():
+            for ticket in inf.tickets:
+                if ticket.closed:
+                    continue
+                if ticket.deadline - now > _REQUEUE_MARGIN_S:
+                    requeue.append(ticket)
+                else:
+                    ticket.fail(503, f"serving world relaunching "
+                                     f"({reason})", epoch=epoch,
+                                retry_after_s=1.0)
+            if inf.fail_msg is None:
+                inf.fail_msg = (f"serving epoch {epoch} torn down: "
+                                f"{reason}")
+            inf.event.set()
+        self._inflight.clear()
+        self._dispatch.clear()
+        self._next_ordinal = 0
+        requeue.sort(key=lambda t: t.t0)
+        self._batcher.requeue(requeue)
+        self._cond.notify_all()
+
+    def stop(self) -> None:
+        """Clean shutdown: parked ``infer`` handlers answer ``stop`` (so
+        worker loops return their stats), queued tickets fail 503."""
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        for ticket in self._batcher.drain():
+            ticket.fail(503, "serving plane shutting down",
+                        epoch=self._epoch, retry_after_s=None)
+
+    def close(self) -> None:
+        self.stop()
+        self._service.shutdown()
+        if self._gateway is not None:
+            self._gateway.close()
+
+    # -- worker RPC handlers --------------------------------------------------
+
+    def _handle(self, req, sock):
+        kind = req[0]
+        if kind == "shello":
+            return self._shello(req, sock)
+        if kind == "infer":
+            _, rank, epoch, ordinal = req
+            return self._infer(rank, int(epoch), int(ordinal))
+        if kind == "result":
+            _, rank, epoch, ordinal, digest, payload, error = req
+            return self._result(int(rank), int(epoch), int(ordinal),
+                                digest, payload, error)
+        raise ValueError(f"unknown serving request {kind!r}")
+
+    def _shello(self, req, sock):
+        _, rank, size, epoch, world_id = req
+        if world_id and self._world_id and world_id != self._world_id:
+            raise RuntimeError(
+                f"serving world mismatch: plane serves "
+                f"{self._world_id!r}, hello came from {world_id!r}")
+        with self._cond:
+            if self._stopping:
+                raise RuntimeError("serving plane shutting down")
+            if self._world is None:
+                # adopt-from-first-hello: plain runner.run worlds with no
+                # elastic driver calling begin_epoch
+                self._epoch, self._world = int(epoch), int(size)
+                _EPOCH_GAUGE.set(self._epoch)
+            if int(epoch) != self._epoch:
+                raise RuntimeError(
+                    f"stale serving epoch {epoch} (current {self._epoch}; "
+                    f"a pre-relaunch zombie worker must not rejoin)")
+            if int(size) != self._world:
+                raise RuntimeError(
+                    f"serving world size mismatch: expected {self._world}, "
+                    f"rank {rank} announced {size}")
+            # supersede: a reconnecting rank's new connection takes over
+            # (de-identify the old one so its close is not a rank death)
+            old = self._rank_conns.get(rank)
+            if old is not None and old != id(sock):
+                self._conn_ranks.pop(old, None)
+            self._rank_conns[rank] = id(sock)
+            self._conn_ranks[id(sock)] = rank
+            self._pending_reconnect.pop(rank, None)
+            self._hellos.add(int(rank))
+            if len(self._hellos) == self._world and not self._armed:
+                self._armed = True
+                self._down_reason = None
+                _ARMS.inc()
+                self._cond.notify_all()
+            return ("ok", self._epoch)
+
+    def _check_epoch_locked(self, epoch: int) -> None:
+        if epoch != self._epoch or self._down_reason is not None:
+            raise RuntimeError(
+                f"serving epoch {epoch} torn down "
+                f"({self._down_reason or f'current epoch is {self._epoch}'})")
+
+    def _infer(self, rank: int, epoch: int, ordinal: int):
+        """Park until batch ``ordinal`` exists; the first rank to reach a
+        new ordinal cuts it (continuously: as soon as any ticket is
+        queued). Every rank receives the stored frame verbatim."""
+        with self._cond:
+            while True:
+                # an already-dispatched frame is served even while
+                # stopping: every rank must fetch and vote on an
+                # in-flight batch or the result rendezvous strands its
+                # peers (completing in-flight work IS the clean drain —
+                # only the NEXT ordinal answers "stop")
+                frame = self._dispatch.get(ordinal)
+                if frame is not None:
+                    return Preserialized(frame)
+                if self._stopping:
+                    return ("stop",)
+                self._check_epoch_locked(epoch)
+                if not self._cutting and ordinal == self._next_ordinal:
+                    self._cutting = True
+                    break
+                self._cond.wait(timeout=0.2)
+        try:
+            return self._cut(epoch, ordinal)
+        finally:
+            with self._cond:
+                self._cutting = False
+                self._cond.notify_all()
+
+    def _cut(self, epoch: int, ordinal: int):
+        while True:
+            with self._cond:
+                if self._stopping:
+                    return ("stop",)
+                self._check_epoch_locked(epoch)
+            got = self._batcher.next_batch(timeout_s=0.2)
+            if got is None:
+                continue
+            key, tickets, padded = got
+            batch = self._batcher.pack(tickets, padded)
+            frame = self._service.wire.frame(
+                ("batch", ordinal, key, len(tickets), batch))
+            with self._cond:
+                if self._stopping or epoch != self._epoch or \
+                        self._down_reason is not None:
+                    # the world state moved between cut and registration:
+                    # these tickets belong to nobody — resolve them here
+                    for ticket in tickets:
+                        ticket.fail(503, "serving world relaunching "
+                                         "(batch dropped at cut)",
+                                    epoch=self._epoch, retry_after_s=1.0)
+                    if self._stopping:
+                        return ("stop",)
+                    self._check_epoch_locked(epoch)
+                for ticket in tickets:
+                    ticket.mark_dispatched()
+                self._dispatch[ordinal] = frame
+                self._inflight[ordinal] = _Inflight(key, tickets,
+                                                    len(tickets))
+                self._next_ordinal += 1
+                self._dispatched_total += 1
+                self._max_batch_real = max(self._max_batch_real,
+                                           len(tickets))
+                _DISPATCHED.inc()
+                self._cond.notify_all()
+            return Preserialized(frame)
+
+    def _result(self, rank: int, epoch: int, ordinal: int,
+                digest: Optional[str], payload, error: Optional[str]):
+        with self._cond:
+            self._check_epoch_locked(epoch)
+            inf = self._inflight.get(ordinal)
+            if inf is None:
+                raise RuntimeError(
+                    f"no in-flight batch {ordinal} in epoch {epoch}")
+            if error:
+                inf.errors[rank] = error
+            inf.votes[rank] = digest
+            if payload is not None:
+                inf.payload = payload
+            if len(inf.votes) == self._world:
+                self._finalize_locked(ordinal, inf)
+        if not inf.event.wait(timeout=_RESULT_RENDEZVOUS_TIMEOUT_S):
+            raise RuntimeError(
+                f"result rendezvous for batch {ordinal} timed out after "
+                f"{_RESULT_RENDEZVOUS_TIMEOUT_S:.0f}s — a rank never "
+                f"reported and the world was not torn down")
+        if inf.fail_msg is not None:
+            raise RuntimeError(inf.fail_msg)
+        return ("ok",)
+
+    def _finalize_locked(self, ordinal: int, inf: _Inflight) -> None:
+        """All votes in (caller holds the lock): verify, complete, learn."""
+        del self._inflight[ordinal]
+        self._dispatch.pop(ordinal, None)
+        epoch = self._epoch
+        if inf.errors:
+            detail = "; ".join(f"rank {r}: {m}"
+                               for r, m in sorted(inf.errors.items()))
+            for ticket in inf.tickets:
+                ticket.fail(500, f"forward step failed ({detail})",
+                            epoch=epoch)
+            inf.event.set()
+            return
+        if len(set(inf.votes.values())) != 1:
+            _MISMATCHES.inc()
+            detail = ", ".join(
+                f"rank {r}: {str(d)[:12]}"
+                for r, d in sorted(inf.votes.items()))
+            msg = (f"batch {ordinal} output digests diverged across ranks "
+                   f"({detail}) — refusing to serve silently wrong bytes")
+            for ticket in inf.tickets:
+                ticket.fail(500, msg, epoch=epoch)
+            inf.fail_msg = msg  # workers raise -> world fault -> relaunch
+            self._world_down_locked(msg)
+            inf.event.set()
+            return
+        if inf.payload is None:
+            msg = f"batch {ordinal}: no rank shipped the output payload"
+            for ticket in inf.tickets:
+                ticket.fail(500, msg, epoch=epoch)
+            inf.fail_msg = msg
+            inf.event.set()
+            return
+        payload = np.asarray(inf.payload)
+        for i, ticket in enumerate(inf.tickets):
+            ticket.complete(payload[i])
+        dt = max(time.monotonic() - inf.t_cut, 1e-6)
+        self._ema_batch_s = dt if self._ema_batch_s is None else \
+            0.8 * self._ema_batch_s + 0.2 * dt
+        inf.event.set()
+        if self._policy is not None:
+            batch_bytes = payload.nbytes * inf.n_real / max(
+                payload.shape[0], 1)
+            decision = self._policy.observe(float(batch_bytes), dt * 1e6)
+            if decision is not None:
+                self._apply_decision(decision)
+
+    def _apply_decision(self, decision) -> None:
+        from ..tune.policy import KNOB_SERVING_BATCH, KNOB_SERVING_EDGES
+
+        self._batcher.set_batch_max(
+            int(decision.config[KNOB_SERVING_BATCH]))
+        self._batcher.set_edge_ratio(
+            float(decision.config[KNOB_SERVING_EDGES]))
+
+    # -- failure detection ----------------------------------------------------
+
+    def _on_disconnect(self, sock) -> None:
+        """A rank-bound serving connection dropped: give it the reconnect
+        window to supersede (a chaos-healed client redials within it),
+        then declare the world down — the same grace the controller
+        gives its cycle connections."""
+        with self._cond:
+            rank = self._conn_ranks.pop(id(sock), None)
+            if rank is not None and self._rank_conns.get(rank) == id(sock):
+                del self._rank_conns[rank]
+            if rank is None or self._stopping or not self._armed:
+                return
+            deadline = time.monotonic() + self._window_s
+            self._pending_reconnect[rank] = deadline
+        timer = threading.Timer(self._window_s + 0.05,
+                                self._reconnect_deadline,
+                                args=(rank, deadline))
+        timer.daemon = True
+        timer.start()
+
+    def _reconnect_deadline(self, rank: int, deadline: float) -> None:
+        with self._cond:
+            if self._pending_reconnect.get(rank) != deadline:
+                return  # superseded or healed
+            del self._pending_reconnect[rank]
+            if self._stopping or rank in self._rank_conns:
+                return
+            self._world_down_locked(
+                f"rank {rank} serving connection lost past the "
+                f"{self._window_s:.1f}s reconnect window")
+
+
+def healthz_doc(plane: ServingPlane) -> bytes:
+    """The gateway's /v1/healthz body (here so tooling can reuse it)."""
+    doc = dict(plane.stats())
+    doc.update(plane.config_snapshot())
+    return json.dumps(doc).encode()

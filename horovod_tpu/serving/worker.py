@@ -1,0 +1,237 @@
+"""Rank-side serving loop: pull packed batches, run the forward step,
+report results.
+
+Every member rank of a serving world runs :func:`serve_worker` with the
+same ``{name: forward_fn}`` model table. The loop dials the driver's
+:class:`~horovod_tpu.serving.plane.ServingPlane` coordinator on its OWN
+authenticated connection — the PR-9 second-connection pattern: serving
+traffic never holds (or parks behind) the training cycle channel's
+request lock, so a world that trains and serves at once keeps both
+planes independent. The wire is the standard self-healing control plane
+(``BasicClient`` reconnect + request dedup), so a dropped batch or
+result frame heals transparently and a replay can never re-invoke a
+dispatch.
+
+Protocol (all under the ``#rpc`` dedup envelope; docs/serving.md):
+
+* ``("shello", rank, size, epoch, world_id)`` — identify; refused when
+  the epoch is stale (a zombie worker of a pre-relaunch world).
+* ``("infer", rank, epoch, ordinal)`` — parks until batch ``ordinal``
+  exists, then every rank receives the IDENTICAL
+  ``("batch", ordinal, bucket, n_real, payload)`` broadcast (framed once
+  coordinator-side, the ``Preserialized`` idiom).
+* ``("result", rank, epoch, ordinal, digest, payload, error)`` — the
+  result rendezvous: every rank ships the batch digest (rank 0 also the
+  output payload); the coordinator verifies the digests agree before any
+  ticket completes — replicated dispatch is only worth broadcasting if
+  divergence is caught, not averaged away.
+
+The forward step is pre-compiled per padding bucket: with ``jit=True``
+each ``(name, batch_shape, dtype)`` compiles once (``jax.jit``) and
+every later batch in that bucket replays the compiled step — the reason
+the batcher pads to a bounded edge set at all.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core import config as _config
+from ..core.status import HorovodInternalError
+from ..obs.registry import registry as _metrics
+
+_WORKER_BATCHES = _metrics().counter(
+    "horovod_serving_worker_batches_total",
+    "Packed batches this rank's serving loop executed")
+_WORKER_COMPILES = _metrics().counter(
+    "horovod_serving_worker_compiles_total",
+    "Distinct (model, bucket) forward steps this rank compiled")
+
+
+class ServingAbortedError(HorovodInternalError):
+    """The serving world failed under this rank (coordinator abort, a
+    peer's death, transport budget exhausted). Subclasses
+    ``HorovodInternalError`` so the elastic driver classifies the
+    attempt as a recoverable WORLD fault and relaunches (the PR-2
+    ``_is_world_fault`` contract), instead of failing fast as if the
+    user's forward fn had a bug."""
+
+
+_FAULT_RE = re.compile(
+    r"^kill@rank(?P<rank>\d+):batch(?P<batch>\d+)(?:@epoch(?P<epoch>\d+))?$")
+
+
+def parse_serving_fault(spec: str) -> Optional[Tuple[int, int, int]]:
+    """``kill@rankN:batchM[@epochE]`` -> (rank, batch_ordinal, epoch);
+    empty -> None; typos fail loudly (the chaos-grammar loudness
+    contract: a silently ignored fault spec certifies nothing). The
+    batch ordinal is 1-based, like the chaos plane's msg ordinals."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    m = _FAULT_RE.match(spec)
+    if m is None:
+        raise ValueError(
+            f"bad {_config.HOROVOD_SERVING_FAULT} spec {spec!r}; expected "
+            f"'kill@rankN:batchM[@epochE]' (os._exit the rank right "
+            f"before it reports its Mth batch result in epoch E)")
+    if int(m.group("batch")) < 1:
+        raise ValueError(
+            f"bad {_config.HOROVOD_SERVING_FAULT} spec {spec!r}: batch "
+            f"ordinals are 1-based")
+    return (int(m.group("rank")), int(m.group("batch")),
+            int(m.group("epoch") or 0))
+
+
+def _digest(out: np.ndarray) -> str:
+    """Cross-rank consistency digest of a batch output: bytes + dtype +
+    shape (two ranks agreeing on bytes of different shapes is still a
+    divergence)."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(out).tobytes())
+    h.update(str(out.dtype).encode())
+    h.update(repr(tuple(out.shape)).encode())
+    return h.hexdigest()
+
+
+def serve_worker(models: Dict[str, Callable],
+                 addr: Optional[Tuple[str, int]] = None,
+                 secret: Optional[bytes] = None,
+                 rank: Optional[int] = None,
+                 size: Optional[int] = None,
+                 epoch: Optional[int] = None,
+                 world_id: str = "",
+                 jit: bool = True,
+                 warmup: Tuple[Tuple[str, Tuple[int, ...], str], ...] = (),
+                 connect_attempts: int = 100) -> dict:
+    """Serve until the coordinator says stop; returns this rank's stats.
+
+    Defaults come from the environment the driver exported
+    (``HOROVOD_SERVING_ADDR/PORT/SECRET`` via ``ServingPlane.env()``,
+    rank/size from the launcher, epoch from the elastic driver).
+    ``warmup`` pre-compiles ``(name, example_shape, dtype)`` buckets
+    across every padding edge BEFORE the hello, so the first live batch
+    never pays a compile. Clean stop returns
+    ``{"outcome": "stopped", ...}``; any world-level failure raises
+    :class:`ServingAbortedError` so the elastic driver relaunches."""
+    from ..chaos import injector_from_env
+    from ..runner.network import BasicClient, WireError
+
+    if rank is None:
+        rank = int(os.environ.get(_config.HOROVOD_RANK, "0"))
+    if size is None:
+        size = int(os.environ.get(_config.HOROVOD_SIZE, "1"))
+    if epoch is None:
+        epoch = int(os.environ.get(_config.HOROVOD_ELASTIC_EPOCH, "0"))
+    if addr is None:
+        addr = (os.environ.get(_config.HOROVOD_SERVING_ADDR, "127.0.0.1"),
+                int(os.environ[_config.HOROVOD_SERVING_PORT]))
+    if secret is None:
+        raw = os.environ.get(_config.HOROVOD_SERVING_SECRET, "")
+        secret = bytes.fromhex(raw) if raw else None
+    fault = parse_serving_fault(
+        os.environ.get(_config.HOROVOD_SERVING_FAULT, ""))
+    chaos = injector_from_env(rank, env=_config.HOROVOD_SERVING_CHAOS)
+
+    compiled: Dict[Tuple, Callable] = {}
+    jax_jit = None
+    if jit:
+        try:
+            import jax
+
+            jax_jit = jax.jit
+        except Exception:  # noqa: BLE001 - numpy-only worlds still serve
+            jax_jit = None
+
+    def _step_fn(name: str):
+        fn = models.get(name)
+        if fn is None:
+            raise KeyError(
+                f"unknown model {name!r}; this world serves "
+                f"{sorted(models)}")
+        return fn
+
+    def _run(name: str, batch: np.ndarray) -> np.ndarray:
+        key = (name, tuple(batch.shape), str(batch.dtype))
+        step = compiled.get(key)
+        if step is None:
+            base = _step_fn(name)
+            step = jax_jit(base) if jax_jit is not None else base
+            compiled[key] = step
+            _WORKER_COMPILES.inc()
+        return np.asarray(step(batch))
+
+    # Pre-compile the declared buckets across every padding edge the
+    # PLANE will actually pad to (its env block exports the effective
+    # edge list; the env-derived ladder is only the no-plane fallback) —
+    # these are the only shapes live traffic can present for the
+    # declared examples.
+    from ..core.config import _env_float
+    from .batcher import derive_edges
+
+    batch_max = max(int(os.environ.get(
+        _config.HOROVOD_SERVING_BATCH_MAX, "8") or 8), 1)
+    raw_edges = os.environ.get(_config.HOROVOD_SERVING_BUCKET_EDGES, "")
+    explicit = tuple(int(e) for e in raw_edges.split(",")
+                     if e.strip()) or None
+    edges = derive_edges(
+        batch_max, _env_float(_config.HOROVOD_SERVING_EDGE_RATIO, 2.0),
+        explicit)
+    for name, example_shape, dtype in warmup:
+        for edge in edges:
+            _run(name, np.zeros((edge,) + tuple(example_shape),
+                                dtype=np.dtype(dtype)))
+
+    shello = ("shello", rank, size, epoch, world_id)
+    stats = {"rank": rank, "epoch": epoch, "batches": 0, "requests": 0,
+             "compiled_buckets": 0, "outcome": "stopped"}
+    client = BasicClient(addr, secret=secret, timeout_s=None,
+                         attempts=connect_attempts, chaos=chaos)
+    # Re-identify after every transparent reconnect BEFORE the resent
+    # request, like the controller client's hello (a dedup REPLAY
+    # bypasses the handler and must not leave the connection anonymous).
+    client.on_reconnect = lambda c: c.bare_request(shello)
+    try:
+        client.request(shello)
+        ordinal = 0
+        while True:
+            resp = client.request(("infer", rank, epoch, ordinal))
+            if resp[0] == "stop":
+                break
+            assert resp[0] == "batch", resp
+            _, got_ordinal, key, n_real, payload = resp
+            assert got_ordinal == ordinal, (got_ordinal, ordinal)
+            name = key[0]
+            digest = None
+            output = None
+            error = None
+            try:
+                output = _run(name, payload)
+                digest = _digest(output)
+            except Exception as exc:  # noqa: BLE001 - a structural 500,
+                # not a world fault: the coordinator fails this batch's
+                # tickets and the loop keeps serving
+                error = f"{type(exc).__name__}: {exc}"
+            stats["batches"] += 1
+            stats["requests"] += int(n_real)
+            _WORKER_BATCHES.inc()
+            if fault is not None and fault[0] == rank and \
+                    fault[2] == epoch and stats["batches"] == fault[1]:
+                os._exit(1)  # kill-mid-batch: result never reported
+            client.request(("result", rank, epoch, ordinal, digest,
+                            output if rank == 0 else None, error))
+            ordinal += 1
+    except WireError as exc:
+        raise ServingAbortedError(
+            f"serving world aborted under rank {rank} (epoch {epoch}): "
+            f"{exc}") from exc
+    finally:
+        client.close()
+    stats["compiled_buckets"] = len(compiled)
+    stats["reconnects"] = client.reconnects
+    return stats

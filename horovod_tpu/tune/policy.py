@@ -44,6 +44,10 @@ KNOB_CACHE = "cache_capacity"
 KNOB_INTERVAL = "metrics_interval_s"
 KNOB_CODEC = "codec"
 KNOB_SUBBUFFERS = "fusion_subbuffers"
+# Serving-plane knobs (docs/serving.md): tuned by the driver-resident
+# ServingPlane's own policy instance, scored by batch payload throughput.
+KNOB_SERVING_BATCH = "serving_batch_max"
+KNOB_SERVING_EDGES = "serving_bucket_edges"
 
 # Prometheus gauges are numeric; the codec knob reports this id mapping
 # (documented in docs/autotune.md).
@@ -443,4 +447,24 @@ def default_knobs(cfg, extended: bool = False) -> List[Knob]:
                               if c != current]
         knobs.append(Knob(KNOB_CODEC, tuple(ladder), 0,
                           pinned=len(ladder) == 1))
+    return knobs
+
+
+def serving_knobs(batch_max: int, edge_ratio: float,
+                  batch_max_explicit: bool = False,
+                  edges_explicit: bool = False) -> List[Knob]:
+    """The serving plane's knob set (docs/serving.md): largest packed
+    batch and the padding-bucket edge growth ratio. Both are
+    numerics-neutral — padding rows are sliced off before any ticket
+    completes and packing never changes a request's row values — so
+    neither carries a consent gate like the codec's. The usual pin rule
+    applies: a knob whose env (HOROVOD_SERVING_BATCH_MAX /
+    HOROVOD_SERVING_BUCKET_EDGES) was set explicitly never moves."""
+    knobs: List[Knob] = []
+    values, index = _ladder(batch_max, [1, 2, 4, 8, 16, 32, 64, 128])
+    knobs.append(Knob(KNOB_SERVING_BATCH, values, index,
+                      pinned=batch_max_explicit))
+    values, index = _ladder(edge_ratio, [2.0, 4.0])
+    knobs.append(Knob(KNOB_SERVING_EDGES, values, index,
+                      pinned=edges_explicit))
     return knobs

@@ -18,6 +18,9 @@ import os
 import sys
 
 _LABELS = {
+    "serving_continuous_batching_speedup":
+        "Serving gateway, continuous batching (batch {batch_max}) vs "
+        "naive, peak rps at p99<={p99_budget_ms}ms",
     "resnet50": "ResNet-50, bs {batch_size}",
     "resnet101": "ResNet-101, bs {batch_size}",
     "vgg16": "VGG-16, bs {batch_size}",
@@ -33,7 +36,8 @@ def _label(rec: dict) -> str:
     model = rec.get("metric", "").split("_synthetic")[0]
     model = model.replace("_train_images_per_sec_per_device", "")
     model = model.replace("_tokens_per_sec_per_device", "")
-    tmpl = _LABELS.get(model, model or "?")
+    tmpl = _LABELS.get(rec.get("metric", ""), _LABELS.get(model,
+                                                          model or "?"))
     try:
         label = tmpl.format(**rec)
     except KeyError:
@@ -45,9 +49,43 @@ def _label(rec: dict) -> str:
     return label
 
 
+def _render_serving(rec: dict) -> None:
+    """The serving_bench.py final-line contract (docs/serving.md): the
+    per-mode offered-QPS sweeps rendered as the docs/benchmarks.md
+    serving table — p50/p99 latency next to achieved throughput, naive
+    and batched side by side per offered level."""
+    sweeps = rec["serving"]
+    by_offered = {}
+    for mode in ("naive", "batched"):
+        for row in sweeps.get(mode, []):
+            by_offered.setdefault(row["offered_qps"], {})[mode] = row
+    print()
+    print(f"Serving sweep (batch_max {rec.get('batch_max', '?')}, "
+          f"{rec.get('clients', '?')} clients, p99 budget "
+          f"{rec.get('p99_budget_ms', '?')} ms) — speedup "
+          f"{rec.get('value', '?')}x:")
+    print("| Offered QPS | naive rps | naive p50/p99 ms | batched rps |"
+          " batched p50/p99 ms |")
+    print("|---|---|---|---|---|")
+
+    def _cell(row, key):
+        return "—" if row is None or row.get(key) is None else row[key]
+
+    for offered in sorted(by_offered):
+        naive = by_offered[offered].get("naive")
+        batched = by_offered[offered].get("batched")
+        print(f"| {offered:g} "
+              f"| {_cell(naive, 'achieved_rps')} "
+              f"| {_cell(naive, 'p50_ms')} / {_cell(naive, 'p99_ms')} "
+              f"| {_cell(batched, 'achieved_rps')} "
+              f"| {_cell(batched, 'p50_ms')} / {_cell(batched, 'p99_ms')} "
+              f"|")
+
+
 def main() -> None:
     out_dir = sys.argv[1] if len(sys.argv) > 1 else "bench_results_r5"
     rows = []
+    serving_recs = []
     for path in sorted(glob.glob(os.path.join(out_dir, "*.json"))):
         try:
             with open(path) as f:
@@ -58,6 +96,8 @@ def main() -> None:
             continue
         if "metric" not in rec or "value" not in rec:
             continue  # onchip bench etc. have their own tables
+        if isinstance(rec.get("serving"), dict):
+            serving_recs.append(rec)
         rows.append((os.path.basename(path), rec))
     if not rows:
         print(f"(no parseable captures in {out_dir})", file=sys.stderr)
@@ -75,6 +115,8 @@ def main() -> None:
               f"{str(mfu) + '%' if mfu is not None else '—'} | "
               f"{str(vs) + 'x' if vs is not None else '—'} | "
               f"{'yes' if rec.get('live', True) else 'watcher'} |")
+    for rec in serving_recs:
+        _render_serving(rec)
 
 
 if __name__ == "__main__":

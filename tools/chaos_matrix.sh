@@ -19,6 +19,25 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# --serving sweeps the serving-plane grid (docs/serving.md) instead:
+# drop/delay/close on the serving RPC wire (heal via the dedup envelope)
+# and kill-rank-mid-batch (recover through the elastic driver), on both
+# negotiation cores (the serving world is a real hvd world; the serving
+# RPC rides its own connection either way).
+if [ "${1:-}" = "--serving" ]; then
+  shift
+  rc=0
+  for core in 0 1; do
+    echo "=== serving plane: HOROVOD_NATIVE_CORE=$core ==="
+    if ! JAX_PLATFORMS=cpu HOROVOD_NATIVE_CONTROLLER=0 \
+        HOROVOD_NATIVE_CORE=$core \
+        python -m horovod_tpu.chaos.matrix --serving "$@"; then
+      rc=1
+    fi
+  done
+  exit $rc
+fi
+
 if [ "${1:-}" = "--data-plane" ]; then
   shift
   rc=0

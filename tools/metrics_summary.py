@@ -84,6 +84,12 @@ TUNING_PREFIXES = ("horovod_autotune_", "horovod_straggler_evict")
 # non-zero check count, so the two must read together.
 INTEGRITY_PREFIXES = ("horovod_sentry_", "horovod_consensus_")
 
+# Serving-plane families (docs/serving.md): request codes, queue depth,
+# batch fill, and the latency histogram (p50/p99 read off the cumulative
+# buckets by the shared histogram renderer) are the "is the gateway
+# serving inside its SLO?" glance.
+SERVING_PREFIXES = ("horovod_serving_",)
+
 
 def _render_section(title: str, families: Dict[str, dict], prefix: str,
                     out, skip: tuple = ()) -> None:
@@ -116,6 +122,15 @@ def _render_integrity_section(families: Dict[str, dict], prefix: str,
     _render_section("integrity plane", integrity, prefix, out)
 
 
+def _render_serving_section(families: Dict[str, dict], prefix: str,
+                            out) -> None:
+    serving = {n: f for n, f in families.items()
+               if n.startswith(SERVING_PREFIXES) and n.startswith(prefix)}
+    if not serving:
+        return  # no serving plane in this snapshot: no empty section
+    _render_section("serving plane", serving, prefix, out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="pretty-print a saved /metrics.json or "
@@ -141,8 +156,10 @@ def main(argv=None) -> int:
 
     _render_tuning_section(world, args.family, sys.stdout)
     _render_integrity_section(world, args.family, sys.stdout)
+    _render_serving_section(world, args.family, sys.stdout)
     _render_section("world", world, args.family, sys.stdout,
-                    skip=TUNING_PREFIXES + INTEGRITY_PREFIXES)
+                    skip=TUNING_PREFIXES + INTEGRITY_PREFIXES
+                    + SERVING_PREFIXES)
     # JSON round-trips rank keys as strings; accept either
     by_rank = {int(k): v for k, v in ranks.items()}
     wanted = sorted(by_rank) if args.all else (

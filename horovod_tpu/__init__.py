@@ -35,7 +35,9 @@ del _jax_compat
 # prepends itself to the list). Applied at import so launcher-spawned
 # workers — which import this package before their first device query —
 # are steered without code changes; see docs/running.md.
-_platform = _os.environ.get("HOROVOD_PLATFORM")
+from .core import config as _config
+
+_platform = _os.environ.get(_config.HOROVOD_PLATFORM)
 if _platform:
     import jax as _jax
 

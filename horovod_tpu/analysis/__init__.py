@@ -1,0 +1,62 @@
+"""hvdlint: the contract-analysis plane (docs/analysis.md).
+
+Pure-stdlib AST checkers that enforce the repo's cross-cutting
+invariants — knob registry, lock order, collective order, wire
+compatibility, metrics/docs agreement, error taxonomy, pytest markers —
+plus an opt-in runtime lock witness (``HOROVOD_LOCK_WITNESS=1``) for the
+orders the AST pass cannot see. Nothing in this package may import jax
+(or anything that transitively does): ``tools/hvdlint.py`` must run
+anywhere ``runner.network`` does, including by loading this package
+straight from its files on machines without the package installed.
+
+CLI: ``python tools/hvdlint.py [--json]``; gate: ``tools/lint.sh``.
+Tier-1 enforcement: ``tests/test_analysis.py`` runs the whole suite over
+the repo and fails on any unwaived finding.
+"""
+
+# Only the witness is imported eagerly: it is the one piece production
+# code touches (obs/registry, ops/engine, ops/controller wrap their
+# locks through maybe_wrap), and it must stay cheap. The checker suite
+# (runner + 7 checker modules) loads lazily via PEP 562 so a worker's
+# import of horovod_tpu never pays for — or can be broken by — lint-only
+# code.
+from .witness import (
+    LockInversionError,
+    LockWitness,
+    WitnessedLock,
+    global_witness,
+    maybe_wrap,
+)
+
+__all__ = [
+    "BASELINE_REL",
+    "Baseline",
+    "CODES",
+    "Finding",
+    "LockInversionError",
+    "LockWitness",
+    "WitnessedLock",
+    "global_witness",
+    "maybe_wrap",
+    "run_all",
+    "summary_json",
+]
+
+_LAZY = {
+    "BASELINE_REL": "runner",
+    "run_all": "runner",
+    "summary_json": "runner",
+    "Baseline": "base",
+    "CODES": "base",
+    "Finding": "base",
+}
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

@@ -1,0 +1,291 @@
+"""hvdlint core: findings, suppressions, baselines, source loading.
+
+The contract-analysis plane (docs/analysis.md) statically enforces the
+repo's cross-cutting conventions — knob registry, lock order, collective
+order, wire compatibility, metrics/docs agreement, error taxonomy,
+pytest markers. This module is the shared substrate every checker builds
+on and is deliberately **stdlib-only**: ``tools/hvdlint.py`` must run
+anywhere ``runner.network`` does (CI boxes, jax-less workstations), so
+nothing under ``horovod_tpu/analysis/`` may import jax, numpy, or any
+module that transitively does.
+
+Suppression syntax (the single place a violation may be silenced in
+source)::
+
+    something_flagged()  # hvdlint: disable=HVL301 -- reason why this is fine
+
+applies to the flagged line or, when placed alone, to the line directly
+below it. Repo-wide waivers live in ``tools/hvdlint_baseline.json`` as
+``{"code", "key", "reason"}`` records keyed by each finding's *stable*
+fingerprint (never a line number, so unrelated edits don't invalidate
+them); a waiver without a written reason, or one matching nothing, is
+itself a finding — the baseline can only shrink honestly.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# One catalogue of every code a checker may emit: the runner validates
+# emitted findings against it, docs/analysis.md and the troubleshooting
+# table are generated from the same names, and a typo'd suppression code
+# fails loudly instead of silently suppressing nothing.
+CODES: Dict[str, str] = {
+    # knob registry (analysis/knobs.py)
+    "HVL101": "HOROVOD_* env read through a string literal outside "
+              "core/config.py — reference the core.config constant",
+    "HVL102": "env read references a knob constant not declared in "
+              "core/config.py",
+    "HVL103": "knob constant declared in core/config.py has no row in "
+              "docs/ — document it",
+    # lock order (analysis/locks.py)
+    "HVL201": "lock-acquisition order cycle across the merged "
+              "per-module lock graphs — potential deadlock",
+    # collective divergence (analysis/collectives.py)
+    "HVL301": "collective/rendezvous call reachable under a "
+              "rank-conditional branch — rank-divergent collective order",
+    # wire compatibility (analysis/wire.py)
+    "HVL401": "controller RPC tag not present in the wire-compat "
+              "registry naming its native-controller degrade",
+    "HVL402": "negotiation message field not present in the wire-compat "
+              "registry naming its predates-the-field degrade",
+    "HVL403": "stale wire-compat registry entry: names a tag/field the "
+              "code no longer has",
+    # metrics/docs drift (analysis/metrics_docs.py)
+    "HVL501": "metric family registered in code but missing from "
+              "docs/metrics.md",
+    "HVL502": "metric family named in docs/metrics.md but registered "
+              "nowhere in code",
+    "HVL503": "tools/metrics_summary.py section prefix matches no "
+              "registered family",
+    # error taxonomy (analysis/errors.py)
+    "HVL601": "structured error defined in core/status.py is never "
+              "raised by Status.raise_if_error — its wire tag cannot "
+              "round-trip",
+    "HVL602": "format_* tag renderer has no parse_* twin wired into "
+              "Status.raise_if_error",
+    "HVL603": "HorovodInternalError subclass defined outside "
+              "core/status.py is not in the wire-compat error registry",
+    # pytest markers (analysis/markers.py)
+    "HVL701": "pytest marker used in tests/ but not registered in "
+              "pyproject.toml [tool.pytest.ini_options] markers",
+    # suppression hygiene (analysis/base.py, analysis/runner.py)
+    "HVL901": "stale baseline waiver: matches no current finding",
+    "HVL902": "baseline waiver carries no written reason",
+    "HVL903": "inline suppression without a written reason — it "
+              "suppresses nothing until '-- reason' is added",
+    "HVL904": "inline suppression names an unknown finding code — "
+              "typo'd codes must fail loudly, not silently no-op",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*hvdlint:\s*disable=([A-Z0-9,\s]+?)(?:\s*--\s*(.*))?\s*$")
+
+
+@dataclass
+class Finding:
+    """One contract violation.
+
+    ``key`` is the stable fingerprint baseline waivers match against —
+    derived from *what* is wrong (env name + function, lock-cycle node
+    set, tag name, …), never from line numbers, so formatting-only edits
+    neither create nor destroy waiver matches.
+    """
+
+    code: str
+    path: str  # repo-relative, "" for repo-level findings
+    line: int  # 1-based; 0 for repo-level findings
+    message: str
+    key: str
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}: " if self.path else ""
+        return f"{where}{self.code} {self.message} [{self.key}]"
+
+
+@dataclass
+class SourceModule:
+    """A parsed python module plus everything checkers keep asking for."""
+
+    path: str  # absolute
+    rel: str  # repo-relative, forward slashes
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+
+    def suppressed_codes(self, line: int) -> List[str]:
+        """Codes EFFECTIVELY disabled at ``line`` (1-based): an inline
+        trailing comment on the line itself, or a comment-ONLY line
+        directly above (a trailing suppression on the previous statement
+        must not leak onto this one). A suppression without a written
+        reason or with an unknown code suppresses nothing — it is
+        reported instead (HVL903/HVL904, see ``suppression_hygiene``)."""
+        codes: List[str] = []
+        for ln in (line, line - 1):
+            if not 1 <= ln <= len(self.lines):
+                continue
+            text = self.lines[ln - 1]
+            if ln == line - 1 and not text.lstrip().startswith("#"):
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m and (m.group(2) or "").strip():
+                codes.extend(c.strip() for c in m.group(1).split(",")
+                             if c.strip() in CODES)
+        return codes
+
+    def suppression_hygiene(self) -> List["Finding"]:
+        """HVL903/HVL904 for every malformed suppression comment in this
+        module — the inline layer enforces the same written-reason and
+        known-code contract the baseline layer does."""
+        out: List[Finding] = []
+        for ln, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            listed = [c.strip() for c in m.group(1).split(",")
+                      if c.strip()]
+            if not (m.group(2) or "").strip():
+                out.append(Finding(
+                    code="HVL903", path=self.rel, line=ln,
+                    message="inline suppression has no '-- reason'; it "
+                            "is ignored until one is written",
+                    key=f"inline-reasonless:{self.rel}:{ln}"))
+            for code in listed:
+                if code not in CODES:
+                    out.append(Finding(
+                        code="HVL904", path=self.rel, line=ln,
+                        message=f"inline suppression names unknown code "
+                                f"{code!r}; it suppresses nothing",
+                        key=f"inline-unknown:{code}:{self.rel}:{ln}"))
+        return out
+
+
+def load_module(path: str, root: str) -> Optional[SourceModule]:
+    """Parse one file; syntactically-broken files return None (the test
+    suite, not the linter, owns syntax errors)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError, ValueError):
+        return None
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    return SourceModule(path=path, rel=rel, source=source, tree=tree,
+                        lines=source.splitlines())
+
+
+def iter_py_files(root: str, subdirs: Iterable[str]) -> List[str]:
+    """All .py files under ``root/<subdir>`` for each subdir, sorted for
+    deterministic finding order."""
+    out: List[str] = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and
+                           not d.startswith(".")]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def load_tree(root: str, subdirs: Iterable[str]) -> List[SourceModule]:
+    mods = []
+    for path in iter_py_files(root, subdirs):
+        mod = load_module(path, root)
+        if mod is not None:
+            mods.append(mod)
+    return mods
+
+
+def apply_inline_suppressions(
+        findings: List[Finding],
+        modules: Dict[str, SourceModule]) -> List[Finding]:
+    """Drop findings whose source line (or the line above it) carries a
+    matching ``# hvdlint: disable=CODE`` comment."""
+    kept: List[Finding] = []
+    for f in findings:
+        mod = modules.get(f.path)
+        if mod is not None and f.line and \
+                f.code in mod.suppressed_codes(f.line):
+            continue
+        kept.append(f)
+    return kept
+
+
+# -- baseline ----------------------------------------------------------------
+
+@dataclass
+class Baseline:
+    """Checked-in repo-wide waivers (tools/hvdlint_baseline.json)."""
+
+    entries: List[dict] = field(default_factory=list)
+    path: str = ""
+
+    @staticmethod
+    def load(path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return Baseline(entries=[], path=path)
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return Baseline(entries=list(data.get("waivers", [])), path=path)
+
+    def apply(self, findings: List[Finding]
+              ) -> Tuple[List[Finding], List[Finding], int]:
+        """Returns (kept, hygiene_findings, waived_count): findings that
+        survive, plus HVL901/HVL902 findings about the baseline itself."""
+        hygiene: List[Finding] = []
+        matched = [False] * len(self.entries)
+        kept: List[Finding] = []
+        waived = 0
+        for f in findings:
+            hit = False
+            for i, e in enumerate(self.entries):
+                if e.get("code") == f.code and e.get("key") == f.key:
+                    matched[i] = True
+                    hit = True
+            if hit:
+                waived += 1
+            else:
+                kept.append(f)
+        rel = os.path.basename(self.path) if self.path else "baseline"
+        for i, e in enumerate(self.entries):
+            if not str(e.get("reason", "")).strip():
+                hygiene.append(Finding(
+                    code="HVL902", path=f"tools/{rel}", line=0,
+                    message=f"waiver {e.get('code')}/{e.get('key')} has "
+                            "no written reason",
+                    key=f"reasonless:{e.get('code')}:{e.get('key')}"))
+            if not matched[i]:
+                hygiene.append(Finding(
+                    code="HVL901", path=f"tools/{rel}", line=0,
+                    message=f"stale waiver {e.get('code')}/"
+                            f"{e.get('key')}: matches no finding — "
+                            "delete it",
+                    key=f"stale:{e.get('code')}:{e.get('key')}"))
+        return kept, hygiene, waived
+
+
+def call_name(node: ast.AST) -> str:
+    """Best-effort dotted name of a call's callee ('' when dynamic)."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -1,0 +1,141 @@
+"""HVL3xx — collective-divergence lint (docs/analysis.md).
+
+Collectives must execute in rank-identical order: every rank joins every
+negotiation cycle, every sentry rendezvous, every payload exchange, in
+the same sequence — the invariant ``flush_ordinal``'s cross-check and
+PR 8's consensus judge verify at *runtime*. This is the static twin: a
+collective or rendezvous call site lexically reachable under a
+rank-conditional branch is exactly the shape that lets one rank skip (or
+double-join) an exchange its peers are parked in, which surfaces hours
+later as a hang or a desync naming the wrong rank.
+
+Legitimate rank-gated sites exist — coordinator-only bookkeeping,
+rank-0 persistence after a collective commit — and are waived inline
+with a written reason (``# hvdlint: disable=HVL301 -- why``), which
+doubles as the review artifact the runtime checks don't give you.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .base import Finding, SourceModule, call_name
+
+# callee last-names that are collectives wherever they appear
+COLLECTIVE_NAMES: Set[str] = {
+    "allreduce", "allreduce_async", "grouped_allreduce",
+    "allgather", "allgather_async", "all_gather", "all_to_all",
+    "broadcast", "broadcast_async", "broadcast_object",
+    "broadcast_parameters", "barrier", "reduce_scatter",
+    "quantized_allreduce",
+    "psum", "pmean", "pmax", "pmin",
+}
+
+# callee last-names that are collective ONLY on a rendezvous/controller
+# receiver (`self._cycles.submit(...)`, `client.payload(...)`)
+CHANNEL_METHODS: Set[str] = {"submit", "cycle", "payload", "sentry"}
+CHANNEL_RECEIVERS = ("rendezvous", "_cycles", "_payloads", "_sentry",
+                     "client", "controller", "negotiator")
+
+# identifiers in an `if` test that make the branch rank-conditional
+RANK_IDENTIFIERS: Set[str] = {
+    "rank", "_rank", "local_rank", "cross_rank", "world_rank",
+    "my_rank", "node_rank", "push_rank", "root_rank",
+}
+
+
+def is_collective_call(node: ast.Call) -> bool:
+    dotted = call_name(node)
+    if not dotted:
+        return False
+    last = dotted.rsplit(".", 1)[-1]
+    if last in COLLECTIVE_NAMES:
+        return True
+    if last in CHANNEL_METHODS and "." in dotted:
+        receiver = dotted.rsplit(".", 1)[0].lower()
+        return any(tok in receiver for tok in CHANNEL_RECEIVERS)
+    return False
+
+
+def is_rank_conditional(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in RANK_IDENTIFIERS:
+            return True
+        if isinstance(node, ast.Attribute) and \
+                node.attr in RANK_IDENTIFIERS:
+            return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, mod: SourceModule):
+        self.mod = mod
+        self.findings: List[Finding] = []
+        self.qual: List[str] = []
+        self.rank_depth = 0
+
+    def _qualname(self) -> str:
+        return ".".join(self.qual) or "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.qual.append(node.name)
+        self.generic_visit(node)
+        self.qual.pop()
+
+    def _visit_func(self, node) -> None:
+        self.qual.append(node.name)
+        # a nested def under a rank conditional runs later, possibly on
+        # every rank — reset the conditional context inside it
+        saved, self.rank_depth = self.rank_depth, 0
+        self.generic_visit(node)
+        self.rank_depth = saved
+        self.qual.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_If(self, node: ast.If) -> None:
+        conditional = is_rank_conditional(node.test)
+        self.visit(node.test)  # calls in the test run on every rank
+        if conditional:
+            self.rank_depth += 1
+        for child in node.body + node.orelse:
+            self.visit(child)
+        if conditional:
+            self.rank_depth -= 1
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        conditional = is_rank_conditional(node.test)
+        self.visit(node.test)
+        if conditional:
+            self.rank_depth += 1
+        self.visit(node.body)
+        self.visit(node.orelse)
+        if conditional:
+            self.rank_depth -= 1
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.rank_depth > 0 and is_collective_call(node):
+            callee = call_name(node)
+            self.findings.append(Finding(
+                code="HVL301", path=self.mod.rel, line=node.lineno,
+                message=f"collective call {callee}() under a "
+                        "rank-conditional branch — every rank must join "
+                        "every exchange in the same order",
+                key=f"{callee}@{self.mod.rel}:{self._qualname()}"))
+        self.generic_visit(node)
+
+
+def scan_module(mod: SourceModule) -> List[Finding]:
+    visitor = _Visitor(mod)
+    visitor.visit(mod.tree)
+    return visitor.findings
+
+
+def run(root: str, modules: List[SourceModule]) -> List[Finding]:
+    del root
+    findings: List[Finding] = []
+    for mod in modules:
+        findings.extend(scan_module(mod))
+    return findings

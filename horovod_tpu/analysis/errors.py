@@ -1,0 +1,161 @@
+"""HVL6xx — error-taxonomy lint (docs/analysis.md).
+
+The repo's structured errors survive the wire as *text*: a tag rendered
+by a ``format_*`` helper in ``core/status.py`` rides every abort reason,
+and ``Status.raise_if_error`` re-parses it into the typed exception on
+the receiving rank. That round trip is a contract with three legs this
+checker pins:
+
+* HVL601: a ``HorovodInternalError`` subclass defined in
+  ``core/status.py`` that ``raise_if_error`` never raises — the typed
+  error can be thrown locally but arrives at every peer as the generic
+  base class, losing its attribution.
+* HVL602: a ``format_*`` tag renderer without a ``parse_*`` twin wired
+  into ``raise_if_error`` — a tag that can be written but never read.
+* HVL603: a ``HorovodInternalError`` subclass defined *outside*
+  ``core/status.py`` that is not in the wire-compat error registry —
+  new planes may add structured errors, but must write down how the
+  attribution survives (or deliberately doesn't survive) the wire.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from .base import Finding, SourceModule, call_name
+
+STATUS_REL = "horovod_tpu/core/status.py"
+BASE_CLASS = "HorovodInternalError"
+
+
+def _class_bases(node: ast.ClassDef) -> List[str]:
+    names = []
+    for b in node.bases:
+        if isinstance(b, ast.Name):
+            names.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            names.append(b.attr)
+    return names
+
+
+def status_subclasses(status_mod: SourceModule) -> Dict[str, int]:
+    """name -> line of every (transitive) HorovodInternalError subclass
+    defined in core/status.py."""
+    known: Set[str] = {BASE_CLASS}
+    out: Dict[str, int] = {}
+    changed = True
+    while changed:
+        changed = False
+        for node in status_mod.tree.body:
+            if isinstance(node, ast.ClassDef) and node.name not in known \
+                    and any(b in known for b in _class_bases(node)):
+                known.add(node.name)
+                out[node.name] = node.lineno
+                changed = True
+    return out
+
+
+def _find_raise_if_error(status_mod: SourceModule):
+    for node in ast.walk(status_mod.tree):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name == "raise_if_error":
+            return node
+    return None
+
+
+def raised_in_raise_if_error(status_mod: SourceModule) -> Set[str]:
+    fn = _find_raise_if_error(status_mod)
+    out: Set[str] = set()
+    if fn is None:
+        return out
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Raise) and \
+                isinstance(node.exc, ast.Call):
+            name = call_name(node.exc)
+            if name:
+                out.add(name.rsplit(".", 1)[-1])
+    return out
+
+
+def parsers_called(status_mod: SourceModule) -> Set[str]:
+    fn = _find_raise_if_error(status_mod)
+    out: Set[str] = set()
+    if fn is None:
+        return out
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = call_name(node).rsplit(".", 1)[-1]
+            if name.startswith("parse_"):
+                out.add(name)
+    return out
+
+
+def check_status(status_mod: SourceModule) -> List[Finding]:
+    findings: List[Finding] = []
+    raised = raised_in_raise_if_error(status_mod)
+    for name, line in sorted(status_subclasses(status_mod).items()):
+        if name not in raised:
+            findings.append(Finding(
+                code="HVL601", path=status_mod.rel, line=line,
+                message=f"{name} subclasses {BASE_CLASS} but "
+                        "Status.raise_if_error never raises it — its "
+                        "wire tag cannot round-trip",
+                key=f"err:{name}"))
+    # every format_X needs parse_X, and parse_X must be wired into
+    # raise_if_error (reading the tag is what makes it a contract)
+    defined = {n.name: n.lineno for n in status_mod.tree.body
+               if isinstance(n, ast.FunctionDef)}
+    parsers = parsers_called(status_mod)
+    for name, line in sorted(defined.items()):
+        if not name.startswith("format_"):
+            continue
+        twin = "parse_" + name.removeprefix("format_")
+        if twin not in defined or twin not in parsers:
+            findings.append(Finding(
+                code="HVL602", path=status_mod.rel, line=line,
+                message=f"{name} has no {twin} twin wired into "
+                        "Status.raise_if_error — a tag that can be "
+                        "written but never read",
+                key=f"tag:{name}"))
+    return findings
+
+
+def check_external_subclasses(modules: List[SourceModule],
+                              status_names: Set[str],
+                              registry: Dict[str, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    known = status_names | {BASE_CLASS}
+    for mod in modules:
+        if mod.rel == STATUS_REL:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and \
+                    any(b in known for b in _class_bases(node)):
+                if node.name not in registry:
+                    findings.append(Finding(
+                        code="HVL603", path=mod.rel, line=node.lineno,
+                        message=f"{node.name} subclasses {BASE_CLASS} "
+                                "outside core/status.py but is not in "
+                                "the wire-compat error registry — "
+                                "state how its attribution survives "
+                                "the wire",
+                        key=f"err:{node.name}@{mod.rel}"))
+    return findings
+
+
+def run(root: str, modules: List[SourceModule]) -> List[Finding]:
+    del root
+    from . import wire_registry
+
+    status_mod = next((m for m in modules if m.rel == STATUS_REL), None)
+    if status_mod is None:
+        return [Finding(code="HVL601", path=STATUS_REL, line=0,
+                        message="core/status.py missing — error-taxonomy "
+                                "lint cannot run",
+                        key="status-missing")]
+    findings = check_status(status_mod)
+    findings += check_external_subclasses(
+        modules, set(status_subclasses(status_mod)),
+        wire_registry.ERROR_CLASSES)
+    return findings

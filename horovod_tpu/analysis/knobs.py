@@ -1,0 +1,175 @@
+"""HVL1xx — knob-registry lint (docs/analysis.md).
+
+Every ``HOROVOD_*`` runtime knob routes through ``core/config.py``: the
+constant declaration is the registry (operational muscle memory: one
+grep finds every knob), and a docs row is the operator contract. This
+checker enforces both ends:
+
+* HVL101: an ``os.environ`` / ``os.getenv`` read of a string-literal
+  ``HOROVOD_*`` name anywhere outside ``core/config.py``. The read must
+  go through the declared constant (``_config.HOROVOD_X``) so renames
+  and greps stay atomic.
+* HVL102: a read through ``<mod>.HOROVOD_X`` where ``HOROVOD_X`` is not
+  actually declared in ``core/config.py`` — the typo is caught at lint
+  time instead of as an AttributeError on the first execution of a
+  possibly-rare code path.
+* HVL103: a constant declared in ``core/config.py`` whose env-var name
+  appears nowhere under ``docs/`` — an undocumented knob.
+
+Env *writes* (``os.environ[X] = ...``, launcher exports, chaos matrix
+subprocess env dicts) are deliberately out of scope: producing a knob is
+the launcher's job; the registry disciplines *consumers*.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .base import Finding, SourceModule, call_name, const_str
+
+CONFIG_REL = "horovod_tpu/core/config.py"
+
+# call shapes that read the environment; (suffix match on dotted name)
+_READ_CALLS = ("environ.get", "getenv", "environ.pop")
+
+
+def declared_knobs(config_mod: SourceModule) -> Dict[str, Tuple[str, int]]:
+    """constant-name -> (env-var-name, line) for every module-level
+    ``NAME = "HOROVOD_..."`` assignment in core/config.py."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in config_mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            value = const_str(node.value)
+            if value is not None and value.startswith("HOROVOD_"):
+                out[node.targets[0].id] = (value, node.lineno)
+    return out
+
+
+def _env_key_node(node: ast.AST) -> Optional[ast.AST]:
+    """The name-expression of an environment read, or None."""
+    if isinstance(node, ast.Call):
+        if call_name(node).endswith(_READ_CALLS) and node.args:
+            return node.args[0]
+        return None
+    if isinstance(node, ast.Subscript) and \
+            isinstance(node.ctx, ast.Load):
+        value = node.value
+        if (isinstance(value, ast.Attribute) and
+                value.attr == "environ") or \
+                (isinstance(value, ast.Name) and value.id == "environ"):
+            return node.slice
+    return None
+
+
+def check_env_reads(modules: List[SourceModule],
+                    declared: Dict[str, Tuple[str, int]],
+                    config_rel: str = CONFIG_REL) -> List[Finding]:
+    findings: List[Finding] = []
+    constant_names = set(declared)
+    for mod in modules:
+        if mod.rel == config_rel:
+            continue
+        for node in ast.walk(mod.tree):
+            key = _env_key_node(node)
+            if key is None:
+                continue
+            literal = const_str(key)
+            if literal is not None:
+                if literal.startswith("HOROVOD_"):
+                    findings.append(Finding(
+                        code="HVL101", path=mod.rel, line=node.lineno,
+                        message=f"literal env read of {literal!r}: use "
+                                "the core.config constant",
+                        key=f"{literal}@{mod.rel}"))
+                continue
+            if isinstance(key, ast.Attribute) and \
+                    key.attr.startswith("HOROVOD_") and \
+                    key.attr not in constant_names:
+                findings.append(Finding(
+                    code="HVL102", path=mod.rel, line=node.lineno,
+                    message=f"env read via {call_name(key)}: constant "
+                            f"{key.attr} is not declared in "
+                            "core/config.py",
+                    key=f"{key.attr}@{mod.rel}"))
+            elif isinstance(key, ast.Name) and \
+                    key.id.startswith("HOROVOD_") and \
+                    key.id not in constant_names:
+                # `from core.config import HOROVOD_X` style reads of a
+                # name that config does not declare
+                findings.append(Finding(
+                    code="HVL102", path=mod.rel, line=node.lineno,
+                    message=f"env read via bare name {key.id}: not "
+                            "declared in core/config.py",
+                    key=f"{key.id}@{mod.rel}"))
+    return findings
+
+
+def docs_corpus(root: str) -> str:
+    """Concatenated text of every docs/*.md plus README.md — a knob row
+    anywhere in the operator docs satisfies HVL103."""
+    chunks: List[str] = []
+    for path in sorted(glob.glob(os.path.join(root, "docs", "*.md"))) + \
+            [os.path.join(root, "README.md")]:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                chunks.append(f.read())
+        except OSError:
+            pass
+    return "\n".join(chunks)
+
+
+# docs name knob families in a combined form ("HOROVOD_RANK/SIZE",
+# "HOROVOD_ELASTIC_ADDR / _PORT", "HOROVOD_HIERARCHICAL_ALLREDUCE/
+# ALLGATHER") — each slash segment documents a sibling knob
+_DOC_KNOB_RE = re.compile(
+    r"HOROVOD_[A-Z0-9_]+(?:[`\s]*/[`\s]*_?[A-Z0-9_]+)*")
+
+
+def documented_knob_names(docs_text: str) -> set:
+    out = set()
+    for m in _DOC_KNOB_RE.finditer(docs_text):
+        parts = re.split(r"[`\s]*/[`\s]*", m.group(0))
+        base = parts[0]
+        out.add(base)
+        for seg in parts[1:]:
+            if seg.startswith("HOROVOD_"):
+                out.add(seg)
+                continue
+            stripped = seg.lstrip("_")
+            # both readings of the shorthand: a fresh HOROVOD_ name, and
+            # the base with its last chunk(s) swapped
+            out.add("HOROVOD_" + stripped)
+            out.add(base.rsplit("_", 1)[0] + "_" + stripped)
+    return out
+
+
+def check_docs_rows(config_mod: SourceModule,
+                    docs_text: str) -> List[Finding]:
+    findings: List[Finding] = []
+    documented = documented_knob_names(docs_text)
+    for const, (env_name, line) in \
+            sorted(declared_knobs(config_mod).items()):
+        if env_name not in documented:
+            findings.append(Finding(
+                code="HVL103", path=config_mod.rel, line=line,
+                message=f"knob {env_name} ({const}) has no docs row "
+                        "under docs/",
+                key=env_name))
+    return findings
+
+
+def run(root: str, modules: List[SourceModule]) -> List[Finding]:
+    config_mod = next((m for m in modules if m.rel == CONFIG_REL), None)
+    if config_mod is None:
+        return [Finding(code="HVL102", path=CONFIG_REL, line=0,
+                        message="core/config.py not found or unparseable",
+                        key="config-missing")]
+    declared = declared_knobs(config_mod)
+    findings = check_env_reads(modules, declared)
+    findings += check_docs_rows(config_mod, docs_corpus(root))
+    return findings

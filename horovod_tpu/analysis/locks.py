@@ -1,0 +1,267 @@
+"""HVL2xx — lock-order analysis (docs/analysis.md).
+
+The engine's two-channel discipline exists because a real lock-inversion
+deadlock was found by hand (PR 9: a flush parked in a coordinator
+rendezvous holding the cycle connection's request lock). This checker
+makes that class of bug a lint failure instead of a review catch:
+
+* extract a per-module lock-acquisition graph from ``with self._lock:``
+  nesting and paired ``.acquire()``/``.release()`` calls,
+* merge every module's graph into one global order graph,
+* fail (HVL201) on any cycle — two code paths that take the same two
+  locks in opposite orders.
+
+Lock identity is lexical: ``self._lock`` inside class ``C`` of module
+``M`` is the node ``M:C._lock``; a module-level ``_LOCK`` is ``M:_LOCK``.
+That makes the analysis conservative in the safe direction — distinct
+instances of one class share a node, so an inversion *within* a class is
+always caught, while cross-object aliasing the AST cannot see is the
+runtime witness's job (``analysis/witness.py``, HOROVOD_LOCK_WITNESS=1).
+
+Known limits (by design, documented in docs/analysis.md): the pass is
+intra-procedural — an edge exists only where one function lexically
+nests two acquisitions. Calls made while holding a lock are not chased;
+the runtime witness records those orders in tests and raises on the
+inversions this pass cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from .base import Finding, SourceModule
+
+# attribute / name shapes that denote a synchronization primitive
+_LOCKISH_RE = re.compile(r"(lock|mutex|cond)", re.IGNORECASE)
+
+Edge = Tuple[str, str]
+Witness = Tuple[str, int, str]  # (rel path, line, function qualname)
+
+
+def _lockish_name(node: ast.AST, module: str, cls: str) -> str:
+    """Canonical node name when ``node`` looks like a lock, else ''."""
+    if isinstance(node, ast.Attribute) and _LOCKISH_RE.search(node.attr):
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            owner = f"{cls}." if cls else ""
+            return f"{module}:{owner}{node.attr}"
+        try:
+            base = ast.unparse(node.value)
+        except Exception:  # pragma: no cover - unparse is total on 3.9+
+            base = "?"
+        return f"{module}:{base}.{node.attr}"
+    if isinstance(node, ast.Name) and _LOCKISH_RE.search(node.id):
+        return f"{module}:{node.id}"
+    return ""
+
+
+class _FunctionScan:
+    """Walks one function body in statement order, maintaining the held
+    stack; records an edge held -> acquired for every nested grab."""
+
+    def __init__(self, module: str, cls: str, qualname: str, rel: str,
+                 edges: Dict[Edge, Witness]):
+        self.module = module
+        self.cls = cls
+        self.qualname = qualname
+        self.rel = rel
+        self.edges = edges
+        self.held: List[str] = []
+
+    def _grab(self, name: str, line: int) -> None:
+        for h in self.held:
+            if h != name and (h, name) not in self.edges:
+                self.edges[(h, name)] = (self.rel, line, self.qualname)
+        self.held.append(name)
+
+    def _drop(self, name: str) -> None:
+        for i in range(len(self.held) - 1, -1, -1):
+            if self.held[i] == name:
+                del self.held[i]
+                return
+
+    def scan(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    @staticmethod
+    def _own_exprs(stmt: ast.stmt) -> List[ast.AST]:
+        """The statement's OWN expressions (never its nested blocks,
+        which the structural recursion owns)."""
+        if isinstance(stmt, ast.Expr):
+            return [stmt.value]
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                             ast.Return)):
+            return [stmt.value] if stmt.value is not None else []
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, ast.Assert):
+            return [stmt.test]
+        return []
+
+    def _sync_calls(self, expr: ast.AST):
+        """(lock name, 'acquire'|'release', line) for every sync-
+        primitive call in the expression."""
+        out = []
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("acquire", "release"):
+                target = _lockish_name(node.func.value, self.module,
+                                       self.cls)
+                if target:
+                    out.append((target, node.func.attr, node.lineno))
+        return out
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            grabbed: List[str] = []
+            for item in stmt.items:
+                name = _lockish_name(item.context_expr, self.module,
+                                     self.cls)
+                if name:
+                    self._grab(name, stmt.lineno)
+                    grabbed.append(name)
+            self.scan(stmt.body)
+            for name in reversed(grabbed):
+                self._drop(name)
+            return
+        # acquire()/release() in any expression position the repo (or a
+        # future trylock/timeout idiom) might use: bare statement,
+        # `got = lock.acquire(False)`, `if lock.acquire(timeout=5):`,
+        # `assert lock.acquire(...)` — an invisible acquire form would
+        # let a real inversion lint green
+        for expr in self._own_exprs(stmt):
+            for target, kind, line in self._sync_calls(expr):
+                if kind == "acquire":
+                    self._grab(target, line)
+                else:
+                    self._drop(target)
+        # nested defs get their own empty held stack (they run later)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _FunctionScan(self.module, self.cls,
+                          f"{self.qualname}.{stmt.name}", self.rel,
+                          self.edges).scan(stmt.body)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        # compound statements: walk each block in order with the SAME
+        # held stack — branch-local acquires are approximated as
+        # sequential, which only ever ADDS conservative edges
+        for block_name in ("body", "orelse", "finalbody"):
+            block = getattr(stmt, block_name, None)
+            if block:
+                self.scan(block)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self.scan(handler.body)
+
+
+def module_graph(mod: SourceModule) -> Dict[Edge, Witness]:
+    """Held-before edges observed in one module."""
+    pkg = mod.rel.removesuffix(".py").replace("/", ".")
+    edges: Dict[Edge, Witness] = {}
+
+    def visit(body: List[ast.stmt], cls: str, prefix: str) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                visit(node.body, node.name,
+                      f"{prefix}{node.name}.")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _FunctionScan(pkg, cls, f"{prefix}{node.name}", mod.rel,
+                              edges).scan(node.body)
+
+    visit(mod.tree.body, "", "")
+    return edges
+
+
+def merge_graphs(graphs: List[Dict[Edge, Witness]]) -> Dict[Edge, Witness]:
+    merged: Dict[Edge, Witness] = {}
+    for g in graphs:
+        for edge, witness in g.items():
+            merged.setdefault(edge, witness)
+    return merged
+
+
+def find_cycles(edges: Dict[Edge, Witness]) -> List[List[str]]:
+    """Strongly-connected components with >1 node (Tarjan), i.e. sets of
+    locks with circular held-before orders."""
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Dict[str, bool] = {}
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan: the lock graph is tiny, but recursion depth
+        # should never depend on repo size
+        work = [(v, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack[node] = True
+            advanced = False
+            succs = graph.get(node, [])
+            for i in range(pi, len(succs)):
+                w = succs[i]
+                if w not in index:
+                    work[-1] = (node, i + 1)
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                if on_stack.get(w):
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp: List[str] = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def cycle_findings(edges: Dict[Edge, Witness]) -> List[Finding]:
+    findings: List[Finding] = []
+    for comp in find_cycles(edges):
+        members = set(comp)
+        involved = [(e, w) for e, w in sorted(edges.items())
+                    if e[0] in members and e[1] in members]
+        detail = "; ".join(
+            f"{a} -> {b} at {w[0]}:{w[1]} ({w[2]})"
+            for (a, b), w in involved)
+        rel, line = (involved[0][1][0], involved[0][1][1]) if involved \
+            else ("", 0)
+        findings.append(Finding(
+            code="HVL201", path=rel, line=line,
+            message="lock-order cycle between "
+                    f"{{{', '.join(comp)}}}: {detail}",
+            key="cycle:" + "->".join(comp)))
+    return findings
+
+
+def run(root: str, modules: List[SourceModule]) -> List[Finding]:
+    del root
+    merged = merge_graphs([module_graph(m) for m in modules])
+    return cycle_findings(merged)

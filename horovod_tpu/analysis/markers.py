@@ -1,0 +1,90 @@
+"""HVL7xx — pytest-marker audit (docs/analysis.md).
+
+Every ``pytest.mark.<name>`` used under ``tests/`` must be registered in
+``pyproject.toml``'s ``[tool.pytest.ini_options] markers`` list:
+an unregistered marker is a silent no-op under ``--strict-markers`` and
+— worse — a typo'd ``slow``/``soak`` mark silently promotes an expensive
+test into the tier-1 budget. The audit is itself an hvdlint checker so
+it cannot regress into a one-off review note.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from .base import Finding, SourceModule
+
+# pytest builtins (plus plugins baked into the image) that need no
+# registration row
+BUILTIN_MARKS: Set[str] = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "timeout", "anyio", "asyncio",
+}
+
+_MARKERS_BLOCK_RE = re.compile(
+    r"markers\s*=\s*\[(.*?)\]", re.DOTALL)
+
+
+def used_markers(test_modules: List[SourceModule]
+                 ) -> Dict[str, Tuple[str, int]]:
+    """marker -> (rel, line) of first use of pytest.mark.<marker>."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for mod in test_modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Attribute) and \
+                    node.value.attr == "mark" and \
+                    isinstance(node.value.value, ast.Name) and \
+                    node.value.value.id == "pytest":
+                out.setdefault(node.attr, (mod.rel, node.lineno))
+    return out
+
+
+def registered_markers(pyproject_text: str) -> Set[str]:
+    """Marker names from [tool.pytest.ini_options] markers. Uses tomllib
+    where available (3.11+); regex fallback keeps the checker working on
+    the 3.10 floor."""
+    try:
+        import tomllib
+
+        data = tomllib.loads(pyproject_text)
+        rows = (data.get("tool", {}).get("pytest", {})
+                .get("ini_options", {}).get("markers", []))
+    except Exception:
+        m = _MARKERS_BLOCK_RE.search(pyproject_text)
+        rows = re.findall(r"\"((?:[^\"\\]|\\.)*)\"", m.group(1)) \
+            if m else []
+    out: Set[str] = set()
+    for row in rows:
+        name = str(row).split(":", 1)[0].strip()
+        if name:
+            out.add(name)
+    return out
+
+
+def check(test_modules: List[SourceModule],
+          pyproject_text: str) -> List[Finding]:
+    registered = registered_markers(pyproject_text)
+    findings: List[Finding] = []
+    for marker, (rel, line) in sorted(used_markers(test_modules).items()):
+        if marker in BUILTIN_MARKS or marker in registered:
+            continue
+        findings.append(Finding(
+            code="HVL701", path=rel, line=line,
+            message=f"pytest marker {marker!r} is not registered in "
+                    "pyproject.toml [tool.pytest.ini_options] markers",
+            key=f"marker:{marker}"))
+    return findings
+
+
+def run(root: str, test_modules: List[SourceModule]) -> List[Finding]:
+    try:
+        with open(os.path.join(root, "pyproject.toml"), "r",
+                  encoding="utf-8") as f:
+            pyproject_text = f.read()
+    except OSError:
+        pyproject_text = ""
+    return check(test_modules, pyproject_text)

@@ -1,0 +1,164 @@
+"""HVL5xx — metrics/docs drift (docs/analysis.md).
+
+Three surfaces describe the metric families and they must agree:
+
+* the code — ``registry().counter/gauge/histogram("horovod_...")``
+  registration sites,
+* ``docs/metrics.md`` — the operator-facing family tables,
+* ``tools/metrics_summary.py`` — the ``*_PREFIXES`` section routing.
+
+HVL501: family registered in code, absent from docs/metrics.md.
+HVL502: family named in docs, registered nowhere (a ghost row — usually
+a rename that only landed on one side).
+HVL503: a metrics_summary section prefix that matches no registered
+family (the section would silently render empty forever).
+
+Docs tokens support the ``horovod_foo_tx/rx_bytes_total`` combined form
+(one row documenting a tx/rx pair) — both expansions count as
+documented.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from .base import Finding, SourceModule, call_name, const_str
+
+DOCS_REL = "docs/metrics.md"
+SUMMARY_REL = "tools/metrics_summary.py"
+_REGISTER_METHODS = ("counter", "gauge", "histogram")
+_FAMILY_TOKEN_RE = re.compile(r"horovod_[a-z0-9_]+(?:/[a-z0-9_]+)?")
+# not family names: the package itself, and bare plane-prefix mentions
+_IGNORE_TOKENS = {"horovod_tpu"}
+
+
+def _module_str_constants(mod: SourceModule) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` bindings — registration sites
+    like ``reg.gauge(GAUGE_OFFSET, ...)`` (obs/tracing.py) name their
+    family through a constant, and the scan must see through it."""
+    out: Dict[str, str] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            value = const_str(node.value)
+            if value is not None:
+                out[node.targets[0].id] = value
+    return out
+
+
+def registered_families(modules: List[SourceModule]
+                        ) -> Dict[str, Tuple[str, int]]:
+    """family -> (rel, line) of its first registration site."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for mod in modules:
+        consts = _module_str_constants(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            dotted = call_name(node)
+            if dotted.rsplit(".", 1)[-1] not in _REGISTER_METHODS:
+                continue
+            arg = node.args[0]
+            name = const_str(arg)
+            if name is None and isinstance(arg, ast.Name):
+                name = consts.get(arg.id)
+            if name and name.startswith("horovod_"):
+                out.setdefault(name, (mod.rel, node.lineno))
+    return out
+
+
+def _expand(token: str) -> List[str]:
+    """'horovod_negotiation_tx/rx_bytes_total' -> both variants."""
+    if "/" not in token:
+        return [token]
+    head, _, rest = token.partition("/")
+    alt_first, _, alt_rest = rest.partition("_")
+    a = head + ("_" + alt_rest if alt_rest else "")
+    b = head.rsplit("_", 1)[0] + "_" + alt_first + \
+        ("_" + alt_rest if alt_rest else "")
+    return [a, b]
+
+
+def docs_families(docs_text: str) -> Dict[str, int]:
+    """family-ish token -> first line number in docs/metrics.md."""
+    out: Dict[str, int] = {}
+    for i, line in enumerate(docs_text.splitlines(), start=1):
+        for m in _FAMILY_TOKEN_RE.finditer(line):
+            raw = m.group(0)
+            # "horovod_tpu/tune/" is a package path, not a tx/rx pair
+            if raw == "horovod_tpu" or raw.startswith("horovod_tpu/"):
+                continue
+            for token in _expand(raw):
+                if token not in _IGNORE_TOKENS:
+                    out.setdefault(token, i)
+    return out
+
+
+def summary_prefixes(summary_mod: SourceModule) -> Dict[str, int]:
+    """prefix -> line for every *_PREFIXES tuple in metrics_summary."""
+    out: Dict[str, int] = {}
+    for node in summary_mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id.endswith("_PREFIXES") and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                prefix = const_str(elt)
+                if prefix:
+                    out[prefix] = node.lineno
+    return out
+
+
+def check(code_families: Dict[str, Tuple[str, int]],
+          doc_tokens: Dict[str, int],
+          prefixes: Dict[str, int]) -> List[Finding]:
+    findings: List[Finding] = []
+    doc_set: Set[str] = set(doc_tokens)
+    for family, (rel, line) in sorted(code_families.items()):
+        if family not in doc_set:
+            findings.append(Finding(
+                code="HVL501", path=rel, line=line,
+                message=f"metric family {family} registered here is "
+                        f"missing from {DOCS_REL}",
+                key=f"family:{family}"))
+    for token, line in sorted(doc_tokens.items()):
+        if token in code_families:
+            continue
+        # only an EXPLICIT prefix mention (trailing underscore, e.g.
+        # "horovod_sentry_") is a plane reference; a complete-looking
+        # token that happens to prefix a family is exactly the one-sided
+        # rename drift this check exists for
+        if token.endswith("_") and \
+                any(fam.startswith(token) for fam in code_families):
+            continue
+        findings.append(Finding(
+            code="HVL502", path=DOCS_REL, line=line,
+            message=f"{DOCS_REL} names {token} but no code registers "
+                    "it — stale row or rename drift",
+            key=f"docs:{token}"))
+    for prefix, line in sorted(prefixes.items()):
+        if not any(fam.startswith(prefix) for fam in code_families):
+            findings.append(Finding(
+                code="HVL503", path=SUMMARY_REL, line=line,
+                message=f"metrics_summary section prefix {prefix!r} "
+                        "matches no registered family",
+                key=f"prefix:{prefix}"))
+    return findings
+
+
+def run(root: str, modules: List[SourceModule]) -> List[Finding]:
+    from .base import load_module
+
+    code_families = registered_families(modules)
+    docs_path = os.path.join(root, DOCS_REL)
+    try:
+        with open(docs_path, "r", encoding="utf-8") as f:
+            doc_tokens = docs_families(f.read())
+    except OSError:
+        doc_tokens = {}
+    summary_mod = load_module(os.path.join(root, SUMMARY_REL), root)
+    prefixes = summary_prefixes(summary_mod) if summary_mod else {}
+    return check(code_families, doc_tokens, prefixes)

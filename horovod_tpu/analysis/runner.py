@@ -1,0 +1,120 @@
+"""hvdlint orchestration: run every checker, apply suppressions and the
+baseline, produce the report (docs/analysis.md).
+
+``tools/hvdlint.py`` is the CLI face; tests call :func:`run_all`
+directly. Adding a checker = add a module with a
+``run(root, modules) -> List[Finding]`` function, register it in
+``CHECKERS`` below, claim a code range in ``base.CODES``, and document
+the row in docs/analysis.md — the test suite cross-checks all three.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from . import (
+    collectives,
+    errors,
+    knobs,
+    locks,
+    markers,
+    metrics_docs,
+    wire,
+)
+from .base import (
+    Baseline,
+    CODES,
+    Finding,
+    SourceModule,
+    apply_inline_suppressions,
+    load_tree,
+)
+
+# checker name -> (module, which tree it scans)
+CHECKERS = (
+    ("knobs", knobs, "library"),
+    ("locks", locks, "library"),
+    ("collectives", collectives, "library"),
+    ("wire", wire, "library"),
+    ("metrics_docs", metrics_docs, "library"),
+    ("errors", errors, "library"),
+    ("markers", markers, "tests"),
+)
+
+BASELINE_REL = "tools/hvdlint_baseline.json"
+
+
+def run_all(root: str,
+            baseline_path: Optional[str] = None,
+            only: Optional[List[str]] = None) -> dict:
+    """Run the suite over the repo at ``root``.
+
+    Returns ``{"findings": [Finding...], "waived": int,
+    "by_code": {...}, "checkers": [...], "ok": bool}`` — the dict the
+    CLI serializes (Findings rendered) as its final JSON line."""
+    library = load_tree(root, ["horovod_tpu"])
+    tests = load_tree(root, ["tests"])
+    modules_by_rel: Dict[str, SourceModule] = {
+        m.rel: m for m in library + tests}
+
+    findings: List[Finding] = []
+    ran: List[str] = []
+    if only:
+        unknown = sorted(set(only) - {name for name, _, _ in CHECKERS})
+        if unknown:  # a typo'd --only must never turn the gate green
+            raise ValueError(
+                f"unknown checker(s): {', '.join(unknown)} — valid: "
+                f"{', '.join(name for name, _, _ in CHECKERS)}")
+    for name, module, scope in CHECKERS:
+        if only and name not in only:
+            continue
+        ran.append(name)
+        scan = library if scope == "library" else tests
+        for f in module.run(root, scan):
+            if f.code not in CODES:  # a checker emitting outside its range
+                raise ValueError(
+                    f"checker {name} emitted unknown code {f.code}")
+            findings.append(f)
+
+    findings = apply_inline_suppressions(findings, modules_by_rel)
+    # a malformed inline suppression never silently no-ops: reasonless /
+    # typo'd-code comments are findings themselves (the baseline layer's
+    # HVL901/902 contract, applied to the inline layer)
+    for mod in library + tests:
+        findings.extend(mod.suppression_hygiene())
+
+    if baseline_path is None:
+        import os
+
+        baseline_path = os.path.join(root, BASELINE_REL)
+    baseline = Baseline.load(baseline_path)
+    findings, hygiene, waived = baseline.apply(findings)
+    findings.extend(hygiene)
+
+    by_code: Dict[str, int] = {}
+    for f in findings:
+        by_code[f.code] = by_code.get(f.code, 0) + 1
+    return {
+        "findings": findings,
+        "waived": waived,
+        "by_code": dict(sorted(by_code.items())),
+        "checkers": ran,
+        "ok": not findings,
+    }
+
+
+def summary_json(result: dict) -> str:
+    """The final-line JSON contract (the trace_merge/bench convention)."""
+    return json.dumps({
+        "tool": "hvdlint",
+        "ok": result["ok"],
+        "findings": len(result["findings"]),
+        "waived": result["waived"],
+        "by_code": result["by_code"],
+        "checkers": result["checkers"],
+    })
+
+
+def render(result: dict) -> List[str]:
+    return [f.render() for f in result["findings"]]

@@ -1,0 +1,139 @@
+"""HVL4xx — wire-compatibility lint (docs/analysis.md).
+
+Cross-references the wire-compat registry
+(``analysis/wire_registry.py``) against the code:
+
+* HVL401: ``ControllerService`` dispatches an RPC tag the registry does
+  not know — a new RPC shipped without deciding (and writing down) its
+  native-controller degrade.
+* HVL402: ``RequestList``/``CacheRequest`` grew a field the registry
+  does not know — the "predates the field → degrade warned once"
+  pattern (PRs 3/5/6/8/9) must be stated before the wire grows.
+* HVL403: registry entry names a tag/field the code no longer has, or
+  carries no degrade text — the registry only stays authoritative if it
+  cannot rot.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from .base import Finding, SourceModule, const_str
+
+CONTROLLER_REL = "horovod_tpu/ops/controller.py"
+MESSAGES_REL = "horovod_tpu/ops/messages.py"
+MESSAGE_CLASSES = ("RequestList", "CacheRequest")
+
+
+def scan_rpc_tags(controller_mod: SourceModule,
+                  service_class: str = "ControllerService"
+                  ) -> Dict[str, int]:
+    """tag -> line for every ``kind == "tag"`` comparison inside the
+    service class (the _handle dispatch and its helpers)."""
+    tags: Dict[str, int] = {}
+    for node in ast.walk(controller_mod.tree):
+        if isinstance(node, ast.ClassDef) and node.name == service_class:
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Compare) and
+                        isinstance(sub.left, ast.Name) and
+                        sub.left.id == "kind" and len(sub.ops) == 1):
+                    continue
+                op, comp = sub.ops[0], sub.comparators[0]
+                if isinstance(op, (ast.Eq, ast.NotEq)):
+                    tag = const_str(comp)
+                    if tag is not None:
+                        tags.setdefault(tag, sub.lineno)
+                elif isinstance(op, (ast.In, ast.NotIn)) and \
+                        isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                    # `kind in ("a", "b")` dispatch: every member is a
+                    # handled tag — missing this shape would report the
+                    # registry entries as stale, steering it WRONG
+                    for elt in comp.elts:
+                        tag = const_str(elt)
+                        if tag is not None:
+                            tags.setdefault(tag, sub.lineno)
+    return tags
+
+
+def scan_message_fields(messages_mod: SourceModule,
+                        classes: Tuple[str, ...] = MESSAGE_CLASSES
+                        ) -> Dict[str, int]:
+    """'Class.field' -> line for every annotated dataclass field."""
+    fields: Dict[str, int] = {}
+    for node in messages_mod.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name in classes:
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and \
+                        isinstance(stmt.target, ast.Name):
+                    fields[f"{node.name}.{stmt.target.id}"] = stmt.lineno
+    return fields
+
+
+def check(controller_mod: SourceModule, messages_mod: SourceModule,
+          rpc_registry: Dict[str, str],
+          field_registry: Dict[str, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    tags = scan_rpc_tags(controller_mod)
+    fields = scan_message_fields(messages_mod)
+    for tag, line in sorted(tags.items()):
+        if tag not in rpc_registry:
+            findings.append(Finding(
+                code="HVL401", path=controller_mod.rel, line=line,
+                message=f"RPC tag {tag!r} handled by ControllerService "
+                        "has no wire-compat registry entry naming its "
+                        "native-controller degrade",
+                key=f"rpc:{tag}"))
+    for name, line in sorted(fields.items()):
+        if name not in field_registry:
+            findings.append(Finding(
+                code="HVL402", path=messages_mod.rel, line=line,
+                message=f"negotiation message field {name} has no "
+                        "wire-compat registry entry naming its "
+                        "predates-the-field degrade",
+                key=f"field:{name}"))
+    registry_rel = "horovod_tpu/analysis/wire_registry.py"
+    for tag, note in sorted(rpc_registry.items()):
+        if tag not in tags:
+            findings.append(Finding(
+                code="HVL403", path=registry_rel, line=0,
+                message=f"registry RPC tag {tag!r} is not dispatched by "
+                        "ControllerService any more — delete the entry",
+                key=f"stale-rpc:{tag}"))
+        elif not str(note).strip():
+            findings.append(Finding(
+                code="HVL403", path=registry_rel, line=0,
+                message=f"registry RPC tag {tag!r} has an empty degrade "
+                        "note",
+                key=f"empty-rpc:{tag}"))
+    for name, note in sorted(field_registry.items()):
+        if name not in fields:
+            findings.append(Finding(
+                code="HVL403", path=registry_rel, line=0,
+                message=f"registry message field {name} no longer "
+                        "exists — delete the entry",
+                key=f"stale-field:{name}"))
+        elif not str(note).strip():
+            findings.append(Finding(
+                code="HVL403", path=registry_rel, line=0,
+                message=f"registry message field {name} has an empty "
+                        "degrade note",
+                key=f"empty-field:{name}"))
+    return findings
+
+
+def run(root: str, modules: List[SourceModule]) -> List[Finding]:
+    del root
+    from . import wire_registry
+
+    controller = next((m for m in modules if m.rel == CONTROLLER_REL),
+                      None)
+    messages = next((m for m in modules if m.rel == MESSAGES_REL), None)
+    if controller is None or messages is None:
+        return [Finding(
+            code="HVL403", path=CONTROLLER_REL, line=0,
+            message="controller/messages module missing — wire-compat "
+                    "lint cannot run",
+            key="wire-scan-missing")]
+    return check(controller, messages, wire_registry.RPC_TAGS,
+                 wire_registry.MESSAGE_FIELDS)

@@ -1,0 +1,96 @@
+"""Wire-compatibility registry (docs/analysis.md).
+
+Single source of truth for the repo's wire-evolution discipline: every
+RPC tag ``ControllerService`` handles and every field of the
+rank→coordinator negotiation messages (``RequestList``/``CacheRequest``)
+must have an entry here **naming its degrade** — what happens when the
+peer is the native C++ controller whose binary wire predates the
+feature, or an old-format client. The ``analysis/wire.py`` checker
+cross-references this dict against the AST of ``ops/controller.py`` and
+``ops/messages.py``: a new tag or field without an entry fails lint
+(HVL401/HVL402), and an entry whose tag/field no longer exists is stale
+(HVL403). The pattern being enforced is the one PRs 3/5/6/8/9 each
+re-derived by hand: "the native wire predates the field → deterministic
+degrade, warned once".
+
+``ERROR_CLASSES`` plays the same role for the error taxonomy
+(HVL603): a ``HorovodInternalError`` subclass defined outside
+``core/status.py`` must be registered with the story of how its
+attribution survives the wire.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+# RPC tags dispatched by ControllerService._handle (ops/controller.py).
+# Value = native-controller / old-peer degrade, in one sentence.
+RPC_TAGS: Dict[str, str] = {
+    "hello": "baseline wire (both controllers): rank identification at "
+             "connect; native C++ service speaks the same tag",
+    "cycle": "baseline wire (both controllers): the negotiation "
+             "rendezvous itself",
+    "payload": "baseline wire (both controllers): host data-plane fused "
+               "payload exchange; rides the PR-9 second connection "
+               "where armed",
+    "bye": "baseline wire: clean tooling detach; native service treats "
+           "an unknown tag as a no-op close",
+    "watch": "Python controller only: abort push channel; native "
+             "controller clients poll wait_world_shutdown instead",
+    "metrics": "Python controller only (PR 5): native wire predates the "
+               "RPC — publisher never dials it, world snapshots "
+               "degrade to local-only, warned once",
+    "metrics_pull": "Python controller only (PR 5): native wire "
+                    "predates the RPC — metrics_snapshot(world=True) "
+                    "degrades to the local registry, warned once",
+    "clock_probe": "Python controller only (PR 6): native wire predates "
+                   "the RPC — clock_sync_supported=False, traces merge "
+                   "uncorrected and say so",
+    "sentry": "Python controller only (PR 8): native wire predates the "
+              "verdict rendezvous — the gradient sentry degrades to a "
+              "local verdict, warned once",
+}
+
+# Fields of the rank -> coordinator negotiation messages
+# (ops/messages.py). Value = what a wire that predates the field does.
+MESSAGE_FIELDS: Dict[str, str] = {
+    "RequestList.rank": "baseline wire: present since the reference "
+                        "message.h layout",
+    "RequestList.requests": "baseline wire: present since the reference "
+                            "message.h layout",
+    "RequestList.shutdown": "baseline wire: negotiated-drain bit from "
+                            "the reference layout",
+    "RequestList.integrity_digest": "PR 8: native controller wire "
+                                    "predates the field — consensus "
+                                    "verification disabled, warned once",
+    "RequestList.flush_ordinal": "PR 9: None on wires that predate the "
+                                 "field — the coordinator skips the "
+                                 "cycle-alignment cross-check for that "
+                                 "rank",
+    "CacheRequest.rank": "PR 3 steady-state wire: the native controller "
+                         "never receives CacheRequest at all "
+                         "(cache_generation=None full-path fallback)",
+    "CacheRequest.bits": "PR 3: same full-path fallback — the native "
+                         "wire predates the cache-bit fast path "
+                         "entirely",
+    "CacheRequest.generation": "PR 3: generation pins the cache state; "
+                               "wires without it never send bits",
+    "CacheRequest.integrity_digest": "PR 8: warm-cache digest piggyback; "
+                                     "absent on wires that predate it — "
+                                     "judge warns once about the "
+                                     "never-digesting rank",
+    "CacheRequest.flush_ordinal": "PR 9: warm-path twin of "
+                                  "RequestList.flush_ordinal; None "
+                                  "skips the cross-check",
+}
+
+# HorovodInternalError subclasses defined OUTSIDE core/status.py, with
+# how their attribution round-trips (or deliberately doesn't).
+ERROR_CLASSES: Dict[str, str] = {
+    "ServingAbortedError": "serving/worker.py (PR 11): crosses the wire "
+                           "as message text; elastic classifies it as a "
+                           "world fault via the HorovodInternalError "
+                           "subclass check in failure_record — no tag "
+                           "of its own by design (the relaunch path "
+                           "needs no rank attribution)",
+}

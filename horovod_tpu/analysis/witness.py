@@ -1,0 +1,220 @@
+"""Runtime lock witness (docs/analysis.md, ``HOROVOD_LOCK_WITNESS=1``).
+
+The AST lock-order pass (``analysis/locks.py``) is intra-procedural: it
+sees ``with self._lock:`` nesting but not an order established through a
+call chain (engine holds its lock, calls into the registry, which takes
+its own). This opt-in runtime layer closes that gap in tests: witnessed
+locks record the *actual* per-thread acquisition order into one global
+held-before graph, and an acquisition that would close a cycle raises
+``LockInversionError`` at the exact second site — the moment the
+inverted order is *attempted*, not the rare schedule where it deadlocks.
+
+Off by default and free when off: ``maybe_wrap`` returns the raw lock
+unless the knob is set, so production paths carry zero overhead and the
+witness can wrap hot locks without a second thought. Timing-dependent
+cases (Condition-wrapped locks, ``_release_save`` re-entry) bypass
+recording by design — the witness is a test amplifier, not a jailer.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# The knob constant lives in core/config.py like every other knob; the
+# fallback literal keeps this module loadable standalone (tools/hvdlint
+# loads the analysis package by path on jax-less machines, where the
+# parent package — and so core.config — is unreachable).
+try:
+    from ..core import config as _config
+
+    HOROVOD_LOCK_WITNESS = _config.HOROVOD_LOCK_WITNESS
+except ImportError:  # pragma: no cover - the standalone load
+    HOROVOD_LOCK_WITNESS = "HOROVOD_LOCK_WITNESS"
+
+
+class LockInversionError(RuntimeError):
+    """Two locks were acquired in both orders across the process's
+    lifetime — a deadlock waiting for the right schedule."""
+
+
+class LockWitness:
+    """Global held-before graph over witnessed lock names.
+
+    ``on_acquire(name)`` runs before the raw grab: for every lock the
+    calling thread already holds it checks whether ``name`` can reach
+    the held lock through previously observed edges — if so, the
+    reverse order was already witnessed and the acquisition is an
+    inversion — and otherwise records the edge ``held -> name``.
+    ``on_acquired(name)`` pushes onto the thread's held stack once the
+    raw acquire succeeded."""
+
+    def __init__(self) -> None:
+        self._graph_lock = threading.Lock()
+        # edge -> (thread name, first-seen stack summary)
+        self._edges: Dict[Tuple[str, str], str] = {}
+        # incremental adjacency mirror of _edges: rebuilt-per-acquire
+        # would serialize every wrapped lock in the process on an
+        # O(edges) scan once the witness is armed
+        self._adj: Dict[str, List[str]] = {}
+        self._tls = threading.local()
+
+    def _held(self) -> List[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = []
+            self._tls.held = held
+        return held
+
+    def _reaches_locked(self, src: str, dst: str) -> Optional[List[str]]:
+        """A path src -> ... -> dst through observed edges, or None.
+        Caller holds ``_graph_lock``."""
+        adj = self._adj
+        seen = {src}
+        frontier = [(src, [src])]
+        while frontier:
+            node, path = frontier.pop()
+            for nxt in adj.get(node, []):
+                if nxt == dst:
+                    return path + [dst]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append((nxt, path + [nxt]))
+        return None
+
+    def on_acquire(self, name: str) -> List[Tuple[str, str]]:
+        """Called BEFORE the raw lock is acquired: an inversion must
+        raise while the caller holds nothing new, or the diagnosis
+        itself would wedge the lock it was acquiring. Reach-check and
+        edge insertion happen atomically under the graph lock, so two
+        threads establishing opposite orders concurrently cannot both
+        slip their edge in unchecked. Returns the edges newly recorded
+        by this call so a failed non-blocking acquire can retract them
+        (an order that never happened must not condemn a later one).
+
+        Re-acquiring a lock this thread already holds is a no-op: an
+        owned re-entrant grab (RLock) can never deadlock, so patterns
+        like ``with a: with b: with a:`` are not inversions."""
+        held = self._held()
+        if name in held:
+            return []
+        me = f"thread {threading.current_thread().name}"
+        added: List[Tuple[str, str]] = []
+        with self._graph_lock:
+            for h in held:
+                path = self._reaches_locked(name, h)
+                if path is not None:
+                    first = self._edges.get((path[0], path[1]), "?")
+                    raise LockInversionError(
+                        f"lock-order inversion: acquiring {name!r} while "
+                        f"holding {h!r}, but the order "
+                        f"{' -> '.join(path)} was already witnessed "
+                        f"(first at: {first})")
+                if (h, name) not in self._edges:
+                    self._edges[(h, name)] = me
+                    self._adj.setdefault(h, []).append(name)
+                    added.append((h, name))
+        return added
+
+    def on_acquired(self, name: str) -> None:
+        """Called after the raw acquire succeeded."""
+        self._held().append(name)
+
+    def retract(self, edges: List[Tuple[str, str]]) -> None:
+        """Remove edges recorded by an acquire attempt that failed (a
+        trylock that returned False established no order)."""
+        if not edges:
+            return
+        with self._graph_lock:
+            for edge in edges:
+                if self._edges.pop(edge, None) is not None:
+                    succs = self._adj.get(edge[0], [])
+                    if edge[1] in succs:
+                        succs.remove(edge[1])
+
+    def on_release(self, name: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    def edges(self) -> Dict[Tuple[str, str], str]:
+        with self._graph_lock:
+            return dict(self._edges)
+
+    def reset(self) -> None:
+        with self._graph_lock:
+            self._edges.clear()
+            self._adj.clear()
+        self._tls = threading.local()
+
+
+_GLOBAL = LockWitness()
+
+
+def global_witness() -> LockWitness:
+    return _GLOBAL
+
+
+def enabled() -> bool:
+    # same disabled spellings as native_controller_enabled() and the
+    # bench init cache: an explicit "off"/"no" must never ARM the witness
+    return os.environ.get(HOROVOD_LOCK_WITNESS, "").strip().lower() \
+        not in ("", "0", "false", "off", "no")
+
+
+class WitnessedLock:
+    """Context-manager/acquire/release shim recording order into a
+    witness; everything else delegates to the wrapped lock. Bound
+    methods reached through ``__getattr__`` (``Condition``'s
+    ``_release_save``/``_acquire_restore``) bypass recording — their
+    release-and-reacquire is not an ordering decision."""
+
+    def __init__(self, lock, name: str,
+                 witness: Optional[LockWitness] = None):
+        self._lock = lock
+        self._name = name
+        self._witness = witness or _GLOBAL
+
+    def acquire(self, *args, **kwargs):
+        # inversion check BEFORE the raw grab: on a violation the raw
+        # lock is untouched, so the structured error propagates instead
+        # of wedging every other thread behind a lock nobody releases
+        added = self._witness.on_acquire(self._name)
+        got = self._lock.acquire(*args, **kwargs)
+        if got:
+            self._witness.on_acquired(self._name)
+        else:
+            self._witness.retract(added)
+        return got
+
+    def release(self):
+        self._witness.on_release(self._name)
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __getattr__(self, attr):
+        return getattr(self._lock, attr)
+
+    def __repr__(self) -> str:
+        return f"<WitnessedLock {self._name} {self._lock!r}>"
+
+
+def maybe_wrap(lock, name: str):
+    """Witness ``lock`` under HOROVOD_LOCK_WITNESS=1; otherwise return it
+    untouched (zero overhead when the knob is off)."""
+    if not enabled():
+        return lock
+    return WitnessedLock(lock, name)

@@ -75,7 +75,7 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
-from ..core.config import HOROVOD_CHAOS
+from ..core.config import HOROVOD_CHAOS, HOROVOD_RANK
 from ..obs.registry import registry as _metrics
 
 # Observability plane (docs/metrics.md): every fired fault counts here
@@ -439,5 +439,5 @@ def injector_from_env(rank: Optional[int] = None,
     if not spec:
         return None
     if rank is None:
-        rank = int(os.environ.get("HOROVOD_RANK", "-1"))
+        rank = int(os.environ.get(HOROVOD_RANK, "-1"))
     return ChaosInjector(parse_chaos_spec(spec), rank)

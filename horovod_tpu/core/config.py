@@ -255,6 +255,28 @@ HOROVOD_SERVING_FAULT = "HOROVOD_SERVING_FAULT"
 # Python controller wire (the cache-bit / metrics-RPC degrade pattern).
 HOROVOD_FUSION_SUBBUFFERS = "HOROVOD_FUSION_SUBBUFFERS"
 
+# --- implementation selection + developer knobs (ours) -----------------------
+# Negotiation-core selection: "0" forces the pure-Python negotiator;
+# anything else prefers the C++ core where built (make_negotiator in
+# ops/controller.py; also gates the native timeline writer). Availability
+# is per-host — heterogeneous deployments pin it explicitly.
+HOROVOD_NATIVE_CORE = "HOROVOD_NATIVE_CORE"
+# Controller-service selection (ops/native_controller.py): "auto"
+# (default) uses the C++ service where built, "0"/"1" force Python/C++.
+HOROVOD_NATIVE_CONTROLLER = "HOROVOD_NATIVE_CONTROLLER"
+# Interface the rank-0 controller service binds (default loopback);
+# multi-host worlds set the DCN-reachable address (docs/running.md).
+HOROVOD_CONTROLLER_BIND = "HOROVOD_CONTROLLER_BIND"
+# bench.py warm-init cache (docs/benchmarks.md): "0" disables, unset/"1"
+# the default repo-local directory, anything else a custom directory.
+HOROVOD_BENCH_INIT_CACHE = "HOROVOD_BENCH_INIT_CACHE"
+# Runtime lock witness (docs/analysis.md): "1" wraps the engine's /
+# controller's / registry's locks so tests record the ACTUAL acquisition
+# order into a global held-before graph and raise LockInversionError on
+# inversions the AST lock-order pass (tools/hvdlint.py) cannot see.
+# Strictly opt-in: unset means the raw locks, zero overhead.
+HOROVOD_LOCK_WITNESS = "HOROVOD_LOCK_WITNESS"
+
 DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024  # operations.cc:1838
 DEFAULT_CACHE_CAPACITY = 1024  # upstream response_cache.cc default
 DEFAULT_CYCLE_TIME_MS = 5.0  # operations.cc:1846

@@ -29,7 +29,9 @@ _LEVELS = {
 
 def min_log_level_from_env() -> int:
     """Reference: ``MinLogLevelFromEnv`` (``logging.cc``); default WARNING."""
-    raw = os.environ.get("HOROVOD_LOG_LEVEL", "warning").strip().lower()
+    from .config import HOROVOD_LOG_LEVEL
+
+    raw = os.environ.get(HOROVOD_LOG_LEVEL, "warning").strip().lower()
     return _LEVELS.get(raw, _pylogging.WARNING)
 
 

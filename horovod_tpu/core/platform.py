@@ -72,7 +72,9 @@ def init_cache_path(config_key, extra_sources=()):
     import glob
     import hashlib
 
-    knob = os.environ.get("HOROVOD_BENCH_INIT_CACHE", "").strip()
+    from .config import HOROVOD_BENCH_INIT_CACHE
+
+    knob = os.environ.get(HOROVOD_BENCH_INIT_CACHE, "").strip()
     if knob.lower() in ("0", "false", "off"):
         return ""
     import jax

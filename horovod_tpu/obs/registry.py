@@ -41,6 +41,8 @@ import threading
 from bisect import bisect_left
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from ..analysis.witness import maybe_wrap as _witness_wrap
+
 # Latency-oriented default bounds (seconds), Prometheus-style: the last
 # implicit bucket is +Inf. Negotiation cycles live in the 1-50 ms range
 # (docs/response-cache.md steady-state table), stalls in whole seconds.
@@ -224,7 +226,11 @@ class Registry:
     in tests."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # lock witness (docs/analysis.md): the registry lock is grabbed
+        # from every plane, so it anchors the global held-before graph
+        # under HOROVOD_LOCK_WITNESS=1
+        self._lock = _witness_wrap(threading.Lock(),
+                                   "obs.registry.Registry._lock")
         self._families: Dict[str, Family] = {}
 
     def _family(self, name: str, help: str, metric_cls,

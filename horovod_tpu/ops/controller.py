@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis.witness import maybe_wrap as _witness_wrap
 from ..core.logging import LOG
 from ..core.status import (
     CONTROLLER_RESTARTING,
@@ -130,7 +131,9 @@ def make_negotiator(size: int, cfg) -> "Negotiator":
     ``HOROVOD_NATIVE_CORE=0`` forcing the Python path."""
     import os
 
-    if os.environ.get("HOROVOD_NATIVE_CORE", "1") != "0":
+    from ..core.config import HOROVOD_NATIVE_CORE
+
+    if os.environ.get(HOROVOD_NATIVE_CORE, "1") != "0":
         from .. import cc
 
         if cc.available():
@@ -172,7 +175,8 @@ class Negotiator:
         self._arrivals = 0
         self._last_stall_check = time.monotonic()
         self._shutdown = False
-        self._lock = threading.Lock()
+        self._lock = _witness_wrap(threading.Lock(),
+                                   "ops.controller.Negotiator._lock")
 
     def add_request_list(self, rl: RequestList) -> None:
         """IncrementTensorCount for every request (``operations.cc:287-319``)."""
@@ -682,7 +686,10 @@ class ControllerService:
         self._payloads = _Rendezvous(size)
         self._cycle_no = 0
         self._history: Dict[int, ResponseList] = {}
-        self._lock = threading.Lock()
+        # lock witness (docs/analysis.md): the service + metrics locks
+        # join the global held-before graph under HOROVOD_LOCK_WITNESS=1
+        self._lock = _witness_wrap(
+            threading.Lock(), "ops.controller.ControllerService._lock")
         self._cycle_t0: Dict[Any, float] = {}
         # Straggler attribution (docs/tracing.md): per-cycle arrival time
         # of every rank's cycle request, popped (and charged to the last
@@ -726,7 +733,9 @@ class ControllerService:
         # wire ("metrics" requests — so aggregation inherits the dedup/
         # reconnect semantics of every other control message). Read by
         # rank 0's exposition server and by "metrics_pull" requests.
-        self._metrics_lock = threading.Lock()
+        self._metrics_lock = _witness_wrap(
+            threading.Lock(),
+            "ops.controller.ControllerService._metrics_lock")
         self._metrics_ranks: Dict[int, dict] = {}
         self._service = BasicService(
             "horovod-controller", self._handle, secret=secret, port=port,

@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import basics
+from ..analysis.witness import maybe_wrap as _witness_wrap
 from ..core import config as _config
 from ..core.logging import LOG
 from ..core.status import SHUT_DOWN_ERROR, Status
@@ -361,7 +362,11 @@ class Engine:
         self._rank = topo.rank
         self._size = topo.size
         self._cfg = cfg
-        self._lock = threading.Lock()
+        # lock witness (docs/analysis.md): under HOROVOD_LOCK_WITNESS=1
+        # the engine lock joins the global held-before graph so tests
+        # catch cross-module inversions the AST pass cannot see
+        self._lock = _witness_wrap(threading.Lock(),
+                                   "ops.engine.Engine._lock")
         self._submissions: List[TensorTableEntry] = []
         self._pending: Dict[str, TensorTableEntry] = {}
         self.handles = HandleManager()
@@ -498,7 +503,7 @@ class Engine:
                 # Controller duty follows the launcher's advertised address
                 # (world rank 0), not the subset rank numbering.
                 bind_host = os.environ.get(
-                    "HOROVOD_CONTROLLER_BIND", "127.0.0.1")
+                    _config.HOROVOD_CONTROLLER_BIND, "127.0.0.1")
                 listen_fd = _adopt_controller_fd(use_native)
                 # Self-healing grace for dropped rank connections: host-
                 # plane worlds only, unless the knob was set explicitly.
@@ -1822,7 +1827,8 @@ def start_subset_service(subset_ranks) -> None:
     # the SAME identity the members compute from their topology
     world_id = world_id_of(tuple(subset_ranks), subset_size)
     port = int(os.environ.get(_config.HOROVOD_CONTROLLER_PORT, "0"))
-    bind_host = os.environ.get("HOROVOD_CONTROLLER_BIND", "127.0.0.1")
+    bind_host = os.environ.get(_config.HOROVOD_CONTROLLER_BIND,
+                               "127.0.0.1")
     use_native = native_controller_enabled(cfg)
     autotuner = Autotuner(cfg, extended=not use_native) \
         if cfg.autotune else None

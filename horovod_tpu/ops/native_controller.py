@@ -500,9 +500,10 @@ def native_controller_enabled(cfg) -> bool:
     import os
 
     from .. import cc
+    from ..core.config import HOROVOD_NATIVE_CONTROLLER
 
     del cfg  # knob + library only: autotune runs on both services
-    knob = os.environ.get("HOROVOD_NATIVE_CONTROLLER", "auto").lower()
+    knob = os.environ.get(HOROVOD_NATIVE_CONTROLLER, "auto").lower()
     if knob in ("0", "false", "off"):
         return False
     if not cc.available():

@@ -37,7 +37,9 @@ def _parent_death_watchdog() -> None:
 
 def main() -> int:
     _parent_death_watchdog()
-    rank = int(os.environ["HOROVOD_RANK"])
+    from ..core.config import HOROVOD_RANK
+
+    rank = int(os.environ[HOROVOD_RANK])
     port = int(os.environ[_DRIVER_PORT_ENV])
     # Elastic jobs: heartbeat the driver's health plane for the whole
     # lifetime of this worker (no-op when HOROVOD_ELASTIC_PORT is absent).

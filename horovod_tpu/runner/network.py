@@ -88,7 +88,9 @@ def default_secret() -> bytes:
     distributes a random per-job secret); here the standalone path keeps
     working for tests/dev, but production jobs must come through the
     launcher or export HOROVOD_SECRET_KEY."""
-    raw = os.environ.get("HOROVOD_SECRET_KEY", "")
+    from ..core.config import HOROVOD_SECRET_KEY
+
+    raw = os.environ.get(HOROVOD_SECRET_KEY, "")
     if raw:
         return bytes.fromhex(raw)
     global _warned_default_secret

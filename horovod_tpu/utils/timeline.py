@@ -80,7 +80,9 @@ class Timeline:
         below is the fallback when the native core isn't built."""
         import os
 
-        if os.environ.get("HOROVOD_NATIVE_CORE", "1") == "0":
+        from ..core.config import HOROVOD_NATIVE_CORE
+
+        if os.environ.get(HOROVOD_NATIVE_CORE, "1") == "0":
             return None
         try:
             from ..cc import NativeTimelineWriter, available

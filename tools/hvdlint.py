@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""hvdlint — the repo's contract-analysis suite (docs/analysis.md).
+
+Statically enforces the conventions review memory used to carry: knob
+registry (HVL1xx), lock order (HVL2xx), collective order (HVL3xx), wire
+compatibility (HVL4xx), metrics/docs agreement (HVL5xx), error taxonomy
+(HVL6xx), pytest markers (HVL701), baseline hygiene (HVL9xx).
+
+    python tools/hvdlint.py              # human report, exit != 0 on findings
+    python tools/hvdlint.py --json       # findings to stderr, final stdout
+                                         # line is one JSON summary object
+    python tools/hvdlint.py --only locks,knobs
+    python tools/hvdlint.py --list-codes
+
+Pure stdlib, no jax: runs anywhere ``runner.network`` does. When the
+``horovod_tpu`` package cannot be imported (jax-less workstation), the
+``horovod_tpu/analysis/`` package is loaded straight from its files —
+it is stdlib-only for exactly this reason (the obs/tracing precedent).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    """Load horovod_tpu/analysis straight from its files — never through
+    the horovod_tpu package, whose __init__ imports jax and applies
+    platform steering; a linter must not pay (or depend on) any of
+    that. The package is stdlib-only by contract, so the by-path load
+    works everywhere."""
+    import importlib.util
+
+    pkg_dir = os.path.join(_REPO, "horovod_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "hvdlint_analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["hvdlint_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+analysis = _load_analysis()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--json", action="store_true",
+                        help="findings to stderr; final stdout line is "
+                             "one JSON summary (the repo tool contract)")
+    parser.add_argument("--only", default="",
+                        help="comma-separated checker subset (e.g. "
+                             "'locks,knobs')")
+    parser.add_argument("--baseline", default="",
+                        help="override the baseline file path (default: "
+                             f"{analysis.BASELINE_REL})")
+    parser.add_argument("--root", default=_REPO,
+                        help="repo root to analyze (default: this "
+                             "checkout)")
+    parser.add_argument("--list-codes", action="store_true",
+                        help="print the finding-code catalogue and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_codes:
+        for code, desc in sorted(analysis.CODES.items()):
+            print(f"{code}  {desc}")
+        return 0
+
+    only = [c.strip() for c in args.only.split(",") if c.strip()] or None
+    baseline = args.baseline or None
+    try:
+        result = analysis.run_all(args.root, baseline_path=baseline,
+                                  only=only)
+    except ValueError as exc:  # typo'd --only must fail loudly, not pass
+        print(f"hvdlint: {exc}", file=sys.stderr)
+        return 2
+
+    out = sys.stderr if args.json else sys.stdout
+    for f in result["findings"]:
+        print(f.render(), file=out)
+    n = len(result["findings"])
+    human = (f"[hvdlint] {n} finding(s), {result['waived']} waived, "
+             f"checkers: {', '.join(result['checkers'])}")
+    if args.json:
+        print(human, file=sys.stderr)
+        print(analysis.summary_json(result))
+    else:
+        print(human)
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Contract-analysis gate (docs/analysis.md): run the full hvdlint suite
+# — knob registry, lock order, collective divergence, wire compat,
+# metrics/docs drift, error taxonomy, pytest markers — and exit non-zero
+# on any unwaived finding. The final stdout line is one JSON summary
+# (the repo tool contract, like tools/chaos_matrix.sh's cells).
+#
+# Extra args are forwarded to tools/hvdlint.py, e.g.:
+#   tools/lint.sh --only locks,collectives
+#   tools/lint.sh --list-codes
+#
+# Pure stdlib, no jax: runs on the same boxes runner.network does.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python tools/hvdlint.py --json "$@"

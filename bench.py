@@ -472,6 +472,16 @@ def _parse_args(argv=None):
                              "achieved overlap ratio lands in the BENCH "
                              "json. Governs the eager control plane; "
                              "SPMD steps overlap inside XLA.")
+    parser.add_argument("--fused-apply", action="store_true",
+                        default=False,
+                        help="arm the fused reduce+apply plane for this "
+                             "run (HOROVOD_FUSED_APPLY=1, "
+                             "docs/tensor-fusion.md §fused apply): "
+                             "hvd.apply_step lands applied parameters "
+                             "from one reduce+apply program per batch; "
+                             "apply-batch and dispatch provenance lands "
+                             "in the BENCH json. Governs the eager "
+                             "plane; SPMD steps fuse inside XLA.")
     parser.add_argument("--grad-sentry", default="",
                         choices=["", "off", "warn", "skip", "zero",
                                  "abort"],
@@ -546,7 +556,8 @@ def _supervise(args) -> None:
          else []) + \
         (["--autotune"] if args.autotune else []) + \
         (["--grad-sentry", args.grad_sentry] if args.grad_sentry else []) + \
-        (["--subbuffers", str(args.subbuffers)] if args.subbuffers else [])
+        (["--subbuffers", str(args.subbuffers)] if args.subbuffers else []) + \
+        (["--fused-apply"] if args.fused_apply else [])
     import signal
     import subprocess as sp
 
@@ -694,6 +705,15 @@ def main() -> None:
         _log(f"sub-buffer flush armed: HOROVOD_FUSION_SUBBUFFERS="
              f"{os.environ['HOROVOD_FUSION_SUBBUFFERS']} (overlap ratio "
              f"lands in the BENCH json)")
+
+    if args.fused_apply:
+        # Fused reduce+apply (docs/tensor-fusion.md §fused apply): like
+        # --subbuffers, BEFORE hvd.init() reads the config; setdefault
+        # so an operator's explicit pin wins.
+        os.environ.setdefault("HOROVOD_FUSED_APPLY", "1")
+        _log(f"fused reduce+apply armed: HOROVOD_FUSED_APPLY="
+             f"{os.environ['HOROVOD_FUSED_APPLY']} (apply-batch and "
+             f"dispatch provenance lands in the BENCH json)")
 
     if args.autotune:
         # Closed-loop tuning plane (docs/autotune.md): like --timeline-dir,
@@ -886,6 +906,8 @@ def main() -> None:
         provenance["grad_sentry"] = args.grad_sentry
     if args.subbuffers:
         provenance["subbuffers"] = args.subbuffers
+    if args.fused_apply:
+        provenance["fused_apply"] = True
 
     for i in range(args.num_iters):
         t0 = time.perf_counter()
@@ -962,6 +984,24 @@ def main() -> None:
         result["overlap_seconds"] = round(ov["overlap_seconds"], 6)
         result["overlap_ratio"] = round(
             ov["overlap_seconds"] / busy, 4) if busy > 0 else 0.0
+    if args.fused_apply:
+        # apply-fused audit beside the number (docs/tensor-fusion.md
+        # §fused apply): apply-capable batches by execution strategy and
+        # the dispatches-per-step story, read off the LIVE engine only
+        # (the --subbuffers pattern: the SPMD bench loop has no eager
+        # cycles, and a side-effect engine would be fake provenance).
+        from horovod_tpu.ops import engine as _engine_mod
+
+        eng = _engine_mod._engine
+        ap = eng.apply_stats() if eng is not None else {
+            "exec_fused": False, "fused_batches": 0, "split_batches": 0,
+            "apply_dispatches": 0}
+        result["apply_fused_batches"] = ap["fused_batches"]
+        result["apply_split_batches"] = ap["split_batches"]
+        result["apply_dispatches"] = ap["apply_dispatches"]
+        batches = ap["fused_batches"] + ap["split_batches"]
+        result["apply_dispatches_per_batch"] = round(
+            ap["apply_dispatches"] / batches, 3) if batches else 0.0
     # cost_analysis() reports the per-device SPMD program's flops — and for
     # a lax.scan program it must count the loop BODY once, not times the
     # trip count, or mfu/tflops inflate by scan_batches. One body == one

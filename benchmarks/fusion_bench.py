@@ -15,12 +15,18 @@ planes on a 2-process world:
 
 Usage:  python benchmarks/fusion_bench.py [--tensors 64] [--elems 25000]
                                           [--rounds 12] [--subbuffers 1,2,4]
+                                          [--no-fused-apply]
 
 Prints one table row per (plane, threshold) with tensors/s and speedup,
 then the sub-buffer OVERLAP table (docs/tensor-fusion.md): tensors/s,
 achieved overlap ratio (measured negotiate-while-flushing seconds over
 flush-execute seconds, off the obs registry), and peak in-flight depth
-per ``HOROVOD_FUSION_SUBBUFFERS`` count. Wire bytes in the main table
+per ``HOROVOD_FUSION_SUBBUFFERS`` count, then the fused REDUCE+APPLY
+table (two-dispatch vs apply-fused ``hvd.apply_step`` rounds: tensors/s,
+achieved overlap ratio, and measured apply dispatches per round — the
+fused plane lands applied parameters, collapsing one apply program per
+LEAF into one per BATCH). The final stdout line is one JSON summary of
+the overlap/apply tables (the repo tool contract). Wire bytes in the main table
 are MEASURED per round off the obs registry counters (the single
 accounting definition: ``horovod_eager_wire_bytes_post_total`` on the
 device plane, ``horovod_wire_tx/rx_bytes_total`` on the host TCP plane);
@@ -101,6 +107,23 @@ def _worker() -> None:
         jax.block_until_ready([o for o in outs
                                if not isinstance(o, np.ndarray)])
 
+    if os.environ.get("FUSION_BENCH_APPLY"):
+        # Apply-fused measurement (docs/tensor-fusion.md §fused apply):
+        # each round is one hvd.apply_step over n_tensors parameter
+        # leaves — the engine lands applied parameters; with
+        # HOROVOD_FUSED_APPLY=1 one reduce+apply program per batch,
+        # otherwise the two-dispatch reference (reduce + per-leaf apply)
+        tx = hvd.DistributedOptimizer(hvd.fused_sgd(0.01))
+        params = {f"t{i}": np.full((n_elems,), 0.5, np.float32)
+                  for i in range(n_tensors)}
+        opt_state = tx.init(params)
+
+        def one_round(tag: str) -> None:
+            nonlocal params, opt_state
+            grads = {f"t{i}": t for i, t in enumerate(tensors)}
+            params, opt_state = hvd.apply_step(tx, grads, opt_state,
+                                               params)
+
     one_round("warm0")  # warm the compile cache / connections
     one_round("warm1")
     snap0 = _registry().snapshot()
@@ -121,12 +144,25 @@ def _worker() -> None:
                              "horovod_wire_rx_bytes_total"))
     from horovod_tpu.ops.engine import get_engine
 
-    overlap = get_engine().overlap_stats()
+    eng = get_engine()
+    overlap = eng.overlap_stats()
+    apply_stats = eng.apply_stats()
+    # apply-program dispatches and achieved overlap seconds during the
+    # TIMED rounds only (registry deltas, like the wire bytes) — the
+    # dispatches-per-step and overlap-window columns
+    apply_disp = _fam_total(snap1, "horovod_apply_dispatches_total") - \
+        _fam_total(snap0, "horovod_apply_dispatches_total")
+    timed_overlap = _fam_total(snap1, "horovod_overlap_seconds_total") - \
+        _fam_total(snap0, "horovod_overlap_seconds_total")
     if hvd.rank() == 0:
         print(json.dumps({"seconds": dt,
                           "tensors_per_s": rounds * n_tensors / dt,
                           "wire_bytes_per_round": wire / rounds,
-                          "overlap": overlap}))
+                          "overlap": overlap,
+                          "timed_overlap_seconds": timed_overlap,
+                          "apply": apply_stats,
+                          "apply_dispatches_per_round":
+                              apply_disp / rounds}), flush=True)
     hvd.shutdown()
 
 
@@ -187,7 +223,8 @@ def _wire_bytes_per_round(plane: str, threshold: int, tensors: int,
 
 def _run_world(plane: str, threshold: int, args, tensor_input="numpy",
                subbuffers: int = 1,
-               force_python_controller: bool = False) -> dict:
+               force_python_controller: bool = False,
+               apply_mode: str = "") -> dict:
     port = _free_port()
     coord = f"127.0.0.1:{_free_port()}" if plane == "xla" else ""
     procs = []
@@ -209,6 +246,14 @@ def _run_world(plane: str, threshold: int, args, tensor_input="numpy",
             "FUSION_BENCH_JAX_COORD": coord,
             "FUSION_BENCH_INPUT": tensor_input,
         })
+        if apply_mode:
+            # apply-fused measurement (docs/tensor-fusion.md §fused
+            # apply): rounds are hvd.apply_step calls; "fused" lands
+            # applied params from one reduce+apply program per batch,
+            # "two-dispatch" runs the reference reduce + per-leaf apply
+            env["FUSION_BENCH_APPLY"] = "1"
+            env["HOROVOD_FUSED_APPLY"] = \
+                "1" if apply_mode == "fused" else "0"
         if subbuffers > 1 or force_python_controller:
             # the flush pipeline needs the Python controller wire
             # (ops/engine._arm_flush_pipeline degrade rule); the overlap
@@ -237,6 +282,13 @@ def main() -> None:
                         help="comma-separated HOROVOD_FUSION_SUBBUFFERS "
                              "counts for the overlap table (empty skips "
                              "it; docs/tensor-fusion.md)")
+    parser.add_argument("--fused-apply", dest="fused_apply", default=True,
+                        action="store_true",
+                        help="run the fused reduce+apply table "
+                             "(two-dispatch vs apply-fused hvd.apply_step "
+                             "rounds; docs/tensor-fusion.md §fused apply)")
+    parser.add_argument("--no-fused-apply", dest="fused_apply",
+                        action="store_false")
     args = parser.parse_args()
 
     mb = args.tensors * args.elems * 4 / 1e6
@@ -268,6 +320,9 @@ def main() -> None:
     # over flush-execute seconds, straight off the engine's pipeline
     # counters — per HOROVOD_FUSION_SUBBUFFERS count on the host plane
     # (the fused threshold; sub-buffering generalizes the single flush).
+    summary = {"tool": "fusion_bench", "tensors": args.tensors,
+               "elems": args.elems, "rounds": args.rounds,
+               "overlap_table": [], "apply_table": []}
     counts = [int(c) for c in args.subbuffers.split(",") if c.strip()]
     if counts:
         print(f"\n# sub-buffer overlap (host plane, 64MiB threshold)")
@@ -283,9 +338,52 @@ def main() -> None:
             ov = r["overlap"]
             busy = ov["execute_busy_seconds"]
             ratio = ov["overlap_seconds"] / busy if busy > 0 else 0.0
+            summary["overlap_table"].append({
+                "subbuffers": n_sub,
+                "tensors_per_s": round(r["tensors_per_s"], 1),
+                "overlap_ratio": round(ratio, 3),
+                "inflight_peak": ov["inflight_peak"]})
             print(f"{n_sub:>10} {r['tensors_per_s']:>10.0f} "
                   f"{r['tensors_per_s'] / base:>7.1f}x "
                   f"{100 * ratio:>6.0f}% {ov['inflight_peak']:>8}",
+                  flush=True)
+
+    # Apply-fused table (docs/tensor-fusion.md §fused apply): the same
+    # workload as hvd.apply_step rounds — two-dispatch (reduce + one
+    # apply program per leaf) vs apply-fused (the engine lands applied
+    # parameters, one reduce+apply program per batch) under the overlap
+    # pipeline, with the measured dispatches-per-step column.
+    if counts and args.fused_apply:
+        n_sub = max(counts)
+        print(f"\n# fused reduce+apply (host plane, 64MiB threshold, "
+              f"subbuffers={n_sub}; 'overlap' counts the whole flush —")
+        print(f"# which under 'fused' INCLUDES the update math the "
+              f"two-dispatch mode runs un-overlapped on the main thread)")
+        print(f"{'mode':>14} {'tensors/s':>10} {'speedup':>8} "
+              f"{'overlap':>8} {'ov ms/rd':>9} {'disp/rd':>8}")
+        base = None
+        for mode in ("two-dispatch", "fused"):
+            r = _run_world("host", 64 * 1024 * 1024, args,
+                           subbuffers=n_sub,
+                           force_python_controller=True,
+                           apply_mode=mode)
+            if base is None:
+                base = r["tensors_per_s"]
+            ov = r["overlap"]
+            busy = ov["execute_busy_seconds"]
+            ratio = ov["overlap_seconds"] / busy if busy > 0 else 0.0
+            ov_ms = 1e3 * r["timed_overlap_seconds"] / args.rounds
+            disp = r["apply_dispatches_per_round"]
+            summary["apply_table"].append({
+                "mode": mode,
+                "tensors_per_s": round(r["tensors_per_s"], 1),
+                "overlap_ratio": round(ratio, 3),
+                "overlap_ms_per_round": round(ov_ms, 3),
+                "apply_dispatches_per_round": round(disp, 2),
+                "fused_batches": r["apply"]["fused_batches"]})
+            print(f"{mode:>14} {r['tensors_per_s']:>10.0f} "
+                  f"{r['tensors_per_s'] / base:>7.1f}x "
+                  f"{100 * ratio:>6.0f}% {ov_ms:>9.2f} {disp:>8.1f}",
                   flush=True)
     # codec byte ledger (no timed run: byte accounting is analytic; the
     # timed int8 world needs >=2 jax processes and is covered by
@@ -297,6 +395,9 @@ def main() -> None:
     print(f"# fused-bucket wire bytes: f32 {f32_b / 1e6:.1f} MB vs int8 "
           f"codec {int8_b / 1e6:.1f} MB ({f32_b / int8_b:.1f}x reduction)",
           flush=True)
+    summary["codec_wire_bytes"] = {"f32": f32_b, "int8": int8_b}
+    # final-line JSON (the repo tool contract, like tools/lint.sh)
+    print(json.dumps(summary), flush=True)
 
 
 if __name__ == "__main__":

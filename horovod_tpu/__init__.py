@@ -113,9 +113,14 @@ from .ops import (
     spmd,
     synchronize,
 )
+from .ops.fused_apply import (
+    adam as fused_adam,
+    momentum as fused_momentum,
+    sgd as fused_sgd,
+)
 from .ops.pallas_attention import flash_attention
 from .ops.sparse import IndexedSlices, allreduce_sparse
-from .optimizers import DistributedOptimizer, allreduce_gradients
+from .optimizers import DistributedOptimizer, allreduce_gradients, apply_step
 from .state_bcast import (
     broadcast_global_variables,
     broadcast_object,
@@ -146,7 +151,8 @@ __all__ = [
     "Compression", "spmd", "parallel", "callbacks", "checkpoint",
     "elastic", "obs", "tune", "metrics_snapshot", "straggler_report",
     "IndexedSlices", "allreduce_sparse", "flash_attention",
-    "DistributedOptimizer", "allreduce_gradients",
+    "DistributedOptimizer", "allreduce_gradients", "apply_step",
+    "fused_sgd", "fused_momentum", "fused_adam",
     "broadcast_parameters", "broadcast_optimizer_state",
     "broadcast_global_variables", "broadcast_object",
     "HorovodInternalError", "NotInitializedError", "RanksAbortedError",

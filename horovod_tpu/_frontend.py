@@ -25,7 +25,16 @@ from .callbacks import (  # noqa: F401  (re-exported by the shims)
     MetricAverageCallback,
 )
 from .ops.compression import Compression
-from .optimizers import DistributedOptimizer, is_distributed
+from .ops.fused_apply import (  # noqa: F401  (re-exported by the shims)
+    adam as fused_adam,
+    momentum as fused_momentum,
+    sgd as fused_sgd,
+)
+from .optimizers import (  # noqa: F401  (apply_step re-exported by shims)
+    DistributedOptimizer,
+    apply_step,
+    is_distributed,
+)
 
 CALLBACK_EXPORTS = [
     "BroadcastGlobalVariablesCallback",

@@ -6,9 +6,12 @@ Cross-references the wire-compat registry
 * HVL401: ``ControllerService`` dispatches an RPC tag the registry does
   not know — a new RPC shipped without deciding (and writing down) its
   native-controller degrade.
-* HVL402: ``RequestList``/``CacheRequest`` grew a field the registry
-  does not know — the "predates the field → degrade warned once"
-  pattern (PRs 3/5/6/8/9) must be stated before the wire grows.
+* HVL402: a negotiation message class (``Request``/``RequestList``/
+  ``Response``/``CacheRequest``) grew a field the registry does not
+  know — the "predates the field → degrade warned once" pattern
+  (PRs 3/5/6/8/9/13) must be stated before the wire grows. ``Request``
+  and ``Response`` joined the scan when PR 13's fused-apply fields
+  proved per-tensor/per-batch wire growth follows the same discipline.
 * HVL403: registry entry names a tag/field the code no longer has, or
   carries no degrade text — the registry only stays authoritative if it
   cannot rot.
@@ -23,7 +26,7 @@ from .base import Finding, SourceModule, const_str
 
 CONTROLLER_REL = "horovod_tpu/ops/controller.py"
 MESSAGES_REL = "horovod_tpu/ops/messages.py"
-MESSAGE_CLASSES = ("RequestList", "CacheRequest")
+MESSAGE_CLASSES = ("Request", "RequestList", "Response", "CacheRequest")
 
 
 def scan_rpc_tags(controller_mod: SourceModule,

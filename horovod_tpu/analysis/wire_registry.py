@@ -51,9 +51,52 @@ RPC_TAGS: Dict[str, str] = {
               "local verdict, warned once",
 }
 
-# Fields of the rank -> coordinator negotiation messages
-# (ops/messages.py). Value = what a wire that predates the field does.
+# Fields of the negotiation messages (ops/messages.py): the rank ->
+# coordinator envelopes (RequestList/CacheRequest) plus the per-tensor
+# Request and per-batch Response records that ride inside them (scanned
+# since PR 13 — per-tensor wire growth like the codec and the fused-
+# apply fingerprint follows the same predates-the-field discipline).
+# Value = what a wire that predates the field does.
 MESSAGE_FIELDS: Dict[str, str] = {
+    "Request.request_rank": "baseline wire: present since the reference "
+                            "message.h layout",
+    "Request.request_type": "baseline wire: reference message.h layout",
+    "Request.tensor_name": "baseline wire: reference message.h layout",
+    "Request.tensor_type": "baseline wire: reference message.h layout",
+    "Request.tensor_shape": "baseline wire: reference message.h layout",
+    "Request.root_rank": "baseline wire: reference message.h layout",
+    "Request.device": "baseline wire: the reference's CUDA device id "
+                      "slot; informational only, never negotiated",
+    "Request.codec": "PR 1: the native C++ negotiator's schema predates "
+                     "the field — NativeNegotiator keeps per-name codec "
+                     "bookkeeping in Python and stamps/splits responses; "
+                     "the native controller wire drops it (engine "
+                     "enqueue falls back to the full-precision wire, "
+                     "warned once)",
+    "Request.apply_fingerprint": "PR 13: negotiated like the codec; the "
+                                 "native controller wire predates the "
+                                 "field and drops it — the engine keeps "
+                                 "its apply contexts rank-side and runs "
+                                 "the split reduce-then-apply execution, "
+                                 "warned once (applied parameters still "
+                                 "land)",
+    "Response.response_type": "baseline wire: reference message.h layout",
+    "Response.tensor_names": "baseline wire: reference message.h layout",
+    "Response.error_message": "baseline wire: reference message.h layout",
+    "Response.tensor_sizes": "baseline wire: reference message.h layout",
+    "Response.tensor_dtype": "baseline wire: reference message.h layout",
+    "Response.payload_bytes": "baseline wire: fusion-planner metadata "
+                              "since the seed; old peers re-derive from "
+                              "shape/dtype",
+    "Response.tensor_codec": "PR 1: absent on wires that predate it — "
+                             "ranks read it via getattr default "
+                             "\"none\" and execute the full-precision "
+                             "program",
+    "Response.fused_apply": "PR 13: the apply-capable response kind; "
+                            "absent (empty) on wires that predate it — "
+                            "the engine's rank-side apply contexts "
+                            "degrade to the split reduce-then-apply "
+                            "execution, warned once",
     "RequestList.rank": "baseline wire: present since the reference "
                         "message.h layout",
     "RequestList.requests": "baseline wire: present since the reference "

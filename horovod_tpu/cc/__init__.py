@@ -196,10 +196,14 @@ class NativeNegotiator:
         if rl.requests:
             self._dirty = True
         for req in rl.requests:
-            codec = getattr(req, "codec", "none")
-            prev = self._codecs.setdefault(req.tensor_name, codec)
-            if prev != codec:
-                self._mismatched.setdefault(req.tensor_name, (prev, codec))
+            # one (codec, apply-fingerprint) wire identity per tensor:
+            # both postdate the C++ schema, so both ride this Python
+            # bookkeeping and stamp onto the constructed responses
+            wire = (getattr(req, "codec", "none"),
+                    getattr(req, "apply_fingerprint", ""))
+            prev = self._codecs.setdefault(req.tensor_name, wire)
+            if prev != wire:
+                self._mismatched.setdefault(req.tensor_name, (prev, wire))
             dims = (ctypes.c_longlong * len(req.tensor_shape))(
                 *req.tensor_shape)
             self._lib.htpu_negotiator_add_request(
@@ -208,25 +212,29 @@ class NativeNegotiator:
                 req.root_rank, len(req.tensor_shape), dims)
 
     def _stamp_codecs(self, responses):
-        """Attach negotiated codecs. Mixed-codec ALLREDUCE batches split
-        into adjacent codec-pure runs (execution order preserved);
-        cross-rank codec mismatches carve out per-tensor ERROR responses
-        (the Python Negotiator's contract)."""
+        """Attach the negotiated (codec, apply-fingerprint) wire
+        identities. Mixed-identity ALLREDUCE batches split into adjacent
+        identity-pure runs (execution order preserved); cross-rank
+        mismatches carve out per-tensor ERROR responses (the Python
+        Negotiator's contract for codecs and fused-apply rules
+        alike)."""
         from ..ops.messages import Response, ResponseType
 
         out: List = []
         for resp in responses:
             codecs = []
             for n in resp.tensor_names:
-                codec = self._codecs.pop(n, "none")
+                codec = self._codecs.pop(n, ("none", ""))
                 if n in self._mismatched:
-                    a, b = self._mismatched.pop(n)
+                    (a, fa), (b, fb) = self._mismatched.pop(n)
+                    what = "compression codecs" if a != b \
+                        else "fused-apply rules"
+                    one, other = (a, b) if a != b else (fa, fb)
                     codec = Response(
                         ResponseType.ERROR, tensor_names=[n],
                         error_message=(
-                            f"Mismatched compression codecs: one rank "
-                            f"sent {a!r}, another sent {b!r} for tensor "
-                            f"{n}."))
+                            f"Mismatched {what}: one rank sent {one!r}, "
+                            f"another sent {other!r} for tensor {n}."))
                 codecs.append(codec)
             if resp.response_type != ResponseType.ALLREDUCE:
                 # non-fused ops carry one name; a mismatch there still
@@ -234,7 +242,7 @@ class NativeNegotiator:
                 if codecs and isinstance(codecs[0], Response):
                     out.append(codecs[0])
                     continue
-                resp.tensor_codec = codecs[0] if codecs else "none"
+                resp.tensor_codec = codecs[0][0] if codecs else "none"
                 out.append(resp)
                 continue
             start = 0
@@ -255,7 +263,8 @@ class NativeNegotiator:
                         # autotuner byte accounting stays conserved
                         # across the split
                         payload_bytes=bytes_left,
-                        tensor_codec=codecs[start]))
+                        tensor_codec=codecs[start][0],
+                        fused_apply=codecs[start][1]))
                     bytes_left = 0
                 start = i
         return out

@@ -255,6 +255,19 @@ HOROVOD_SERVING_FAULT = "HOROVOD_SERVING_FAULT"
 # Python controller wire (the cache-bit / metrics-RPC degrade pattern).
 HOROVOD_FUSION_SUBBUFFERS = "HOROVOD_FUSION_SUBBUFFERS"
 
+# Fused reduce+apply data plane (docs/tensor-fusion.md §fused apply;
+# ours, the PAPERS 2305.06942 fused computation-collective design): "1"
+# makes ``hvd.apply_step`` submit apply-capable allreduces — the engine
+# lands APPLIED parameters and fresh optimizer slots from one compiled
+# reduce+apply program per fused batch (psum/quantized decode, loss-scale
+# unscale, nonfinite census, SGD/momentum/Adam leaf update) instead of
+# handing gradients back for a separate optimizer dispatch. Unset/"0"
+# (default) keeps the two-dispatch path bit-exactly. The execution
+# strategy within the armed plane (fused single program vs reduce-then-
+# apply) additionally sits on the autotune ladder as ``fused_apply``
+# (numerics-exact, so never pinned by this env; docs/autotune.md).
+HOROVOD_FUSED_APPLY = "HOROVOD_FUSED_APPLY"
+
 # --- implementation selection + developer knobs (ours) -----------------------
 # Negotiation-core selection: "0" forces the pure-Python negotiator;
 # anything else prefers the C++ core where built (make_negotiator in
@@ -322,6 +335,10 @@ class Config:
     # the single-flush barrier; explicit values pin the autotune knob
     fusion_subbuffers: int = 1
     fusion_subbuffers_explicit: bool = False
+    # fused reduce+apply plane (docs/tensor-fusion.md §fused apply): the
+    # front-end opt-in; the fused-vs-split execution strategy inside the
+    # armed plane belongs to the autotune ladder, not this env
+    fused_apply: bool = False
     timeline_path: str = ""
     timeline_mark_cycles: bool = False
     timeline_all_ranks: bool = False
@@ -390,6 +407,7 @@ class Config:
                 _env_int(HOROVOD_FUSION_SUBBUFFERS, 1), 1),
             fusion_subbuffers_explicit=bool(
                 os.environ.get(HOROVOD_FUSION_SUBBUFFERS)),
+            fused_apply=_env_bool(HOROVOD_FUSED_APPLY),
             timeline_path=os.environ.get(HOROVOD_TIMELINE, ""),
             timeline_mark_cycles=_env_bool(HOROVOD_TIMELINE_MARK_CYCLES),
             timeline_all_ranks=_env_bool(HOROVOD_TIMELINE_ALL_RANKS),

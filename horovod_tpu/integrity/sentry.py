@@ -144,18 +144,34 @@ class GradSentry:
         self.ordinal = 0
         self.trips: List[Tuple[int, str, str]] = []  # (ordinal, action, kind)
 
-    def screen_batch(self, names: Sequence[str], results: List):
+    def screen_batch(self, names: Sequence[str], results: List,
+                     precomputed: Optional[Tuple[int, int]] = None):
         """Screen one reduced allreduce batch; returns the (possibly
         policy-modified) results. Raises ``NonFiniteGradError`` under
         ``abort``. Must be called for EVERY allreduce batch while armed —
         the verdict exchange is a rendezvous, and a rank that skipped one
         would wedge the world (the same every-rank-every-cycle contract
-        the negotiation itself relies on)."""
+        the negotiation itself relies on).
+
+        ``precomputed`` is the apply-fused path's in-program two-scalar
+        census ``(nan_count, inf_count)`` of the whole batch
+        (docs/tensor-fusion.md §fused apply): the verdict then skips
+        per-tensor probing and applies at BATCH granularity — every
+        tensor's bit carries the batch verdict, so the collective
+        exchange, the ordinals, and the skip/zero rewrite stay
+        bit-identical on every rank, while the fused program's census
+        gate has already made the poisoned step a no-op in-program."""
         if self.policy == "off":
             return results
         self.ordinal += 1
         _SENTRY_CHECKS.inc()
-        local = [_local_bad(r, self._probe) for r in results]
+        if precomputed is not None:
+            n_nan, n_inf = precomputed
+            bad = bool(n_nan or n_inf)
+            kind = "nan" if n_nan else ("inf" if n_inf else "")
+            local = [(bad, kind)] * len(results)
+        else:
+            local = [_local_bad(r, self._probe) for r in results]
         bits = [bad for bad, _ in local]
         if self._exchange is not None:
             bits = unpack_bits(

@@ -23,9 +23,9 @@ import numpy as np
 
 from .. import basics
 from ..core.status import HorovodInternalError
-from . import spmd
+from . import fused_apply, spmd
 from .compression import Compression
-from .engine import _is_jax_array, get_engine
+from .engine import ApplyContext, ApplyResult, _is_jax_array, get_engine
 from .messages import OP_NAMES, RequestType
 
 _noname_counter = itertools.count()
@@ -204,6 +204,81 @@ def allreduce_async(tensor: Any, average: bool = True,
                    average=average, compression=compression)
 
 
+# -- fused reduce+apply (docs/tensor-fusion.md §fused apply) ------------------
+
+def fused_apply_async(grad: Any, param: Any, slots, rule, count: int,
+                      name: Optional[str] = None, average: bool = True,
+                      compression=Compression.none) -> int:
+    """Submit one gradient leaf for an apply-capable allreduce: the
+    engine lands the APPLIED parameter and fresh optimizer slots from a
+    fused reduce+apply program (or its split degrade) instead of
+    handing the reduced gradient back. The caller must keep ``param``
+    and ``slots`` alive (and unmutated) until :func:`apply_synchronize`
+    returns — the engine packs them into the flush's buckets on its own
+    thread. float32 only: the apply bucket math is defined at the wire
+    dtype, and a silent cast here would change the optimizer's
+    numerics."""
+    if _is_tracer(grad):
+        raise ValueError(
+            "fused_apply_async called on a traced value inside jit; use "
+            "spmd.reduce_apply (axis_name) there instead.")
+    rule_obj = fused_apply.rule_of(rule) or rule
+    if not isinstance(rule_obj, fused_apply.ApplyRule):
+        raise TypeError(
+            f"rule must be an ApplyRule or a transform from "
+            f"hvd.fused_sgd/fused_momentum/fused_adam, got {rule!r}")
+    for leaf in (grad, param) + tuple(slots):
+        if str(getattr(leaf, "dtype", None)) != "float32":
+            raise TypeError(
+                f"fused apply requires float32 grads/params/slots, got "
+                f"{getattr(leaf, 'dtype', type(leaf))} (cast the model "
+                f"or keep the two-dispatch path)")
+    if len(slots) != rule_obj.nslots:
+        raise ValueError(
+            f"rule {rule_obj.kind!r} needs {rule_obj.nslots} slot "
+            f"leaves, got {len(slots)}")
+    name = _auto_name("allreduce", name)
+    codec = getattr(compression, "codec_name", "none") \
+        if getattr(compression, "quantized", False) else "none"
+    arr = _device_snapshot(grad) if _is_jax(grad) else _to_numpy(grad)
+    engine = get_engine()
+    handle = engine.enqueue(
+        RequestType.ALLREDUCE, arr, name, codec=codec,
+        apply=ApplyContext(rule=rule_obj, param=param,
+                           slots=tuple(slots), count=int(count),
+                           average=average))
+    with _ctx_lock:
+        _handle_ctx[handle] = {"apply": True, "jax_out": _is_jax(param),
+                               "engine": engine}
+        _evict_stale_ctx_locked()
+    return handle
+
+
+def apply_synchronize(handle: int):
+    """Block on an apply-capable handle; returns
+    ``(new_param, new_slots)`` in the submission's array flavor (jax
+    param in → jax out). Raises like :func:`synchronize` on coordinator
+    errors, sentry aborts, and shutdowns."""
+    engine = _engine_of(handle)
+    with _ctx_lock:
+        ctx = _handle_ctx.pop(handle, {})
+    result = engine.handles.wait(handle)
+    if not isinstance(result, ApplyResult):
+        raise HorovodInternalError(
+            "apply_synchronize on a non-apply handle (use synchronize "
+            "for plain collectives)")
+    if ctx.get("jax_out"):
+        import jax.numpy as jnp
+
+        return (jnp.asarray(result.param),
+                tuple(jnp.asarray(s) for s in result.slots))
+    # copy, never a view: host-route results are reshape views into the
+    # power-of-two padded apply buckets — handing them out would pin up
+    # to ~2x param+slot memory on the caller's long-lived state trees
+    return (np.array(result.param),
+            tuple(np.array(s) for s in result.slots))
+
+
 # -- allgather ----------------------------------------------------------------
 
 def allgather(tensor: Any, name: Optional[str] = None,
@@ -240,6 +315,7 @@ __all__ = [
     "allreduce", "allreduce_async",
     "allgather", "allgather_async",
     "broadcast", "broadcast_async",
+    "fused_apply", "fused_apply_async", "apply_synchronize",
     "poll", "synchronize", "release",
     "spmd",
 ]

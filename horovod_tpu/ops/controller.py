@@ -209,6 +209,9 @@ class Negotiator:
                 first = entry.requests[min(entry.requests)]
                 resp.tensor_dtype = first.tensor_type
                 resp.tensor_codec = getattr(first, "codec", "none")
+                if resp.response_type == ResponseType.ALLREDUCE:
+                    resp.fused_apply = getattr(first, "apply_fingerprint",
+                                               "")
                 resp.payload_bytes = _nbytes(first)
                 responses.append(resp)
             warnings = self._maybe_check_stalls()
@@ -261,6 +264,19 @@ class Negotiator:
                     f"{getattr(first, 'codec', 'none')!r}, but rank "
                     f"{req.request_rank} sent "
                     f"{getattr(req, 'codec', 'none')!r} for tensor {name}.")
+            if getattr(req, "apply_fingerprint", "") != \
+                    getattr(first, "apply_fingerprint", ""):
+                # the fused reduce+apply program is part of the
+                # negotiated identity exactly like the codec: divergent
+                # rules (or apply-vs-plain divergence) would land
+                # different parameters on different ranks
+                return error(
+                    f"Mismatched fused-apply rules: rank "
+                    f"{first.request_rank} sent "
+                    f"{getattr(first, 'apply_fingerprint', '')!r}, but "
+                    f"rank {req.request_rank} sent "
+                    f"{getattr(req, 'apply_fingerprint', '')!r} for "
+                    f"tensor {name}.")
 
         op = first.request_type
         if op == RequestType.ALLREDUCE:
@@ -337,7 +353,8 @@ class Negotiator:
                              tensor_names=list(resp.tensor_names),
                              tensor_dtype=resp.tensor_dtype,
                              payload_bytes=resp.payload_bytes,
-                             tensor_codec=resp.tensor_codec)
+                             tensor_codec=resp.tensor_codec,
+                             fused_apply=resp.fused_apply)
             dtype = resp.tensor_dtype
             total = resp.payload_bytes
             j = i + 1
@@ -345,7 +362,8 @@ class Negotiator:
                 nxt = responses[j]
                 if nxt.response_type != ResponseType.ALLREDUCE or \
                         nxt.tensor_dtype != dtype or \
-                        nxt.tensor_codec != resp.tensor_codec:
+                        nxt.tensor_codec != resp.tensor_codec or \
+                        nxt.fused_apply != resp.fused_apply:
                     break
                 if total + nxt.payload_bytes > self._fusion_threshold:
                     break
@@ -1353,7 +1371,7 @@ class ControllerService:
                 self._applied_codec = codec
             extras = {k: knobs[k] for k in
                       ("cache_capacity", "metrics_interval_s", "codec",
-                       "fusion_subbuffers")
+                       "fusion_subbuffers", "fused_apply")
                       if k in knobs}
             if extras:
                 self._tuned_knobs = extras

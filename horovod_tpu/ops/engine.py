@@ -87,6 +87,22 @@ _SUBBUFFER_FLUSHES = _obs_registry().counter(
     "horovod_subbuffer_flushes_total",
     "Sub-buffer flushes dispatched through the overlap pipeline")
 
+# Fused reduce+apply plane (docs/tensor-fusion.md §fused apply): batches
+# that landed applied parameters, by execution strategy — "fused" is the
+# single reduce+apply program, "split" the reduce-then-apply degrade
+# (native controller wire, mixed batches, or the tuned knob) — plus the
+# optimizer-apply dispatch count behind the dispatches-per-step story
+# (fused: one per batch; split: one per leaf).
+_REDUCE_APPLY_BATCHES = _obs_registry().counter(
+    "horovod_reduce_apply_batches_total",
+    "Allreduce batches that landed applied parameters from the engine",
+    labels=("mode",))
+_APPLY_DISPATCHES = _obs_registry().counter(
+    "horovod_apply_dispatches_total",
+    "Optimizer-apply program dispatches (standalone per-leaf programs "
+    "on the two-dispatch/split routes; one combined program per batch "
+    "when fused into the reduce)")
+
 
 def cut_generations(entries: List["TensorTableEntry"],
                     n: int) -> List[List["TensorTableEntry"]]:
@@ -160,6 +176,39 @@ class _FlushClock:
 
 
 @dataclass
+class ApplyContext:
+    """Fused reduce+apply submission context (docs/tensor-fusion.md
+    §fused apply): everything the engine needs to land this gradient's
+    APPLIED parameter instead of the reduced gradient — the baked-in
+    update rule, the current parameter and optimizer-slot leaves (the
+    caller keeps them alive until ``apply_synchronize`` returns), and
+    the already-incremented step count (Adam bias correction)."""
+
+    rule: Any  # fused_apply.ApplyRule
+    param: Any  # np.ndarray | jax.Array
+    slots: tuple  # rule.nslots leaves, same shape as param
+    count: int
+    average: bool = True
+
+
+class ApplyResult:
+    """What an apply-capable response lands in the handle table: the
+    applied parameter and the fresh optimizer slots (never the reduced
+    gradient). Carries ``shape`` so the timeline's end-record contract
+    for results holds unchanged."""
+
+    __slots__ = ("param", "slots")
+
+    def __init__(self, param, slots: tuple) -> None:
+        self.param = param
+        self.slots = tuple(slots)
+
+    @property
+    def shape(self):
+        return self.param.shape
+
+
+@dataclass
 class TensorTableEntry:
     """In-flight named tensor (``common.h:77-98`` TensorTableEntry).
 
@@ -174,6 +223,8 @@ class TensorTableEntry:
     handle: int
     root_rank: int = -1
     codec: str = "none"  # negotiated wire-compression tag (messages.Request)
+    # fused reduce+apply context, None for a plain collective
+    apply: Optional[ApplyContext] = None
 
 
 def _is_jax_array(a) -> bool:
@@ -349,8 +400,18 @@ class _DevicePlaneWorker:
         self._q.put((fn, args, fut))
         return fut
 
-    def stop(self) -> None:
+    def stop(self, join_timeout_s: float = 0.0) -> None:
+        """Queue the shutdown sentinel; with ``join_timeout_s`` > 0 also
+        wait (bounded) for the thread to exit. Joining matters when the
+        worker has RUN compiled XLA programs: a daemon thread frozen
+        mid-C++ at interpreter finalization can leave jaxlib destructors
+        facing a live thread ("terminate called without an active
+        exception" aborts at exit). A worker parked in a dead collective
+        never consumes the sentinel — the bounded join keeps teardown
+        hang-free and the daemon flag keeps the abandonment safe."""
         self._q.put((None, None, None))
+        if join_timeout_s > 0:
+            self._thread.join(timeout=join_timeout_s)
 
 
 class Engine:
@@ -677,6 +738,18 @@ class Engine:
         # today's single-flush barrier byte-identically: no worker, no
         # data channel, the untouched loop body.
         self._subbuffers = cfg.fusion_subbuffers
+        # Fused reduce+apply plane (docs/tensor-fusion.md §fused apply):
+        # execution strategy for apply-capable batches — True runs the
+        # single reduce+apply program, False the reduce-then-apply split.
+        # Numerics-exact either way (the shared ApplyRule math), so the
+        # tuning plane may flip it live via the `fused_apply` tuned knob
+        # without a consent gate — on the HOST wire only, where the
+        # reduce exchange is byte-identical in both strategies; on the
+        # XLA device plane the strategies issue different compiled
+        # collective programs, so the value is pinned at init
+        # (_apply_tuned_knobs ignores the retune there, warned once).
+        self._fused_apply_exec = True
+        self._apply_counts = {"fused": 0, "split": 0, "dispatches": 0}
         self._flush_worker: Optional[_DevicePlaneWorker] = None
         self._flush_clock: Optional[_FlushClock] = None
         self._inflight: "deque" = deque()
@@ -885,6 +958,17 @@ class Engine:
         finally:
             self._flush_clock.mark_end()
 
+    # The coordinator retains a cycle's ResponseList (the payload
+    # exchange's lookup table) for a 16-cycle sliding window
+    # (ControllerService history). A slow in-flight flush — e.g. an
+    # apply-fused batch compiling a fresh bucket program — must not let
+    # the loop thread negotiate idle cycles past that window, or the
+    # flush's own payload exchange KeyErrors on an expired cycle. Half
+    # the window keeps a wide safety margin; throttling is symmetric
+    # (cycles are a world rendezvous, so one throttled rank simply slows
+    # the world's cycle count until its flush completes).
+    _MAX_FLUSH_CYCLE_LAG = 8
+
     def _reap_flushes(self, block: bool = False) -> None:
         """Retire completed in-flight flushes in order; ``block=True``
         waits (abortably, like ``_device_call``) for the oldest one — the
@@ -893,7 +977,7 @@ class Engine:
         from concurrent.futures import TimeoutError as _FutTimeout
 
         while self._inflight:
-            fut = self._inflight[0]
+            _, fut = self._inflight[0]
             if not fut.done() and not block:
                 break
             if not fut.done():
@@ -916,7 +1000,7 @@ class Engine:
         world is over."""
         deadline = time.monotonic() + timeout_s
         while self._inflight:
-            fut = self._inflight.popleft()
+            _, fut = self._inflight.popleft()
             try:
                 fut.result(timeout=max(0.0, deadline - time.monotonic()))
             except Exception:  # noqa: BLE001 - teardown: best effort
@@ -936,6 +1020,28 @@ class Engine:
             "execute_busy_seconds": busy,
             "inflight_peak": self._inflight_peak,
         }
+
+    def _downgrade_codec(self, entry: TensorTableEntry, codec: str) -> str:
+        """One rule for quantized-wire eligibility on the eager plane
+        (shared by the plain and apply-fused allreduce paths): the
+        decision reads only NEGOTIATED metadata (codec + dtype) and
+        world-uniform state (plane presence), so every rank downgrades
+        identically and compiled programs stay launch-order
+        compatible."""
+        if codec == "none":
+            return codec
+        if self._plane is not None and self._plane.supports_quantized(
+                dtype_of(entry.array)):
+            return codec
+        if self._plane is None and \
+                ("codec", codec) not in self._host_fallback_warned:
+            self._host_fallback_warned.add(("codec", codec))
+            LOG.warning(
+                "quantized allreduce (%s) requested but the host "
+                "TCP data plane is active; reducing at full "
+                "precision (set HOROVOD_DATA_PLANE=xla for the "
+                "quantized device wire).", codec)
+        return "none"
 
     def _warn_host_fallback(self, op_name: str, tensor_name: str,
                             array: np.ndarray) -> None:
@@ -1052,7 +1158,8 @@ class Engine:
     # -- submission (API threads) --------------------------------------------
 
     def enqueue(self, op: RequestType, array: np.ndarray, name: str,
-                root_rank: int = -1, codec: str = "none") -> int:
+                root_rank: int = -1, codec: str = "none",
+                apply: Optional[ApplyContext] = None) -> int:
         """EnqueueTensor* (``operations.cc:2472-2591``): duplicate names are
         rejected while the previous submission is still in flight, as the
         reference's tensor_table emplace does."""
@@ -1084,7 +1191,7 @@ class Engine:
             handle = self.handles.allocate()
             entry = TensorTableEntry(name=name, op=op, array=array,
                                      handle=handle, root_rank=root_rank,
-                                     codec=codec)
+                                     codec=codec, apply=apply)
             self._submissions.append(entry)
         self.timeline.negotiate_start(name, _OP_NAMES[op])
         # No wake: submissions ride the next cycle tick, preserving the
@@ -1242,7 +1349,11 @@ class Engine:
                 # consumes the sentinel, but it is a daemon thread
                 self._device_worker.stop()
             if self._flush_worker is not None:
-                self._flush_worker.stop()  # same best-effort contract
+                # joined bounded (unlike the device worker): the flush
+                # worker runs compiled apply programs on the host plane,
+                # and leaving it frozen mid-C++ at interpreter exit
+                # aborts in jaxlib teardown (see _DevicePlaneWorker.stop)
+                self._flush_worker.stop(join_timeout_s=3.0)
             if timeline_safe:
                 self.timeline.close()
             else:
@@ -1266,7 +1377,10 @@ class Engine:
         response_list = None
         for sub in batches:
             self._reap_flushes()  # fail fast on a crashed flush
-            while len(self._inflight) >= self._subbuffers:
+            while len(self._inflight) >= self._subbuffers or (
+                    self._inflight and
+                    self._client.last_cycle - self._inflight[0][0]
+                    >= self._MAX_FLUSH_CYCLE_LAG):
                 self._reap_flushes(block=True)
             requests = [self._request_of(e) for e in sub]
             request_list = RequestList(rank=self._rank, requests=requests,
@@ -1284,10 +1398,11 @@ class Engine:
             span_args = self._cycle_span_args(response_list)
             self._span_args = span_args
             if response_list.responses:
+                cycle_no = self._client.last_cycle
                 fut = self._flush_worker.submit(
                     self._execute_flush, list(response_list.responses),
-                    span_args, self._client.last_cycle)
-                self._inflight.append(fut)
+                    span_args, cycle_no)
+                self._inflight.append((cycle_no, fut))
                 self._flush_count += 1
                 _SUBBUFFER_FLUSHES.inc()
                 depth = len(self._inflight)
@@ -1426,6 +1541,31 @@ class Engine:
             if self._subbuffers > 1:
                 self._arm_flush_pipeline()
             changed["fusion_subbuffers"] = self._subbuffers
+        fused_apply = knobs.get("fused_apply")
+        if fused_apply is not None and \
+                bool(int(fused_apply)) != self._fused_apply_exec:
+            if self._plane is not None:
+                # On the XLA device plane the two strategies issue
+                # DIFFERENT compiled collective programs (psum+apply vs
+                # plain psum) for the same negotiated batch; a retune
+                # lands on each rank's loop thread at its own moment, so
+                # a mid-stream flip could desynchronize launch order
+                # (the plane's byte-identical-programs invariant). The
+                # strategy stays pinned at its init value there.
+                self._warn_apply_once(
+                    "tuned-exec-plane",
+                    "fused_apply retune ignored on the XLA device "
+                    "plane: the execution strategy changes the compiled "
+                    "collective program and cannot flip mid-stream; "
+                    "pin HOROVOD_FUSED_APPLY instead.")
+            else:
+                # Host TCP wire: the reduce exchange is byte-identical
+                # in both strategies (the apply is rank-local compute),
+                # so the flip is safe at any moment — numerics-exact by
+                # the shared ApplyRule math; in-flight batches finish
+                # under whichever mode they started.
+                self._fused_apply_exec = bool(int(fused_apply))
+                changed["fused_apply"] = int(fused_apply)
         codec = knobs.get("codec")
         if codec is not None and \
                 codec != (self._applied_knobs.get("codec") or "none"):
@@ -1529,6 +1669,11 @@ class Engine:
             tensor_shape=tuple(entry.array.shape),
             root_rank=entry.root_rank,
             codec=entry.codec,
+            # negotiated like the codec; the native controller's binary
+            # wire predates the field and simply drops it (the engine
+            # then runs the split execution off its rank-side contexts)
+            apply_fingerprint=(entry.apply.rule.fingerprint
+                               if entry.apply is not None else ""),
         )
 
     def _flush_outstanding(self, status: Status) -> None:
@@ -1591,12 +1736,21 @@ class Engine:
             tl.start(entry.name, op_name, args=span_args)
         try:
             if resp.response_type == ResponseType.ALLREDUCE:
-                results = self._run_allreduce(
-                    idx, entries, getattr(resp, "tensor_codec", "none"),
-                    cycle_no=cycle_no)
-                if self._sentry is not None or \
-                        self._consensus_acc is not None:
-                    results = self._screen_reduced(entries, results)
+                if any(e.apply is not None for e in entries):
+                    # apply-capable batch: land applied parameters and
+                    # fresh optimizer slots, not gradients
+                    # (docs/tensor-fusion.md §fused apply); the path
+                    # owns its own consensus/sentry interplay
+                    results = self._run_reduce_apply(idx, entries, resp,
+                                                     cycle_no=cycle_no)
+                else:
+                    results = self._run_allreduce(
+                        idx, entries,
+                        getattr(resp, "tensor_codec", "none"),
+                        cycle_no=cycle_no)
+                    if self._sentry is not None or \
+                            self._consensus_acc is not None:
+                        results = self._screen_reduced(entries, results)
             elif resp.response_type == ResponseType.ALLGATHER:
                 results = self._run_allgather(idx, entries[0], resp,
                                               cycle_no=cycle_no)
@@ -1648,18 +1802,7 @@ class Engine:
         # compiled collective programs stay launch-order compatible.
         # Ineligible dtypes and plane-less (host TCP) worlds deterministically
         # ride the full-precision wire.
-        if codec != "none":
-            if self._plane is None or not self._plane.supports_quantized(
-                    dtype_of(entries[0].array)):
-                if self._plane is None and \
-                        ("codec", codec) not in self._host_fallback_warned:
-                    self._host_fallback_warned.add(("codec", codec))
-                    LOG.warning(
-                        "quantized allreduce (%s) requested but the host "
-                        "TCP data plane is active; reducing at full "
-                        "precision (set HOROVOD_DATA_PLANE=xla for the "
-                        "quantized device wire).", codec)
-                codec = "none"
+        codec = self._downgrade_codec(entries[0], codec)
         device_in = all(_is_jax_array(e.array) for e in entries)
         if device_in and self._client is None:
             # World of one, device tensors: sum over a single rank without
@@ -1739,6 +1882,298 @@ class Engine:
             for e in entries:
                 tl.activity_end(e.name)
         return results
+
+    # -- fused reduce+apply (docs/tensor-fusion.md §fused apply) --------------
+
+    def _warn_apply_once(self, key: str, msg: str, *args) -> None:
+        if ("apply", key) in self._host_fallback_warned:
+            return
+        self._host_fallback_warned.add(("apply", key))
+        LOG.warning(msg, *args)
+
+    def _apply_leaf(self, ctx: ApplyContext, reduced) -> ApplyResult:
+        """Split-path per-leaf apply: ONE jitted program per leaf — the
+        same ``bucket_apply_fn`` family the fused route compiles over
+        the whole bucket, so split and fused are bit-identical by
+        construction (the update is elementwise; XLA's within-program
+        op fusion is shape-independent, pinned by the twin tests). The
+        average divide rides in-program (``denom``), gate off: the
+        sentry already screened the reduced batch at full tensor
+        granularity on this route."""
+        from .fused_apply import bucket_apply_fn
+
+        denom = self._size if ctx.average and self._size > 1 else 1
+        out = bucket_apply_fn(ctx.rule, False, denom)(
+            reduced, ctx.param, np.int32(ctx.count), *ctx.slots)
+        self._apply_counts["dispatches"] += 1
+        _APPLY_DISPATCHES.inc()
+        return ApplyResult(out[0], tuple(out[3:]))
+
+    def _run_reduce_apply(self, idx: int, entries: List[TensorTableEntry],
+                          resp: Response,
+                          cycle_no: Optional[int] = None) -> List:
+        """Execute one apply-capable allreduce batch: the flush lands
+        APPLIED parameters and fresh optimizer slots (``ApplyResult``)
+        instead of reduced gradients.
+
+        Two strategies, numerics-identical by the shared ``ApplyRule``
+        math:
+
+        * **fused** — ONE compiled reduce+apply dispatch per batch: on
+          the device plane the psum (or quantized decode), loss-scale
+          unscale, nonfinite census, and leaf update compile into a
+          single donated program (``XlaDataPlane.reduce_apply``); on the
+          host plane the TCP exchange reduces and one bucket program
+          applies. Requires the negotiated ``Response.fused_apply``
+          kind — the Python controller's guarantee that the batch is
+          rule-uniform on every rank.
+        * **split** — the reduce exactly as a plain batch (full sentry
+          tensor granularity included), then one jitted apply per leaf:
+          the degrade for the native controller wire (which predates
+          the fingerprint field), mixed batches, non-uniform step
+          counts, and the ``fused_apply`` tuned knob's 0 position.
+
+        Consensus digests the reduced bytes PRE-apply on both routes;
+        the sentry's verdict exchange runs per batch on both routes, at
+        batch granularity under fused (the in-program census gate
+        already made a poisoned step a collective no-op)."""
+        codec = getattr(resp, "tensor_codec", "none")
+        ctxs = [e.apply for e in entries]
+        fingerprint = getattr(resp, "fused_apply", "")
+        # rank-identical by construction: apply contexts are a
+        # deterministic function of replicated front-end state (same
+        # tensors, same step counts, same average flag on every rank),
+        # the fingerprint rides the negotiated response, and the exec
+        # flag is init-pinned on the device plane — so every rank takes
+        # the same fused/split branch for the same batch
+        uniform = all(c is not None for c in ctxs) and len(
+            {(c.rule.fingerprint, c.count, c.average)
+             for c in ctxs if c is not None}) == 1
+        fused = bool(fingerprint) and uniform and self._fused_apply_exec
+        if fused and fingerprint != ctxs[0].rule.fingerprint:
+            # the coordinator negotiated a different apply program than
+            # this rank submitted — a bug, never a silent divergence
+            raise RuntimeError(
+                f"fused-apply desync: response negotiated rule "
+                f"{fingerprint!r} but rank {self._rank} submitted "
+                f"{ctxs[0].rule.fingerprint!r} for batch "
+                f"{[e.name for e in entries]}")
+        if not fused:
+            if not fingerprint and uniform and self._fused_apply_exec:
+                self._warn_apply_once(
+                    "split-wire",
+                    "fused reduce+apply degrades to the split "
+                    "reduce-then-apply execution: this controller wire "
+                    "predates the apply fingerprint field (set "
+                    "HOROVOD_NATIVE_CONTROLLER=0 for single-dispatch "
+                    "apply batches). Applied parameters still land.")
+            reduced = self._run_allreduce(idx, entries, codec,
+                                          cycle_no=cycle_no)
+            if self._sentry is not None or self._consensus_acc is not None:
+                reduced = self._screen_reduced(entries, reduced)
+            self._apply_counts["split"] += 1
+            _REDUCE_APPLY_BATCHES.labels(mode="split").inc()
+            return [r if e.apply is None else self._apply_leaf(e.apply, r)
+                    for e, r in zip(entries, reduced)]
+
+        from .fused_apply import bucket_apply_fn
+        from .xla_plane import _next_bucket
+
+        tl = self.timeline
+        chaos = self._data_chaos
+        if chaos is not None:
+            chaos.begin_batch()  # same ordinal domain as plain batches
+        rule, count = ctxs[0].rule, ctxs[0].count
+        denom = self._size if ctxs[0].average and self._size > 1 else 1
+        # census gate: for skip/zero/abort the program must not land a
+        # poisoned update (abort tears the world down right after, but
+        # the params a restore reads must be the ungated ones); warn/off
+        # hand values through like the two-dispatch path would
+        gate = self._sentry is not None and \
+            self._sentry.policy in ("skip", "zero", "abort")
+        if gate and self._sentry.policy == "zero" and \
+                len(entries) > 1:
+            self._warn_apply_once(
+                "zero-granularity",
+                "HOROVOD_GRAD_SENTRY=zero applies at BATCH granularity "
+                "under fused reduce+apply (the in-program census gate "
+                "zeroes the whole batch, i.e. skip semantics); use the "
+                "split execution for per-tensor nulling.")
+        shapes = [tuple(int(s) for s in e.array.shape) for e in entries]
+        sizes = [int(np.prod(s, dtype=np.int64)) if s else 1
+                 for s in shapes]
+        total = int(sum(sizes))
+        bucket = _next_bucket(total)
+        codec = self._downgrade_codec(entries[0], codec)
+        for e in entries:
+            tl.activity_start(e.name, "EXECUTE")
+        need_views = self._consensus_acc is not None or \
+            self._sentry is not None
+        if self._plane is not None and self._plane.supports(
+                dtype_of(entries[0].array)):
+            # device route: pack grad/param/slot buckets, ONE compiled
+            # psum+apply dispatch with donated buckets
+            write = self._plane._write_fn(np.dtype(np.float32),
+                                          np.dtype(np.float32))
+            zeros = self._plane._zeros_fn(bucket, np.dtype(np.float32))
+
+            def pack(leaves):
+                buf, off = zeros(), 0
+                for leaf, n in zip(leaves, sizes):
+                    buf = write(buf, leaf, off)
+                    off += n
+                return buf
+
+            grad_buf = pack([e.array for e in entries])
+            param_buf = pack([c.param for c in ctxs])
+            slot_bufs = [pack([c.slots[k] for c in ctxs])
+                         for k in range(rule.nslots)]
+            self._plane._account_allreduce(
+                "apply", total, np.dtype(np.float32).itemsize,
+                np.float32, codec)
+            reduced, new_p, nan, inf, new_slots = self._device_call(
+                self._plane.reduce_apply, grad_buf, param_buf, count,
+                slot_bufs, rule, codec, gate, denom)
+            read = lambda buf, shape, n, off: self._plane._read_fn(  # noqa: E731
+                shape, n, np.dtype(np.float32), np.dtype(np.float32),
+                bucket)(buf, off)
+            red_host = np.asarray(reduced) if need_views else None
+        else:
+            # host route: the TCP exchange reduces (the same unpadded
+            # concat bytes a plain batch would ship), then one bucket
+            # program applies (census+gate+divide+update in a single
+            # dispatch)
+            buf = np.empty((total,), np.float32)
+            off = 0
+            for e, n in zip(entries, sizes):
+                buf[off:off + n] = np.asarray(e.array).ravel()
+                off += n
+            if chaos is not None:
+                buf = chaos.on_reduce_input(buf)
+            if self._client is None:
+                out = np.array(buf, copy=True)  # world of one
+            else:
+                raw = self._client.payload(
+                    self._rank, idx,
+                    np.ascontiguousarray(buf).tobytes(),
+                    cycle_no=cycle_no)
+                out = np.frombuffer(raw, dtype=np.float32).copy()
+            if chaos is not None:
+                out = chaos.on_reduce_output(out)
+            # np.empty + explicit tail zero: the pad region only needs
+            # deterministic FINITE values (the census reads g; params
+            # and slots are never read back past ``total``), and
+            # zero-filling whole power-of-two buckets was measurable on
+            # the bench at fusion-buffer sizes
+            gpad = np.empty((bucket,), np.float32)
+            gpad[:total] = out[:total]
+            gpad[total:] = 0.0
+            ppad = np.empty((bucket,), np.float32)
+            ppad[total:] = 0.0
+            spads = [np.empty((bucket,), np.float32)
+                     for _ in range(rule.nslots)]
+            off = 0
+            for c, n in zip(ctxs, sizes):
+                ppad[off:off + n] = np.asarray(c.param).ravel()
+                for k in range(rule.nslots):
+                    spads[k][off:off + n] = np.asarray(c.slots[k]).ravel()
+                off += n
+            for k in range(rule.nslots):
+                spads[k][total:] = 0.0
+            fused_out = bucket_apply_fn(rule, gate, denom)(
+                gpad, ppad, np.int32(count), *spads)
+            new_p = np.asarray(fused_out[0])  # one D2H per bucket
+            nan, inf = int(fused_out[1]), int(fused_out[2])
+            new_slots = [np.asarray(s) for s in fused_out[3:]]
+            red_host = gpad if need_views else None
+            read = lambda buf, shape, n, off: \
+                buf[off:off + n].reshape(shape)  # noqa: E731
+        self._apply_counts["fused"] += 1
+        self._apply_counts["dispatches"] += 1
+        _REDUCE_APPLY_BATCHES.labels(mode="fused").inc()
+        _APPLY_DISPATCHES.inc()
+        names = [e.name for e in entries]
+        if need_views:
+            views, off = [], 0
+            for shape, n in zip(shapes, sizes):
+                views.append(red_host[off:off + n].reshape(shape))
+                off += n
+            # consensus FIRST, on the raw reduced bytes (pre-apply, the
+            # docs/integrity.md contract), then the sentry's collective
+            # verdict off the in-program two-scalar census
+            if self._consensus_acc is not None:
+                self._consensus_acc.observe_batch(names, views)
+            if self._sentry is not None:
+                trips_before = len(self._sentry.trips)
+                self._sentry.screen_batch(names, views,
+                                          precomputed=(int(nan),
+                                                       int(inf)))
+                if gate and int(nan) + int(inf) == 0 and \
+                        len(self._sentry.trips) > trips_before:
+                    # The COLLECTIVE verdict says bad but this rank's
+                    # local census was clean — a peer-divergent reduced
+                    # buffer (the sentry's "peer" kind): the in-program
+                    # gate fired on the bad rank but not here, so the
+                    # full update already landed locally. Recompute the
+                    # zero-gradient step from the UNTOUCHED submission
+                    # contexts (collective-free — never a psum re-run)
+                    # so every rank converges on the identical no-op
+                    # update the gated rank applied.
+                    new_p, new_slots = self._zero_grad_apply(
+                        rule, ctxs, sizes, total, bucket, count, denom)
+                    read = lambda buf, shape, n, off: \
+                        buf[off:off + n].reshape(shape)  # noqa: E731
+        results, off = [], 0
+        for shape, n in zip(shapes, sizes):
+            results.append(ApplyResult(
+                read(new_p, shape, n, off),
+                tuple(read(s, shape, n, off) for s in new_slots)))
+            off += n
+        for e in entries:
+            tl.activity_end(e.name)
+        return results
+
+    def _zero_grad_apply(self, rule, ctxs, sizes, total: int,
+                         bucket: int, count: int, denom: int):
+        """The collective sentry rewrite for an apply-fused batch whose
+        LOCAL census was clean: re-run the bucket apply with a zeroed
+        gradient over the original param/slot leaves — the exact step
+        the census gate computed on the rank that saw the fault (the
+        gate zeroes the gradient before the divide), so the world
+        converges. Host buckets are bit-identical (same gated program,
+        same shapes); a device-plane batch recomputes through the host
+        program, within 1 ulp of the peer's in-program chain — in a
+        scenario where the reduced bytes already diverged, which armed
+        consensus names loudly regardless."""
+        from .fused_apply import bucket_apply_fn
+
+        gpad = np.zeros((bucket,), np.float32)
+        ppad = np.empty((bucket,), np.float32)
+        ppad[total:] = 0.0
+        spads = [np.empty((bucket,), np.float32)
+                 for _ in range(rule.nslots)]
+        off = 0
+        for c, n in zip(ctxs, sizes):
+            ppad[off:off + n] = np.asarray(c.param).ravel()
+            for k in range(rule.nslots):
+                spads[k][off:off + n] = np.asarray(c.slots[k]).ravel()
+            off += n
+        for k in range(rule.nslots):
+            spads[k][total:] = 0.0
+        out = bucket_apply_fn(rule, True, denom)(
+            gpad, ppad, np.int32(count), *spads)
+        return np.asarray(out[0]), [np.asarray(s) for s in out[3:]]
+
+    def apply_stats(self) -> Dict[str, Any]:
+        """Fused reduce+apply counters for tests, the dryrun
+        certification, and bench provenance (zeros when the plane never
+        ran)."""
+        return {
+            "exec_fused": self._fused_apply_exec,
+            "fused_batches": self._apply_counts["fused"],
+            "split_batches": self._apply_counts["split"],
+            "apply_dispatches": self._apply_counts["dispatches"],
+        }
 
     def _run_allgather(self, idx: int, entry: TensorTableEntry,
                        resp: Response,

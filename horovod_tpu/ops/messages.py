@@ -90,6 +90,17 @@ class Request:
     # and fusion only batches same-codec tensors. Cast codecs (fp16/bf16)
     # stay "none" here: they already changed tensor_type itself.
     codec: str = "none"
+    # Fused reduce+apply fingerprint (docs/tensor-fusion.md §fused
+    # apply): the ApplyRule identity this tensor's reduction should land
+    # an optimizer apply for, "" for a plain allreduce. Negotiated like
+    # the codec — it changes the compiled program every rank issues, so
+    # mismatches become coordinator errors and fusion only batches
+    # same-fingerprint tensors; a hyperparameter change is a new
+    # fingerprint and therefore a response-cache identity MISS. Absent
+    # on wires that predate the field (native controller): the engine
+    # keeps its apply contexts rank-side and degrades to the split
+    # reduce-then-apply execution there.
+    apply_fingerprint: str = ""
 
 
 @dataclass
@@ -139,6 +150,13 @@ class Response:
     payload_bytes: int = 0
     # negotiated wire-compression codec for the batch (see Request.codec)
     tensor_codec: str = "none"
+    # Apply-capable response kind (docs/tensor-fusion.md §fused apply):
+    # the negotiated ApplyRule fingerprint when every rank asked this
+    # batch to land applied parameters, "" for a plain reduce. Uniform
+    # across the batch by construction (fusion keys on it); wires that
+    # predate the field leave it "" and the engine's rank-side apply
+    # contexts run the split execution instead.
+    fused_apply: str = ""
 
 
 @dataclass

@@ -109,10 +109,15 @@ def _default_epoch() -> int:
 
 def request_identity(req: Request) -> Tuple:
     """The full identity a cached response is keyed by: name, op, dtype,
-    shape (which fixes payload bytes), codec. ``request_rank`` is excluded
-    — allreduce identities are rank-invariant by negotiation contract."""
+    shape (which fixes payload bytes), codec, and the fused-apply rule
+    fingerprint (docs/tensor-fusion.md §fused apply — an optimizer
+    hyperparameter change is a new fingerprint and must MISS, never
+    replay a layout negotiated under a different apply program).
+    ``request_rank`` is excluded — allreduce identities are
+    rank-invariant by negotiation contract."""
     return (req.tensor_name, int(req.request_type), int(req.tensor_type),
-            tuple(req.tensor_shape), getattr(req, "codec", "none"))
+            tuple(req.tensor_shape), getattr(req, "codec", "none"),
+            getattr(req, "apply_fingerprint", ""))
 
 
 def bits_of(positions: List[int], capacity: int) -> bytes:
@@ -250,11 +255,13 @@ class ResponseCache:
                     f"position {pos} the coordinator does not hold; "
                     f"HOROVOD_CACHE_CAPACITY must be identical on every "
                     f"rank")
-            for name, rtype, dtype, shape, codec in entry.identities:
+            for name, rtype, dtype, shape, codec, apply_fp in \
+                    entry.identities:
                 requests.append(Request(
                     request_rank=rank, request_type=RequestType(rtype),
                     tensor_name=name, tensor_type=DataType(dtype),
-                    tensor_shape=shape, codec=codec))
+                    tensor_shape=shape, codec=codec,
+                    apply_fingerprint=apply_fp))
         return RequestList(rank=rank, requests=requests)
 
     def response_at(self, position: int) -> Response:

@@ -301,6 +301,39 @@ def _quantized_axis_sum(x: jax.Array, axis: str, codec) -> jax.Array:
     return out.reshape(-1)[:n_elems].reshape(orig_shape)
 
 
+def reduce_apply(grad: jax.Array, param: jax.Array, slots, rule,
+                 count, axis_name: AxisName, average: bool = True,
+                 codec=None):
+    """Fused reduce+apply inside a compiled SPMD program: psum (or the
+    block-quantized EQuARX wire when ``codec`` is given) of the gradient,
+    then the shared :class:`ops.fused_apply.ApplyRule` leaf update —
+    one traced expression XLA schedules as a single program, the SPMD
+    companion of the eager engine's apply-fused flush
+    (docs/tensor-fusion.md §fused apply).
+
+    Returns ``(new_param, new_slots)``. ``count`` is the
+    already-incremented step number (Adam bias correction); ``slots``
+    is the rule's slot tuple for this leaf. Groundwork for the ZeRO
+    item: a sharded-state variant composes this body with
+    :func:`reducescatter` over the batch axis instead of the full psum
+    (the ROADMAP's 2-D mesh + ZeRO-1 design)."""
+    from .fused_apply import ApplyRule, rule_of
+
+    rule = rule_of(rule) or rule
+    if not isinstance(rule, ApplyRule):
+        raise TypeError(f"rule must be an ApplyRule, got {rule!r}")
+    _SPMD_LOWERINGS.labels(op="reduce_apply").inc()
+    if codec is not None:
+        red = quantized_allreduce(grad, axis_name, average=False,
+                                  codec=codec)
+    else:
+        red = allreduce(grad, axis_name, average=False)
+    denom = _axis_size(axis_name) if average else 1
+    out = rule.apply_body(red, param, jnp.int32(count), tuple(slots),
+                          gate=False, denom=denom)
+    return out[0], tuple(out[3:])
+
+
 def axis_rank(axis_name: AxisName) -> jax.Array:
     """This shard's index along the axis (device-level 'rank' inside jit)."""
     return lax.axis_index(axis_name)

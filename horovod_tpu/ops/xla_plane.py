@@ -219,6 +219,104 @@ class XlaDataPlane:
 
         return self._local_fn((kind,) + key, _build)
 
+    # -- fused reduce+apply (docs/tensor-fusion.md §fused apply) --------------
+
+    def _reduce_apply_fn(self, rule, codec: str, gate: bool, denom: int):
+        """The apply-fused bucket program (PAPERS 2305.06942): psum —
+        or the block-quantized EQuARX decode when the negotiated codec
+        asks for it — then the shared ``ApplyRule.apply_body`` (census,
+        optional census gate, average divide, loss-scale unscale,
+        optimizer leaf update), all in ONE compiled dispatch. Outputs
+        ``(reduced, new_params, nan, inf, *new_slots)``: the raw reduced
+        bucket rides along so consensus keeps digesting the bytes as
+        received, PRE-apply. Donation covers the grad bucket (aliases
+        the reduced output — per-partition shapes match, like the plain
+        psum program) AND the param/slot buckets (alias their updated
+        twins), so an apply-fused flush holds no duplicate buckets;
+        ``reduce_apply_hlo`` is the audit surface."""
+        def _build():
+            import jax
+            from jax import lax
+
+            P = self._P
+            nslots = rule.nslots
+
+            def body(g, p, count, *slots):
+                if codec != "none":
+                    from .compression import Compression
+                    from .spmd import quantized_allreduce
+
+                    red = quantized_allreduce(
+                        g, "hvd", average=False,
+                        codec=Compression.lookup(codec))
+                else:
+                    red = lax.psum(g, "hvd")
+                return (red,) + rule.apply_body(red, p, count, slots,
+                                                gate, denom)
+
+            in_specs = (P("hvd"), P(), P()) + (P(),) * nslots
+            out_specs = (P(),) * (4 + nslots)
+            donate = (0, 1) + tuple(3 + k for k in range(nslots))
+            return jax.jit(jax.shard_map(
+                body, mesh=self._mesh, in_specs=in_specs,
+                out_specs=out_specs, check_vma=False),
+                donate_argnums=donate)
+
+        return self._local_fn(
+            ("rapply", rule.fingerprint, codec, gate, denom), _build)
+
+    def _replicated_put(self, arr):
+        """Host (or lead-device) value → replicated global array: the
+        P() inputs of the reduce+apply program (param/slot buckets, the
+        step count) — every process contributes its identical copy."""
+        jax = self._jax
+        a = jax.device_put(arr, self._local_device)
+        return jax.make_array_from_single_device_arrays(
+            a.shape, self._replicated, [a])
+
+    def reduce_apply(self, grad_buf, param_buf, count: int, slot_bufs,
+                     rule, codec: str = "none", gate: bool = False,
+                     denom: int = 1):
+        """Run the apply-fused program over pre-packed buckets.
+
+        ``grad_buf`` is this rank's local fused gradient bucket (host
+        numpy or device array, already padded to the negotiated power-
+        of-two bucket); ``param_buf``/``slot_bufs`` are the replicated
+        parameter and optimizer-slot buckets packed to the same layout.
+        Returns ``(reduced, new_params, nan, inf, new_slots)`` as local
+        per-process views (lead-device arrays)."""
+        fn = self._reduce_apply_fn(rule, codec, gate, denom)
+        args = [self._global_put(grad_buf),
+                self._replicated_put(param_buf),
+                self._replicated_put(np.int32(count))]
+        args += [self._replicated_put(s) for s in slot_bufs]
+        outs = fn(*args)
+        local = [o.addressable_shards[0].data for o in outs]
+        reduced, new_p, nan, inf = local[:4]
+        return reduced, new_p, int(nan), int(inf), tuple(local[4:])
+
+    def reduce_apply_hlo(self, n_elems: int, rule, dtype=np.float32,
+                         codec: str = "none", gate: bool = False,
+                         denom: int = 1) -> str:
+        """Compiled-HLO text of the apply-fused program for an
+        ``n_elems``-element batch — the donation audit surface: ONE
+        module whose ``input_output_alias`` header must cover the grad
+        bucket AND the param/slot buckets, or the single-dispatch flush
+        silently degraded to copy-in/copy-out (the
+        ``reduce_donation_hlo`` precedent)."""
+        import jax
+
+        bucket = _next_bucket(n_elems)
+        wire_dt, _ = self._wire_parts(np.dtype(dtype))
+        grad = jax.ShapeDtypeStruct((self._size * bucket,), wire_dt,
+                                    sharding=self._shard)
+        rep = lambda shape, dt: jax.ShapeDtypeStruct(  # noqa: E731
+            shape, dt, sharding=self._replicated)
+        args = [grad, rep((bucket,), wire_dt), rep((), np.int32)]
+        args += [rep((bucket,), wire_dt)] * rule.nslots
+        return self._reduce_apply_fn(rule, codec, gate, denom).lower(
+            *args).compile().as_text()
+
     def reduce_donation_hlo(self, n_elems: int, dtype=np.float32,
                             codec: str = "none") -> str:
         """Compiled-HLO text of the fused-reduction program for an

@@ -284,6 +284,19 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     # Tag for is_distributed(): GradientTransformation is a plain NamedTuple
     # (no instance attributes), so the marker rides on the update function.
     update_fn._horovod_distributed = True
+    # Fused reduce+apply threading (docs/tensor-fusion.md §fused apply):
+    # when the inner optimizer is one of the fusable rules
+    # (hvd.fused_sgd/fused_momentum/fused_adam), carry the rule and the
+    # wrap's routing knobs so apply_step can hand the whole
+    # reduce→unscale→update chain to the engine as ONE program under
+    # HOROVOD_FUSED_APPLY=1.
+    from .ops.fused_apply import rule_of as _rule_of
+
+    update_fn._horovod_apply_rule = _rule_of(optimizer)
+    update_fn._horovod_apply_meta = {
+        "axis_name": axis_name, "average": average,
+        "compression": compression, "n_acc": n_acc,
+    }
     return optax.GradientTransformation(init_fn, update_fn)
 
 
@@ -291,3 +304,123 @@ def is_distributed(tx: optax.GradientTransformation) -> bool:
     """True if ``tx`` was produced by :func:`DistributedOptimizer` (used by
     the front-ends to refuse double wrapping)."""
     return bool(getattr(tx.update, "_horovod_distributed", False))
+
+
+def _fused_apply_armed() -> bool:
+    """The ``HOROVOD_FUSED_APPLY`` opt-in, resolved like the other
+    build-time knobs: pinned config once initialized, env before."""
+    if basics.is_initialized():
+        return basics.config().fused_apply
+    from .core.config import Config
+
+    return Config.from_env().fused_apply
+
+
+def apply_step(tx: optax.GradientTransformation, grads: Any, state: Any,
+               params: Any):
+    """One distributed optimizer step that LANDS applied parameters:
+    ``(new_params, new_state) = apply_step(tx, grads, state, params)``.
+
+    ``tx`` must be a :func:`DistributedOptimizer`. Two routes, bit-exact
+    to each other by the shared :mod:`ops.fused_apply` rule math
+    (certified by ``dryrun_fused_apply``):
+
+    * **two-dispatch** (default): the classic pair — allreduce the
+      gradients through ``tx.update``, then ``optax.apply_updates`` —
+      one reduce dispatch plus per-leaf apply dispatches.
+    * **apply-fused** (``HOROVOD_FUSED_APPLY=1``, eager path, inner
+      optimizer from :func:`~horovod_tpu.fused_sgd` /
+      :func:`~horovod_tpu.fused_momentum` / :func:`~horovod_tpu.fused_adam`):
+      each leaf rides an apply-capable allreduce and the engine's flush
+      returns the applied parameter and fresh optimizer slots from one
+      fused reduce+apply program per batch (docs/tensor-fusion.md
+      §fused apply) — the reduce→apply device round trip is gone, and
+      the PR 9 sub-buffer overlap window covers the update math too.
+
+    The SPMD path (``axis_name=``) always takes the two-dispatch form
+    here — inside jit XLA already fuses the chain; see
+    :func:`ops.spmd.reduce_apply` for the explicit in-program fusion."""
+    if not is_distributed(tx):
+        raise ValueError(
+            "apply_step needs a DistributedOptimizer-wrapped transform")
+    meta = getattr(tx.update, "_horovod_apply_meta", None) or {}
+    rule = getattr(tx.update, "_horovod_apply_rule", None)
+    comp = _resolve_compression(meta.get("compression"))
+    # cast codecs (fp16/bf16) change the wire dtype pre-submit — the
+    # f32 apply bucket cannot carry them, so they keep the two-dispatch
+    # path; quantized codecs decode INSIDE the fused program (EQuARX)
+    quantized_ok = comp is Compression.none or \
+        getattr(comp, "quantized", False)
+    fusable = rule is not None and meta.get("axis_name") is None and \
+        meta.get("n_acc", 1) == 1 and quantized_ok
+    if fusable and _fused_apply_armed():
+        from .ops import apply_synchronize, fused_apply_async
+        from .ops.fused_apply import FusedApplyState
+
+        inner = state.inner
+        count_next = int(inner.count) + 1
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        p_leaves = jax.tree_util.tree_flatten(params)[0]
+        slot_leaves = [jax.tree_util.tree_flatten(s)[0]
+                       for s in inner.slots]
+        handles = [
+            fused_apply_async(
+                g, p_leaves[i], tuple(s[i] for s in slot_leaves), rule,
+                count_next, name=f"DistributedOptimizer.apply.{i}",
+                average=meta.get("average", True), compression=comp)
+            for i, g in enumerate(leaves)]
+        outs = [apply_synchronize(h) for h in handles]
+        unflatten = jax.tree_util.tree_unflatten
+        new_params = unflatten(treedef, [o[0] for o in outs])
+        new_slots = tuple(
+            unflatten(treedef, [o[1][k] for o in outs])
+            for k in range(rule.nslots))
+        new_inner = FusedApplyState(count=inner.count + 1,
+                                    slots=new_slots)
+        return new_params, DistributedOptState(
+            inner=new_inner, accum=state.accum, counter=state.counter)
+    if fusable:
+        # the two-dispatch REFERENCE path: one reduce dispatch (summed
+        # wire, the fused plane's exact input), then one jitted apply
+        # program per leaf from the SAME bucket_apply_fn family the
+        # engine compiles — average divide in-program — so fused vs
+        # two-dispatch is bit-exact by construction (the
+        # dryrun_fused_apply certification). The optax-compatible
+        # tx.update surface below remains for generic inner optimizers;
+        # its eager apply_updates add lands within 1 ulp of these
+        # in-program chains (XLA fuses mul+add differently there).
+        from .ops.engine import _APPLY_DISPATCHES
+        from .ops.fused_apply import FusedApplyState, bucket_apply_fn
+
+        reduced = allreduce_gradients(
+            grads, axis_name=None, average=False, compression=comp)
+        inner = state.inner
+        count_next = int(inner.count) + 1
+        denom = basics.size() if meta.get("average", True) else 1
+        fn = bucket_apply_fn(rule, False, denom)
+        leaves, treedef = jax.tree_util.tree_flatten(reduced)
+        p_leaves = jax.tree_util.tree_flatten(params)[0]
+        slot_leaves = [jax.tree_util.tree_flatten(s)[0]
+                       for s in inner.slots]
+        new_p, new_slot_cols = [], [[] for _ in range(rule.nslots)]
+        import numpy as _np
+
+        for i, g in enumerate(leaves):
+            out = fn(g, p_leaves[i], _np.int32(count_next),
+                     *(s[i] for s in slot_leaves))
+            # one standalone apply dispatch per leaf — the cost the
+            # fused plane folds into the reduce (the dispatches-per-step
+            # story, docs/tensor-fusion.md §fused apply)
+            _APPLY_DISPATCHES.inc()
+            new_p.append(out[0])
+            for k in range(rule.nslots):
+                new_slot_cols[k].append(out[3 + k])
+        unflatten = jax.tree_util.tree_unflatten
+        new_params = unflatten(treedef, new_p)
+        new_slots = tuple(unflatten(treedef, c) for c in new_slot_cols)
+        new_inner = FusedApplyState(count=inner.count + 1,
+                                    slots=new_slots)
+        return new_params, DistributedOptState(
+            inner=new_inner, accum=state.accum, counter=state.counter)
+    updates, new_state = tx.update(grads, state, params)
+    return optax.apply_updates(params, updates), new_state

@@ -44,6 +44,7 @@ KNOB_CACHE = "cache_capacity"
 KNOB_INTERVAL = "metrics_interval_s"
 KNOB_CODEC = "codec"
 KNOB_SUBBUFFERS = "fusion_subbuffers"
+KNOB_FUSED_APPLY = "fused_apply"
 # Serving-plane knobs (docs/serving.md): tuned by the driver-resident
 # ServingPlane's own policy instance, scored by batch payload throughput.
 KNOB_SERVING_BATCH = "serving_batch_max"
@@ -420,6 +421,16 @@ def default_knobs(cfg, extended: bool = False) -> List[Knob]:
         values, index = _ladder(cfg.fusion_subbuffers, [1, 2, 4, 8])
         knobs.append(Knob(KNOB_SUBBUFFERS, values, index,
                           pinned=cfg.fusion_subbuffers_explicit))
+    if extended and cfg.fused_apply:
+        # Fused reduce+apply execution strategy (docs/tensor-fusion.md
+        # §fused apply): 1 = the single reduce+apply program, 0 = the
+        # reduce-then-apply split. Present only when the operator armed
+        # the plane (HOROVOD_FUSED_APPLY=1 — the env opts into the
+        # PLANE, not the strategy, so the knob is never pinned by it).
+        # Numerics-exact both ways — the two strategies share the
+        # ApplyRule math bit-for-bit — so no consent gate like the
+        # codec's; applied by the engine off the tuned_knobs piggyback.
+        knobs.append(Knob(KNOB_FUSED_APPLY, (0, 1), 1, pinned=False))
     if extended and cfg.metrics_port > 0:
         # present (pinned) even when the interval was set explicitly, so
         # the config map / gauges / decision log can distinguish "pinned

@@ -1,0 +1,613 @@
+"""Fused reduce+apply tests (docs/tensor-fusion.md §fused apply).
+
+The apply-fused tentpole's battery: ApplyRule math vs real optax, the
+bucket-vs-leaf program-family bit-exactness the whole design rests on,
+fingerprint/cache-identity semantics, negotiator fusion keying, the
+donation HLO audit, knob/ladder plumbing, and multi-process worlds —
+fused vs two-dispatch bit-exactness for SGD/momentum/Adam, sentry
+skip/zero interplay under nan@rank1 chaos, native-controller and size-1
+degrades. Named ``zz`` to sort past the 870 s tier-1 truncation point
+(ROADMAP operational note); the dryrun subprocess lives under ``slow``.
+"""
+
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.ops import fused_apply as fa  # noqa: E402
+from horovod_tpu.ops.messages import (  # noqa: E402
+    DataType,
+    Request,
+    RequestList,
+    RequestType,
+    ResponseType,
+)
+
+pytestmark = pytest.mark.fused_apply
+
+RULES = {
+    "sgd": fa.ApplyRule("sgd", 0.1),
+    "momentum": fa.ApplyRule("momentum", 0.1, momentum=0.9),
+    "nesterov": fa.ApplyRule("momentum", 0.1, momentum=0.9,
+                             nesterov=True),
+    "adam": fa.ApplyRule("adam", 1e-3),
+}
+
+
+# -- rule math ----------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["sgd", "momentum", "nesterov", "adam"])
+def test_rule_math_matches_real_optax(kind):
+    """The optax twins implement the textbook formulas: updates and
+    state track real optax within float32 roundoff (1-ulp differences
+    are expected — XLA fuses the jitted chain where optax's eager
+    per-op dispatch rounds between ops; the twins' own paths are pinned
+    BIT-exact below)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    refs = {
+        "sgd": optax.sgd(0.1),
+        "momentum": optax.sgd(0.1, momentum=0.9),
+        "nesterov": optax.sgd(0.1, momentum=0.9, nesterov=True),
+        "adam": optax.adam(1e-3),
+    }
+    mine, ref = fa.as_optax(RULES[kind]), refs[kind]
+    rng = np.random.RandomState(3)
+    params = {"w": jnp.asarray(rng.randn(9).astype(np.float32)),
+              "b": jnp.asarray(rng.randn(2, 3).astype(np.float32))}
+    s_m, s_r = mine.init(params), ref.init(params)
+    for _ in range(3):
+        g = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(
+                rng.randn(*x.shape).astype(np.float32)), params)
+        u_m, s_m = mine.update(g, s_m, params)
+        u_r, s_r = ref.update(g, s_r, params)
+        for k in u_m:
+            np.testing.assert_allclose(np.asarray(u_m[k]),
+                                       np.asarray(u_r[k]),
+                                       rtol=2e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("kind", ["sgd", "momentum", "adam"])
+def test_bucket_vs_leaf_same_program_family_bit_exact(kind):
+    """THE load-bearing invariant: one leaf's slice of the fused bucket
+    program equals the same program run over the leaf alone, bit for
+    bit, across steps — elementwise math plus shape-independent XLA
+    fusion. This is what makes fused == split == two-dispatch."""
+    rule = RULES[kind]
+    rng = np.random.RandomState(7)
+    sizes = [5, 11, 3]
+    ps = [rng.randn(n).astype(np.float32) for n in sizes]
+    slots = [[np.zeros(n, np.float32) for n in sizes]
+             for _ in range(rule.nslots)]
+    ps_b = np.concatenate(ps)
+    slots_b = [np.concatenate(s) for s in slots]
+    fn = fa.bucket_apply_fn(rule, True, 2)
+    offs = np.cumsum([0] + sizes)
+    for step in range(1, 4):
+        gs = [rng.randn(n).astype(np.float32) for n in sizes]
+        out = fn(np.concatenate(gs), ps_b, np.int32(step), *slots_b)
+        ps_b = np.asarray(out[0])
+        slots_b = [np.asarray(s) for s in out[3:]]
+        for i, g in enumerate(gs):
+            res = fn(g, ps[i], np.int32(step),
+                     *[s[i] for s in slots])
+            ps[i] = np.asarray(res[0])
+            for k in range(rule.nslots):
+                slots[k][i] = np.asarray(res[3 + k])
+            sl = slice(offs[i], offs[i + 1])
+            assert np.array_equal(ps[i], ps_b[sl]), (kind, step, i)
+            for k in range(rule.nslots):
+                assert np.array_equal(slots[k][i], slots_b[k][sl])
+
+
+def test_census_gate_is_the_zeroed_grad_step():
+    """A non-finite batch under the census gate lands exactly the step
+    a zeroed gradient would (the sentry's skip semantics): params move
+    by the zero-grad update, slots decay identically, census counts
+    land in the two scalars."""
+    rule = RULES["momentum"]
+    g = np.array([1.0, np.nan, 2.0, 3.0], np.float32)
+    p = np.ones(4, np.float32)
+    tr = np.full(4, 0.5, np.float32)
+    gated = fa.bucket_apply_fn(rule, True, 2)(g, p, np.int32(5), tr)
+    ref = fa.bucket_apply_fn(rule, True, 2)(
+        np.zeros(4, np.float32), p, np.int32(5), tr)
+    assert int(gated[1]) == 1 and int(gated[2]) == 0  # (nan, inf)
+    assert np.array_equal(np.asarray(gated[0]), np.asarray(ref[0]))
+    assert np.array_equal(np.asarray(gated[3]), np.asarray(ref[3]))
+
+
+def test_fingerprint_is_the_hyperparameter_identity():
+    a = fa.ApplyRule("adam", 1e-3)
+    assert a.fingerprint == fa.ApplyRule("adam", 1e-3).fingerprint
+    for other in (fa.ApplyRule("adam", 2e-3),
+                  fa.ApplyRule("adam", 1e-3, b1=0.8),
+                  fa.ApplyRule("adam", 1e-3, eps=1e-6),
+                  fa.ApplyRule("adam", 1e-3, loss_scale=128.0),
+                  fa.ApplyRule("sgd", 1e-3)):
+        assert other.fingerprint != a.fingerprint, other
+    with pytest.raises(ValueError, match="unknown fused-apply rule"):
+        fa.ApplyRule("adagrad", 0.1)
+    with pytest.raises(ValueError, match="loss_scale"):
+        fa.ApplyRule("sgd", 0.1, loss_scale=0.0)
+
+
+# -- negotiation + cache identity ---------------------------------------------
+
+def _req(name, fp, rank=0, codec="none"):
+    return Request(request_rank=rank, request_type=RequestType.ALLREDUCE,
+                   tensor_name=name, tensor_type=DataType.FLOAT32,
+                   tensor_shape=(8,), codec=codec, apply_fingerprint=fp)
+
+
+def test_cache_identity_misses_on_hyperparam_change():
+    """The response-cache request identity carries the fingerprint: an
+    optimizer-hyperparameter change (new fingerprint) is a MISS, never
+    a replay of a layout negotiated under a different apply program."""
+    from horovod_tpu.ops.response_cache import (
+        ResponseCache,
+        request_identity,
+    )
+
+    fp_a = RULES["adam"].fingerprint
+    fp_b = fa.ApplyRule("adam", 2e-3).fingerprint
+    assert request_identity(_req("t", fp_a)) != \
+        request_identity(_req("t", fp_b))
+    from horovod_tpu.ops.messages import Response
+
+    cache = ResponseCache(8)
+    resp = Response(ResponseType.ALLREDUCE, tensor_names=["t"],
+                    tensor_dtype=DataType.FLOAT32, fused_apply=fp_a)
+    cache.insert_cycle({"t": _req("t", fp_a)}, [resp])
+    assert cache.plan_cycle([_req("t", fp_a)]) is not None
+    assert cache.plan_cycle([_req("t", fp_b)]) is None  # the miss
+
+
+def test_negotiator_fuses_by_fingerprint_and_errors_on_mismatch():
+    from horovod_tpu.core.config import Config
+    from horovod_tpu.ops.controller import Negotiator
+
+    fp = RULES["sgd"].fingerprint
+    neg = Negotiator(2, Config().fusion_threshold_bytes)
+    for rank in (0, 1):
+        neg.add_request_list(RequestList(rank=rank, requests=[
+            _req("a", fp, rank), _req("b", fp, rank),
+            _req("c", "", rank)]))
+    out = neg.construct_response_list()
+    kinds = [(r.response_type, tuple(r.tensor_names), r.fused_apply)
+             for r in out.responses]
+    # same-fingerprint tensors fuse into ONE apply-capable batch; the
+    # plain allreduce never joins it
+    assert (ResponseType.ALLREDUCE, ("a", "b"), fp) in kinds, kinds
+    assert (ResponseType.ALLREDUCE, ("c",), "") in kinds, kinds
+    # cross-rank rule mismatch is a coordinator error, like the codec
+    neg = Negotiator(2, Config().fusion_threshold_bytes)
+    neg.add_request_list(RequestList(rank=0, requests=[_req("t", fp, 0)]))
+    neg.add_request_list(RequestList(rank=1, requests=[
+        _req("t", RULES["adam"].fingerprint, 1)]))
+    out = neg.construct_response_list()
+    assert out.responses[0].response_type == ResponseType.ERROR
+    assert "fused-apply" in out.responses[0].error_message
+
+
+# -- donation HLO audit -------------------------------------------------------
+
+def test_reduce_apply_hlo_single_program_with_donated_buckets():
+    """The single-dispatch claim, audited: ONE compiled module whose
+    ``input_output_alias`` header covers the grad bucket (aliasing the
+    raw reduced output) AND the param/slot buckets — f32 and the int8
+    codec variant alike (the ``reduce_donation_hlo`` precedent)."""
+    from horovod_tpu.ops.xla_plane import XlaDataPlane
+
+    plane = XlaDataPlane(types.SimpleNamespace(rank=0, size=1))
+    for codec in ("none", "int8"):
+        for rule in (RULES["sgd"], RULES["adam"]):
+            hlo = plane.reduce_apply_hlo(5000, rule, codec=codec,
+                                         gate=True, denom=2)
+            assert "input_output_alias" in hlo, (codec, rule.kind)
+            line = [l for l in hlo.splitlines()
+                    if "input_output_alias" in l][0]
+            n_alias = line.count("alias)")
+            assert n_alias >= 2 + rule.nslots, (codec, rule.kind, line)
+
+
+def test_spmd_reduce_apply_companion():
+    """The in-jit companion (groundwork for the ZeRO sharded update):
+    ``spmd.reduce_apply`` fuses psum + the shared ApplyRule math into
+    one traced expression, matching the bucket program applied to the
+    mean gradient."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops.spmd import reduce_apply
+    from horovod_tpu.parallel import DATA_AXIS, data_parallel_mesh
+
+    mesh = data_parallel_mesh()
+    n_dev = mesh.devices.size
+    if n_dev < 2:
+        pytest.skip("needs a multi-device mesh (conftest XLA_FLAGS)")
+    rule = RULES["adam"]
+
+    def step(g, p, mu, nu):
+        new_p, (nmu, nnu) = reduce_apply(
+            g, p, (mu, nu), rule, 1, DATA_AXIS, average=True)
+        return new_p, nmu, nnu
+
+    f = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(DATA_AXIS), P(), P(), P()),
+        out_specs=(P(), P(), P()), check_vma=False))
+    rng = np.random.RandomState(11)
+    g = rng.randn(n_dev, 6).astype(np.float32)
+    p = rng.randn(6).astype(np.float32)
+    z = np.zeros(6, np.float32)
+    new_p, mu, nu = f(g, p, z, z)
+    ref = fa.bucket_apply_fn(rule, False, 1)(
+        (g.sum(axis=0) / n_dev).astype(np.float32), p, np.int32(1), z, z)
+    np.testing.assert_allclose(
+        np.asarray(new_p).reshape(-1), np.asarray(ref[0]),
+        rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(mu).reshape(-1), np.asarray(ref[3]),
+        rtol=1e-6, atol=1e-7)
+
+
+# -- submission validation ----------------------------------------------------
+
+def test_fused_apply_async_validation():
+    from horovod_tpu import ops
+
+    g = np.ones(4, np.float32)
+    with pytest.raises(TypeError, match="ApplyRule"):
+        ops.fused_apply_async(g, g, (), object(), 1)
+    with pytest.raises(TypeError, match="float32"):
+        ops.fused_apply_async(g.astype(np.float64), g, (),
+                              RULES["sgd"], 1)
+    with pytest.raises(ValueError, match="slot"):
+        ops.fused_apply_async(g, g, (), RULES["adam"], 1)
+
+
+# -- knob / ladder / decision log ---------------------------------------------
+
+def test_policy_fused_apply_knob_gating_and_decision_log():
+    """The ``fused_apply`` ladder entry (docs/autotune.md): present only
+    when the operator armed the plane (HOROVOD_FUSED_APPLY=1), never
+    pinned by that env (numerics-exact strategy choice belongs to the
+    tuner), and its moves land in the JSONL decision log."""
+    import json
+
+    from horovod_tpu.core.config import Config
+    from horovod_tpu.tune.policy import (
+        Knob,
+        TuningPolicy,
+        default_knobs,
+    )
+
+    names = {k.name for k in default_knobs(Config(), extended=True)}
+    assert "fused_apply" not in names  # plane not armed
+    by_name = {k.name: k for k in default_knobs(
+        Config(fused_apply=True), extended=True)}
+    assert "fused_apply" in by_name
+    knob = by_name["fused_apply"]
+    assert knob.values == (0, 1) and not knob.pinned
+    assert knob.current == 1
+    # native wire: classic pair only, the knob never rides it
+    names = {k.name for k in default_knobs(Config(fused_apply=True),
+                                           extended=False)}
+    assert names == {"fusion_threshold_bytes", "cycle_time_ms"}
+    # decision log: drive a policy over just this knob until it moves
+    records = []
+    policy = TuningPolicy([Knob("fused_apply", (0, 1), 1)],
+                          window=1, cooldown=0,
+                          decision_sink=records.append)
+    for _ in range(6):
+        policy.observe(1e6, 1e3)
+    moved = [r for r in records if r["action"] != "init"]
+    assert moved and any(r["knob"] == "fused_apply" for r in moved)
+    for record in records:
+        json.dumps(record)  # the JSONL contract
+        assert "fused_apply" in record["config"]
+
+
+def test_size1_fused_apply_and_tuned_knob_flip(monkeypatch):
+    """Size-1 world: apply-capable batches land applied parameters
+    bit-exact to the shared program run locally, and the tuned
+    ``fused_apply`` knob flips the engine's execution strategy (split
+    still lands applied parameters)."""
+    monkeypatch.setenv("HOROVOD_FUSED_APPLY", "1")
+    import horovod_tpu as hvd
+    from horovod_tpu.ops.engine import get_engine
+
+    hvd.init()
+    try:
+        rule = RULES["adam"]
+        tx = hvd.DistributedOptimizer(fa.as_optax(rule))
+        params = {"w": np.arange(16, dtype=np.float32)}
+        state = tx.init(params)
+        grads = {"w": np.full(16, 0.25, np.float32)}
+        p1, s1 = hvd.apply_step(tx, grads, state, params)
+        eng = get_engine()
+        assert eng.apply_stats()["fused_batches"] == 1
+        ref = fa.bucket_apply_fn(rule, False, 1)(
+            grads["w"], params["w"], np.int32(1),
+            np.zeros(16, np.float32), np.zeros(16, np.float32))
+        np.testing.assert_array_equal(np.asarray(p1["w"]),
+                                      np.asarray(ref[0]))
+        # the tuning plane's piggyback flips the strategy live
+        msg = types.SimpleNamespace(tuned_knobs={"fused_apply": 0})
+        eng._apply_tuned_knobs(msg)
+        assert not eng._fused_apply_exec
+        p2, s2 = hvd.apply_step(tx, grads, s1, p1)
+        stats = eng.apply_stats()
+        assert stats["split_batches"] == 1, stats
+        ref2 = fa.bucket_apply_fn(rule, False, 1)(
+            grads["w"], np.asarray(p1["w"]), np.int32(2),
+            np.asarray(s1.inner.slots[0]["w"]),
+            np.asarray(s1.inner.slots[1]["w"]))
+        np.testing.assert_array_equal(np.asarray(p2["w"]),
+                                      np.asarray(ref2[0]))
+    finally:
+        hvd.shutdown()
+
+
+def test_peer_verdict_rewrites_locally_clean_fused_batch(monkeypatch):
+    """The collective-sentry contract under fused apply: when the
+    verdict exchange ORs in a PEER's bad bit while this rank's
+    in-program census was clean (a peer-divergent reduced buffer — the
+    sentry's "peer" kind), the already-landed full update must be
+    replaced by the zero-gradient step the gated rank computed, so the
+    world converges instead of silently diverging."""
+    monkeypatch.setenv("HOROVOD_FUSED_APPLY", "1")
+    monkeypatch.setenv("HOROVOD_GRAD_SENTRY", "skip")
+    import horovod_tpu as hvd
+    from horovod_tpu.ops.engine import get_engine
+
+    hvd.init()
+    try:
+        rule = RULES["momentum"]
+        tx = hvd.DistributedOptimizer(fa.as_optax(rule))
+        params = {"w": np.arange(16, dtype=np.float32)}
+        state = tx.init(params)
+        # seed a nonzero trace so the zero-grad step still MOVES params
+        # (u = -lr * momentum * trace) — unchanged-params alone could
+        # not tell the rewrite from a dropped apply
+        g0 = {"w": np.full(16, 2.0, np.float32)}
+        params, state = hvd.apply_step(tx, g0, state, params)
+        eng = get_engine()
+        # a peer saw the batch bad: every exchanged bit comes back set
+        eng._sentry._exchange = lambda ordinal, bits: b"\xff"
+        p_before = np.asarray(params["w"]).copy()
+        tr_before = np.asarray(state.inner.slots[0]["w"]).copy()
+        g1 = {"w": np.full(16, 5.0, np.float32)}  # locally clean
+        params2, state2 = hvd.apply_step(tx, g1, state, params)
+        trips = eng._sentry.trips
+        assert trips and trips[-1][2] == "peer", trips
+        # the landed state is the ZERO-grad step, not g1's update
+        ref = fa.bucket_apply_fn(rule, True, 1)(
+            np.zeros(16, np.float32), p_before,
+            np.int32(int(state.inner.count) + 1), tr_before)
+        np.testing.assert_array_equal(np.asarray(params2["w"]),
+                                      np.asarray(ref[0]))
+        np.testing.assert_array_equal(
+            np.asarray(state2.inner.slots[0]["w"]), np.asarray(ref[3]))
+    finally:
+        hvd.shutdown()
+
+
+# -- multi-process worlds -----------------------------------------------------
+
+def _world_fn(opts, steps, n_leaves):
+    """Per-rank body: run each optimizer kind for ``steps`` fused (or
+    two-dispatch, per HOROVOD_FUSED_APPLY) apply_steps; report final
+    params/slots plus engine apply/overlap/sentry stats."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import fused_apply as fa
+    from horovod_tpu.ops.engine import get_engine
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    out = {"rank": rank}
+    makers = {"sgd": lambda: fa.sgd(0.1),
+              "momentum": lambda: fa.momentum(0.1, 0.9),
+              "adam": lambda: fa.adam(1e-2)}
+    for kind in opts:
+        tx = hvd.DistributedOptimizer(makers[kind]())
+        params = {f"l{i}": (np.arange(8 + i, dtype=np.float32) / 7 - 0.4)
+                  for i in range(n_leaves)}
+        state = tx.init(params)
+        for step in range(steps):
+            grads = {f"l{i}": np.full(8 + i,
+                                      float((rank + 1) * (i + 1)
+                                            * (step + 1)) / 8,
+                                      np.float32)
+                     for i in range(n_leaves)}
+            params, state = hvd.apply_step(tx, grads, state, params)
+        out[kind] = {
+            "params": {k: np.asarray(v).tolist()
+                       for k, v in params.items()},
+            "slots": [{k: np.asarray(v).tolist() for k, v in s.items()}
+                      for s in state.inner.slots],
+            "count": int(state.inner.count),
+        }
+    eng = get_engine()
+    out["apply"] = eng.apply_stats()
+    out["overlap"] = eng.overlap_stats()
+    integrity = eng.integrity_stats()
+    out["sentry"] = integrity["sentry"]
+    hvd.shutdown()
+    return out
+
+
+def _run_world(np_, opts=("sgd",), steps=4, n_leaves=3, **env):
+    from horovod_tpu.runner import run
+
+    pins = {"HOROVOD_PLATFORM": "cpu", "HOROVOD_CYCLE_TIME": "2",
+            "HOROVOD_NATIVE_CONTROLLER": "0", **env}
+    saved = {k: os.environ.get(k) for k in pins}
+    os.environ.update(pins)
+    try:
+        return run(_world_fn, args=(tuple(opts), steps, n_leaves),
+                   np=np_, timeout_s=180.0, start_timeout_s=120.0)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _assert_states_equal(a, b, kinds):
+    for kind in kinds:
+        assert a[kind]["params"] == b[kind]["params"], kind
+        assert a[kind]["slots"] == b[kind]["slots"], kind
+        assert a[kind]["count"] == b[kind]["count"], kind
+
+
+def test_mp_fused_bit_exact_vs_two_dispatch_all_rules():
+    """The acceptance pin: fused apply is BIT-exact against the
+    two-dispatch path for SGD, momentum, and Adam in a real 2-proc
+    world, with the fused route actually exercised (apply batches > 0,
+    one apply dispatch per batch) and the two-dispatch world landing
+    zero apply-capable batches."""
+    kinds = ("sgd", "momentum", "adam")
+    fused = _run_world(2, opts=kinds, HOROVOD_FUSED_APPLY="1")
+    plain = _run_world(2, opts=kinds, HOROVOD_FUSED_APPLY="0")
+    fr = {r["rank"]: r for r in fused}
+    pr = {r["rank"]: r for r in plain}
+    _assert_states_equal(fr[0], fr[1], kinds)  # ranks identical
+    _assert_states_equal(fr[0], pr[0], kinds)  # fused == two-dispatch
+    for r in fused:
+        st = r["apply"]
+        assert st["fused_batches"] > 0, st
+        assert st["split_batches"] == 0, st
+        assert st["apply_dispatches"] == st["fused_batches"], st
+    for r in plain:
+        assert r["apply"]["fused_batches"] == 0, r["apply"]
+        assert r["apply"]["apply_dispatches"] == 0, r["apply"]
+
+
+def test_mp_fused_bit_exact_on_native_negotiation_core():
+    """The native C++ negotiation core's schema predates the
+    fingerprint: the NativeNegotiator wrapper's Python bookkeeping
+    stamps and splits batches, so fused apply stays available and
+    bit-exact there (the PR 1 codec pattern)."""
+    from horovod_tpu import cc
+
+    if not cc.available():
+        pytest.skip(f"native core unavailable: {cc.load_error()}")
+    fused = _run_world(2, opts=("adam",), HOROVOD_FUSED_APPLY="1",
+                       HOROVOD_NATIVE_CORE="1")
+    plain = _run_world(2, opts=("adam",), HOROVOD_FUSED_APPLY="0",
+                       HOROVOD_NATIVE_CORE="1")
+    fr = {r["rank"]: r for r in fused}
+    pr = {r["rank"]: r for r in plain}
+    _assert_states_equal(fr[0], fr[1], ("adam",))
+    _assert_states_equal(fr[0], pr[0], ("adam",))
+    for r in fused:
+        assert r["apply"]["fused_batches"] > 0, r["apply"]
+
+
+@pytest.mark.parametrize("policy", ["skip", "zero"])
+def test_mp_sentry_gate_under_nan_chaos(policy):
+    """Sentry interplay under ``nan@rank1`` data chaos: the in-program
+    census gate makes the poisoned batch a collective no-op — both
+    ranks trip at the same ordinal with identical final state, and the
+    fused world stays BIT-exact to the two-dispatch world under the
+    same fault (single-leaf steps pin batch == step, so the injection
+    ordinal is deterministic)."""
+    env = {"HOROVOD_GRAD_SENTRY": policy,
+           "HOROVOD_CHAOS": "nan@rank1:msg2,seed:5"}
+    fused = _run_world(2, opts=("momentum",), n_leaves=1, steps=4,
+                       HOROVOD_FUSED_APPLY="1", **env)
+    plain = _run_world(2, opts=("momentum",), n_leaves=1, steps=4,
+                       HOROVOD_FUSED_APPLY="0", **env)
+    fr = {r["rank"]: r for r in fused}
+    pr = {r["rank"]: r for r in plain}
+    _assert_states_equal(fr[0], fr[1], ("momentum",))
+    _assert_states_equal(fr[0], pr[0], ("momentum",))
+    for r in fused:
+        sentry = r["sentry"]
+        assert sentry["collective"], sentry  # the real-wire OR-fold ran
+        trips = sentry["trips"]
+        assert len(trips) == 1 and trips[0][2] == "nan", sentry
+    # identical trip ordinal on both ranks (the collective verdict)
+    assert fr[0]["sentry"]["trips"] == fr[1]["sentry"]["trips"]
+    # clean world sanity: no trips, different final state than poisoned
+    clean = _run_world(2, opts=("momentum",), n_leaves=1, steps=4,
+                       HOROVOD_FUSED_APPLY="1",
+                       HOROVOD_GRAD_SENTRY=policy)
+    cr = {r["rank"]: r for r in clean}
+    assert cr[0]["sentry"]["trips"] == []
+    assert cr[0]["momentum"]["params"] != fr[0]["momentum"]["params"]
+
+
+def test_mp_native_controller_degrades_to_split():
+    """The native controller's binary wire predates the fingerprint
+    field: apply-capable submissions degrade deterministically to the
+    split reduce-then-apply execution (warned once) — applied
+    parameters still land, bit-exact to the two-dispatch world."""
+    from horovod_tpu import cc
+
+    if not cc.available():
+        pytest.skip(f"native controller unavailable: {cc.load_error()}")
+    fused = _run_world(2, opts=("sgd",), HOROVOD_FUSED_APPLY="1",
+                       HOROVOD_NATIVE_CONTROLLER="1")
+    plain = _run_world(2, opts=("sgd",), HOROVOD_FUSED_APPLY="0",
+                       HOROVOD_NATIVE_CONTROLLER="1")
+    fr = {r["rank"]: r for r in fused}
+    pr = {r["rank"]: r for r in plain}
+    _assert_states_equal(fr[0], pr[0], ("sgd",))
+    for r in fused:
+        st = r["apply"]
+        assert st["fused_batches"] == 0, st  # the degrade landed
+        assert st["split_batches"] > 0, st
+        assert st["apply_dispatches"] > 0, st
+
+
+def test_mp_fused_apply_under_subbuffer_overlap():
+    """The headline composition: subbuffers=2 + fused apply — the
+    overlap pipeline runs (the update math now rides inside the
+    overlapped flush), bit-exact vs the single-flush fused world."""
+    base = {"HOROVOD_FUSED_APPLY": "1"}
+    piped = _run_world(2, opts=("adam",), n_leaves=6, steps=5,
+                       HOROVOD_FUSION_SUBBUFFERS="2", **base)
+    single = _run_world(2, opts=("adam",), n_leaves=6, steps=5,
+                        HOROVOD_FUSION_SUBBUFFERS="1", **base)
+    fr = {r["rank"]: r for r in piped}
+    sr = {r["rank"]: r for r in single}
+    _assert_states_equal(fr[0], fr[1], ("adam",))
+    _assert_states_equal(fr[0], sr[0], ("adam",))
+    for r in piped:
+        assert r["overlap"]["pipelined"], r["overlap"]
+        assert r["apply"]["fused_batches"] > 0, r["apply"]
+    for r in single:
+        assert not r["overlap"]["pipelined"], r["overlap"]
+
+
+@pytest.mark.slow
+def test_dryrun_fused_apply_certification():
+    """The driver-facing certification end to end, as __main__ runs it."""
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    result = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_fused_apply(); "
+         "print('dryrun_fused_apply OK')"],
+        cwd=root, env=env, capture_output=True, text=True, timeout=580)
+    assert result.returncode == 0, (result.stdout, result.stderr)
+    assert "dryrun_fused_apply OK" in result.stdout, result.stdout

@@ -323,7 +323,65 @@ def _print_chip_diagnostics(log) -> None:
         pass
 
 
-def _emit_fallback(args, log) -> bool:
+def _harvest_blackbox(args, log, since: float = 0.0) -> list:
+    """Satellite of docs/blackbox.md: a failed or timed-out round must
+    carry the incident that explains it. Glob any ``blackbox-*.json``
+    beside the BENCH json (cwd, ``--timeline-dir``,
+    ``HOROVOD_FLIGHTREC_DIR``), classify each with the flight recorder's
+    own classifier, and return ``[{path, verdict}]`` for the capture
+    record — the r01–r05 hung-preflight rounds produced ZERO diagnostics,
+    and this is what makes the next wedged window self-explaining.
+    ``since`` bounds the harvest to THIS round's incidents: a stale file
+    a previous job left beside the cwd must not be attached as this
+    round's explanation (its verdict would point the postmortem at a
+    different world's failure)."""
+    import glob
+    import json as _json
+
+    dirs = [os.getcwd()]
+    if getattr(args, "timeline_dir", ""):
+        dirs.append(args.timeline_dir)
+    try:
+        from horovod_tpu.core.config import HOROVOD_FLIGHTREC_DIR
+
+        env_dir = os.environ.get(HOROVOD_FLIGHTREC_DIR, "")
+        if env_dir:
+            dirs.append(env_dir)
+    except Exception:  # noqa: BLE001 - harvest is best-effort
+        pass
+    seen = set()
+    out = []
+    for directory in dirs:
+        for path in sorted(glob.glob(os.path.join(directory,
+                                                  "blackbox-*.json"))):
+            real = os.path.realpath(path)
+            if real in seen:
+                continue
+            seen.add(real)
+            try:
+                if since and os.path.getmtime(real) < since:
+                    log(f"[blackbox] ignoring stale incident {path} "
+                        "(predates this round)")
+                    continue
+            except OSError:
+                continue
+            verdict = "unclassifiable"
+            try:
+                from horovod_tpu.obs.flightrec import classify_incident
+
+                with open(path, "r", encoding="utf-8") as fh:
+                    verdict = classify_incident(
+                        _json.load(fh))["verdict"]
+            except Exception as exc:  # noqa: BLE001 - still record it
+                verdict = f"unclassifiable ({exc})"
+            log(f"[blackbox] incident {path}: {verdict}")
+            out.append({"path": os.path.relpath(path), "verdict": verdict})
+    if not out:
+        log("[blackbox] no incident files found beside the BENCH json")
+    return out
+
+
+def _emit_fallback(args, log, blackbox: list = ()) -> bool:
     """Emit the newest REAL watcher-captured measurement when live
     measurement is impossible.
 
@@ -410,6 +468,11 @@ def _emit_fallback(args, log) -> bool:
     rec["captured_by"] = "chip_watch"
     rec["captured_at"] = captured
     rec["captured_from"] = os.path.relpath(path, root)
+    if blackbox:
+        # a wedged round that DID leave flight-recorder incidents: carry
+        # their paths + verdict lines in the capture record so the
+        # postmortem starts from the emitted artifact (docs/blackbox.md)
+        rec["blackbox"] = list(blackbox)
     if head is not None:
         rec["revision_match"] = rev_match
         if not rev_match:
@@ -541,6 +604,7 @@ def _supervise(args) -> None:
     JSON result line is relayed from child stdout.
     """
     log = _log
+    round_start = time.time()  # recency bound for _harvest_blackbox
     timeout_s = float(os.environ.get("HOROVOD_BENCH_MEASURE_TIMEOUT",
                                      "1200"))
     attempts = int(os.environ.get("HOROVOD_BENCH_MEASURE_ATTEMPTS", "2"))
@@ -622,16 +686,21 @@ def _supervise(args) -> None:
         # the preflight fallback in main().
         log("[supervise] giving up: no measurement completed. The "
             "accelerator pool stayed wedged; re-run when the chip frees up.")
-        if _emit_fallback(args, log):
+        if _emit_fallback(args, log, blackbox=_harvest_blackbox(
+                args, log, since=round_start)):
             return
     else:
         log("[supervise] giving up: the last measurement attempt failed "
             "without hanging — that is a bench/code failure, not a chip "
             "wedge; no fallback will be emitted.")
+        # a failed round should still name its incident: any black-box
+        # dump the dying world left explains the failure better than rc=1
+        _harvest_blackbox(args, log, since=round_start)
     sys.exit(1)
 
 
 def main() -> None:
+    run_start = time.time()  # recency bound for _harvest_blackbox
     args = _parse_args()
 
     if args.warm_init_cache:
@@ -659,7 +728,9 @@ def main() -> None:
                                     "1") != "0"
         if preflight_on and initial_on:
             if _preflight_backend(fatal=False) is None:
-                if _emit_fallback(args, _log):
+                if _emit_fallback(args, _log,
+                                  blackbox=_harvest_blackbox(
+                                      args, _log, since=run_start)):
                     return
                 sys.exit(1)
         # Supervision defaults to following preflight (CI/CPU runs that

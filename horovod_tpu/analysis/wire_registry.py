@@ -49,6 +49,9 @@ RPC_TAGS: Dict[str, str] = {
     "sentry": "Python controller only (PR 8): native wire predates the "
               "verdict rendezvous — the gradient sentry degrades to a "
               "local verdict, warned once",
+    "flightrec": "Python controller only (PR 14): native wire predates "
+                 "the incident-push RPC — the flight recorder degrades "
+                 "to a rank-local blackbox dump, warned once",
 }
 
 # Fields of the negotiation messages (ops/messages.py): the rank ->

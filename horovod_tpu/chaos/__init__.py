@@ -76,6 +76,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from ..core.config import HOROVOD_CHAOS, HOROVOD_RANK
+from ..obs import flightrec as _flightrec
 from ..obs.registry import registry as _metrics
 
 # Observability plane (docs/metrics.md): every fired fault counts here
@@ -287,6 +288,10 @@ class ChaosInjector:
         if rule is not None:
             self.events.append((kind, ordinal))
             _CHAOS_INJECTIONS.labels(kind=kind).inc()
+            # flight recorder (docs/blackbox.md): the injected rank is
+            # the one whose stream RECORDS the injection — the incident
+            # classifier's attribution source for data-plane faults
+            _flightrec.record(_flightrec.EV_CHAOS, ordinal, detail=kind)
         return rule
 
     @staticmethod
@@ -328,6 +333,8 @@ class ChaosInjector:
                 self._episode_refusals[id(rule)] = used + 1
                 self.events.append(("refuse", self.ordinal))
                 _CHAOS_INJECTIONS.labels(kind="refuse").inc()
+                _flightrec.record(_flightrec.EV_CHAOS, self.ordinal,
+                                  detail="refuse")
                 raise ConnectionRefusedError(
                     f"chaos: reconnect refused ({rule.describe()}, "
                     f"refusal {used + 1}/{rule.refusals})")

@@ -547,6 +547,90 @@ def run_cell(spec: str,
     return cell
 
 
+class _BlackboxCheck:
+    """``--blackbox`` assertion mode (docs/blackbox.md): every ESCALATED
+    cell must also leave a classifiable ``blackbox-*.json`` incident
+    file — an escalation with no dump is a failing cell (the flight
+    recorder's whole contract is that no world abort goes undiagnosed).
+    Each cell gets a fresh ``HOROVOD_FLIGHTREC_DIR`` so incidents never
+    cross-contaminate cells."""
+
+    def __init__(self) -> None:
+        import tempfile
+
+        from horovod_tpu.core.config import HOROVOD_FLIGHTREC_DIR
+
+        self._key = HOROVOD_FLIGHTREC_DIR
+        self._root = tempfile.mkdtemp(prefix="hvd-blackbox-")
+        self._n = 0
+        self.dir = ""
+        self._saved = None
+
+    def begin_cell(self) -> None:
+        import os
+
+        self._n += 1
+        self.dir = os.path.join(self._root, f"cell{self._n}")
+        os.makedirs(self.dir, exist_ok=True)
+        self._saved = os.environ.get(self._key)
+        os.environ[self._key] = self.dir
+
+    def end_cell(self) -> None:
+        import os
+
+        if self._saved is None:
+            os.environ.pop(self._key, None)
+        else:
+            os.environ[self._key] = self._saved
+
+    def verdict(self) -> Optional[str]:
+        """Classify this cell's incident file(s); None when none exist."""
+        import glob
+        import json
+        import os
+
+        from horovod_tpu.obs.flightrec import (
+            classify_incident,
+            merge_incidents,
+        )
+
+        files = sorted(glob.glob(os.path.join(self.dir,
+                                              "blackbox-*.json")))
+        if not files:
+            return None
+        docs = []
+        for path in files:
+            with open(path, "r", encoding="utf-8") as fh:
+                docs.append(json.load(fh))
+        return classify_incident(merge_incidents(docs))["verdict"]
+
+    def run(self, cell_fn):
+        """Run one grid cell under a fresh per-cell incident dir."""
+        self.begin_cell()
+        try:
+            return cell_fn()
+        finally:
+            self.end_cell()
+
+    def assess(self, outcome: str) -> tuple:
+        """``(print_suffix, ok)`` for a finished cell: every ESCALATED
+        cell must leave a classifiable incident — an escalation with no
+        dump is a failing cell (the one assertion of --blackbox mode)."""
+        if outcome != "escalated":
+            return "", True
+        verdict = self.verdict()
+        if verdict is None:
+            return "  blackbox=MISSING (escalation left no dump)", False
+        return f"  blackbox={verdict!r}", True
+
+    def cleanup(self) -> None:
+        """Drop the per-sweep incident root (the verdicts were printed;
+        repeated CI sweeps must not accumulate /tmp trees)."""
+        import shutil
+
+        shutil.rmtree(self._root, ignore_errors=True)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
@@ -567,6 +651,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "consensus cells, each asserting "
                              "healed-by-skip / zeroed / "
                              "escalated-in-deadline (docs/integrity.md)")
+    parser.add_argument("--blackbox", action="store_true",
+                        help="assert black-box incident coverage "
+                             "(docs/blackbox.md): every ESCALATED cell "
+                             "must leave a classifiable blackbox-*.json "
+                             "in a per-cell HOROVOD_FLIGHTREC_DIR; an "
+                             "escalation with no dump is a failing cell")
     parser.add_argument("--serving", action="store_true",
                         help="run the serving-plane grid instead "
                              "(docs/serving.md): drop/delay/close on the "
@@ -592,19 +682,32 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1 if failed else 0
     if args.data_plane:
         failed = 0
-        for spec, policy, consensus, expect in DATA_GRID:
-            cell = run_data_cell(spec, policy, consensus, expect,
-                                 np_=args.np_, steps=args.steps)
-            ok = cell["outcome"] == expect
-            if not ok:
-                failed += 1
-            label = f"{spec} sentry={policy}" + (
-                f" consensus={consensus}" if consensus else "")
-            print(f"data-cell {'OK ' if ok else 'BAD'} "
-                  f"outcome={cell['outcome']:<15} "
-                  f"{cell['elapsed_s']:6.1f}s  {label}", flush=True)
-            if not ok:
-                print(f"  {cell.get('error', '')}", flush=True)
+        blackbox = _BlackboxCheck() if args.blackbox else None
+        try:
+            for spec, policy, consensus, expect in DATA_GRID:
+                def _cell(spec=spec, policy=policy, consensus=consensus,
+                          expect=expect):
+                    return run_data_cell(spec, policy, consensus, expect,
+                                         np_=args.np_, steps=args.steps)
+                cell = blackbox.run(_cell) if blackbox is not None \
+                    else _cell()
+                ok = cell["outcome"] == expect
+                bb = ""
+                if blackbox is not None:
+                    bb, bb_ok = blackbox.assess(cell["outcome"])
+                    ok = ok and bb_ok
+                if not ok:
+                    failed += 1
+                label = f"{spec} sentry={policy}" + (
+                    f" consensus={consensus}" if consensus else "")
+                print(f"data-cell {'OK ' if ok else 'BAD'} "
+                      f"outcome={cell['outcome']:<15} "
+                      f"{cell['elapsed_s']:6.1f}s  {label}{bb}", flush=True)
+                if not ok:
+                    print(f"  {cell.get('error', '')}", flush=True)
+        finally:
+            if blackbox is not None:
+                blackbox.cleanup()
         return 1 if failed else 0
     if not args.allow_escalation:
         from horovod_tpu.core.config import Config
@@ -625,28 +728,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     specs = args.spec or (
         [ESCALATION_SPEC] if args.escalation else DEFAULT_SPECS)
     failed = 0
-    for spec in specs:
-        escalation_cell = args.escalation or spec == ESCALATION_SPEC
-        cell = run_cell(spec, np_=args.np_, steps=args.steps,
-                        expect_escalation=escalation_cell
-                        or args.allow_escalation)
-        # The expectation IS the certification: an escalation cell must
-        # escalate, and a heal cell must HEAL — accepting "escalated"
-        # there would hide a broken dedup-heal path behind a green sweep
-        # (--allow-escalation relaxes heal cells for the native
-        # controller's dedup-less binary wire, where faults escalate by
-        # design).
-        expected = (("escalated",) if escalation_cell
-                    else ("healed", "escalated") if args.allow_escalation
-                    else ("healed",))
-        ok = cell["outcome"] in expected
-        if not ok:
-            failed += 1
-        print(f"chaos-cell {'OK ' if ok else 'BAD'} "
-              f"outcome={cell['outcome']:<9} {cell['elapsed_s']:6.1f}s  "
-              f"{spec}", flush=True)
-        if not ok:
-            print(f"  {cell.get('error', '')}", flush=True)
+    blackbox = _BlackboxCheck() if args.blackbox else None
+    try:
+        for spec in specs:
+            escalation_cell = args.escalation or spec == ESCALATION_SPEC
+
+            def _cell(spec=spec, escalation_cell=escalation_cell):
+                return run_cell(spec, np_=args.np_, steps=args.steps,
+                                expect_escalation=escalation_cell
+                                or args.allow_escalation)
+            cell = blackbox.run(_cell) if blackbox is not None else _cell()
+            # The expectation IS the certification: an escalation cell
+            # must escalate, and a heal cell must HEAL — accepting
+            # "escalated" there would hide a broken dedup-heal path
+            # behind a green sweep (--allow-escalation relaxes heal
+            # cells for the native controller's dedup-less binary wire,
+            # where faults escalate by design).
+            expected = (("escalated",) if escalation_cell
+                        else ("healed", "escalated")
+                        if args.allow_escalation else ("healed",))
+            ok = cell["outcome"] in expected
+            bb = ""
+            if blackbox is not None:
+                bb, bb_ok = blackbox.assess(cell["outcome"])
+                ok = ok and bb_ok
+            if not ok:
+                failed += 1
+            print(f"chaos-cell {'OK ' if ok else 'BAD'} "
+                  f"outcome={cell['outcome']:<9} {cell['elapsed_s']:6.1f}s  "
+                  f"{spec}{bb}", flush=True)
+            if not ok:
+                print(f"  {cell.get('error', '')}", flush=True)
+    finally:
+        if blackbox is not None:
+            blackbox.cleanup()
     return 1 if failed else 0
 
 

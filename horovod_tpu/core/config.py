@@ -197,6 +197,34 @@ HOROVOD_GRAD_SENTRY = "HOROVOD_GRAD_SENTRY"
 # training on silently diverged state. 0 (default) disables.
 HOROVOD_CONSENSUS_INTERVAL = "HOROVOD_CONSENSUS_INTERVAL_STEPS"
 
+# --- flight recorder (horovod_tpu.obs.flightrec; ours, docs/blackbox.md) -----
+# Always-on per-rank black-box event ring: every control- and data-plane
+# transition (negotiation cycles, flushes, sentry verdicts, consensus
+# seals, reconnects, chaos injections, elastic commits, serving batches)
+# lands in a fixed-capacity ring buffer, and any world abort dumps a
+# cross-rank `blackbox-<world>-<epoch>.json` incident file for
+# tools/blackbox_report.py. "0" disables (the hot path then records
+# nothing and allocates nothing).
+HOROVOD_FLIGHTREC = "HOROVOD_FLIGHTREC"
+# Ring capacity in events (default 4096; preallocated slots, O(1)
+# append — older events are overwritten, counted as dropped).
+HOROVOD_FLIGHTREC_EVENTS = "HOROVOD_FLIGHTREC_EVENTS"
+# Seconds the coordinator's incident collector waits for per-rank event
+# tails before writing the dump with whatever arrived (best-effort,
+# time-bounded by contract — a dead rank never pushes).
+HOROVOD_FLIGHTREC_DUMP_TIMEOUT = "HOROVOD_FLIGHTREC_DUMP_TIMEOUT_S"
+# Incident-file directory; default: beside the timeline artifact when
+# HOROVOD_TIMELINE is set, else the working directory.
+HOROVOD_FLIGHTREC_DIR = "HOROVOD_FLIGHTREC_DIR"
+# Seconds the launcher lets SURVIVING ranks drain after a rank dies hard
+# (nonzero exit) before terminating them — the window in which the
+# coordinator's incident collector lands the dump that the teardown
+# SIGTERM would otherwise destroy. Default: reconnect window + dump
+# timeout + 1, capped at 15; "0" restores immediate fail-fast teardown.
+# Only a bound on the FAILURE path: survivors that exit on their own end
+# the wait early, and clean worlds never enter it.
+HOROVOD_FLIGHTREC_LAUNCH_GRACE = "HOROVOD_FLIGHTREC_LAUNCH_GRACE_S"
+
 # --- observability plane (horovod_tpu.obs; ours, docs/metrics.md) ------------
 # HTTP exposition of the metrics registry on rank 0: Prometheus text at
 # /metrics, JSON snapshot at /metrics.json, loopback-bound. 0 or unset =

@@ -68,15 +68,37 @@ class Status:
         # are more specific than the aborted-ranks tag a consensus reason
         # may also carry for the elastic driver's benefit.
         consensus = parse_consensus(reason)
+        nonfinite = None if consensus is not None else \
+            parse_nonfinite(reason)
+        ranks = None if (consensus is not None or nonfinite is not None) \
+            else parse_aborted_ranks(reason)
+        if consensus is not None or nonfinite is not None or \
+                ranks is not None:
+            # Flight recorder (docs/blackbox.md): a STRUCTURED world
+            # escalation is about to raise — ship this rank's black-box
+            # tail before the exception unwinds (idempotent; a no-op
+            # unless an engine armed the dump context, so synthetic
+            # errors in tests trigger nothing).
+            _flightrec_hook(reason)
         if consensus is not None:
             raise ConsensusError(consensus[0], consensus[1], reason)
-        nonfinite = parse_nonfinite(reason)
         if nonfinite is not None:
             raise NonFiniteGradError(nonfinite[0], nonfinite[1], reason)
-        ranks = parse_aborted_ranks(reason)
         if ranks is not None:
             raise RanksAbortedError(ranks, reason)
         raise HorovodInternalError(reason)
+
+
+def _flightrec_hook(reason: str) -> None:
+    """Lazy, failure-proof bridge to ``obs.flightrec.on_structured_error``
+    (imported here, not at module level: core.status must stay the
+    dependency floor of the package)."""
+    try:
+        from ..obs.flightrec import on_structured_error
+
+        on_structured_error(reason)
+    except Exception:  # noqa: BLE001 - never worsen the failure path
+        pass
 
 
 # The message every outstanding callback receives when the background

@@ -310,6 +310,15 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                     # HorovodInternalError-class failures)
                     raise
                 _ELASTIC_FAILURES.inc()
+                # flight recorder (docs/blackbox.md): the driver's own
+                # black box records every failed attempt — the dying
+                # world's coordinator wrote the cross-rank incident file
+                # (HOROVOD_FLIGHTREC_DIR / beside the timeline); this
+                # stream is how a postmortem orders attempts vs relaunches
+                from ..obs import flightrec as _flightrec
+
+                _flightrec.record(_flightrec.EV_ELASTIC_FAIL, epoch,
+                                  detail=type(exc).__name__)
                 last_err = exc
                 failed = _failed_ranks(exc)
                 if isinstance(exc, StragglerEvictError):
@@ -336,6 +345,7 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                         f"gave up after {max_restarts} restart(s); last "
                         f"failure: {exc}") from exc
                 _ELASTIC_RELAUNCHES.inc()
+                _flightrec.record(_flightrec.EV_ELASTIC_RELAUNCH, epoch)
                 delay = backoff_s * (2.0 ** (epoch - 1))
                 LOG.warning("elastic backoff: %.1fs before relaunch",
                             delay)

@@ -145,6 +145,12 @@ class State:
         from ..integrity.consensus import observe_commit
 
         observe_commit(self._committed, self._commit_no)
+        # flight recorder (docs/blackbox.md): the commit ordinal is the
+        # restore point a postmortem reader reasons back from
+        from ..obs import flightrec as _flightrec
+
+        _flightrec.record(_flightrec.EV_COMMIT, self._commit_no,
+                          aux=basics.world_epoch())
         if basics.rank() == 0:
             self._push_commit()
 

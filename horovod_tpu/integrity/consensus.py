@@ -140,6 +140,11 @@ class DigestAccumulator:
         self._batches = 0
         self.windows_emitted += 1
         _CONSENSUS_WINDOWS.inc()
+        # flight recorder (docs/blackbox.md): window seal with its
+        # ordinal — what a consensus-fork verdict aligns ranks by
+        from ..obs import flightrec as _flightrec
+
+        _flightrec.record(_flightrec.EV_CONSENSUS_SEAL, self._ordinal)
 
     def drain(self) -> Optional[List[tuple]]:
         """Completed windows to piggyback on the next cycle message (None

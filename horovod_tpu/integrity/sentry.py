@@ -187,6 +187,12 @@ class GradSentry:
         action = self.policy
         _SENTRY_TRIPS.labels(policy=self.policy, kind=kind).inc()
         self.trips.append((self.ordinal, action, kind))
+        # flight recorder (docs/blackbox.md): the verdict with its batch
+        # ordinal — aligned across ranks by the collective exchange
+        from ..obs import flightrec as _flightrec
+
+        _flightrec.record(_flightrec.EV_SENTRY, self.ordinal,
+                          detail=f"{action}:{kind}")
         record = {"step": self.ordinal, "policy": self.policy,
                   "kind": kind, "tensors": list(bad_names)}
         if self._on_trip is not None:

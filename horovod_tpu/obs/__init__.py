@@ -34,6 +34,7 @@ from .registry import (  # noqa: F401 - public surface
 )
 from .bridge import TimelineBridge  # noqa: F401
 from . import exposition  # noqa: F401
+from . import flightrec  # noqa: F401 - public surface (docs/blackbox.md)
 from .tracing import (  # noqa: F401 - public surface (docs/tracing.md)
     ClockSync,
     build_straggler_report,
@@ -107,6 +108,45 @@ def metrics_snapshot(world: bool = False):
     ranks = dict(store)
     ranks[rank] = local
     return {"world": merge_snapshots(ranks.values()), "ranks": ranks}
+
+
+def health_report() -> dict:
+    """One-shot fold of the live engine/controller state (docs/blackbox.md):
+    the SAME snapshots a black-box incident dump embeds — one definition
+    — served live, so a slow-but-alive world can be poked without
+    killing it. Exposed over HTTP as ``GET /v1/introspect`` on rank 0's
+    exposition server and on the serving gateway's co-hosted metrics
+    routes (the PR 11 httpd)."""
+    report: dict = {
+        "initialized": False,
+        "engine": None,
+        "controller": None,
+        "flightrec": flightrec.recorder().stats(),
+    }
+    engine = None
+    try:
+        from .. import basics
+        from ..ops import engine as _engine_mod
+
+        if basics.is_initialized():
+            report.update(initialized=True, rank=basics.rank(),
+                          size=basics.size(),
+                          epoch=basics.world_epoch())
+        engine = _engine_mod._engine
+    except Exception:  # noqa: BLE001 - pre-init callers get the shell
+        pass
+    if engine is not None:
+        try:
+            report["engine"] = engine.state_snapshot()
+        except Exception as exc:  # noqa: BLE001 - live poke, best-effort
+            report["engine"] = {"error": str(exc)}
+        service = getattr(engine, "_service", None)
+        if service is not None and hasattr(service, "state_snapshot"):
+            try:
+                report["controller"] = service.state_snapshot()
+            except Exception as exc:  # noqa: BLE001
+                report["controller"] = {"error": str(exc)}
+    return report
 
 
 def world_snapshot_provider():

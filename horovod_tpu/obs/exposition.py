@@ -217,7 +217,11 @@ def _split_labels(inner: str):
 def metrics_routes(provider: Callable[[], dict]):
     """The metrics endpoint as an ``obs.httpd`` route set: Prometheus
     text at ``GET /metrics`` (rendered from the provider's merged world
-    view), the full structured snapshot at ``GET /metrics.json``. Shared
+    view), the full structured snapshot at ``GET /metrics.json``, and
+    the live engine/controller introspection fold at
+    ``GET /v1/introspect`` (``hvd.health_report()``, docs/blackbox.md —
+    the same snapshot a black-box incident dump embeds, served live so a
+    slow-but-alive world can be poked without killing it). Shared
     verbatim by the standalone ``MetricsServer`` and the serving
     gateway's co-hosted metrics surface (docs/serving.md) — one
     implementation, two route sets."""
@@ -234,8 +238,16 @@ def metrics_routes(provider: Callable[[], dict]):
         return HttpResponse(200, "application/json",
                             json.dumps(provider()).encode())
 
+    def _introspect(_query, _headers, _body) -> HttpResponse:
+        # lazy: obs/__init__ imports this module at package import time
+        from . import health_report
+
+        return HttpResponse(200, "application/json",
+                            json.dumps(health_report()).encode())
+
     return {("GET", "/metrics"): _metrics,
-            ("GET", "/metrics.json"): _metrics_json}
+            ("GET", "/metrics.json"): _metrics_json,
+            ("GET", "/v1/introspect"): _introspect}
 
 
 class MetricsServer:

@@ -1,0 +1,683 @@
+"""Flight recorder + cross-rank black-box incident dumps (docs/blackbox.md).
+
+The structured escalations this repo grew (``RanksAbortedError``,
+``ConsensusError``, ``NonFiniteGradError``, ``StallEscalation``,
+``ServingAbortedError``) name *who* failed but discard the event history
+that explains *how*. PR 6's tracing is steady-state and file-based —
+opt-in, unbounded, dead when the process dies mid-write. This module is
+the aircraft-flight-recorder shape production needs instead: an
+always-on (``HOROVOD_FLIGHTREC=0`` to disable), fixed-capacity ring
+buffer on every rank recording the lifecycle of every control- and
+data-plane transition with its aligning ordinals — cycle ordinals the
+way 1810.11112 correlates per-rank collective timing, flush ordinals
+(PR 9), sentry batch ordinals (PR 8), consensus window ordinals — and,
+on any world escalation, a best-effort, time-bounded cross-rank
+collection into one ``blackbox-<world>-<epoch>.json`` incident file that
+``tools/blackbox_report.py`` merges and classifies.
+
+Layering, matching ``obs/tracing.py``: the module level is deliberately
+STDLIB-ONLY (package imports live inside the functions that need them),
+so ``tools/blackbox_report.py`` can load this file directly on
+workstations where importing the package would pull in jax — the
+classifier half is pure dict math over a saved incident document. The
+wire-tag regexes below are deliberate small copies of the
+``core/status.py`` format contract (pinned against it by
+``tests/test_zzflightrec.py``): the classifier must run with nothing but
+the incident file in hand.
+
+Event record layout (one preallocated slot each, mutated in place —
+O(1) append under a lock, allocation-free on the hot path like the PR 5
+registry): ``[ts_us, kind, ordinal, aux, detail]`` where ``ts_us`` is
+the local monotonic clock in microseconds (the same clock every
+``Timeline`` span carries; the dump stamps the rank's PR 6 ``ClockSync``
+offset beside the tail so merged incidents share one timebase),
+``ordinal`` the kind's aligning ordinal (cycle / flush / sentry batch /
+consensus window / chaos / epoch; -1 when none), ``aux`` a secondary
+integer (cache generation, in-flight depth), and ``detail`` a short
+string (tensor name, fault kind, reason prefix).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+# -- metric family names (docs/metrics.md "flight recorder" section) -----------
+FAMILY_EVENTS = "horovod_flightrec_events_total"
+FAMILY_DROPPED = "horovod_flightrec_dropped_events_total"
+FAMILY_DUMPS = "horovod_flightrec_dumps_total"
+FAMILY_DUMP_FAILURES = "horovod_flightrec_dump_failures_total"
+
+# -- event-kind vocabulary (docs/blackbox.md event schema) ---------------------
+EV_ENQUEUE = "enqueue"            # ordinal=-1, detail=tensor name
+EV_NEGOTIATE = "negotiate"        # ordinal=cycle ordinal (submit)
+EV_RESPONSE = "response"          # ordinal=cycle ordinal, aux=cache gen
+EV_CACHE_HIT = "cache_hit"        # ordinal=cycle ordinal, aux=cache gen
+EV_FLUSH_START = "flush_start"    # ordinal=flush cycle, aux=in-flight depth
+EV_FLUSH_END = "flush_end"        # ordinal=flush cycle
+EV_SENTRY = "sentry"              # ordinal=batch ordinal, detail=policy:kind
+EV_CONSENSUS_SEAL = "consensus_seal"  # ordinal=window ordinal
+EV_RECONNECT = "reconnect"        # aux=attempt number
+EV_RECONNECT_HEALED = "reconnect_healed"
+EV_CHAOS = "chaos"                # ordinal=injector ordinal, detail=kind
+EV_EPOCH = "epoch"                # ordinal=elastic world epoch
+EV_COMMIT = "commit"              # ordinal=elastic commit number
+EV_ELASTIC_FAIL = "elastic_fail"  # ordinal=epoch, detail=exception type
+EV_ELASTIC_RELAUNCH = "elastic_relaunch"  # ordinal=new epoch
+EV_SERVING_BATCH = "serving_batch"      # ordinal=batch ordinal
+EV_SERVING_DIGEST = "serving_digest"    # ordinal=batch ordinal
+EV_SERVING_DISPATCH = "serving_dispatch"  # ordinal=batch ordinal (driver)
+EV_FUSED_APPLY = "fused_apply"    # ordinal=cycle, detail=fused/split
+EV_ESCALATE = "escalate"          # coordinator escalation, detail=reason
+EV_ABORT = "abort"                # rank-side abort, detail=reason
+
+# Cycle-ordinal-bearing kinds: the classifier's cross-rank alignment
+# ground truth (every rank joins every cycle exactly once and in order).
+CYCLE_KINDS = (EV_NEGOTIATE, EV_RESPONSE, EV_CACHE_HIT)
+
+# Data-plane chaos kinds — a deliberate small copy of
+# ``chaos.DATA_KINDS`` (pinned against it by tests, like the wire-tag
+# regexes below): a non-finite verdict may only blame a rank whose
+# stream recorded a DATA injection. A co-occurring wire fault (delay /
+# drop / close / refuse) on a lower rank is harmless to the numerics and
+# must not steal the attribution.
+DATA_CHAOS_KINDS = ("nan", "flipbits")
+
+# Wire-tag patterns — small deliberate copies of the core/status.py
+# format contract (format_aborted_ranks / format_consensus /
+# format_nonfinite), pinned by tests so they cannot drift: the classifier
+# runs on jax-less boxes from nothing but the incident file.
+_ABORTED_RE = re.compile(r"\[aborted ranks: ([0-9][0-9,\s]*)\]")
+_CONSENSUS_RE = re.compile(r"\[consensus mismatch: ranks ([0-9][0-9,\s]*)\]")
+_NONFINITE_RE = re.compile(r"\[non-finite grad: step (\d+)\]")
+_EXITED_RE = re.compile(r"rank (\d+) (?:exited mid-job|disconnected)")
+
+DEFAULT_CAPACITY = 4096
+DEFAULT_DUMP_TIMEOUT_S = 5.0
+
+
+class FlightRecorder:
+    """Fixed-capacity event ring. Slots are preallocated lists mutated in
+    place; ``record`` is one lock acquire plus five item writes — never
+    an allocation — so it can sit beside every hot-path transition. When
+    ``enabled`` is False every producer call returns after one attribute
+    check (the zero-overhead contract of ``HOROVOD_FLIGHTREC=0``)."""
+
+    __slots__ = ("enabled", "capacity", "_slots", "_next", "_lock",
+                 "recorded", "_c_events", "_c_dropped")
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 enabled: bool = True, counters=None) -> None:
+        self.capacity = max(int(capacity), 1)
+        self.enabled = bool(enabled)
+        self._slots: List[list] = (
+            [[0, "", -1, -1, ""] for _ in range(self.capacity)]
+            if self.enabled else [])
+        self._next = 0
+        self.recorded = 0
+        self._lock = threading.Lock()
+        self._c_events = counters[0] if counters else None
+        self._c_dropped = counters[1] if counters else None
+
+    def record(self, kind: str, ordinal: int = -1, aux: int = -1,
+               detail: str = "") -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            slot = self._slots[self._next]
+            slot[0] = time.monotonic_ns() // 1000
+            slot[1] = kind
+            slot[2] = ordinal
+            slot[3] = aux
+            slot[4] = detail
+            self._next = (self._next + 1) % self.capacity
+            self.recorded += 1
+            dropped = self.recorded > self.capacity
+        # counters inc OUTSIDE the ring lock: no nested lock acquisition
+        # on the hot path (the lock-order discipline of docs/analysis.md)
+        if self._c_events is not None:
+            self._c_events.inc()
+            if dropped:
+                self._c_dropped.inc()
+
+    def tail(self) -> List[list]:
+        """The retained events, oldest first (copies — safe to mutate)."""
+        with self._lock:
+            n = min(self.recorded, self.capacity)
+            start = (self._next - n) % self.capacity
+            return [list(self._slots[(start + i) % self.capacity])
+                    for i in range(n)]
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self.recorded - self.capacity)
+
+    def stats(self) -> dict:
+        return {"enabled": self.enabled, "capacity": self.capacity,
+                "recorded": self.recorded, "dropped": self.dropped}
+
+
+# -- process-global recorder ---------------------------------------------------
+
+_recorder: Optional[FlightRecorder] = None
+_recorder_lock = threading.Lock()
+
+
+def _counters():
+    """The one registration site for the flight-recorder families
+    (package import kept function-level; see module docstring)."""
+    from .registry import registry as _metrics
+
+    reg = _metrics()
+    return (
+        reg.counter(FAMILY_EVENTS,
+                    "Events recorded into this rank's flight-recorder "
+                    "ring (all kinds)"),
+        reg.counter(FAMILY_DROPPED,
+                    "Flight-recorder events overwritten by ring wrap "
+                    "before any dump could retain them"),
+        reg.counter(FAMILY_DUMPS,
+                    "Black-box incident files written by this process "
+                    "(coordinator cross-rank dumps and rank-local "
+                    "degrades both count)"),
+        reg.counter(FAMILY_DUMP_FAILURES,
+                    "Incident pushes or file writes that failed "
+                    "(best-effort by contract: the failure is counted "
+                    "and logged, never raised into the abort path)"),
+    )
+
+
+def _build_recorder() -> FlightRecorder:
+    from ..core.config import HOROVOD_FLIGHTREC, HOROVOD_FLIGHTREC_EVENTS
+
+    enabled = os.environ.get(HOROVOD_FLIGHTREC, "1").strip().lower() \
+        not in ("0", "false", "off")
+    capacity = DEFAULT_CAPACITY
+    raw = os.environ.get(HOROVOD_FLIGHTREC_EVENTS, "")
+    if raw:
+        try:
+            capacity = int(raw)
+        except ValueError:
+            capacity = DEFAULT_CAPACITY
+    counters = _counters()[:2] if enabled else None
+    return FlightRecorder(capacity, enabled, counters=counters)
+
+
+def recorder() -> FlightRecorder:
+    """The process-global flight recorder (built from env on first use)."""
+    global _recorder
+    rec = _recorder
+    if rec is None:
+        with _recorder_lock:
+            rec = _recorder
+            if rec is None:
+                rec = _recorder = _build_recorder()
+    return rec
+
+
+def record(kind: str, ordinal: int = -1, aux: int = -1,
+           detail: str = "") -> None:
+    """Module-level hot-path producer: one global read, one enabled
+    check, then the ring append. Disabled recorders return immediately
+    with zero allocation."""
+    rec = _recorder
+    if rec is None:
+        rec = recorder()
+    if rec.enabled:
+        rec.record(kind, ordinal, aux, detail)
+
+
+def reset_for_tests() -> None:
+    """Rebuild the recorder from the current env (tests flip the knob
+    in-process; production processes build exactly one)."""
+    global _recorder, _dump_fired, _push_ctx, _local_warned
+    with _recorder_lock:
+        _recorder = None
+    with _dump_lock:
+        _dump_fired = False
+        _push_ctx = None
+        _local_warned = False
+
+
+# -- dump plumbing (rank side) -------------------------------------------------
+
+_push_ctx: Optional[dict] = None
+_dump_lock = threading.Lock()
+_dump_fired = False
+_local_warned = False
+
+
+def dump_timeout_s() -> float:
+    from ..core.config import HOROVOD_FLIGHTREC_DUMP_TIMEOUT
+
+    raw = os.environ.get(HOROVOD_FLIGHTREC_DUMP_TIMEOUT, "")
+    try:
+        return float(raw) if raw else DEFAULT_DUMP_TIMEOUT_S
+    except ValueError:
+        return DEFAULT_DUMP_TIMEOUT_S
+
+
+def launch_grace_s() -> float:
+    """How long the launcher should let surviving ranks drain after a
+    rank dies hard (nonzero exit) before terminating them. A rank that
+    dies by ``os._exit``/``SIGKILL`` makes the launcher's fail-fast
+    teardown SIGTERM the survivors within milliseconds — destroying the
+    coordinator's incident collector AFTER the dying world's tails were
+    pushed, so no dump survives. The grace bounds a drain window on the
+    FAILURE path only: survivors that exit on their own (they abort once
+    the coordinator declares the rank dead, then their interpreter exit
+    joins the non-daemon collector) end it early; clean worlds never
+    enter it. 0 when the recorder is disabled or the knob says 0."""
+    if not recorder().enabled:
+        return 0.0
+    from ..core.config import (
+        HOROVOD_FLIGHTREC_LAUNCH_GRACE,
+        HOROVOD_RECONNECT_WINDOW,
+    )
+
+    raw = os.environ.get(HOROVOD_FLIGHTREC_LAUNCH_GRACE, "")
+    if raw:
+        try:
+            return max(0.0, float(raw))
+        except ValueError:
+            pass
+    window = 5.0
+    try:
+        window = float(os.environ.get(HOROVOD_RECONNECT_WINDOW, "") or 5.0)
+    except ValueError:
+        pass
+    return min(window + dump_timeout_s() + 1.0, 15.0)
+
+
+def dump_dir() -> str:
+    """Incident-file directory: ``HOROVOD_FLIGHTREC_DIR``, else beside
+    the timeline artifact, else the working directory."""
+    from ..core.config import HOROVOD_FLIGHTREC_DIR, HOROVOD_TIMELINE
+
+    explicit = os.environ.get(HOROVOD_FLIGHTREC_DIR, "")
+    if explicit:
+        return explicit
+    timeline = os.environ.get(HOROVOD_TIMELINE, "")
+    if timeline:
+        return os.path.dirname(os.path.abspath(timeline)) or "."
+    return "."
+
+
+def incident_filename(world_id, epoch, rank: Optional[int] = None) -> str:
+    wid = re.sub(r"[^A-Za-z0-9]+", "-", str(world_id) or "world")
+    wid = wid.strip("-") or "world"
+    base = f"blackbox-{wid}-{epoch}"
+    if rank is not None:
+        base += f".rank{rank}"
+    return base + ".json"
+
+
+def metrics_values() -> Dict[str, float]:
+    """Compact counter/gauge map of the local registry — the "metrics
+    deltas" section of an incident file (histograms are omitted: the
+    incident story lives in counters, and the full distributions remain
+    on the metrics plane)."""
+    from .registry import registry as _metrics
+
+    out: Dict[str, float] = {}
+    for name, fam in _metrics().snapshot().items():
+        if fam.get("type") == "histogram":
+            continue
+        total = 0.0
+        for sample in fam.get("samples", []):
+            total += sample.get("value", 0) or 0
+        out[name] = total
+    return out
+
+
+def rank_payload(reason: str,
+                 snapshot_fn: Optional[Callable[[], dict]] = None) -> dict:
+    """What one rank ships on abort: its event tail, the engine state
+    snapshot (the same one ``hvd.health_report()`` serves — one
+    definition), its registry values, and its PR 6 clock offset so the
+    merge tool can fold tails onto one timebase."""
+    values = {}
+    try:
+        values = metrics_values()
+    except Exception:  # noqa: BLE001 - best-effort by contract
+        pass
+    payload = {
+        "events": recorder().tail(),
+        "stats": recorder().stats(),
+        "error": str(reason)[:1000] if reason else "",
+        "clock_offset_us": values.get("horovod_clock_offset_us"),
+        "metrics": values,
+    }
+    if snapshot_fn is not None:
+        try:
+            payload["snapshot"] = snapshot_fn()
+        except Exception as exc:  # noqa: BLE001 - snapshot is best-effort
+            payload["snapshot"] = {"error": str(exc)}
+    return payload
+
+
+def arm_push(addr, secret, world_id: str, rank: int, epoch: int,
+             snapshot_fn: Optional[Callable[[], dict]] = None,
+             local_only: bool = False) -> None:
+    """Register this rank's dump context (the engine calls this at init).
+    ``local_only`` is the native-controller degrade: the binary wire
+    predates the ``flightrec`` RPC, so the dump is written rank-locally
+    instead of collected by the coordinator (warned once at dump time,
+    the established degrade pattern)."""
+    global _push_ctx, _dump_fired
+    with _dump_lock:
+        _push_ctx = {"addr": addr, "secret": secret, "world_id": world_id,
+                     "rank": rank, "epoch": epoch,
+                     "snapshot_fn": snapshot_fn, "local_only": local_only}
+        _dump_fired = False
+    record(EV_EPOCH, ordinal=int(epoch))
+
+
+def disarm_push() -> None:
+    global _push_ctx
+    with _dump_lock:
+        _push_ctx = None
+
+
+def on_structured_error(reason: str) -> None:
+    """``Status.raise_if_error`` hook: a structured world escalation
+    (RanksAborted / Consensus / NonFiniteGrad) is about to raise — ship
+    this rank's tail before the exception unwinds. Idempotent and
+    unarmed-safe (tests constructing structured errors directly trigger
+    nothing)."""
+    try:
+        trigger_dump(reason)
+    except Exception:  # noqa: BLE001 - never worsen the failure path
+        pass
+
+
+def trigger_dump(reason: str) -> Optional[str]:
+    """Best-effort rank-side incident shipment, once per armed world:
+    push the event tail to the coordinator's ``flightrec`` store (the
+    coordinator's collector folds every rank's into one incident file),
+    or write a rank-local file on the native-controller degrade / when
+    the coordinator is already gone. Returns the local path when one was
+    written."""
+    global _dump_fired, _local_warned
+    rec = recorder()
+    if not rec.enabled:
+        return None
+    with _dump_lock:
+        ctx = _push_ctx
+        if ctx is None or _dump_fired:
+            return None
+        _dump_fired = True
+    record(EV_ABORT, detail=str(reason)[:200])
+    payload = rank_payload(reason, ctx.get("snapshot_fn"))
+    if ctx["local_only"]:
+        from ..core.logging import LOG
+
+        if not _local_warned:
+            _local_warned = True
+            LOG.warning(
+                "flight recorder: this controller wire predates the "
+                "flightrec collection RPC; writing a rank-local incident "
+                "dump (set HOROVOD_NATIVE_CONTROLLER=0 for one merged "
+                "cross-rank file).")
+        return _write_local(ctx, reason, payload)
+    try:
+        from ..runner.network import BasicClient
+
+        client = BasicClient(ctx["addr"], secret=ctx["secret"],
+                             timeout_s=min(dump_timeout_s(), 5.0),
+                             attempts=1)
+        try:
+            client.request(("flightrec", ctx["rank"], payload,
+                            ctx["world_id"]))
+        finally:
+            client.close()
+        return None
+    except Exception as exc:  # noqa: BLE001 - coordinator gone: degrade
+        from ..core.logging import LOG
+
+        _counters()[3].inc()
+        LOG.warning(
+            "flight recorder: incident push to the coordinator failed "
+            "(%s); writing a rank-local dump instead", exc)
+        return _write_local(ctx, reason, payload)
+
+
+def _write_local(ctx: dict, reason: str, payload: dict) -> Optional[str]:
+    doc = {
+        "format": 1,
+        "world_id": ctx["world_id"],
+        "epoch": ctx["epoch"],
+        "size": None,
+        "reason": str(reason)[:1000],
+        "written_by": f"rank-local:{ctx['rank']}",
+        "written_at_unix": time.time(),
+        "ranks": {str(ctx["rank"]): payload},
+        "coordinator": None,
+    }
+    return write_incident(doc, rank=ctx["rank"])
+
+
+def write_incident(doc: dict, rank: Optional[int] = None) -> Optional[str]:
+    """Atomic incident-file write (tmp + rename); counted, never raised."""
+    from ..core.logging import LOG
+
+    try:
+        directory = dump_dir()
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, incident_filename(
+            doc.get("world_id"), doc.get("epoch"), rank))
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, path)
+    except Exception as exc:  # noqa: BLE001 - best-effort by contract
+        _counters()[3].inc()
+        LOG.warning("flight recorder: incident dump failed: %s", exc)
+        return None
+    _counters()[2].inc()
+    LOG.warning("flight recorder: black-box incident dump written to %s",
+                path)
+    return path
+
+
+def coordinator_collect(reason: str, size: int, world_id: str, epoch: int,
+                        store_get: Callable[[], Dict[int, dict]],
+                        snapshot_fn: Optional[Callable[[], dict]] = None
+                        ) -> Optional[threading.Thread]:
+    """Coordinator-side incident collection: a bounded NON-daemon thread
+    (interpreter exit joins it, so the dump lands even when the
+    coordinator process dies right after the abort) waits up to
+    ``HOROVOD_FLIGHTREC_DUMP_TIMEOUT_S`` for per-rank tails in
+    ``store_get()`` — exiting early once all ``size`` ranks pushed, or
+    once pushes stop arriving (a dead rank never pushes; waiting its full
+    timeout on every abort would tax every escalation test) — then writes
+    one merged incident file."""
+    if not recorder().enabled:
+        return None
+    timeout = dump_timeout_s()
+    settle = min(1.0, timeout / 2.0)
+
+    def _run() -> None:
+        deadline = time.monotonic() + timeout
+        last_n = -1
+        last_change = time.monotonic()
+        while time.monotonic() < deadline:
+            n = len(store_get())
+            if n >= size:
+                break
+            now = time.monotonic()
+            if n != last_n:
+                last_n, last_change = n, now
+            elif n > 0 and now - last_change > settle:
+                break
+            time.sleep(0.05)
+        ranks = store_get()
+        coord = {"events": recorder().tail()}
+        try:
+            coord["metrics"] = metrics_values()
+        except Exception:  # noqa: BLE001
+            pass
+        if snapshot_fn is not None:
+            try:
+                coord["snapshot"] = snapshot_fn()
+            except Exception as exc:  # noqa: BLE001
+                coord["snapshot"] = {"error": str(exc)}
+        doc = {
+            "format": 1,
+            "world_id": world_id,
+            "epoch": epoch,
+            "size": size,
+            "reason": str(reason)[:1000],
+            "written_by": "coordinator",
+            "written_at_unix": time.time(),
+            "ranks": {str(r): p for r, p in sorted(ranks.items())},
+            "coordinator": coord,
+        }
+        write_incident(doc)
+
+    thread = threading.Thread(target=_run, name="horovod-flightrec-dump",
+                              daemon=False)
+    thread.start()
+    return thread
+
+
+# -- incident classification (stdlib-only: runs from the file alone) -----------
+
+
+def merge_incidents(docs: List[dict]) -> dict:
+    """Fold one or more incident documents (a coordinator dump, or the
+    per-rank files of the rank-local degrade) into one: ranks union
+    (first writer wins per rank), first non-empty reason wins, first
+    coordinator section wins."""
+    merged: dict = {"format": 1, "world_id": None, "epoch": None,
+                    "size": None, "reason": "", "ranks": {},
+                    "coordinator": None, "written_by": []}
+    for doc in docs:
+        for key in ("world_id", "epoch", "size"):
+            if merged[key] is None and doc.get(key) is not None:
+                merged[key] = doc[key]
+        if not merged["reason"] and doc.get("reason"):
+            merged["reason"] = doc["reason"]
+        for rank, payload in (doc.get("ranks") or {}).items():
+            merged["ranks"].setdefault(str(rank), payload)
+        if merged["coordinator"] is None and doc.get("coordinator"):
+            merged["coordinator"] = doc["coordinator"]
+        merged["written_by"].append(doc.get("written_by", "?"))
+    return merged
+
+
+def _parse_int_list(text: str) -> List[int]:
+    return sorted({int(tok) for tok in text.replace(",", " ").split()})
+
+
+def classify_incident(doc: dict) -> dict:
+    """Classify one (merged) incident document: the last cycle ordinal
+    every rank agrees on, the first diverging rank and the event where
+    its stream forks, the parked-rendezvous table, and a one-line
+    verdict (``stall@rank2 cycle 417``, ``consensus-fork@rank1 window
+    12``, ``desync: flush_ordinal``, ``dead@rank1 cycle 9``,
+    ``nonfinite@rank1 step 3``)."""
+    ranks = {int(r): p or {} for r, p in (doc.get("ranks") or {}).items()}
+    last_cycle: Dict[int, int] = {}
+    for rank, payload in ranks.items():
+        cycles = [e[2] for e in payload.get("events", [])
+                  if len(e) >= 3 and e[1] in CYCLE_KINDS]
+        if cycles:
+            last_cycle[rank] = max(cycles)
+    agreed = min(last_cycle.values()) if last_cycle else None
+    diverging = None
+    fork_event = None
+    if len(last_cycle) >= 2 and len(set(last_cycle.values())) > 1:
+        diverging = min(sorted(last_cycle), key=lambda r: last_cycle[r])
+        events = ranks[diverging].get("events", [])
+        fork_event = list(events[-1]) if events else None
+    reason = doc.get("reason") or ""
+    if not reason:
+        for rank in sorted(ranks):
+            if ranks[rank].get("error"):
+                reason = ranks[rank]["error"]
+                break
+    # The coordinator's dump reason can be the generic "rank N exited"
+    # while the SPECIFIC structured tag (consensus / non-finite / desync)
+    # only survives in a rank's error field: the tag search spans both.
+    search = "\n".join([reason] + [str(ranks[r].get("error") or "")
+                                   for r in sorted(ranks)])
+    coord = doc.get("coordinator") or {}
+    parked = (coord.get("snapshot") or {}).get("pending_rendezvous")
+    # Ranks whose streams carry fault injections: under chaos, the
+    # injected rank is the one that RECORDED the injection — a NaN
+    # propagates through the sum, so post-combine evidence (sentry kinds)
+    # implicates every rank equally; the injection event does not.
+    chaos_ranks = sorted(
+        rank for rank in ranks
+        if any(len(e) >= 2 and e[1] == EV_CHAOS
+               for e in ranks[rank].get("events", [])))
+
+    def seal_ordinals(rank: int) -> List[int]:
+        return [e[2] for e in ranks[rank].get("events", [])
+                if len(e) >= 3 and e[1] == EV_CONSENSUS_SEAL]
+
+    cycle_s = "?" if agreed is None else str(agreed)
+    verdict = f"abort cycle {cycle_s}"
+    m = _CONSENSUS_RE.search(search)
+    if m is not None:
+        bad = _parse_int_list(m.group(1))
+        seals = [max(seal_ordinals(r), default=0) for r in sorted(ranks)]
+        window = min(seals) if seals else 0
+        verdict = (f"consensus-fork@rank{bad[0]} window {window}"
+                   if bad else f"consensus-fork window {window}")
+    elif _NONFINITE_RE.search(search) is not None:
+        step = int(_NONFINITE_RE.search(search).group(1))
+        data_chaos = sorted(
+            rank for rank in ranks
+            if any(len(e) >= 5 and e[1] == EV_CHAOS and
+                   str(e[4]) in DATA_CHAOS_KINDS
+                   for e in ranks[rank].get("events", [])))
+        culprit = data_chaos[0] if data_chaos else None
+        if culprit is None:
+            # no injection evidence: a genuinely non-finite gradient —
+            # name a rank only when exactly one saw a LOCAL (non-peer)
+            # fault; a sum-propagated NaN implicates everyone equally
+            local = [rank for rank in sorted(ranks)
+                     if any(len(e) >= 5 and e[1] == EV_SENTRY and
+                            e[2] == step and
+                            not str(e[4]).endswith(":peer")
+                            for e in ranks[rank].get("events", []))]
+            if len(local) == 1:
+                culprit = local[0]
+        verdict = (f"nonfinite@rank{culprit} step {step}"
+                   if culprit is not None else f"nonfinite step {step}")
+    elif "cycle stream desync" in search or "flush_ordinal" in search:
+        verdict = "desync: flush_ordinal"
+    elif "stalled past" in reason or "Stalled ops" in reason:
+        named = _ABORTED_RE.search(reason)
+        stalled = _parse_int_list(named.group(1)) if named else []
+        who = f"rank{stalled[0]}" if stalled else "rank?"
+        verdict = f"stall@{who} cycle {cycle_s}"
+    else:
+        named = _ABORTED_RE.search(reason)
+        if named is None:
+            named = _EXITED_RE.search(reason)
+            dead = [int(named.group(1))] if named else []
+        else:
+            dead = _parse_int_list(named.group(1))
+        if dead:
+            verdict = f"dead@rank{dead[0]} cycle {cycle_s}"
+    return {
+        "verdict": verdict,
+        "reason": reason,
+        "world_id": doc.get("world_id"),
+        "epoch": doc.get("epoch"),
+        "ranks_present": sorted(ranks),
+        "chaos_ranks": chaos_ranks,
+        "last_agreed_cycle": agreed,
+        "per_rank_last_cycle": {str(r): c for r, c in
+                                sorted(last_cycle.items())},
+        "first_diverging_rank": diverging,
+        "fork_event": fork_event,
+        "parked_rendezvous": parked,
+    }

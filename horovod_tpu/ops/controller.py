@@ -35,6 +35,7 @@ from ..core.status import (
     WORLD_MISMATCH,
     format_aborted_ranks,
 )
+from ..obs import flightrec as _flightrec
 from ..obs.registry import Counter, registry as _metrics
 from ..runner.network import (
     BasicClient,
@@ -502,6 +503,16 @@ class _Rendezvous:
                 self._aborted = exc
             self._cond.notify_all()
 
+    def pending(self) -> Dict[str, List[int]]:
+        """Parked-rendezvous table (docs/blackbox.md): for every key
+        still short of its full rank set, the ranks that DID arrive —
+        the black-box incident dump's "who was everyone waiting on"
+        evidence. Keys stringified (tuples are not JSON)."""
+        with self._cond:
+            return {repr(key): sorted(slot)
+                    for key, slot in self._slots.items()
+                    if key not in self._results}
+
 
 def world_id_of(members, size: int) -> str:
     """Canonical identity of a world instance on the shared controller
@@ -755,6 +766,13 @@ class ControllerService:
             threading.Lock(),
             "ops.controller.ControllerService._metrics_lock")
         self._metrics_ranks: Dict[int, dict] = {}
+        # Flight recorder (docs/blackbox.md): per-rank black-box event
+        # tails pushed on abort over the anonymous "flightrec" RPC; the
+        # incident collector folds them into one blackbox-*.json. The
+        # once-flag keeps one incident file per world no matter how many
+        # escalation paths fire.
+        self._flightrec_ranks: Dict[int, dict] = {}
+        self._flightrec_fired = False
         self._service = BasicService(
             "horovod-controller", self._handle, secret=secret, port=port,
             bind_host=bind_host, on_disconnect=self._on_disconnect,
@@ -832,12 +850,66 @@ class ControllerService:
             if self._watch_reason is None:
                 self._watch_reason = str(exc)
         self._watch_event.set()
+        self._flightrec_incident(str(exc))
 
     def metrics_store(self) -> Dict[int, dict]:
         """Copy of the per-rank snapshot store (rank → registry families),
         as fresh as each rank's last publisher push."""
         with self._metrics_lock:
             return dict(self._metrics_ranks)
+
+    def flightrec_store(self) -> Dict[int, dict]:
+        """Copy of the per-rank black-box tails pushed on abort."""
+        with self._metrics_lock:
+            return dict(self._flightrec_ranks)
+
+    def state_snapshot(self) -> dict:
+        """Coordinator state for the black-box incident dump and
+        ``hvd.health_report()`` — one definition (docs/blackbox.md):
+        cycle position, live rank bindings, parked rendezvous (who is
+        everyone waiting on), response-cache generation, and the last
+        tuned-knob map."""
+        with self._lock:
+            snap = {
+                "cycle_no": self._cycle_no,
+                "world_shutdown": self._world_shutdown,
+                "abort_fired": self._abort_fired,
+                "abort_reason": self._watch_reason,
+                "bound_ranks": sorted(self._rank_conns),
+                "pending_reconnect": {str(r): d for r, d in
+                                      self._pending_reconnect.items()},
+                "tuned_knobs": dict(self._tuned_knobs)
+                if self._tuned_knobs else None,
+                "tuned_cycle_ms": self._tuned_cycle_ms,
+            }
+        snap["cache_generation"] = (self._cache.generation
+                                    if self._cache is not None else None)
+        snap["pending_rendezvous"] = {
+            "cycle": self._cycles.pending(),
+            "payload": self._payloads.pending(),
+            "sentry": self._sentry_rv.pending(),
+        }
+        return snap
+
+    def _flightrec_incident(self, reason: str) -> None:
+        """Start the bounded cross-rank incident collection, once per
+        world (docs/blackbox.md). The collector thread is non-daemon and
+        time-bounded by construction: interpreter exit joins it, so the
+        dump lands even when this process dies right after the abort."""
+        with self._lock:
+            if self._flightrec_fired:
+                return
+            self._flightrec_fired = True
+        try:
+            from ..basics import world_epoch
+
+            _flightrec.coordinator_collect(
+                reason, self._size, self._world_id, world_epoch(),
+                store_get=self.flightrec_store,
+                snapshot_fn=self.state_snapshot)
+        except Exception as exc:  # noqa: BLE001 - never worsen an abort
+            LOG.warning("flight recorder: incident collection failed to "
+                        "start: %s", exc)
 
     def _handle(self, req: Any, _sock: Any) -> Any:
         kind = req[0]
@@ -856,6 +928,30 @@ class ControllerService:
                     world_mismatch_error(self._world_id, push_wid))
             with self._metrics_lock:
                 self._metrics_ranks[int(push_rank)] = snap
+            return ("ok",)
+        if kind == "flightrec":
+            # Flight-recorder incident push (docs/blackbox.md): one rank's
+            # black-box event tail on abort. Anonymous like "metrics" —
+            # handled BEFORE rank binding, the pushing connection's
+            # teardown is never a rank death — and world-gated the same
+            # way (a co-located different world's tail in this world's
+            # incident file would send a postmortem reader down the wrong
+            # world's history).
+            _, push_rank, payload = req[:3]
+            push_wid = req[3] if len(req) > 3 else ""
+            if push_wid and self._world_id and push_wid != self._world_id:
+                raise RuntimeError(
+                    world_mismatch_error(self._world_id, push_wid))
+            with self._metrics_lock:
+                self._flightrec_ranks[int(push_rank)] = payload
+            # A push IS evidence of a world abort (ranks only ship tails
+            # from their failure paths): start the bounded collection now
+            # — waiting for a disconnect-based abort would lose the dump
+            # in worlds whose ranks all exit quickly after a structured
+            # error (the service dies with this process).
+            self._flightrec_incident(
+                (payload or {}).get("error") or
+                f"rank {push_rank} shipped a black-box incident tail")
             return ("ok",)
         if kind == "metrics_pull":
             caller_wid = req[1] if len(req) > 1 else ""
@@ -1139,6 +1235,12 @@ class ControllerService:
                 self._watch_reason = reason
         self._watch_event.set()
         self._sentry_rv.abort(RuntimeError(reason))
+        # Flight recorder (docs/blackbox.md): every world escalation —
+        # stall deadline, consensus mismatch — leaves a black-box
+        # incident file; ranks push their tails when the abort_reason
+        # reaches them and the bounded collector folds whatever arrives.
+        _flightrec.record(_flightrec.EV_ESCALATE, detail=reason[:200])
+        self._flightrec_incident(reason)
 
     def _check_flush_ordinals(self, slot: Dict[int, Any],
                               key: Any) -> None:
@@ -1622,6 +1724,10 @@ class ControllerClient:
     # (docs/integrity.md); the native client's binary wire predates the
     # RPC and the sentry degrades to local verdicts there (warned once).
     sentry_exchange_supported = True
+    # The Python service collects "flightrec" incident pushes on abort
+    # (docs/blackbox.md); the native wire predates the RPC and the dump
+    # degrades to a rank-local file there (warned once).
+    flightrec_supported = True
 
     def __init__(self, addr,  # (host, port) or {intf: (host, port)}
                  secret: Optional[bytes] = None,
@@ -1758,10 +1864,21 @@ class ControllerClient:
         # shrink).
         wire = self._client._wire
         tx0, rx0 = wire.tx_bytes, wire.rx_bytes
+        # Flight recorder (docs/blackbox.md): the negotiate-submit /
+        # response pair with the cycle ordinal — the cross-rank
+        # alignment ground truth of every incident classification.
+        _flightrec.record(_flightrec.EV_NEGOTIATE, self._cycle_no)
         t0 = time.monotonic()
         out = self._client.request(("cycle", rank, request_list))
         _NEG_CYCLE_SECONDS.observe(time.monotonic() - t0)
         _NEG_CYCLES.inc()
+        if isinstance(out, CacheHitAck):
+            _flightrec.record(_flightrec.EV_CACHE_HIT, self._cycle_no,
+                              aux=out.generation)
+        else:
+            gen = getattr(out, "cache_generation", None)
+            _flightrec.record(_flightrec.EV_RESPONSE, self._cycle_no,
+                              aux=-1 if gen is None else gen)
         self.last_cycle_tx_bytes = wire.tx_bytes - tx0
         self.last_cycle_rx_bytes = wire.rx_bytes - rx0
         self._neg_tx.inc(self.last_cycle_tx_bytes)

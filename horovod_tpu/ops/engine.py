@@ -37,7 +37,8 @@ from ..analysis.witness import maybe_wrap as _witness_wrap
 from ..core import config as _config
 from ..core.logging import LOG
 from ..core.status import SHUT_DOWN_ERROR, Status
-from ..obs import TimelineBridge, registry as _obs_registry
+from ..obs import TimelineBridge, flightrec as _flightrec, \
+    registry as _obs_registry
 from ..runner.network import default_secret
 from ..utils.timeline import TRACE_META, Timeline, rank_timeline_path
 from .autotuner import Autotuner
@@ -647,6 +648,16 @@ class Engine:
             # estimation where something consumes it; degrades
             # deterministically on the native wire (clock_sync_supported).
             self._maybe_start_clock_sync(addr_map, secret, world_id)
+            # Flight recorder (docs/blackbox.md): arm this rank's dump
+            # context — on any world abort the event tail ships to the
+            # coordinator's incident collector over the anonymous
+            # "flightrec" RPC; the native wire predates the RPC and
+            # degrades to a rank-local dump (warned once at dump time).
+            _flightrec.arm_push(
+                addr_map, secret, world_id, self._rank,
+                basics.world_epoch(), snapshot_fn=self.state_snapshot,
+                local_only=not getattr(self._client,
+                                       "flightrec_supported", False))
 
         self._host_fallback_warned = set()
 
@@ -957,6 +968,9 @@ class Engine:
                 _EXECUTE_SECONDS.observe(time.monotonic() - t_exec)
         finally:
             self._flush_clock.mark_end()
+            # flight recorder (docs/blackbox.md): flush lifecycle end,
+            # keyed by the cycle the sub-buffer was negotiated under
+            _flightrec.record(_flightrec.EV_FLUSH_END, cycle_no)
 
     # The coordinator retains a cycle's ResponseList (the payload
     # exchange's lookup table) for a 16-cycle sliding window
@@ -1064,6 +1078,12 @@ class Engine:
         controller stop too — harmless, nothing is in a collective then."""
         self._abort_reason = reason
         self._abort_event.set()
+        if reason and "stopping" not in reason:
+            # flight recorder (docs/blackbox.md): a pushed world abort —
+            # a rank parked inside a compiled collective may never reach
+            # the loop's own teardown trigger, so ship the tail from
+            # here too (idempotent once-flag in trigger_dump)
+            _flightrec.trigger_dump(reason)
 
     def _device_call(self, fn, *args, worker=None):
         """Run a device-plane call abortably (see ``_DevicePlaneWorker``).
@@ -1193,6 +1213,8 @@ class Engine:
                                      handle=handle, root_rank=root_rank,
                                      codec=codec, apply=apply)
             self._submissions.append(entry)
+        # flight recorder (docs/blackbox.md): submission lifecycle start
+        _flightrec.record(_flightrec.EV_ENQUEUE, detail=name)
         self.timeline.negotiate_start(name, _OP_NAMES[op])
         # No wake: submissions ride the next cycle tick, preserving the
         # reference's fusion window (HOROVOD_CYCLE_TIME batches arrivals,
@@ -1298,6 +1320,13 @@ class Engine:
             self._flush_outstanding(Status.unknown_error(reason))
         finally:
             self._stop_requested = True
+            if self._shutdown_reason:
+                # Flight recorder (docs/blackbox.md): an escalated
+                # shutdown or loop crash — ship this rank's black-box
+                # tail while the coordinator is still reachable (the
+                # service teardown below). Clean negotiated shutdowns
+                # leave _shutdown_reason None and dump nothing.
+                _flightrec.trigger_dump(self._shutdown_reason)
             self._abandon_flushes()
             if self._clock_sync is not None:
                 self._clock_sync.stop()
@@ -1360,6 +1389,10 @@ class Engine:
                 LOG.warning(
                     "finalizer still completing at shutdown; leaving the "
                     "timeline writer open to avoid a write-after-free")
+            # after the trigger above: a clean world's later structured
+            # raises (tests constructing errors) must not dump against a
+            # stale context
+            _flightrec.disarm_push()
             self._stopped.set()
 
     def _pipelined_tick(self, new_entries: List[TensorTableEntry],
@@ -1406,6 +1439,10 @@ class Engine:
                 self._flush_count += 1
                 _SUBBUFFER_FLUSHES.inc()
                 depth = len(self._inflight)
+                # flight recorder (docs/blackbox.md): flush dispatch with
+                # its cycle ordinal + the in-flight depth it joined
+                _flightrec.record(_flightrec.EV_FLUSH_START, cycle_no,
+                                  aux=depth)
                 _FLUSH_INFLIGHT.set(depth)
                 if depth > self._inflight_peak:
                     self._inflight_peak = depth
@@ -1651,6 +1688,43 @@ class Engine:
                                   if self._consensus_acc else 0),
             "data_chaos_events": (list(self._data_chaos.events)
                                   if self._data_chaos else []),
+        }
+
+    def state_snapshot(self) -> Dict[str, Any]:
+        """Engine state for the black-box incident dump and
+        ``hvd.health_report()`` — one definition (docs/blackbox.md): the
+        in-flight flush table, pending submissions, cache/apply/overlap
+        counters, and the last tuned-knob map this rank applied. Safe to
+        call from any thread at any time (a live poke must never perturb
+        the loop): collections are copied under the engine lock where one
+        exists, best-effort elsewhere."""
+        with self._lock:
+            pending = sorted(self._pending)
+            queued = len(self._submissions)
+        try:
+            inflight = [cycle_no for cycle_no, _ in list(self._inflight)]
+        except RuntimeError:  # deque mutated mid-copy: retry once, coarse
+            inflight = [cycle_no for cycle_no, _ in list(self._inflight)]
+        client = self._client
+        return {
+            "rank": self._rank,
+            "size": self._size,
+            "stopped": self._stopped.is_set(),
+            "stop_requested": self._stop_requested,
+            "crashed": getattr(self, "_crashed", False),
+            "shutdown_reason": self._shutdown_reason,
+            "abort_reason": self._abort_reason,
+            "last_cycle": (client.last_cycle if client is not None
+                           else max(self._local_cycle_no - 1, 0)),
+            "pending_tensors": pending,
+            "queued_submissions": queued,
+            "inflight_flushes": inflight,
+            "subbuffers": self._subbuffers,
+            "cache": self.cache_stats(),
+            "apply": self.apply_stats(),
+            "overlap": self.overlap_stats(),
+            "applied_knobs": dict(self._applied_knobs),
+            "native_controller": self._native_controller,
         }
 
     def cache_stats(self) -> Dict[str, int]:
@@ -1950,6 +2024,17 @@ class Engine:
             {(c.rule.fingerprint, c.count, c.average)
              for c in ctxs if c is not None}) == 1
         fused = bool(fingerprint) and uniform and self._fused_apply_exec
+        # flight recorder (docs/blackbox.md): the negotiated fused-apply
+        # strategy and fingerprint for this batch — the evidence a
+        # postmortem needs when one rank applied and another reduced.
+        # Enabled check BEFORE building the detail string: the disabled
+        # path must stay allocation-free (the HOROVOD_FLIGHTREC=0
+        # contract pinned by the tracemalloc test).
+        if _flightrec.recorder().enabled:
+            _flightrec.record(
+                _flightrec.EV_FUSED_APPLY,
+                ordinal=-1 if cycle_no is None else cycle_no,
+                detail=("fused:" if fused else "split:") + fingerprint[:16])
         if fused and fingerprint != ctxs[0].rule.fingerprint:
             # the coordinator negotiated a different apply program than
             # this rank submitted — a bug, never a silent divergence

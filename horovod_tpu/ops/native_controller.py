@@ -27,6 +27,7 @@ from typing import Optional
 
 from ..core.logging import LOG
 from ..core.status import SHUT_DOWN_ERROR
+from ..obs import flightrec as _flightrec
 from ..runner.network import WireError
 # observability counters shared with the Python client (controller.py
 # never imports this module, so the import is cycle-free); bound at
@@ -183,6 +184,11 @@ class NativeControllerClient:
     # runs against it; per-rank traces keep their local timebase and
     # trace_merge says so instead of pretending correction happened.
     clock_sync_supported = False
+    # Same pattern for the flight recorder's incident push
+    # (docs/blackbox.md): the binary wire predates the "flightrec" RPC,
+    # so an abort dumps a RANK-LOCAL blackbox file (warned once) instead
+    # of the coordinator's merged cross-rank incident.
+    flightrec_supported = False
 
     def __init__(self, addr, secret: Optional[bytes] = None,
                  timeout_s: Optional[float] = None,
@@ -256,12 +262,16 @@ class NativeControllerClient:
         # wire negotiates identically; only the body codec differs)
         wire = self._client._wire
         tx0, rx0 = wire.tx_bytes, wire.rx_bytes
+        # flight recorder (docs/blackbox.md): same cycle-ordinal stamps
+        # as the Python client — rank-local dumps still align streams
+        _flightrec.record(_flightrec.EV_NEGOTIATE, self._cycle_no)
         t0 = time.monotonic()
         out = decode_cycle_response(
             self._client.request_raw(encode_cycle(rank, request_list)),
             log_stalls=self._log_stalls)
         _NEG_CYCLE_SECONDS.observe(time.monotonic() - t0)
         _NEG_CYCLES.inc()
+        _flightrec.record(_flightrec.EV_RESPONSE, self._cycle_no)
         _NEG_TX.inc(wire.tx_bytes - tx0)
         _NEG_RX.inc(wire.rx_bytes - rx0)
         escalation = self._escalation.check(out.stall_warnings)

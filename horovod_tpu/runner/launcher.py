@@ -386,6 +386,20 @@ def _stderr_tail(fh, max_bytes: int = 4096) -> str:
         return ""
 
 
+def _evidence_grace_s() -> float:
+    """Flight-recorder evidence grace (docs/blackbox.md): how long the
+    failure path lets surviving ranks drain before ``_terminate_all``
+    SIGTERMs them — the window in which the coordinator's black-box
+    incident collector lands its dump. 0 (today's immediate fail-fast)
+    when the recorder is disabled or unimportable."""
+    try:
+        from ..obs.flightrec import launch_grace_s
+
+        return launch_grace_s()
+    except Exception:  # noqa: BLE001 - diagnostics must not break launch
+        return 0.0
+
+
 def _wait_all(procs: List[subprocess.Popen],
               timeout_s: Optional[float],
               cancel_event: Optional["threading.Event"] = None,
@@ -393,6 +407,15 @@ def _wait_all(procs: List[subprocess.Popen],
               exit_codes: Optional[Dict[int, int]] = None) -> int:
     deadline = time.monotonic() + timeout_s if timeout_s else None
     remaining = {rank: p for rank, p in enumerate(procs)}
+    # First nonzero exit observed: (rank, code, stderr tail). Raised
+    # after the flight-recorder evidence grace instead of immediately —
+    # a hard rank death (os._exit/SIGKILL) otherwise SIGTERMs the
+    # coordinator before its incident collector can land the black-box
+    # dump (docs/blackbox.md). Survivors that exit on their own end the
+    # grace early; reference fail-fast semantics are preserved with the
+    # recorder disabled (grace 0).
+    first_failure: Optional[tuple] = None
+    grace_deadline = 0.0
     while remaining:
         for rank, proc in list(remaining.items()):
             code = proc.poll()
@@ -401,11 +424,16 @@ def _wait_all(procs: List[subprocess.Popen],
             del remaining[rank]
             if exit_codes is not None:
                 exit_codes[rank] = code
-            if code != 0:
+            if code != 0 and first_failure is None:
                 tail = ""
                 if stderr_files and rank in stderr_files:
                     tail = _stderr_tail(stderr_files[rank])
-                raise LaunchError(rank, code, stderr_tail=tail)
+                first_failure = (rank, code, tail)
+                grace_deadline = time.monotonic() + _evidence_grace_s()
+        if first_failure is not None and (
+                not remaining or time.monotonic() > grace_deadline):
+            rank, code, tail = first_failure
+            raise LaunchError(rank, code, stderr_tail=tail)
         if cancel_event is not None and cancel_event.is_set():
             raise LaunchCancelled("job cancelled by owner")
         if deadline and time.monotonic() > deadline:

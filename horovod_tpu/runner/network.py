@@ -24,6 +24,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..obs import flightrec as _flightrec
 from ..obs.registry import Counter, registry as _metrics
 
 _LEN = struct.Struct(">Q")
@@ -746,6 +747,9 @@ class BasicClient:
             if attempt > 1:
                 time.sleep(self._policy.delay(attempt - 1))
             _RECONNECT_ATTEMPTS.inc()
+            # flight recorder (docs/blackbox.md): reconnect attempts are
+            # the black-box evidence behind a heal-vs-death postmortem
+            _flightrec.record(_flightrec.EV_RECONNECT, aux=attempt)
             try:
                 sock = self._dial(rounds=1, reconnecting=True)
             except (WireError, OSError) as exc:
@@ -807,6 +811,7 @@ class BasicClient:
             self._broken = False
             self.reconnects += 1
             _RECONNECTS_HEALED.inc()
+            _flightrec.record(_flightrec.EV_RECONNECT_HEALED, aux=attempt)
             if old is not None:
                 try:
                     old.close()

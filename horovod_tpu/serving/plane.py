@@ -508,6 +508,12 @@ class ServingPlane:
                                            len(tickets))
                 _DISPATCHED.inc()
                 self._cond.notify_all()
+            # flight recorder (docs/blackbox.md): driver-side dispatch
+            # with the batch ordinal the workers' receipts align to
+            from ..obs import flightrec as _flightrec
+
+            _flightrec.record(_flightrec.EV_SERVING_DISPATCH, ordinal,
+                              aux=len(tickets))
             return Preserialized(frame)
 
     def _result(self, rank: int, epoch: int, ordinal: int,

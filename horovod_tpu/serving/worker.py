@@ -206,6 +206,12 @@ def serve_worker(models: Dict[str, Callable],
             assert resp[0] == "batch", resp
             _, got_ordinal, key, n_real, payload = resp
             assert got_ordinal == ordinal, (got_ordinal, ordinal)
+            # flight recorder (docs/blackbox.md): batch receipt with its
+            # dispatch ordinal — a wedged serving world's last evidence
+            from ..obs import flightrec as _flightrec
+
+            _flightrec.record(_flightrec.EV_SERVING_BATCH, ordinal,
+                              aux=int(n_real))
             name = key[0]
             digest = None
             output = None
@@ -223,6 +229,7 @@ def serve_worker(models: Dict[str, Callable],
             if fault is not None and fault[0] == rank and \
                     fault[2] == epoch and stats["batches"] == fault[1]:
                 os._exit(1)  # kill-mid-batch: result never reported
+            _flightrec.record(_flightrec.EV_SERVING_DIGEST, ordinal)
             client.request(("result", rank, epoch, ordinal, digest,
                             output if rank == 0 else None, error))
             ordinal += 1

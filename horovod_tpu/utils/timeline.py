@@ -41,6 +41,23 @@ AUTOTUNE = "horovod_autotune"
 # trace shows exactly WHICH batch a skip/zero verdict neutralized.
 INTEGRITY = "horovod_integrity"
 
+# Observability plane (docs/metrics.md): events dropped after close().
+# The drop always warned; counting it too makes a truncated trace
+# visible on the registry / tools/metrics_summary.py instead of only in
+# a log line nobody scrapes (docs/blackbox.md satellite).
+FAMILY_DROPPED_EVENTS = "horovod_timeline_dropped_events_total"
+
+
+def _dropped_counter():
+    """Lazy registration (this module stays stdlib-first; the registry
+    import is deferred exactly like obs/tracing's gauges)."""
+    from ..obs.registry import registry as _metrics
+
+    return _metrics().counter(
+        FAMILY_DROPPED_EVENTS,
+        "Timeline events that arrived after close() and were dropped "
+        "(the written trace is truncated relative to the job)")
+
 
 def rank_timeline_path(path: str, rank: int) -> str:
     """Per-rank artifact name under ``HOROVOD_TIMELINE_ALL_RANKS=1``:
@@ -109,15 +126,23 @@ class Timeline:
             # write there is a use-after-free). Late emitters are bugs in
             # shutdown ordering (a finalizer or metrics bridge outliving
             # the engine), so say so once instead of corrupting the
-            # artifact or silently queueing records nobody will drain.
-            if self._path and not self._drop_warned:
-                self._drop_warned = True
-                import logging
+            # artifact or silently queueing records nobody will drain —
+            # and COUNT every drop, so a truncated trace shows on the
+            # registry, not only in a log line nobody scrapes.
+            if self._path:
+                try:
+                    _dropped_counter().inc()
+                except Exception:  # noqa: BLE001 - audit must not raise
+                    pass
+                if not self._drop_warned:
+                    self._drop_warned = True
+                    import logging
 
-                logging.getLogger("horovod_tpu").warning(
-                    "timeline event %r arrived after close(); dropping it "
-                    "(and any later ones) instead of writing to the "
-                    "closed trace", record.get("name", record.get("ph")))
+                    logging.getLogger("horovod_tpu").warning(
+                        "timeline event %r arrived after close(); "
+                        "dropping it (and any later ones) instead of "
+                        "writing to the closed trace",
+                        record.get("name", record.get("ph")))
             return
         if self._native is not None:
             self._native.write(json.dumps(record))

@@ -19,6 +19,35 @@ pin_cpu_platform(8)
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _flightrec_dir_tmp(tmp_path_factory):
+    """The flight recorder is ALWAYS-ON (docs/blackbox.md), and abort
+    tests — chaos cells, stall escalations, elastic kills — would
+    otherwise litter the repo cwd with blackbox-*.json incident files.
+    Point the dump dir at a session tmp dir (inherited by spawned
+    worker worlds via the environment); tests that assert on incident
+    files set their own dir explicitly."""
+    import os
+
+    from horovod_tpu.core.config import HOROVOD_FLIGHTREC_DIR
+
+    from horovod_tpu.core.config import HOROVOD_FLIGHTREC_LAUNCH_GRACE
+
+    # Pin the launcher's evidence grace to 0 for the whole suite: dozens
+    # of tests exercise hard rank deaths and rely on fail-fast teardown
+    # timing; the handful that assert on the grace-landed dump set the
+    # knob themselves.
+    if not os.environ.get(HOROVOD_FLIGHTREC_LAUNCH_GRACE):
+        os.environ[HOROVOD_FLIGHTREC_LAUNCH_GRACE] = "0"
+    if os.environ.get(HOROVOD_FLIGHTREC_DIR):
+        yield
+        return
+    os.environ[HOROVOD_FLIGHTREC_DIR] = str(
+        tmp_path_factory.mktemp("blackbox"))
+    yield
+    os.environ.pop(HOROVOD_FLIGHTREC_DIR, None)
+
+
 @pytest.fixture()
 def hvd():
     import horovod_tpu as hvd_mod

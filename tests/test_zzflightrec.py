@@ -1,0 +1,815 @@
+"""Flight recorder + black-box incident dumps (docs/blackbox.md).
+
+Named past the 870 s tier-1 truncation point on purpose (the ROADMAP
+note): the unit tier is cheap, but the dump-on-abort worlds each spawn
+2-process runs.
+
+Coverage per the ISSUE-14 satellite: ring overwrite / capacity /
+thread-safety units, dump-on-abort under ``nan@rank1`` and ``drop/
+close@rank1`` chaos cells asserting the classifier names the INJECTED
+rank on both negotiation cores, native-controller rank-local degrade,
+the disabled-knob zero-overhead path, the ``tools/blackbox_report.py``
+final-line-JSON contract, and the 2-proc ``dryrun_flightrec``
+certification (slow tier).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from horovod_tpu.core.config import (
+    HOROVOD_CHAOS,
+    HOROVOD_FLIGHTREC,
+    HOROVOD_FLIGHTREC_DIR,
+    HOROVOD_FLIGHTREC_DUMP_TIMEOUT,
+    HOROVOD_FLIGHTREC_LAUNCH_GRACE,
+    HOROVOD_GRAD_SENTRY,
+    HOROVOD_NATIVE_CONTROLLER,
+    HOROVOD_NATIVE_CORE,
+    HOROVOD_RECONNECT_ATTEMPTS,
+    HOROVOD_RECONNECT_BACKOFF,
+    HOROVOD_RECONNECT_WINDOW,
+    HOROVOD_STALL_SHUTDOWN_TIME,
+    HOROVOD_STALL_WARNING_TIME,
+)
+from horovod_tpu.obs import flightrec
+
+pytestmark = pytest.mark.flightrec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def fresh_recorder(monkeypatch):
+    """A clean enabled recorder rebuilt from env, restored afterwards."""
+    monkeypatch.delenv(HOROVOD_FLIGHTREC, raising=False)
+    monkeypatch.delenv("HOROVOD_FLIGHTREC_EVENTS", raising=False)
+    flightrec.reset_for_tests()
+    yield flightrec.recorder()
+    flightrec.reset_for_tests()
+
+
+# -- ring units ----------------------------------------------------------------
+
+
+class TestRing:
+    def test_capacity_and_overwrite(self):
+        rec = flightrec.FlightRecorder(capacity=4, enabled=True)
+        for i in range(7):
+            rec.record("negotiate", i)
+        assert rec.recorded == 7
+        assert rec.dropped == 3
+        tail = rec.tail()
+        assert [e[2] for e in tail] == [3, 4, 5, 6]  # oldest overwritten
+        assert all(e[1] == "negotiate" for e in tail)
+
+    def test_tail_under_capacity(self):
+        rec = flightrec.FlightRecorder(capacity=8, enabled=True)
+        rec.record("enqueue", detail="t0")
+        rec.record("response", 5, aux=2)
+        tail = rec.tail()
+        assert len(tail) == 2
+        assert tail[0][1] == "enqueue" and tail[0][4] == "t0"
+        assert tail[1][:4] == [tail[1][0], "response", 5, 2]
+        assert tail[1][0] > 0  # monotonic timestamp stamped
+
+    def test_tail_returns_copies(self):
+        rec = flightrec.FlightRecorder(capacity=4, enabled=True)
+        rec.record("negotiate", 1)
+        tail = rec.tail()
+        tail[0][1] = "mutated"
+        assert rec.tail()[0][1] == "negotiate"
+
+    def test_thread_safety(self):
+        rec = flightrec.FlightRecorder(capacity=128, enabled=True)
+        n_threads, per_thread = 8, 500
+
+        def worker(tid):
+            for i in range(per_thread):
+                rec.record("negotiate", i, aux=tid)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        assert rec.recorded == n_threads * per_thread
+        tail = rec.tail()
+        assert len(tail) == 128
+        # every slot is a complete, well-formed record (no torn writes)
+        for event in tail:
+            assert event[1] == "negotiate"
+            assert 0 <= event[2] < per_thread
+            assert 0 <= event[3] < n_threads
+
+    def test_disabled_records_nothing(self):
+        rec = flightrec.FlightRecorder(capacity=16, enabled=False)
+        rec.record("negotiate", 1)
+        assert rec.recorded == 0
+        assert rec.tail() == []
+        assert rec.stats()["enabled"] is False
+
+    def test_disabled_knob_zero_overhead(self, monkeypatch):
+        """HOROVOD_FLIGHTREC=0: the module-level producer is one global
+        read + one attribute check — zero allocation per call (the
+        registry-measured no-added-allocation acceptance)."""
+        import tracemalloc
+
+        monkeypatch.setenv(HOROVOD_FLIGHTREC, "0")
+        flightrec.reset_for_tests()
+        try:
+            assert flightrec.recorder().enabled is False
+            flightrec.record("negotiate", 1)  # warm the singleton path
+            tracemalloc.start()
+            before = tracemalloc.take_snapshot()
+            for i in range(2000):
+                flightrec.record("negotiate", i, aux=3, detail="grad")
+            after = tracemalloc.take_snapshot()
+            tracemalloc.stop()
+            stats = after.compare_to(before, "filename")
+            grown = sum(s.size_diff for s in stats if s.size_diff > 0)
+            # tracemalloc bookkeeping itself can show a few hundred
+            # bytes; 2000 recorded events would show tens of KB
+            assert grown < 4096, f"disabled record() allocated {grown}B"
+            assert flightrec.recorder().recorded == 0
+        finally:
+            flightrec.reset_for_tests()
+
+    def test_env_capacity_and_counters(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FLIGHTREC_EVENTS", "32")
+        monkeypatch.delenv(HOROVOD_FLIGHTREC, raising=False)
+        flightrec.reset_for_tests()
+        try:
+            rec = flightrec.recorder()
+            assert rec.capacity == 32
+            from horovod_tpu.obs.registry import registry
+
+            for i in range(40):
+                flightrec.record("negotiate", i)
+            snap = registry().snapshot()
+            assert flightrec.FAMILY_EVENTS in snap
+            assert flightrec.FAMILY_DROPPED in snap
+            assert flightrec.FAMILY_DUMPS in snap
+            assert flightrec.FAMILY_DUMP_FAILURES in snap
+        finally:
+            flightrec.reset_for_tests()
+
+
+# -- classifier units ----------------------------------------------------------
+
+
+def _events(*triples):
+    """[(kind, ordinal, detail?), ...] -> event records."""
+    out = []
+    for i, spec in enumerate(triples):
+        kind, ordinal = spec[0], spec[1]
+        detail = spec[2] if len(spec) > 2 else ""
+        out.append([1000 + i, kind, ordinal, -1, detail])
+    return out
+
+
+class TestClassifier:
+    def test_dead_rank_with_agreed_cycle(self):
+        doc = {
+            "world_id": "full:2", "epoch": 0,
+            "reason": "rank 1 exited mid-job. shut down "
+                      "[aborted ranks: 1]",
+            "ranks": {
+                "0": {"events": _events(("negotiate", 0), ("response", 0),
+                                        ("negotiate", 1), ("response", 1),
+                                        ("negotiate", 2))},
+                "1": {"events": _events(("negotiate", 0), ("response", 0),
+                                        ("negotiate", 1))},
+            },
+        }
+        report = flightrec.classify_incident(doc)
+        assert report["verdict"] == "dead@rank1 cycle 1"
+        assert report["last_agreed_cycle"] == 1
+        assert report["first_diverging_rank"] == 1
+        assert report["fork_event"][1] == "negotiate"
+
+    def test_stall_verdict(self):
+        from horovod_tpu.core.status import format_aborted_ranks
+
+        doc = {
+            "reason": "collective(s) grad stalled past the 4s "
+                      "HOROVOD_STALL_SHUTDOWN_TIME_S deadline; aborting "
+                      f"the world. {format_aborted_ranks([2])}",
+            "ranks": {"0": {"events": _events(("response", 417))},
+                      "2": {"events": _events(("response", 417))}},
+        }
+        report = flightrec.classify_incident(doc)
+        assert report["verdict"] == "stall@rank2 cycle 417"
+
+    def test_consensus_verdict_with_window(self):
+        from horovod_tpu.core.status import format_consensus
+
+        doc = {
+            "reason": "cross-rank consensus verification failed "
+                      f"{format_consensus([1], ['grad'])} shut down",
+            "ranks": {
+                "0": {"events": _events(("consensus_seal", 12))},
+                "1": {"events": _events(("consensus_seal", 12))},
+            },
+        }
+        report = flightrec.classify_incident(doc)
+        assert report["verdict"] == "consensus-fork@rank1 window 12"
+
+    def test_nonfinite_prefers_chaos_evidence(self):
+        from horovod_tpu.core.status import format_nonfinite
+
+        # the NaN propagates through the sum: BOTH ranks' sentry kinds
+        # read nan — only the injection event names the culprit
+        doc = {
+            "reason": f"grad sentry abort {format_nonfinite(3, ['g'])}",
+            "ranks": {
+                "0": {"events": _events(("sentry", 3, "abort:nan"))},
+                "1": {"events": _events(("chaos", 3, "nan"),
+                                        ("sentry", 3, "abort:nan"))},
+            },
+        }
+        report = flightrec.classify_incident(doc)
+        assert report["verdict"] == "nonfinite@rank1 step 3"
+        assert report["chaos_ranks"] == [1]
+
+    def test_nonfinite_ignores_wire_chaos_on_other_rank(self):
+        """A co-occurring WIRE fault (delay/drop/close) on a lower rank
+        is harmless to the numerics and must not steal the non-finite
+        attribution from the rank that recorded the DATA injection."""
+        from horovod_tpu.core.status import format_nonfinite
+
+        doc = {
+            "reason": f"grad sentry abort {format_nonfinite(3, ['g'])}",
+            "ranks": {
+                "0": {"events": _events(("chaos", 2, "delay"),
+                                        ("sentry", 3, "abort:nan"))},
+                "1": {"events": _events(("chaos", 3, "nan"),
+                                        ("sentry", 3, "abort:nan"))},
+            },
+        }
+        report = flightrec.classify_incident(doc)
+        assert report["verdict"] == "nonfinite@rank1 step 3"
+        # chaos_ranks still reports every injected stream — only the
+        # culprit selection filters to data-plane kinds
+        assert report["chaos_ranks"] == [0, 1]
+
+    def test_data_chaos_kinds_pinned_to_chaos_contract(self):
+        """The classifier's kind list is a deliberate copy of
+        chaos.DATA_KINDS (flightrec.py must stay loadable without the
+        package) — pin them together like the wire-tag regexes."""
+        from horovod_tpu import chaos
+
+        assert flightrec.DATA_CHAOS_KINDS == chaos.DATA_KINDS
+
+    def test_desync_verdict(self):
+        doc = {"reason": "negotiation cycle stream desync: rank 0 at "
+                         "cycle 4, rank 1 at cycle 5 joined one "
+                         "rendezvous",
+               "ranks": {}}
+        assert flightrec.classify_incident(doc)["verdict"] == \
+            "desync: flush_ordinal"
+
+    def test_specific_tag_found_in_rank_error(self):
+        """The coordinator's reason can be the generic rank death while
+        the structured tag only survives in a rank's error field."""
+        from horovod_tpu.core.status import format_consensus
+
+        doc = {
+            "reason": "rank 1 exited mid-job. [aborted ranks: 1]",
+            "ranks": {
+                "0": {"events": [],
+                      "error": f"boom {format_consensus([1], [])}"},
+            },
+        }
+        assert flightrec.classify_incident(doc)["verdict"].startswith(
+            "consensus-fork@rank1")
+
+    def test_tag_regexes_pinned_to_status_contract(self):
+        """The classifier's regex copies must keep matching what
+        core/status.py actually formats (the deliberate-duplication
+        cross-pin: flightrec.py must stay loadable without the
+        package)."""
+        from horovod_tpu.core.status import (
+            format_aborted_ranks,
+            format_consensus,
+            format_nonfinite,
+        )
+
+        assert flightrec._ABORTED_RE.search(format_aborted_ranks([3, 1]))
+        assert flightrec._CONSENSUS_RE.search(
+            format_consensus([2], ["t"]))
+        assert flightrec._NONFINITE_RE.search(format_nonfinite(7, ["t"]))
+
+    def test_merge_incidents_unions_ranks(self):
+        merged = flightrec.merge_incidents([
+            {"world_id": "full:2", "epoch": 0, "reason": "",
+             "ranks": {"1": {"events": [], "error": "e1"}},
+             "written_by": "rank-local:1"},
+            {"world_id": "full:2", "epoch": 0, "reason": "r",
+             "ranks": {"0": {"events": []}},
+             "coordinator": {"snapshot": {}},
+             "written_by": "coordinator"},
+        ])
+        assert sorted(merged["ranks"]) == ["0", "1"]
+        assert merged["reason"] == "r"
+        assert merged["coordinator"] is not None
+
+    def test_incident_filename_sanitized(self):
+        assert flightrec.incident_filename("full:2", 0) == \
+            "blackbox-full-2-0.json"
+        assert flightrec.incident_filename("sub:0,1", 3, rank=1) == \
+            "blackbox-sub-0-1-3.rank1.json"
+
+
+# -- dump plumbing units -------------------------------------------------------
+
+
+class TestDumpPlumbing:
+    def test_unarmed_trigger_is_noop(self, tmp_path, monkeypatch,
+                                     fresh_recorder):
+        monkeypatch.setenv(HOROVOD_FLIGHTREC_DIR, str(tmp_path))
+        flightrec.disarm_push()
+        assert flightrec.trigger_dump("synthetic [aborted ranks: 1]") \
+            is None
+        assert list(tmp_path.iterdir()) == []
+
+    def test_structured_raise_unarmed_writes_nothing(
+            self, tmp_path, monkeypatch, fresh_recorder):
+        from horovod_tpu.core.status import Status
+
+        monkeypatch.setenv(HOROVOD_FLIGHTREC_DIR, str(tmp_path))
+        flightrec.disarm_push()
+        with pytest.raises(Exception):
+            Status.unknown_error("x [aborted ranks: 1]").raise_if_error()
+        assert list(tmp_path.iterdir()) == []
+
+    def test_local_degrade_writes_rank_file_once(self, tmp_path,
+                                                 monkeypatch,
+                                                 fresh_recorder):
+        """The native-controller degrade: local_only=True writes one
+        rank-local incident file; the once-flag makes a second trigger
+        (the raise_if_error hook racing the loop teardown) a no-op."""
+        monkeypatch.setenv(HOROVOD_FLIGHTREC_DIR, str(tmp_path))
+        flightrec.record("negotiate", 0)
+        flightrec.record("response", 0)
+        flightrec.arm_push(None, None, "full:2", 1, 0,
+                           snapshot_fn=lambda: {"x": 1}, local_only=True)
+        try:
+            path = flightrec.trigger_dump(
+                "rank 0 exited mid-job. [aborted ranks: 0]")
+            assert path is not None and os.path.exists(path)
+            assert path.endswith(".rank1.json")
+            assert flightrec.trigger_dump("again") is None  # once
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["written_by"] == "rank-local:1"
+            assert doc["ranks"]["1"]["snapshot"] == {"x": 1}
+            assert any(e[1] == "abort"
+                       for e in doc["ranks"]["1"]["events"])
+            report = flightrec.classify_incident(doc)
+            assert report["verdict"].startswith("dead@rank0")
+        finally:
+            flightrec.disarm_push()
+
+    def test_rearm_resets_once_flag(self, tmp_path, monkeypatch,
+                                    fresh_recorder):
+        monkeypatch.setenv(HOROVOD_FLIGHTREC_DIR, str(tmp_path))
+        flightrec.arm_push(None, None, "full:2", 0, 0, local_only=True)
+        assert flightrec.trigger_dump("a [aborted ranks: 1]") is not None
+        flightrec.arm_push(None, None, "full:2", 0, 1, local_only=True)
+        try:
+            assert flightrec.trigger_dump("b [aborted ranks: 1]") \
+                is not None
+        finally:
+            flightrec.disarm_push()
+
+    def test_disabled_recorder_never_dumps(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(HOROVOD_FLIGHTREC, "0")
+        monkeypatch.setenv(HOROVOD_FLIGHTREC_DIR, str(tmp_path))
+        flightrec.reset_for_tests()
+        try:
+            flightrec.arm_push(None, None, "full:2", 0, 0,
+                               local_only=True)
+            assert flightrec.trigger_dump("x [aborted ranks: 1]") is None
+            assert list(tmp_path.iterdir()) == []
+        finally:
+            flightrec.reset_for_tests()
+
+    def test_coordinator_collect_settles_on_partial_store(
+            self, tmp_path, monkeypatch, fresh_recorder):
+        """A dead rank never pushes: the collector must settle once
+        pushes stop arriving instead of always eating the full
+        timeout."""
+        import time as _time
+
+        monkeypatch.setenv(HOROVOD_FLIGHTREC_DIR, str(tmp_path))
+        monkeypatch.setenv(HOROVOD_FLIGHTREC_DUMP_TIMEOUT, "30")
+        store = {0: flightrec.rank_payload("r0 error", None)}
+        t0 = _time.monotonic()
+        thread = flightrec.coordinator_collect(
+            "rank 1 exited mid-job. [aborted ranks: 1]", 2, "full:2", 0,
+            store_get=lambda: dict(store),
+            snapshot_fn=lambda: {"pending_rendezvous": {"cycle": {}}})
+        thread.join(timeout=20)
+        elapsed = _time.monotonic() - t0
+        assert not thread.is_alive()
+        assert elapsed < 10, f"collector waited {elapsed:.1f}s"
+        files = list(tmp_path.glob("blackbox-*.json"))
+        assert len(files) == 1
+        doc = json.loads(files[0].read_text())
+        assert doc["written_by"] == "coordinator"
+        assert sorted(doc["ranks"]) == ["0"]
+        assert doc["coordinator"]["snapshot"]["pending_rendezvous"] == \
+            {"cycle": {}}
+
+
+# -- blackbox_report.py tool contract ------------------------------------------
+
+
+class TestBlackboxReportTool:
+    def test_final_line_json_contract(self, tmp_path):
+        doc = {
+            "format": 1, "world_id": "full:2", "epoch": 0, "size": 2,
+            "reason": "rank 1 exited mid-job. [aborted ranks: 1]",
+            "written_by": "coordinator",
+            "ranks": {
+                "0": {"events": _events(("negotiate", 0), ("response", 0),
+                                        ("negotiate", 1)),
+                      "clock_offset_us": 12.5},
+                "1": {"events": _events(("negotiate", 0),
+                                        ("response", 0))},
+            },
+            "coordinator": {"snapshot": {
+                "pending_rendezvous": {"cycle": {"('cycle', 1)": [0]}}}},
+        }
+        path = tmp_path / "blackbox-full-2-0.json"
+        path.write_text(json.dumps(doc))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "blackbox_report.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert report["verdict"] == "dead@rank1 cycle 0"
+        assert report["last_agreed_cycle"] == 0
+        assert report["first_diverging_rank"] == 1
+        assert report["sources"] == ["blackbox-full-2-0.json"]
+        assert "parked cycle rendezvous" in proc.stdout
+
+    def test_merges_rank_local_files(self, tmp_path):
+        from horovod_tpu.core.status import format_nonfinite
+
+        for rank in (0, 1):
+            events = _events(("negotiate", 2), ("response", 2),
+                             ("sentry", 3, "abort:nan"))
+            if rank == 1:
+                events = _events(("chaos", 3, "nan")) + events
+            doc = {"world_id": "full:2", "epoch": 0,
+                   "reason": f"x {format_nonfinite(3, ['g'])}",
+                   "written_by": f"rank-local:{rank}",
+                   "ranks": {str(rank): {"events": events}}}
+            (tmp_path / f"blackbox-full-2-0.rank{rank}.json").write_text(
+                json.dumps(doc))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "blackbox_report.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert report["verdict"] == "nonfinite@rank1 step 3"
+        assert report["ranks_present"] == [0, 1]
+
+    def test_no_files_is_an_error(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "blackbox_report.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1
+
+    def test_flightrec_module_loads_without_the_package(self, tmp_path):
+        """The jax-less exec-fallback contract: flightrec.py's module
+        level must stay stdlib-only (the straggler_report precedent)."""
+        script = (
+            "import importlib.util, sys\n"
+            "sys.modules['horovod_tpu'] = None  # poison package import\n"
+            f"spec = importlib.util.spec_from_file_location('_fr', "
+            f"{os.path.join(REPO, 'horovod_tpu', 'obs', 'flightrec.py')!r})\n"
+            "mod = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(mod)\n"
+            "doc = {'reason': 'rank 1 exited mid-job. "
+            "[aborted ranks: 1]', 'ranks': {}}\n"
+            "print(mod.classify_incident(doc)['verdict'])\n")
+        proc = subprocess.run([sys.executable, "-c", script],
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        assert proc.stdout.strip() == "dead@rank1 cycle ?"
+
+
+# -- timeline dropped-events counter (satellite) -------------------------------
+
+
+class TestTimelineDropCounter:
+    def test_late_event_counts_on_registry(self, tmp_path):
+        from horovod_tpu.obs.registry import registry
+        from horovod_tpu.utils.timeline import (
+            FAMILY_DROPPED_EVENTS,
+            Timeline,
+        )
+
+        def total():
+            fam = registry().snapshot().get(FAMILY_DROPPED_EVENTS)
+            return fam["samples"][0]["value"] if fam else 0
+
+        timeline = Timeline(str(tmp_path / "t.json"))
+        timeline.meta("horovod_trace_meta", {"rank": 0})
+        timeline.close()
+        before = total()
+        timeline.counter("late", {"x": 1})
+        timeline.meta("late_meta", {"y": 2})
+        assert total() == before + 2
+
+    def test_disabled_timeline_drops_without_counting(self):
+        from horovod_tpu.obs.registry import registry
+        from horovod_tpu.utils.timeline import (
+            FAMILY_DROPPED_EVENTS,
+            Timeline,
+        )
+
+        def total():
+            fam = registry().snapshot().get(FAMILY_DROPPED_EVENTS)
+            return fam["samples"][0]["value"] if fam else 0
+
+        timeline = Timeline("")  # disabled: no path
+        timeline.close()
+        before = total()
+        timeline.counter("late", {"x": 1})
+        assert total() == before  # no artifact to truncate
+
+
+# -- health_report / introspect route (satellite) ------------------------------
+
+
+class TestHealthReport:
+    def test_shape_without_engine(self):
+        import horovod_tpu as hvd
+
+        report = hvd.health_report()
+        assert set(report) >= {"initialized", "engine", "controller",
+                               "flightrec"}
+        assert report["flightrec"]["capacity"] >= 1
+
+    def test_introspect_route_served(self):
+        import urllib.request
+
+        from horovod_tpu.obs import exposition, metrics_snapshot
+
+        server = exposition.MetricsServer(
+            0, lambda: {"world": metrics_snapshot(), "ranks": {}})
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/v1/introspect",
+                    timeout=10) as resp:
+                doc = json.loads(resp.read())
+            assert "flightrec" in doc and "engine" in doc
+        finally:
+            server.close()
+
+    def test_live_engine_snapshot(self, hvd):
+        import numpy as np
+
+        # the engine is lazy: one collective spins it up
+        hvd.allreduce(np.ones(4, np.float32), name="flightrec.health")
+        report = hvd.health_report()
+        assert report["initialized"] is True
+        engine = report["engine"]
+        assert engine is not None
+        assert engine["size"] == hvd.size()
+        assert "inflight_flushes" in engine
+        assert "cache" in engine and "applied_knobs" in engine
+
+
+# -- dump-on-abort worlds (the acceptance cells) -------------------------------
+
+
+def _abort_world_fn(steps):
+    """Per-rank body (shipped by value): allreduce loop that catches the
+    world fault and returns — the incident file is the artifact."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank = hvd.rank()
+    try:
+        for step in range(steps):
+            hvd.allreduce(np.full((16,), float(rank + step + 1),
+                                  np.float32),
+                          average=False, name="flightrec.abort")
+    except hvd.HorovodInternalError as exc:
+        return {"rank": rank, "outcome": "escalated",
+                "error_type": type(exc).__name__}
+    hvd.shutdown()
+    return {"rank": rank, "outcome": "healed"}
+
+
+def _run_abort_world(tmp_path, monkeypatch, extra, steps=6):
+    from horovod_tpu.runner import run
+
+    env = {
+        HOROVOD_NATIVE_CONTROLLER: "0",
+        HOROVOD_NATIVE_CORE: "0",
+        "HOROVOD_PLATFORM": "cpu",
+        "HOROVOD_CYCLE_TIME": "2",
+        HOROVOD_CHAOS: "",
+        HOROVOD_GRAD_SENTRY: "off",
+        HOROVOD_FLIGHTREC: "1",
+        HOROVOD_FLIGHTREC_DIR: str(tmp_path),
+        HOROVOD_FLIGHTREC_DUMP_TIMEOUT: "3",
+        HOROVOD_RECONNECT_ATTEMPTS: "3",
+        HOROVOD_RECONNECT_BACKOFF: "0.05",
+        HOROVOD_RECONNECT_WINDOW: "1",
+        HOROVOD_STALL_WARNING_TIME: "2",
+        HOROVOD_STALL_SHUTDOWN_TIME: "4",
+        **extra,
+    }
+    for key, value in env.items():
+        monkeypatch.setenv(key, value)
+    try:
+        return run(_abort_world_fn, args=(steps,), np=2,
+                   timeout_s=180.0, start_timeout_s=120.0)
+    except Exception:  # noqa: BLE001 - faulted worlds may fail the run
+        return None
+
+
+def _classified(tmp_path):
+    files = sorted(glob.glob(os.path.join(str(tmp_path),
+                                          "blackbox-*.json")))
+    assert files, "escalated world left no incident file"
+    docs = []
+    for path in files:
+        with open(path) as fh:
+            docs.append(json.load(fh))
+    return flightrec.classify_incident(flightrec.merge_incidents(docs))
+
+
+@pytest.mark.parametrize("core", ["0", "1"])
+def test_mp_kill_cell_names_the_dead_rank(tmp_path, monkeypatch, core):
+    """drop/close chaos exhausts rank 1's reconnect budget: the incident
+    classifier names the dead rank and the last agreed cycle — on both
+    negotiation cores."""
+    _run_abort_world(tmp_path, monkeypatch, {
+        HOROVOD_NATIVE_CORE: core,
+        HOROVOD_CHAOS: "close@rank1:msg6,refuse@relaunch:999"})
+    report = _classified(tmp_path)
+    assert report["verdict"].startswith("dead@rank1"), report
+    assert isinstance(report["last_agreed_cycle"], int), report
+
+
+@pytest.mark.parametrize("core", ["0", "1"])
+def test_mp_nan_cell_names_the_injected_rank(tmp_path, monkeypatch, core):
+    """nan@rank1 under sentry abort: the NaN implicates every rank
+    post-combine; the classifier names rank 1 off its recorded chaos
+    injection — on both negotiation cores."""
+    _run_abort_world(tmp_path, monkeypatch, {
+        HOROVOD_NATIVE_CORE: core,
+        HOROVOD_CHAOS: "nan@rank1:msg3",
+        HOROVOD_GRAD_SENTRY: "abort"})
+    report = _classified(tmp_path)
+    assert report["verdict"] == "nonfinite@rank1 step 3", report
+    assert report["chaos_ranks"] == [1], report
+
+
+def _hard_kill_world_fn(steps):
+    """Per-rank body where rank 1 dies HARD (``os._exit``, no handshake,
+    no exception handling): the launcher observes the nonzero exit and
+    must hold its teardown for the evidence grace so rank 0's collector
+    lands the dump (docs/blackbox.md §Limits)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank = hvd.rank()
+    for step in range(steps):
+        hvd.allreduce(np.full((16,), float(rank + step + 1), np.float32),
+                      average=False, name="flightrec.hardkill")
+        if step == 3 and rank == 1:
+            os._exit(17)
+    hvd.shutdown()
+    return {"rank": rank, "outcome": "healed"}
+
+
+def test_mp_hard_kill_grace_lands_the_dump(tmp_path, monkeypatch):
+    """rank 1 os._exits mid-step (uncaught, nonzero — the path the
+    launcher fail-fasts on): with the evidence grace armed, the
+    surviving coordinator still writes a classifiable incident naming
+    the dead rank before the LaunchError surfaces. With grace 0 (the
+    suite-wide conftest pin) this world provably loses the dump — the
+    grace is what makes a hard kill diagnosable."""
+    from horovod_tpu.runner import run
+    from horovod_tpu.runner.launcher import LaunchError
+
+    env = {
+        HOROVOD_NATIVE_CONTROLLER: "0",
+        HOROVOD_NATIVE_CORE: "0",
+        "HOROVOD_PLATFORM": "cpu",
+        "HOROVOD_CYCLE_TIME": "2",
+        HOROVOD_CHAOS: "",
+        HOROVOD_GRAD_SENTRY: "off",
+        HOROVOD_FLIGHTREC: "1",
+        HOROVOD_FLIGHTREC_DIR: str(tmp_path),
+        HOROVOD_FLIGHTREC_DUMP_TIMEOUT: "3",
+        HOROVOD_FLIGHTREC_LAUNCH_GRACE: "10",
+        HOROVOD_RECONNECT_WINDOW: "1",
+    }
+    for key, value in env.items():
+        monkeypatch.setenv(key, value)
+    with pytest.raises(LaunchError) as excinfo:
+        run(_hard_kill_world_fn, args=(6,), np=2,
+            timeout_s=180.0, start_timeout_s=120.0)
+    assert excinfo.value.rank == 1  # the original failure still surfaces
+    report = _classified(tmp_path)
+    assert report["verdict"].startswith("dead@rank1"), report
+    assert isinstance(report["last_agreed_cycle"], int), report
+
+
+def test_launch_grace_defaults_and_knob(monkeypatch, fresh_recorder):
+    monkeypatch.setenv(HOROVOD_RECONNECT_WINDOW, "2")
+    monkeypatch.setenv(HOROVOD_FLIGHTREC_DUMP_TIMEOUT, "3")
+    monkeypatch.delenv(HOROVOD_FLIGHTREC_LAUNCH_GRACE, raising=False)
+    assert flightrec.launch_grace_s() == 6.0  # window + timeout + 1
+    monkeypatch.setenv(HOROVOD_FLIGHTREC_LAUNCH_GRACE, "0")
+    assert flightrec.launch_grace_s() == 0.0
+    monkeypatch.setenv(HOROVOD_FLIGHTREC_LAUNCH_GRACE, "7.5")
+    assert flightrec.launch_grace_s() == 7.5
+    monkeypatch.setenv(HOROVOD_RECONNECT_WINDOW, "60")
+    monkeypatch.delenv(HOROVOD_FLIGHTREC_LAUNCH_GRACE, raising=False)
+    assert flightrec.launch_grace_s() == 15.0  # capped
+
+
+def test_launch_grace_zero_when_disabled(monkeypatch):
+    monkeypatch.setenv(HOROVOD_FLIGHTREC, "0")
+    monkeypatch.delenv(HOROVOD_FLIGHTREC_LAUNCH_GRACE, raising=False)
+    flightrec.reset_for_tests()
+    try:
+        assert flightrec.launch_grace_s() == 0.0
+    finally:
+        flightrec.reset_for_tests()
+
+
+def test_mp_clean_world_writes_nothing(tmp_path, monkeypatch):
+    results = _run_abort_world(tmp_path, monkeypatch, {})
+    assert results is not None and \
+        all(r["outcome"] == "healed" for r in results), results
+    assert glob.glob(os.path.join(str(tmp_path), "blackbox-*.json")) == []
+
+
+def test_mp_native_controller_local_degrade(tmp_path, monkeypatch):
+    """The native controller wire predates the flightrec RPC: each rank
+    writes a rank-local dump, and the report tool still merges them into
+    a classifiable incident."""
+    pytest.importorskip("horovod_tpu.cc")
+    from horovod_tpu import cc
+
+    if not cc.available():
+        pytest.skip("native controller not built on this image")
+    _run_abort_world(tmp_path, monkeypatch, {
+        HOROVOD_NATIVE_CONTROLLER: "1",
+        HOROVOD_CHAOS: "close@rank1:msg6,refuse@relaunch:999"})
+    files = glob.glob(os.path.join(str(tmp_path), "blackbox-*.json"))
+    assert files, "native-controller abort left no rank-local dump"
+    assert all(".rank" in os.path.basename(p) for p in files), files
+    report = _classified(tmp_path)
+    assert "rank1" in report["verdict"] or \
+        report["verdict"].startswith("abort"), report
+
+
+@pytest.mark.slow
+def test_dryrun_flightrec_certification():
+    """The full 2-proc certification in a subprocess (both negotiation
+    cores, nan cell, clean world, disabled knob)."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from __graft_entry__ import dryrun_flightrec; "
+         "dryrun_flightrec(); print('DRYRUN_FLIGHTREC_OK')"],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "DRYRUN_FLIGHTREC_OK" in proc.stdout

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Merge and classify black-box incident dumps (docs/blackbox.md).
+
+Reads one or more ``blackbox-*.json`` incident files — a coordinator's
+merged cross-rank dump, or the per-rank files the native-controller
+degrade writes — folds them into one document, and classifies it:
+
+    python tools/blackbox_report.py blackbox-full-2-0.json
+    python tools/blackbox_report.py /var/log/horovod/          # glob dir
+    python tools/blackbox_report.py bb.rank0.json bb.rank1.json
+
+Human-readable sections print first (the verdict line, the per-rank
+last-cycle table, the parked-rendezvous table, each rank's final
+events); the final stdout line is the classification as one JSON object
+(the repo's tool contract, like trace_merge/straggler_report).
+
+Verdict lines: ``stall@rank2 cycle 417`` (a stall escalation, with the
+last cycle every rank agrees on), ``consensus-fork@rank1 window 12``,
+``nonfinite@rank1 step 3``, ``dead@rank1 cycle 9``, ``desync:
+flush_ordinal``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# runnable straight from a checkout: `python tools/blackbox_report.py`
+# puts tools/ (not the repo root) on sys.path
+sys.path.insert(0, _REPO)
+
+
+def _load_classifier():
+    """The classifier lives in horovod_tpu.obs.flightrec — but this tool
+    must read incident files copied OFF a pod, on machines where
+    importing the package would pull in jax. flightrec.py keeps its
+    module level stdlib-only for exactly this: when the package import
+    fails, load the file directly (classification is pure dict math)."""
+    try:
+        from horovod_tpu.obs.flightrec import (
+            classify_incident,
+            merge_incidents,
+        )
+
+        return merge_incidents, classify_incident
+    except ImportError:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_blackbox_classifier",
+            os.path.join(_REPO, "horovod_tpu", "obs", "flightrec.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.merge_incidents, mod.classify_incident
+
+
+merge_incidents, classify_incident = _load_classifier()
+
+
+def _expand(paths):
+    out = []
+    for path in paths:
+        if os.path.isdir(path):
+            out.extend(sorted(glob.glob(
+                os.path.join(path, "blackbox-*.json"))))
+        else:
+            out.append(path)
+    return out
+
+
+_EVENT_DEFAULTS = [0, "", -1, -1, ""]
+
+
+def _fmt_event(event) -> str:
+    # pad per-FIELD so a short event gets each missing field's own
+    # sentinel (a 3-field event must read aux=-1, not aux=0)
+    event = list(event)[:5]
+    ts, kind, ordinal, aux, detail = event + _EVENT_DEFAULTS[len(event):]
+    parts = [f"{ts}us", str(kind)]
+    if ordinal not in (-1, None):
+        parts.append(f"ord={ordinal}")
+    if aux not in (-1, None):
+        parts.append(f"aux={aux}")
+    if detail:
+        parts.append(str(detail)[:60])
+    return " ".join(parts)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge + classify blackbox-*.json incident dumps")
+    ap.add_argument("paths", nargs="+",
+                    help="incident file(s), or a directory to glob")
+    ap.add_argument("--tail", type=int, default=8,
+                    help="per-rank final events to print (default 8)")
+    args = ap.parse_args(argv)
+
+    files = _expand(args.paths)
+    if not files:
+        print("no blackbox-*.json files found", file=sys.stderr)
+        return 1
+    docs = []
+    for path in files:
+        with open(path, "r", encoding="utf-8") as fh:
+            docs.append(json.load(fh))
+    merged = merge_incidents(docs)
+    report = classify_incident(merged)
+    report["sources"] = [os.path.basename(p) for p in files]
+
+    print(f"incident: world={report['world_id']} epoch={report['epoch']} "
+          f"({len(files)} file(s))")
+    print(f"verdict: {report['verdict']}")
+    reason = (report.get("reason") or "").replace("\n", " ")
+    if reason:
+        print(f"reason: {reason[:200]}")
+    print(f"last agreed cycle: {report['last_agreed_cycle']}  "
+          f"per-rank: {report['per_rank_last_cycle']}")
+    if report.get("chaos_ranks"):
+        print(f"fault injections recorded on rank(s): "
+              f"{report['chaos_ranks']}")
+    if report.get("first_diverging_rank") is not None:
+        print(f"first diverging rank: {report['first_diverging_rank']} "
+              f"(stream forks at: "
+              f"{_fmt_event(report['fork_event'] or [])})")
+    parked = report.get("parked_rendezvous") or {}
+    for channel, table in sorted(parked.items()):
+        if table:
+            print(f"parked {channel} rendezvous: {table}")
+    for rank in sorted(merged.get("ranks", {}), key=int):
+        payload = merged["ranks"][rank] or {}
+        events = payload.get("events", [])
+        offset = payload.get("clock_offset_us")
+        print(f"rank {rank}: {len(events)} retained events"
+              + (f", clock offset {offset}us" if offset is not None
+                 else "") +
+              (f", error: {str(payload.get('error'))[:120]}"
+               if payload.get("error") else ""))
+        for event in events[-args.tail:]:
+            print(f"    {_fmt_event(event)}")
+    # the one-line-JSON tool contract: the LAST stdout line parses
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

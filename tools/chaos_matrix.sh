@@ -38,6 +38,30 @@ if [ "${1:-}" = "--serving" ]; then
   exit $rc
 fi
 
+# --blackbox runs the flight-recorder assertion mode (docs/blackbox.md):
+# the escalation cell and the data-plane grid on both negotiation cores,
+# where every ESCALATED cell must also leave a classifiable
+# blackbox-*.json incident file — an escalation with no dump fails.
+if [ "${1:-}" = "--blackbox" ]; then
+  shift
+  rc=0
+  for core in 0 1; do
+    echo "=== blackbox escalation cell: HOROVOD_NATIVE_CORE=$core ==="
+    if ! JAX_PLATFORMS=cpu HOROVOD_NATIVE_CONTROLLER=0 \
+        HOROVOD_NATIVE_CORE=$core \
+        python -m horovod_tpu.chaos.matrix --escalation --blackbox "$@"; then
+      rc=1
+    fi
+    echo "=== blackbox data plane: HOROVOD_NATIVE_CORE=$core ==="
+    if ! JAX_PLATFORMS=cpu HOROVOD_NATIVE_CONTROLLER=0 \
+        HOROVOD_NATIVE_CORE=$core \
+        python -m horovod_tpu.chaos.matrix --data-plane --blackbox "$@"; then
+      rc=1
+    fi
+  done
+  exit $rc
+fi
+
 if [ "${1:-}" = "--data-plane" ]; then
   shift
   rc=0

@@ -90,6 +90,12 @@ INTEGRITY_PREFIXES = ("horovod_sentry_", "horovod_consensus_")
 # serving inside its SLO?" glance.
 SERVING_PREFIXES = ("horovod_serving_",)
 
+# Flight-recorder families (docs/blackbox.md): ring traffic, overwrites,
+# and incident dumps written/failed — the "would an abort leave a
+# postmortem?" glance, plus the timeline's own truncation counter (a
+# dropped trace event is the same black-box-coverage question).
+FLIGHTREC_PREFIXES = ("horovod_flightrec_", "horovod_timeline_dropped_")
+
 
 def _render_section(title: str, families: Dict[str, dict], prefix: str,
                     out, skip: tuple = ()) -> None:
@@ -131,6 +137,16 @@ def _render_serving_section(families: Dict[str, dict], prefix: str,
     _render_section("serving plane", serving, prefix, out)
 
 
+def _render_flightrec_section(families: Dict[str, dict], prefix: str,
+                              out) -> None:
+    flightrec = {n: f for n, f in families.items()
+                 if n.startswith(FLIGHTREC_PREFIXES)
+                 and n.startswith(prefix)}
+    if not flightrec:
+        return  # recorder disabled in this snapshot: no empty section
+    _render_section("flight recorder", flightrec, prefix, out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="pretty-print a saved /metrics.json or "
@@ -157,9 +173,10 @@ def main(argv=None) -> int:
     _render_tuning_section(world, args.family, sys.stdout)
     _render_integrity_section(world, args.family, sys.stdout)
     _render_serving_section(world, args.family, sys.stdout)
+    _render_flightrec_section(world, args.family, sys.stdout)
     _render_section("world", world, args.family, sys.stdout,
                     skip=TUNING_PREFIXES + INTEGRITY_PREFIXES
-                    + SERVING_PREFIXES)
+                    + SERVING_PREFIXES + FLIGHTREC_PREFIXES)
     # JSON round-trips rank keys as strings; accept either
     by_rank = {int(k): v for k, v in ranks.items()}
     wanted = sorted(by_rank) if args.all else (

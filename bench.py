@@ -554,6 +554,16 @@ def _parse_args(argv=None):
                              "screened for NaN/Inf on the eager plane and "
                              "guarded in the compiled SPMD step; trip "
                              "counters land in the BENCH json")
+    parser.add_argument("--tensorwatch", type=int, default=0,
+                        help="arm the gradient numerics observatory for "
+                             "this run (HOROVOD_TENSORWATCH_INTERVAL_"
+                             "STEPS=N, docs/tensorwatch.md): every Nth "
+                             "eager allreduce batch is measured — "
+                             "per-tensor norms, decode SNR, the top-k "
+                             "sparse-readiness curve — and SNR/top-k "
+                             "provenance lands in the BENCH json. "
+                             "Governs the eager control plane; SPMD "
+                             "steps have no engine batches to sample.")
     parser.add_argument("--_measure", action="store_true",
                         help=argparse.SUPPRESS)  # internal: child mode
     parser.add_argument("--warm-init-cache", action="store_true",
@@ -621,7 +631,9 @@ def _supervise(args) -> None:
         (["--autotune"] if args.autotune else []) + \
         (["--grad-sentry", args.grad_sentry] if args.grad_sentry else []) + \
         (["--subbuffers", str(args.subbuffers)] if args.subbuffers else []) + \
-        (["--fused-apply"] if args.fused_apply else [])
+        (["--fused-apply"] if args.fused_apply else []) + \
+        (["--tensorwatch", str(args.tensorwatch)]
+         if args.tensorwatch else [])
     import signal
     import subprocess as sp
 
@@ -785,6 +797,17 @@ def main() -> None:
         _log(f"fused reduce+apply armed: HOROVOD_FUSED_APPLY="
              f"{os.environ['HOROVOD_FUSED_APPLY']} (apply-batch and "
              f"dispatch provenance lands in the BENCH json)")
+
+    if args.tensorwatch:
+        # Gradient numerics observatory (docs/tensorwatch.md): like
+        # --grad-sentry, BEFORE hvd.init() reads the config; setdefault
+        # so an operator's explicit pin wins.
+        os.environ.setdefault("HOROVOD_TENSORWATCH_INTERVAL_STEPS",
+                              str(args.tensorwatch))
+        _log(f"numerics observatory armed: "
+             f"HOROVOD_TENSORWATCH_INTERVAL_STEPS="
+             f"{os.environ['HOROVOD_TENSORWATCH_INTERVAL_STEPS']} "
+             f"(SNR/top-k provenance lands in the BENCH json)")
 
     if args.autotune:
         # Closed-loop tuning plane (docs/autotune.md): like --timeline-dir,
@@ -979,6 +1002,8 @@ def main() -> None:
         provenance["subbuffers"] = args.subbuffers
     if args.fused_apply:
         provenance["fused_apply"] = True
+    if args.tensorwatch:
+        provenance["tensorwatch"] = args.tensorwatch
 
     for i in range(args.num_iters):
         t0 = time.perf_counter()
@@ -1073,6 +1098,43 @@ def main() -> None:
         batches = ap["fused_batches"] + ap["split_batches"]
         result["apply_dispatches_per_batch"] = round(
             ap["apply_dispatches"] / batches, 3) if batches else 0.0
+    if args.tensorwatch:
+        # numerics-observatory audit beside the number
+        # (docs/tensorwatch.md): sampled-batch count off the LIVE
+        # engine's watch (the --subbuffers pattern — no side-effect
+        # engine), worst decode SNR and the sparse-readiness curve off
+        # the registry gauges the observatory maintains.
+        from horovod_tpu.obs.tensorwatch import (
+            FAMILY_CODEC_SNR,
+            FAMILY_TOPK,
+            _labeled_values,
+        )
+        from horovod_tpu.ops import engine as _engine_mod
+
+        eng = _engine_mod._engine
+        watch = getattr(eng, "_tensorwatch", None) \
+            if eng is not None else None
+        tw = watch.stats() if watch is not None else {
+            "batches": 0, "samples": 0, "tensors": 0}
+        result["tensorwatch_samples"] = tw["samples"]
+        result["tensorwatch_tensors"] = tw["tensors"]
+        snap = hvd.metrics_snapshot()
+
+        def _labeled(family, label):
+            # the report fold's one definition of the labeled-samples
+            # extraction (obs.tensorwatch), not a local re-implementation
+            return _labeled_values(snap, family, label)
+
+        snrs = _labeled(FAMILY_CODEC_SNR, "codec")
+        if snrs:
+            result["tensorwatch_worst_snr_db"] = round(
+                min(snrs.values()), 2)
+            result["tensorwatch_snr_by_codec"] = {
+                c: round(v, 2) for c, v in sorted(snrs.items())}
+        topk = _labeled(FAMILY_TOPK, "k")
+        if topk:
+            result["tensorwatch_topk_mass"] = {
+                k: round(v, 4) for k, v in sorted(topk.items())}
     # cost_analysis() reports the per-device SPMD program's flops — and for
     # a lax.scan program it must count the loop BODY once, not times the
     # trip count, or mfu/tflops inflate by scan_batches. One body == one

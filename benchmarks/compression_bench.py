@@ -105,6 +105,7 @@ def main() -> None:
     from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
+    from horovod_tpu.obs.tensorwatch import snr_db
     from horovod_tpu.ops import spmd
     from horovod_tpu.ops.compression import Compression
 
@@ -117,10 +118,12 @@ def main() -> None:
     print(f"# quantized allreduce audit: {args.codec}, {n}-device mesh, "
           f"block={codec.BLOCK}")
     print(f"{'bucket':>10} {'flat B/rank':>12} {'quant B/rank':>12} "
-          f"{'reduction':>9} {'max err':>10} {'bound':>10} {'ok':>3}")
+          f"{'reduction':>9} {'max err':>10} {'bound':>10} "
+          f"{'meas SNR':>9} {'ok':>3}")
 
     worst_reduction = None
     worst_err_ratio = 0.0
+    worst_snr = None  # measured end-to-end wire SNR, min over the sweep
     rng = np.random.RandomState(0)
     for elems in sweep:
         xs = (rng.randn(n, elems).astype(np.float32)
@@ -156,9 +159,20 @@ def main() -> None:
         worst_err_ratio = max(worst_err_ratio, ratio)
         worst_reduction = reduction if worst_reduction is None else \
             min(worst_reduction, reduction)
+        # Measured end-to-end wire SNR beside the analytic bound: the
+        # actual quantized collective output vs the exact mean, through
+        # the ONE accounting definition (obs.tensorwatch.snr_db — the
+        # same formula the numerics observatory's in-job decode-SNR
+        # gauges use, docs/tensorwatch.md). The bound column says what
+        # the codec promises; this column says what THIS data measured.
+        sig = float((flat_out.astype(np.float64) ** 2).sum())
+        epow = float((err.astype(np.float64) ** 2).sum())
+        measured_snr = snr_db(sig, epow)
+        worst_snr = measured_snr if worst_snr is None \
+            else min(worst_snr, measured_snr)
         print(f"{elems * 4 // 1024:>9}K {flat_bytes:>12} {quant_bytes:>12} "
               f"{reduction:>8.2f}x {err.max():>10.2e} {bound.max():>10.2e} "
-              f"{'y' if ok else 'N'}", flush=True)
+              f"{measured_snr:>7.1f}dB {'y' if ok else 'N'}", flush=True)
         if not ok:
             print(f"AGREEMENT FAILURE at bucket {elems}: max err "
                   f"{err.max()} exceeds the documented bound", flush=True)
@@ -170,6 +184,7 @@ def main() -> None:
         "unit": "x_vs_f32",
         "devices": n,
         "max_err_over_bound": round(worst_err_ratio, 3),
+        "measured_snr_db_min": round(worst_snr, 2),
         "agreement_within_bound": True,
     }), flush=True)
 

@@ -78,7 +78,12 @@ from . import (
     serving,
     tune,
 )
-from .obs import health_report, metrics_snapshot, straggler_report
+from .obs import (
+    health_report,
+    metrics_snapshot,
+    straggler_report,
+    tensor_report,
+)
 from .basics import (
     cross_rank,
     cross_size,
@@ -150,7 +155,7 @@ __all__ = [
     "broadcast", "broadcast_async", "poll", "synchronize", "release",
     "Compression", "spmd", "parallel", "callbacks", "checkpoint",
     "elastic", "obs", "tune", "metrics_snapshot", "straggler_report",
-    "health_report",
+    "health_report", "tensor_report",
     "IndexedSlices", "allreduce_sparse", "flash_attention",
     "DistributedOptimizer", "allreduce_gradients", "apply_step",
     "fused_sgd", "fused_momentum", "fused_adam",

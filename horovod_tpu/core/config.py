@@ -225,6 +225,30 @@ HOROVOD_FLIGHTREC_DIR = "HOROVOD_FLIGHTREC_DIR"
 # the wait early, and clean worlds never enter it.
 HOROVOD_FLIGHTREC_LAUNCH_GRACE = "HOROVOD_FLIGHTREC_LAUNCH_GRACE_S"
 
+# --- gradient numerics observatory (horovod_tpu.obs.tensorwatch; ours,
+# docs/tensorwatch.md) --------------------------------------------------------
+# Sampled per-tensor gradient telemetry on the eager data plane: every N
+# allreduce batches the engine measures norm², max|g|, nonzero count, a
+# coarse log₂-magnitude occupancy histogram, the top-k mass-coverage
+# curve (sparse-readiness), and — for quantized codecs in play or
+# consented via HOROVOD_AUTOTUNE_CODECS — the decode-error SNR of this
+# rank's local contribution. 0 (default) disables: no observatory
+# object, zero allocations on the hot path (the flightrec bar).
+HOROVOD_TENSORWATCH_INTERVAL = "HOROVOD_TENSORWATCH_INTERVAL_STEPS"
+# Decode-SNR floor (dB) of the evidence gate: the autotuner's lossy
+# codec move is only proposed once HOROVOD_TENSORWATCH_SNR_WINDOW
+# consecutive sampled SNRs certify above this floor, and a sampled SNR
+# falling below it while the codec is applied triggers a revert through
+# the best-known-config guard (decision-log audited).
+HOROVOD_TENSORWATCH_SNR_FLOOR = "HOROVOD_TENSORWATCH_SNR_FLOOR_DB"
+HOROVOD_TENSORWATCH_SNR_WINDOW = "HOROVOD_TENSORWATCH_SNR_WINDOW"
+# Cardinality cap of the labeled horovod_tensor_* families: only the K
+# worst tensors (lowest SNR, else largest norm) carry labels on the
+# registry; the FULL per-tensor table is hvd.tensor_report() /
+# GET /v1/tensors (label values must stay low-cardinality by the
+# registry's contract — never one per tensor of a large model).
+HOROVOD_TENSORWATCH_WORST = "HOROVOD_TENSORWATCH_WORST_K"
+
 # --- observability plane (horovod_tpu.obs; ours, docs/metrics.md) ------------
 # HTTP exposition of the metrics registry on rank 0: Prometheus text at
 # /metrics, JSON snapshot at /metrics.json, loopback-bound. 0 or unset =
@@ -397,6 +421,11 @@ class Config:
     # data-plane integrity plane (docs/integrity.md)
     grad_sentry: str = "off"
     consensus_interval_steps: int = 0
+    # gradient numerics observatory (docs/tensorwatch.md)
+    tensorwatch_interval_steps: int = 0
+    tensorwatch_snr_floor_db: float = 20.0
+    tensorwatch_snr_window: int = 5
+    tensorwatch_worst_k: int = 8
     # True when HOROVOD_CACHE_CAPACITY was set explicitly: the tuner then
     # treats the capacity knob as pinned (same contract as
     # fusion_threshold_explicit below).
@@ -480,6 +509,14 @@ class Config:
                          .strip().lower() or "off"),
             consensus_interval_steps=max(
                 _env_int(HOROVOD_CONSENSUS_INTERVAL, 0), 0),
+            tensorwatch_interval_steps=max(
+                _env_int(HOROVOD_TENSORWATCH_INTERVAL, 0), 0),
+            tensorwatch_snr_floor_db=_env_float(
+                HOROVOD_TENSORWATCH_SNR_FLOOR, 20.0),
+            tensorwatch_snr_window=max(
+                _env_int(HOROVOD_TENSORWATCH_SNR_WINDOW, 5), 1),
+            tensorwatch_worst_k=max(
+                _env_int(HOROVOD_TENSORWATCH_WORST, 8), 1),
             cache_capacity_explicit=bool(
                 os.environ.get(HOROVOD_CACHE_CAPACITY)),
             start_timeout_s=_env_float(

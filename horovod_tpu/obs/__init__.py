@@ -35,6 +35,8 @@ from .registry import (  # noqa: F401 - public surface
 from .bridge import TimelineBridge  # noqa: F401
 from . import exposition  # noqa: F401
 from . import flightrec  # noqa: F401 - public surface (docs/blackbox.md)
+from . import tensorwatch  # noqa: F401 - public surface (docs/tensorwatch.md)
+from .tensorwatch import tensor_report  # noqa: F401
 from .tracing import (  # noqa: F401 - public surface (docs/tracing.md)
     ClockSync,
     build_straggler_report,

@@ -245,9 +245,20 @@ def metrics_routes(provider: Callable[[], dict]):
         return HttpResponse(200, "application/json",
                             json.dumps(health_report()).encode())
 
+    def _tensors(_query, _headers, _body) -> HttpResponse:
+        # Numerics observatory (docs/tensorwatch.md): the FULL per-
+        # tensor table + evidence-gate state — the registry only
+        # carries the bounded worst-K labels, this route carries
+        # everything. Lazy import like _introspect.
+        from .tensorwatch import tensor_report
+
+        return HttpResponse(200, "application/json",
+                            json.dumps(tensor_report()).encode())
+
     return {("GET", "/metrics"): _metrics,
             ("GET", "/metrics.json"): _metrics_json,
-            ("GET", "/v1/introspect"): _introspect}
+            ("GET", "/v1/introspect"): _introspect,
+            ("GET", "/v1/tensors"): _tensors}
 
 
 class MetricsServer:

@@ -72,6 +72,9 @@ EV_SERVING_BATCH = "serving_batch"      # ordinal=batch ordinal
 EV_SERVING_DIGEST = "serving_digest"    # ordinal=batch ordinal
 EV_SERVING_DISPATCH = "serving_dispatch"  # ordinal=batch ordinal (driver)
 EV_FUSED_APPLY = "fused_apply"    # ordinal=cycle, detail=fused/split
+EV_TENSORWATCH = "tensorwatch"    # ordinal=batch, detail=codec:SNRdb —
+#                                   a sampled decode SNR near or below
+#                                   the evidence floor (docs/tensorwatch.md)
 EV_ESCALATE = "escalate"          # coordinator escalation, detail=reason
 EV_ABORT = "abort"                # rank-side abort, detail=reason
 

@@ -329,6 +329,23 @@ def merge_snapshots(snapshots: Iterable[Dict[str, dict]]) -> Dict[str, dict]:
                         raise ValueError(
                             f"metric {name!r} histogram bounds differ "
                             f"across ranks; cannot merge")
+                    if len(into["buckets"]) != len(sample["buckets"]):
+                        # The +Inf overflow bucket is the LAST slot
+                        # (len(bounds)+1 buckets by construction, and
+                        # quantile readers return None when a quantile
+                        # lands there). A truncated bucket list would
+                        # make the zip below silently DROP the overflow
+                        # counts from the world fold — exactly the
+                        # collapse a malformed/old-format snapshot could
+                        # smuggle in — so mismatched lengths fail as
+                        # loudly as mismatched bounds.
+                        raise ValueError(
+                            f"metric {name!r} histogram bucket count "
+                            f"differs across ranks "
+                            f"({len(into['buckets'])} vs "
+                            f"{len(sample['buckets'])}); a truncated "
+                            f"list would silently drop the +Inf "
+                            f"overflow bucket from the world fold")
                     into["buckets"] = [a + b for a, b in
                                        zip(into["buckets"],
                                            sample["buckets"])]

@@ -1,0 +1,864 @@
+"""Gradient numerics observatory (docs/tensorwatch.md).
+
+PRs 5/6/14 made the control plane, wire, and failure paths observable;
+this module is the missing layer for the *numerical content* of the data
+plane. On sampled steps (``HOROVOD_TENSORWATCH_INTERVAL_STEPS``, 0 =
+off) the engine hands each reduced allreduce batch to a
+:class:`TensorWatch`, which measures per tensor:
+
+* ``norm²``, ``max|g|``, nonzero count — the basic gradient-health
+  scalars;
+* a coarse log₂-magnitude occupancy histogram (which exponent decades
+  the mass lives in — the dynamic-range picture a quantized wire cares
+  about);
+* the top-k mass-coverage curve — fraction of ``‖g‖²`` held by the top
+  0.1 / 1 / 10 % entries, the sparse-readiness statistic deep-gradient-
+  compression work (DGC-style top-k, see PAPERS.md) assumes you already
+  have when sizing k;
+* for every quantized codec *in play* (active on the batch, or
+  consented via ``HOROVOD_AUTOTUNE_CODECS``): the decode-error SNR of
+  this rank's LOCAL contribution — one encode→decode leg through the
+  exact EQuARX block math (``Compression.*.roundtrip_error`` /
+  ``ops.spmd.codec_roundtrip``, one definition pinned by tests), so
+  wire error is measured where it happens, before any collective.
+
+Results land three ways (docs/metrics.md "numerics observatory"):
+bounded-cardinality registry families (only the K worst tensors carry
+labels — ``HOROVOD_TENSORWATCH_WORST_K``), the FULL table via
+``hvd.tensor_report()`` / ``GET /v1/tensors`` on the shared httpd, and
+cross-rank via the existing metrics-publisher fold, where the per-rank
+``horovod_tensor_prenorm2`` gauges double as a data-skew detector (a
+rank whose local gradient norm persistently dwarfs its peers' is
+feeding skewed data).
+
+The loop closes through the **evidence gate**: the autotuner's lossy
+codec knob (PR 7's ``HOROVOD_AUTOTUNE_CODECS`` consent) is no longer
+operator faith — a lossy retune is only *proposed* once
+``HOROVOD_TENSORWATCH_SNR_WINDOW`` consecutive sampled SNRs certify
+above ``HOROVOD_TENSORWATCH_SNR_FLOOR_DB``, and an in-flight SNR
+collapse reverts the codec through the policy's best-known-config
+guard, decision-log audited with the evidence record.
+
+Layering, matching ``obs/tracing.py``/``obs/flightrec.py``: the module
+level is deliberately STDLIB-ONLY (numpy/package imports live inside
+the functions that need them), so ``tools/tensorwatch_report.py`` can
+load this file directly on jax-less workstations — the report fold
+(:func:`build_tensor_report`) is pure dict math over a saved
+``/metrics.json`` document.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# -- metric family names (docs/metrics.md "numerics observatory") --------------
+FAMILY_SAMPLES = "horovod_tensorwatch_samples_total"
+FAMILY_TENSORS = "horovod_tensorwatch_tensors"
+FAMILY_NONFINITE = "horovod_tensorwatch_nonfinite_skips_total"
+FAMILY_FLOOR_MISSES = "horovod_tensorwatch_snr_floor_misses_total"
+FAMILY_CODEC_SNR = "horovod_codec_snr_db"
+FAMILY_TOPK = "horovod_tensorwatch_topk_mass"
+FAMILY_TENSOR_NORM2 = "horovod_tensor_norm2"
+FAMILY_TENSOR_PRENORM2 = "horovod_tensor_prenorm2"
+FAMILY_TENSOR_SNR = "horovod_tensor_snr_db"
+
+# The knob name the evidence gate guards on the autotune ladder — a
+# deliberate small copy of ``tune.policy.KNOB_CODEC`` (cross-pinned by
+# test), so this module's exec-fallback load never imports the package.
+CODEC_KNOB = "codec"
+
+# The quantized (lossy, SNR-measurable) codec tags — a deliberate small
+# copy of the ``Compression.int8/fp8`` quantized set (cross-pinned by
+# test): the observatory measures decode SNR only where a decode exists.
+QUANTIZED_CODECS = ("int8", "fp8")
+
+# Top-k mass-coverage curve points: fraction of ‖g‖² in the top q of
+# entries (the ROADMAP sparse-wire item's k ∈ {0.1%, 1%, 10%} design
+# points). Keys are the label values of FAMILY_TOPK.
+TOPK_FRACTIONS = (("0.1", 0.001), ("1", 0.01), ("10", 0.1))
+
+# Coarse log₂-magnitude occupancy histogram geometry: bin i counts
+# elements with floor(log2|g|) == LOG2_HIST_MIN + i (clamped at both
+# ends); zeros are excluded (size - nnz recovers them).
+LOG2_HIST_MIN = -24
+LOG2_HIST_BINS = 32
+
+# Lossless measurements (zero error power) report this instead of +Inf:
+# Infinity is not an RFC JSON token and would break the tools' one-line
+# JSON contract (the PR 6 histogram-quantile lesson).
+SNR_CAP_DB = 200.0
+
+# SNRs within this many dB above the floor record a flightrec near-miss
+# event (docs/blackbox.md EV_TENSORWATCH) — the postmortem breadcrumb
+# for "the codec was one bad batch away from a revert".
+NEAR_MISS_MARGIN_DB = 3.0
+
+# Evidence-gate defaults — the single definition shared by the lazy
+# env-built gate and core/config's resolved knobs (HOROVOD_TENSORWATCH_
+# SNR_FLOOR_DB / _SNR_WINDOW must certify and revert against the same
+# floor the observatory's floor-miss counter uses).
+DEFAULT_SNR_FLOOR_DB = 20.0
+DEFAULT_SNR_WINDOW = 5
+
+
+def snr_db(signal_power: float, error_power: float) -> float:
+    """THE single accounting definition of measured decode SNR (the
+    ``Compression.wire_cost`` precedent): ``10·log₁₀(Σx² / Σe²)``,
+    capped at :data:`SNR_CAP_DB` for lossless measurements and floored
+    at 0-signal. A NON-FINITE power (a NaN gradient reached the sampled
+    measurement — the observatory is pre-sentry by design, or an f32
+    accumulator overflowed) reports 0 dB: conservative for the evidence
+    gate (never certifies, de-certifies an applied codec) and keeps
+    NaN/Infinity out of the gauges and the RFC-JSON surfaces (the PR 6
+    lesson). Shared by the observatory, the compression bench's
+    measured-SNR column, and the tests' NumPy reference."""
+    signal_power = float(signal_power)
+    error_power = float(error_power)
+    if not (math.isfinite(signal_power) and math.isfinite(error_power)):
+        return 0.0
+    if signal_power <= 0.0:
+        return 0.0
+    if error_power <= 0.0:
+        return SNR_CAP_DB
+    return min(10.0 * math.log10(signal_power / error_power), SNR_CAP_DB)
+
+
+def watch_codecs(cfg) -> Tuple[str, ...]:
+    """The quantized codecs the observatory measures for a Config: the
+    active ``HOROVOD_COMPRESSION`` codec when it is a quantized one,
+    plus every ``HOROVOD_AUTOTUNE_CODECS`` consent candidate — measured
+    BEFORE the tuner may apply them, which is what the evidence gate
+    certifies on."""
+    out: List[str] = []
+    active = getattr(cfg, "compression", "none")
+    if active in QUANTIZED_CODECS:
+        out.append(active)
+    for codec in getattr(cfg, "autotune_codecs", ()) or ():
+        if codec in QUANTIZED_CODECS and codec not in out:
+            out.append(codec)
+    return tuple(out)
+
+
+# -- numpy measurement kernels (package-level callers only) --------------------
+
+
+def _np_tensor_stats(arr) -> dict:
+    """Per-tensor stats of one reduced gradient (host path). Float64
+    accumulation: norm² of an fp16-ish tensor must not overflow the
+    measurement. Read-only by construction — the observatory must be
+    bit-exactness-neutral on the training result."""
+    import numpy as np
+
+    flat = np.asarray(arr).reshape(-1)
+    n = int(flat.size)
+    if n == 0 or not np.issubdtype(flat.dtype, np.floating):
+        flat = np.asarray(flat, np.float64).reshape(-1)
+    a = np.abs(flat.astype(np.float64, copy=False))
+    a2 = a * a
+    norm2 = float(a2.sum())
+    absmax = float(a.max()) if n else 0.0
+    nnz = int(np.count_nonzero(a))
+    if nnz:
+        nz = a[a > 0]
+        e = np.clip(np.floor(np.log2(nz)), LOG2_HIST_MIN,
+                    LOG2_HIST_MIN + LOG2_HIST_BINS - 1)
+        hist = np.bincount((e - LOG2_HIST_MIN).astype(np.int64),
+                           minlength=LOG2_HIST_BINS)
+    else:
+        hist = np.zeros(LOG2_HIST_BINS, np.int64)
+    topk: Dict[str, float] = {}
+    total = max(norm2, 1e-300)
+    for key, q in TOPK_FRACTIONS:
+        k = max(1, int(math.ceil(q * n))) if n else 1
+        if n == 0:
+            topk[key] = 0.0
+        elif k >= n:
+            topk[key] = 1.0
+        else:
+            topk[key] = float(np.partition(a2, n - k)[n - k:].sum() / total)
+    return {"elems": n, "norm2": norm2, "absmax": absmax, "nnz": nnz,
+            "log2_hist": [int(c) for c in hist], "topk": topk}
+
+
+def _np_norm2(arr) -> float:
+    """Norm² alone (host path) — the pre-reduce local contribution only
+    needs this one scalar (the skew detector's input), so the sampled
+    step must not pay the full stats program (sort/cumsum/histogram)
+    twice per tensor."""
+    import numpy as np
+
+    flat = np.asarray(arr).reshape(-1)
+    if flat.size == 0:
+        return 0.0
+    a = flat.astype(np.float64, copy=False)
+    return float((a * a).sum())
+
+
+def _np_codec_snr(arr, codec_name: str, size: int) -> Optional[float]:
+    """Decode-error SNR of one local contribution through ``codec_name``
+    (host path): ``Compression.*.roundtrip_error`` is the single
+    definition of the encode→decode leg (docs/compression.md)."""
+    import numpy as np
+
+    from ..ops.compression import Compression
+
+    codec = Compression.lookup(codec_name)
+    if not getattr(codec, "quantized", False):
+        return None
+    flat = np.asarray(arr).reshape(-1)
+    if not np.issubdtype(flat.dtype, np.floating) or flat.size == 0:
+        return None
+    sp, ep = codec.roundtrip_error(flat.astype(np.float32, copy=False),
+                                   size)
+    return snr_db(sp, ep)
+
+
+# -- evidence gate -------------------------------------------------------------
+
+
+class EvidenceGate:
+    """Measured-SNR consent gate for the autotuner's lossy codec knob
+    (docs/tensorwatch.md): a codec is *certified* once ``window``
+    consecutive sampled SNRs land at or above ``floor_db``; a sample
+    below the floor de-certifies it, and — when the drop happened while
+    certified — latches an in-flight *collapse* that the tuning plane
+    consumes as a forced revert through the best-known-config guard.
+    Collapse latches clear on re-certification, so a dip observed while
+    the codec was never applied can't force a spurious revert later."""
+
+    def __init__(self, floor_db: float, window: int) -> None:
+        self.floor_db = float(floor_db)
+        self.window = max(int(window), 1)
+        self._lock = threading.Lock()
+        self._history: Dict[str, object] = {}
+        self._certified: Dict[str, bool] = {}
+        self._certified_at: Dict[str, int] = {}
+        self._collapsed: Dict[str, bool] = {}
+        self.samples = 0
+        self.floor_misses = 0
+
+    def observe(self, codec: str, value_db: float) -> None:
+        with self._lock:
+            self.samples += 1
+            hist = self._history.get(codec)
+            if hist is None:
+                hist = self._history[codec] = deque(maxlen=self.window)
+            hist.append(float(value_db))
+            if value_db < self.floor_db:
+                self.floor_misses += 1
+                if self._certified.get(codec):
+                    # in-flight collapse: the evidence that admitted the
+                    # codec no longer holds — the tuning plane reverts
+                    self._collapsed[codec] = True
+                self._certified[codec] = False
+            elif not self._certified.get(codec) and \
+                    len(hist) == self.window and \
+                    all(v >= self.floor_db for v in hist):
+                self._certified[codec] = True
+                self._certified_at[codec] = self.samples
+                self._collapsed.pop(codec, None)
+
+    def allows(self, codec: str) -> bool:
+        with self._lock:
+            return bool(self._certified.get(codec))
+
+    def take_collapse(self, codec: str) -> bool:
+        """Consume a latched in-flight collapse (the forced-revert
+        trigger fires exactly once per collapse)."""
+        with self._lock:
+            return bool(self._collapsed.pop(codec, False))
+
+    def evidence_record(self, codec: str) -> dict:
+        """The audited evidence behind an admit/revert decision — rides
+        the JSONL decision log (docs/autotune.md)."""
+        with self._lock:
+            hist = self._history.get(codec)
+            return {
+                "codec": codec,
+                "floor_db": self.floor_db,
+                "window": self.window,
+                "snr_db_window": [round(v, 3) for v in hist] if hist
+                else [],
+                "certified": bool(self._certified.get(codec)),
+                "certified_at_sample": self._certified_at.get(codec),
+                "samples": self.samples,
+                "floor_misses": self.floor_misses,
+            }
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "floor_db": self.floor_db,
+                "window": self.window,
+                "samples": self.samples,
+                "floor_misses": self.floor_misses,
+                "certified": {c: bool(v) for c, v in
+                              self._certified.items()},
+                "collapsed": sorted(c for c, v in self._collapsed.items()
+                                    if v),
+            }
+
+
+class PolicyGate:
+    """Duck-typed adapter the :class:`tune.policy.TuningPolicy` consults
+    (``propose_gate=``): ``allows``/``evidence`` guard the codec knob's
+    proposals, ``maybe_revert`` converts a latched SNR collapse into the
+    policy's evidence-audited revert. Non-codec knobs pass through."""
+
+    def __init__(self, gate: EvidenceGate) -> None:
+        self._gate = gate
+
+    def allows(self, knob: str, value) -> bool:
+        if knob != CODEC_KNOB or value in (None, "none"):
+            return True
+        return self._gate.allows(str(value))
+
+    def evidence(self, knob: str, value) -> Optional[dict]:
+        if knob != CODEC_KNOB or value in (None, "none"):
+            return None
+        return self._gate.evidence_record(str(value))
+
+    def maybe_revert(self, policy):
+        """Forced revert on in-flight collapse: when the policy's live
+        codec is lossy and its gate evidence collapsed, roll the knob
+        back to "none" through ``TuningPolicy.evidence_revert`` (the
+        best-known-config guard's bookkeeping, decision-log audited).
+        Returns the Decision, or None when nothing collapsed."""
+        current = policy.config().get(CODEC_KNOB)
+        if current in (None, "none"):
+            return None
+        codec = str(current)
+        if not self._gate.take_collapse(codec):
+            return None
+        return policy.evidence_revert(
+            CODEC_KNOB, "none", evidence=self._gate.evidence_record(codec))
+
+
+_gate: Optional[EvidenceGate] = None
+_gate_built = False
+_gate_lock = threading.Lock()
+
+
+def evidence_gate() -> Optional[EvidenceGate]:
+    """The process-global evidence gate, built from env on first use —
+    present iff the observatory is armed (interval > 0), so a world
+    without tensorwatch keeps the PR 7 consent-only behavior
+    byte-identically."""
+    global _gate, _gate_built
+    with _gate_lock:
+        if not _gate_built:
+            from ..core.config import (
+                HOROVOD_TENSORWATCH_INTERVAL,
+                HOROVOD_TENSORWATCH_SNR_FLOOR,
+                HOROVOD_TENSORWATCH_SNR_WINDOW,
+                _env_float,
+                _env_int,
+            )
+
+            interval = max(_env_int(HOROVOD_TENSORWATCH_INTERVAL, 0), 0)
+            if interval > 0:
+                _gate = EvidenceGate(
+                    _env_float(HOROVOD_TENSORWATCH_SNR_FLOOR,
+                               DEFAULT_SNR_FLOOR_DB),
+                    max(_env_int(HOROVOD_TENSORWATCH_SNR_WINDOW,
+                                 DEFAULT_SNR_WINDOW), 1))
+            _gate_built = True
+        return _gate
+
+
+def ensure_gate(floor_db: float, window: int) -> EvidenceGate:
+    """Build (or return) the process-global gate with RESOLVED knob
+    values — ``from_config`` routes the engine's ``Config`` here so the
+    gate certifies/reverts against the same floor the observatory's
+    floor-miss counter and near-miss events use, even for Configs
+    constructed programmatically rather than from env. First build
+    wins; in production both paths resolve the same env."""
+    global _gate, _gate_built
+    with _gate_lock:
+        if _gate is None:
+            _gate = EvidenceGate(floor_db, window)
+            _gate_built = True
+        return _gate
+
+
+def policy_gate(cfg=None) -> Optional[PolicyGate]:
+    """The autotuner's gate hook (``ops.autotuner``): None when the
+    observatory is disarmed — the codec knob then behaves exactly as
+    before this plane existed. With a resolved ``Config`` the gate is
+    built from ITS knob values (``ensure_gate``): the Autotuner is
+    constructed before the engine's observatory in the same
+    ``Engine.__init__``, so a programmatic Config (env unset) must not
+    latch the env-lazy singleton to None and silently run consent-only
+    while the observatory feeds a gate nobody consults."""
+    if cfg is not None:
+        if getattr(cfg, "tensorwatch_interval_steps", 0) <= 0:
+            return None
+        return PolicyGate(ensure_gate(cfg.tensorwatch_snr_floor_db,
+                                      cfg.tensorwatch_snr_window))
+    gate = evidence_gate()
+    return PolicyGate(gate) if gate is not None else None
+
+
+def reset_for_tests() -> None:
+    """Rebuild the gate from the current env (tests flip the knobs
+    in-process; production processes build exactly one)."""
+    global _gate, _gate_built
+    with _gate_lock:
+        _gate = None
+        _gate_built = False
+
+
+# -- the observatory -----------------------------------------------------------
+
+
+def _families():
+    """The one registration site for the observatory's metric families
+    (package import kept function-level; see module docstring).
+    Cardinality contract: the ``tensor`` label only ever carries the
+    worst-K set (plus retired members pinned to 0), never one child per
+    model tensor."""
+    from .registry import registry as _metrics
+
+    reg = _metrics()
+    return {
+        "samples": reg.counter(
+            FAMILY_SAMPLES,
+            "Allreduce batches the numerics observatory sampled"),
+        "tensors": reg.gauge(
+            FAMILY_TENSORS,
+            "Distinct tensors in the live per-tensor numerics table "
+            "(full table: hvd.tensor_report() / GET /v1/tensors)"),
+        "nonfinite": reg.counter(
+            FAMILY_NONFINITE,
+            "Sampled tensors skipped because their measurement was "
+            "non-finite (NaN gradients reach the observatory pre-"
+            "sentry by design; the sentry is the diagnosis plane, "
+            "these gauges must stay RFC-JSON-finite)"),
+        "floor_misses": reg.counter(
+            FAMILY_FLOOR_MISSES,
+            "Sampled decode SNRs below HOROVOD_TENSORWATCH_SNR_FLOOR_DB",
+            labels=("codec",)),
+        "codec_snr": reg.gauge(
+            FAMILY_CODEC_SNR,
+            "Worst per-tensor decode-error SNR (dB) of the last sampled "
+            "batch, by quantized codec (local encode->decode leg; "
+            "lossless caps at 200)", labels=("codec",)),
+        "topk": reg.gauge(
+            FAMILY_TOPK,
+            "Fraction of the sampled batch's gradient energy in the "
+            "top k% entries (the sparse-readiness curve)",
+            labels=("k",)),
+        "norm2": reg.gauge(
+            FAMILY_TENSOR_NORM2,
+            "Post-reduce gradient norm-squared of the current worst-K "
+            "tensors (0 = tensor left the worst set)",
+            labels=("tensor",)),
+        "prenorm2": reg.gauge(
+            FAMILY_TENSOR_PRENORM2,
+            "This rank's PRE-reduce local contribution norm-squared for "
+            "the worst-K tensors — per-rank spread across the "
+            "/metrics.json rank sections is the data-skew detector",
+            labels=("tensor",)),
+        "snr": reg.gauge(
+            FAMILY_TENSOR_SNR,
+            "Per-tensor decode SNR (dB, min across watched codecs) for "
+            "the worst-K tensors", labels=("tensor",)),
+    }
+
+
+class TensorWatch:
+    """Sampled per-tensor gradient telemetry for one engine.
+
+    ``begin_batch`` advances the batch ordinal — batches execute in
+    negotiated order, so ordinal N names the SAME batch on every rank
+    and the sampling decision (``ordinal % interval == 0``) is
+    rank-identical by construction, like the sentry's ordinals. The
+    non-sampled path is integer arithmetic only (zero-allocation,
+    pinned by the tracemalloc test); the disabled plane is no
+    ``TensorWatch`` object at all (engine holds ``None``).
+
+    ``probe``/``snr_probe`` are the XLA plane's compiled collective-free
+    measurement programs (``XlaDataPlane.tensorwatch_stats`` /
+    ``codec_snr``) — device-resident batches sync a handful of scalars
+    instead of pulling buffers to host (the PR 8 two-scalar census
+    pattern); numpy batches measure host-side."""
+
+    def __init__(self, interval: int, size: int = 1, rank: int = 0,
+                 snr_floor_db: float = 20.0, worst_k: int = 8,
+                 codecs: Sequence[str] = (),
+                 probe: Optional[Callable] = None,
+                 snr_probe: Optional[Callable] = None,
+                 norm2_probe: Optional[Callable] = None,
+                 timeline=None,
+                 gate: Optional[EvidenceGate] = None) -> None:
+        self.interval = max(int(interval), 1)
+        self.size = max(int(size), 1)
+        self.rank = int(rank)
+        self.snr_floor_db = float(snr_floor_db)
+        self.worst_k = max(int(worst_k), 1)
+        self.codecs = tuple(c for c in codecs if c in QUANTIZED_CODECS)
+        self._probe = probe
+        self._snr_probe = snr_probe
+        self._norm2_probe = norm2_probe
+        self._timeline = timeline
+        self._gate = gate if gate is not None else evidence_gate()
+        self.ordinal = 0
+        self.sampling = False
+        self.samples = 0
+        self._lock = threading.Lock()
+        self._table: Dict[str, dict] = {}
+        self._labeled: set = set()
+        self._fams = None
+        self._warned = False
+
+    # -- hot path (every allreduce batch) -------------------------------------
+
+    def begin_batch(self) -> None:
+        """Advance the batch ordinal and decide whether this batch is
+        sampled. Integer arithmetic only — the per-batch cost of an
+        armed-but-idle observatory."""
+        self.ordinal += 1
+        self.sampling = self.ordinal % self.interval == 0
+
+    # -- sampled path ---------------------------------------------------------
+
+    def observe_batch(self, names: Sequence[str], locals_: Sequence,
+                      results: Sequence, codec: str = "none") -> None:
+        """Measure one sampled reduced batch: ``locals_`` are this
+        rank's pre-reduce contributions (the SNR reference and the skew
+        detector's input), ``results`` the reduced values as received
+        (pre-sentry, like consensus). Strictly read-only; a measurement
+        failure is counted-and-logged, never raised into the batch."""
+        try:
+            self._observe(list(names), list(locals_), list(results),
+                          codec)
+        except Exception as exc:  # noqa: BLE001 - observability must
+            # never kill a batch it watches
+            if not self._warned:
+                self._warned = True
+                from ..core.logging import LOG
+
+                LOG.warning(
+                    "tensorwatch: sampled measurement failed (%s); "
+                    "telemetry for this batch is dropped", exc)
+
+    def _measure_stats(self, arr) -> dict:
+        import numpy as np
+
+        if self._probe is not None and not isinstance(arr, np.ndarray):
+            return self._probe(arr)
+        return _np_tensor_stats(arr)
+
+    def _measure_norm2(self, arr) -> float:
+        import numpy as np
+
+        if self._norm2_probe is not None and \
+                not isinstance(arr, np.ndarray):
+            return self._norm2_probe(arr)
+        return _np_norm2(arr)
+
+    def _measure_snr(self, arr, codec: str) -> Optional[float]:
+        import numpy as np
+
+        if self._snr_probe is not None and \
+                not isinstance(arr, np.ndarray):
+            sp, ep = self._snr_probe(arr, codec)
+            return snr_db(sp, ep)
+        return _np_codec_snr(arr, codec, self.size)
+
+    def _observe(self, names: List[str], locals_: List, results: List,
+                 codec: str) -> None:
+        if self._fams is None:
+            self._fams = _families()
+        fams = self._fams
+        self.samples += 1
+        fams["samples"].inc()
+        measured = []
+        if codec in QUANTIZED_CODECS:
+            measured.append(codec)
+        for cand in self.codecs:
+            if cand not in measured:
+                measured.append(cand)
+        rows: Dict[str, dict] = {}
+        batch_norm2 = 0.0
+        batch_topk = {key: 0.0 for key, _ in TOPK_FRACTIONS}
+        batch_min_snr: Dict[str, float] = {}
+        for name, local, result in zip(names, locals_, results):
+            stats = self._measure_stats(result)
+            # pre-reduce side: one scalar only (the skew detector's
+            # input), never the full stats program a second time
+            pre_norm2 = self._measure_norm2(local)
+            if not (math.isfinite(stats["norm2"])
+                    and math.isfinite(stats["absmax"])
+                    and math.isfinite(pre_norm2)):
+                # a NaN/Inf gradient reached the sampled measurement —
+                # the observatory is PRE-sentry by design, so this is
+                # expected under chaos/real nonfinite worlds; the
+                # sentry diagnoses it, these gauges and the JSON
+                # surfaces must stay finite (the PR 6 RFC lesson)
+                fams["nonfinite"].inc()
+                continue
+            snrs: Dict[str, float] = {}
+            for c in measured:
+                value = self._measure_snr(local, c)
+                if value is None:
+                    continue
+                snrs[c] = value
+                prev = batch_min_snr.get(c)
+                batch_min_snr[c] = value if prev is None \
+                    else min(prev, value)
+            row = dict(stats)
+            row["prenorm2"] = pre_norm2
+            row["snr_db"] = snrs
+            row["sample_ordinal"] = self.ordinal
+            row["codec"] = codec
+            rows[name] = row
+            batch_norm2 += stats["norm2"]
+            for key, _ in TOPK_FRACTIONS:
+                # energy-weighted fold of the per-tensor coverages: the
+                # whole-batch curve without a cross-tensor sort
+                batch_topk[key] += stats["topk"][key] * stats["norm2"]
+        with self._lock:
+            for name, row in rows.items():
+                prev = self._table.get(name)
+                if prev is not None:
+                    row["batches_sampled"] = prev.get(
+                        "batches_sampled", 0) + 1
+                else:
+                    row["batches_sampled"] = 1
+                self._table[name] = row
+            n_tensors = len(self._table)
+            worst = self._worst_tensors()
+        fams["tensors"].set(n_tensors)
+        if batch_norm2 > 0:
+            for key, _ in TOPK_FRACTIONS:
+                fams["topk"].labels(k=key).set(
+                    round(batch_topk[key] / batch_norm2, 6))
+        for c, value in batch_min_snr.items():
+            fams["codec_snr"].labels(codec=c).set(round(value, 3))
+            if self._gate is not None:
+                self._gate.observe(c, value)
+            if value < self.snr_floor_db:
+                fams["floor_misses"].labels(codec=c).inc()
+            if value < self.snr_floor_db + NEAR_MISS_MARGIN_DB:
+                from . import flightrec as _flightrec
+
+                _flightrec.record(_flightrec.EV_TENSORWATCH,
+                                  self.ordinal,
+                                  detail=f"{c}:{value:.1f}db")
+        self._update_labels(worst)
+        if self._timeline is not None and \
+                getattr(self._timeline, "enabled", False):
+            track = {"samples": self.samples, "tensors": n_tensors}
+            if batch_min_snr:
+                track["min_snr_db_x100"] = int(
+                    min(batch_min_snr.values()) * 100)
+            try:
+                self._timeline.counter("tensorwatch", track)
+            except Exception:  # noqa: BLE001 - audit never kills a batch
+                pass
+
+    def _worst_tensors(self) -> List[str]:
+        """Caller holds ``_lock``. Worst-first order: lowest SNR first
+        where SNR exists (the codec-risk view), largest norm² otherwise
+        (the wire-sizing view)."""
+        def key(item):
+            name, row = item
+            snrs = row.get("snr_db") or {}
+            worst_snr = min(snrs.values()) if snrs else None
+            return (0, worst_snr, -row["norm2"]) if worst_snr is not None \
+                else (1, 0.0, -row["norm2"])
+
+        ordered = sorted(self._table.items(), key=key)
+        return [name for name, _ in ordered[:self.worst_k]]
+
+    def _update_labels(self, worst: List[str]) -> None:
+        """Refresh the bounded labeled families: current worst-K tensors
+        carry live values, retired members pin to 0 (documented: 0 =
+        "left the worst set"), and label admission hard-caps at 4*K over
+        the process lifetime so a churning worst set can never grow the
+        registry unboundedly — the full table stays in
+        ``tensor_report()``."""
+        fams = self._fams
+        admitted = []
+        for name in worst:
+            if name not in self._labeled and \
+                    len(self._labeled) >= 4 * self.worst_k:
+                continue
+            self._labeled.add(name)
+            admitted.append(name)
+        with self._lock:
+            for name in self._labeled:
+                row = self._table.get(name)
+                if name in admitted and row is not None:
+                    snrs = row.get("snr_db") or {}
+                    fams["norm2"].labels(tensor=name).set(
+                        round(row["norm2"], 6))
+                    fams["prenorm2"].labels(tensor=name).set(
+                        round(row["prenorm2"], 6))
+                    if snrs:
+                        fams["snr"].labels(tensor=name).set(
+                            round(min(snrs.values()), 3))
+                else:
+                    fams["norm2"].labels(tensor=name).set(0)
+                    fams["prenorm2"].labels(tensor=name).set(0)
+                    fams["snr"].labels(tensor=name).set(0)
+
+    # -- introspection --------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"interval": self.interval, "batches": self.ordinal,
+                    "samples": self.samples,
+                    "tensors": len(self._table),
+                    "codecs": list(self.codecs),
+                    "labeled": len(self._labeled)}
+
+    def report(self) -> dict:
+        """The FULL per-tensor table (no cardinality cap — this is the
+        ``hvd.tensor_report()`` / ``GET /v1/tensors`` payload)."""
+        with self._lock:
+            table = {name: dict(row) for name, row in
+                     self._table.items()}
+            worst = self._worst_tensors()
+        return {"enabled": True, "interval": self.interval,
+                "batches": self.ordinal, "samples": self.samples,
+                "codecs": list(self.codecs), "worst": worst,
+                "tensors": table}
+
+
+def from_config(cfg, size: int = 1, rank: int = 0, probe=None,
+                snr_probe=None, norm2_probe=None,
+                timeline=None) -> Optional[TensorWatch]:
+    """Engine-side constructor: None when the interval knob is 0 — the
+    disabled plane is no object at all, so the hot path pays one
+    ``is not None`` check (the flightrec zero-overhead bar)."""
+    interval = getattr(cfg, "tensorwatch_interval_steps", 0)
+    if interval <= 0:
+        return None
+    return TensorWatch(
+        interval, size=size, rank=rank,
+        snr_floor_db=cfg.tensorwatch_snr_floor_db,
+        worst_k=cfg.tensorwatch_worst_k,
+        codecs=watch_codecs(cfg), probe=probe, snr_probe=snr_probe,
+        norm2_probe=norm2_probe, timeline=timeline,
+        gate=ensure_gate(cfg.tensorwatch_snr_floor_db,
+                         cfg.tensorwatch_snr_window))
+
+
+def tensor_report() -> dict:
+    """The live observatory table + gate state of this process
+    (docs/tensorwatch.md): served as ``hvd.tensor_report()`` and
+    ``GET /v1/tensors`` on the shared httpd routes. Safe to call any
+    time; a disarmed world reports ``enabled: False``."""
+    report: dict = {"enabled": False, "interval": 0, "batches": 0,
+                    "samples": 0, "tensors": {}, "worst": [],
+                    "codecs": [], "gate": None}
+    watch = None
+    try:
+        from ..ops import engine as _engine_mod
+
+        eng = _engine_mod._engine
+        watch = getattr(eng, "_tensorwatch", None) \
+            if eng is not None else None
+    except Exception:  # noqa: BLE001 - pre-init callers get the shell
+        watch = None
+    if watch is not None:
+        report.update(watch.report())
+    gate = _gate
+    if gate is not None:
+        report["gate"] = gate.state()
+    return report
+
+
+# -- report fold (stdlib-only: runs from a /metrics.json file alone) -----------
+
+
+def _labeled_values(families: dict, family: str, label: str
+                    ) -> Dict[str, float]:
+    fam = (families or {}).get(family)
+    out: Dict[str, float] = {}
+    for sample in (fam or {}).get("samples", []):
+        key = (sample.get("labels") or {}).get(label)
+        if key is not None:
+            out[key] = sample.get("value", 0)
+    return out
+
+
+def build_tensor_report(ranks: Dict[int, dict], top: int = 20) -> dict:
+    """Fold the per-rank ``horovod_tensor_*`` families of a
+    ``/metrics.json`` document into the worst-SNR / highest-spread
+    tensor table (``tools/tensorwatch_report.py``). Pure dict math —
+    loadable without the package (the straggler_report precedent).
+
+    Gauge value 0 means "tensor left the worst-K set" by the labeling
+    contract, so zero-valued labels are skipped. ``spread`` is the
+    max/min ratio of per-rank PRE-reduce norms — a persistent ratio far
+    from 1 is the data-skew signal (one rank's shard feeds much larger
+    gradients than its peers')."""
+    rows: Dict[str, dict] = {}
+    codec_snr: Dict[str, float] = {}
+    topk: Dict[str, float] = {}
+    samples = 0.0
+    present = False
+    for rank in sorted(ranks):
+        fams = ranks[rank] or {}
+        sample_fam = fams.get(FAMILY_SAMPLES)
+        if sample_fam:
+            present = True
+            for s in sample_fam.get("samples", []):
+                samples += s.get("value", 0)
+        for name, value in _labeled_values(fams, FAMILY_TENSOR_NORM2,
+                                           "tensor").items():
+            if value == 0:
+                continue
+            row = rows.setdefault(name, {"tensor": name, "norm2": 0.0,
+                                         "prenorm2": {}, "snr_db": {}})
+            row["norm2"] = max(row["norm2"], value)
+        for name, value in _labeled_values(fams, FAMILY_TENSOR_PRENORM2,
+                                           "tensor").items():
+            if value == 0:
+                continue
+            row = rows.setdefault(name, {"tensor": name, "norm2": 0.0,
+                                         "prenorm2": {}, "snr_db": {}})
+            row["prenorm2"][str(rank)] = value
+        for name, value in _labeled_values(fams, FAMILY_TENSOR_SNR,
+                                           "tensor").items():
+            if value == 0:
+                continue
+            row = rows.setdefault(name, {"tensor": name, "norm2": 0.0,
+                                         "prenorm2": {}, "snr_db": {}})
+            row["snr_db"][str(rank)] = value
+        for codec, value in _labeled_values(fams, FAMILY_CODEC_SNR,
+                                            "codec").items():
+            codec_snr[codec] = value if codec not in codec_snr \
+                else min(codec_snr[codec], value)
+        for k, value in _labeled_values(fams, FAMILY_TOPK, "k").items():
+            topk[k] = max(topk.get(k, 0.0), value)
+    table = []
+    for name, row in rows.items():
+        pres = [v for v in row["prenorm2"].values() if v > 0]
+        row["spread"] = (max(pres) / min(pres)) if len(pres) >= 2 \
+            else None
+        row["worst_snr_db"] = min(row["snr_db"].values()) \
+            if row["snr_db"] else None
+        table.append(row)
+
+    def order(row):
+        snr = row["worst_snr_db"]
+        spread = row["spread"] or 1.0
+        return (0, snr, -spread) if snr is not None \
+            else (1, -spread, -row["norm2"])
+
+    table.sort(key=order)
+    return {
+        "degraded": not present,
+        "samples": samples,
+        "tensors": table[:max(int(top), 1)],
+        "tensor_count": len(table),
+        "codec_snr_db": codec_snr,
+        "topk_mass": topk,
+    }

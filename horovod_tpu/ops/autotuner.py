@@ -100,13 +100,15 @@ class Autotuner:
     move; a non-None return is a :class:`tune.policy.Decision` whose
     ``config`` map the caller applies."""
 
-    def __init__(self, cfg: Config, extended: bool = False) -> None:
+    def __init__(self, cfg: Config, extended: bool = False,
+                 local_observatory: bool = True) -> None:
         if cfg.autotune_backend not in ("policy", "native"):
             raise ValueError(
                 f"bad HOROVOD_AUTOTUNE_BACKEND "
                 f"{cfg.autotune_backend!r}; expected 'policy' or 'native'")
         self._decisions = None
         self._native = cfg.autotune_backend == "native"
+        self._gate = None
         try:
             self._decisions = open(cfg.autotune_decisions, "a",
                                    encoding="utf-8") \
@@ -116,13 +118,37 @@ class Autotuner:
                 self._sink({"action": "init", "backend": "native",
                             "config": self._backend.config()})
             else:
+                # Evidence gate (docs/tensorwatch.md): with the numerics
+                # observatory armed, the lossy codec knob's consent
+                # (HOROVOD_AUTOTUNE_CODECS) becomes evidence-backed —
+                # proposals wait for the measured-SNR certification and
+                # an in-flight collapse forces a revert. None when the
+                # observatory is off: the PR 7 consent-only behavior,
+                # byte-identically. Native backend: classic pair only,
+                # no codec knob to gate. A non-member service host
+                # (start_subset_service) has NO engine in its process —
+                # nothing would ever feed the gate, so armed evidence
+                # gating there would block the consented codec for the
+                # life of the job; it degrades to consent-only, warned
+                # once (the established degrade pattern).
+                from ..obs import tensorwatch as _tensorwatch
+
+                if local_observatory:
+                    self._gate = _tensorwatch.policy_gate(cfg)
+                elif cfg.tensorwatch_interval_steps > 0:
+                    LOG.warning(
+                        "autotune: numerics observatory armed but this "
+                        "controller host runs no engine to feed the "
+                        "evidence gate; lossy codec consent stays "
+                        "consent-only here (docs/tensorwatch.md)")
                 self._backend = TuningPolicy(
                     default_knobs(cfg, extended=extended),
                     window=cfg.autotune_window,
                     cooldown=cfg.autotune_cooldown,
                     tolerance=cfg.autotune_tolerance,
                     decision_sink=self._sink,
-                    fault=cfg.autotune_fault)
+                    fault=cfg.autotune_fault,
+                    propose_gate=self._gate)
             self._log = open(cfg.autotune_log, "a", encoding="utf-8") \
                 if cfg.autotune_log else None
         except BaseException:
@@ -186,6 +212,19 @@ class Autotuner:
         """Score one (bytes, active µs) sample — the raw form the native
         controller service drains from C++ (no ResponseList exists on the
         Python side there)."""
+        if self._gate is not None:
+            # Evidence collapse first (docs/tensorwatch.md): when the
+            # observatory measured an admitted lossy codec's SNR below
+            # the floor, the forced revert outranks this cycle's score —
+            # the applier reads codec="none" from the decision's config
+            # and the response rewrite stops at the next cycle.
+            forced = self._gate.maybe_revert(self._backend)
+            if forced is not None:
+                LOG.warning(
+                    "autotune: numerics observatory reported an SNR "
+                    "collapse on the admitted lossy codec; reverting to "
+                    "the full-precision wire (decision-log audited)")
+                return forced
         if bytes_processed <= 0 or microseconds <= 0:
             return None
         if self._log is not None:

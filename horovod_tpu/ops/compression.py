@@ -178,6 +178,57 @@ class _BlockQuantCompressor(Compressor):
                 padded * jnp.dtype(cls.wire_dtype()).itemsize
                 + (padded // block) * jnp.dtype(cls.SCALE_DTYPE).itemsize)
 
+    @classmethod
+    def roundtrip_error(cls, flat, size: int = 1) -> tuple:
+        """``(signal_power, error_power)`` of one LOCAL encode→decode
+        leg through this codec's block math — quantize with this
+        contribution's own block scales, dequantize, difference. THE
+        single accounting definition of *measured* wire fidelity (the
+        ``wire_cost`` precedent): the numerics observatory
+        (``obs.tensorwatch``), the compression bench's measured-SNR
+        column, and the SNR tests all derive from it;
+        ``ops.spmd.codec_roundtrip`` is the in-jit twin for
+        device-resident tensors (pinned equal by tests). ``size`` sets
+        the block geometry (``block_layout``) so the measurement matches
+        the wire the world of that size would actually build. One leg
+        only — the real reduce pays a second re-quantization of the
+        mean, so this is the per-contribution floor of wire error, not
+        the end-to-end bound (docs/compression.md)."""
+        import numpy as np
+
+        flat = np.asarray(flat, dtype=np.float32).reshape(-1)
+        n = int(flat.size)
+        if n == 0:
+            return 0.0, 0.0
+        block, padded = cls.block_layout(n, size)
+        if padded != n:
+            flat = np.concatenate(
+                [flat, np.zeros(padded - n, np.float32)])
+        blocks = flat.reshape(-1, block)
+        absmax = np.abs(blocks).max(axis=1)
+        scale = np.where(absmax > 0, absmax / cls.QMAX,
+                         np.ones_like(absmax)).astype(
+            np.dtype(cls.SCALE_DTYPE))
+        # multiply by the reciprocal, not divide: the wire itself does
+        # (ops.spmd._quantized_axis_sum step 2), and the twins must
+        # round identically to stay pinned equal
+        inv = (1.0 / scale.astype(np.float32))[:, None]
+        scale_f32 = scale.astype(np.float32)[:, None]
+        scaled = blocks * inv
+        wire_np = np.dtype(cls.wire_dtype())
+        if np.issubdtype(wire_np, np.integer):
+            q = np.clip(np.round(scaled),
+                        -cls.QMAX, cls.QMAX).astype(wire_np)
+        else:
+            # fp8 wire: saturating cast through the ml_dtypes numpy
+            # dtype (clip first — a plain numpy cast overflows to inf
+            # where the XLA cast saturates)
+            q = np.clip(scaled, -cls.QMAX, cls.QMAX).astype(wire_np)
+        deq = q.astype(np.float32) * scale_f32
+        err = (deq - blocks).astype(np.float64)
+        sig = blocks.astype(np.float64)
+        return float((sig * sig).sum()), float((err * err).sum())
+
 
 class Int8Compressor(_BlockQuantCompressor):
     """Symmetric int8: values in [-127, 127], exact int32 summation."""

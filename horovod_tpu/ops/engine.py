@@ -740,6 +740,27 @@ class Engine:
         if injector is not None and injector.has_data_rules():
             self._data_chaos = injector
 
+        # Gradient numerics observatory (docs/tensorwatch.md): sampled
+        # per-tensor telemetry over reduced allreduce batches — norm²/
+        # absmax/nnz/log₂ histogram/top-k mass, plus decode-error SNR
+        # for quantized codecs in play or consented. Disabled (interval
+        # 0) = no object at all: the hot path pays one `is not None`
+        # check and zero allocations (the flightrec bar, pinned by the
+        # tracemalloc test). Device-resident batches measure through
+        # the plane's compiled collective-free probes (scalars synced,
+        # no buffer D2H — the PR 8 census pattern).
+        from ..obs import tensorwatch as _tensorwatch
+
+        self._tensorwatch = _tensorwatch.from_config(
+            cfg, size=self._size, rank=self._rank,
+            probe=(self._plane.tensorwatch_stats
+                   if self._plane is not None else None),
+            snr_probe=(self._plane.codec_snr
+                       if self._plane is not None else None),
+            norm2_probe=(self._plane.tensorwatch_norm2
+                         if self._plane is not None else None),
+            timeline=self.timeline)
+
         # Generation-ordered sub-buffer flush (docs/tensor-fusion.md):
         # with HOROVOD_FUSION_SUBBUFFERS >= 2 the loop cuts each tick's
         # pending queue into arrival-ordered sub-buffers and keeps up to
@@ -1723,6 +1744,8 @@ class Engine:
             "cache": self.cache_stats(),
             "apply": self.apply_stats(),
             "overlap": self.overlap_stats(),
+            "tensorwatch": (self._tensorwatch.stats()
+                            if self._tensorwatch is not None else None),
             "applied_knobs": dict(self._applied_knobs),
             "native_controller": self._native_controller,
         }
@@ -1871,6 +1894,12 @@ class Engine:
             # boundary and injects nothing, but still advances the
             # ordinal so mixed-path worlds stay aligned.
             chaos.begin_batch()
+        watch = self._tensorwatch
+        if watch is not None:
+            # numerics observatory (docs/tensorwatch.md): the sampling
+            # ordinal advances per allreduce batch in negotiated
+            # execution order — rank-identical, like the sentry's
+            watch.begin_batch()
         # Quantized wire eligibility is decided from NEGOTIATED batch
         # metadata (codec + dtype), identical on every rank, so the
         # compiled collective programs stay launch-order compatible.
@@ -1888,6 +1917,10 @@ class Engine:
                 tl.activity_start(e.name, "EXECUTE")
                 results.append(e.array)
                 tl.activity_end(e.name)
+            if watch is not None and watch.sampling:
+                watch.observe_batch([e.name for e in entries],
+                                    [e.array for e in entries],
+                                    results, codec)
             return results
         if device_in and self._plane is not None and \
                 self._plane.supports(dtype_of(entries[0].array)):
@@ -1900,6 +1933,12 @@ class Engine:
                                         [e.array for e in entries], codec)
             for e in entries:
                 tl.activity_end(e.name)
+            if watch is not None and watch.sampling:
+                # device route: the observatory's compiled probes sync
+                # scalars off these arrays, no buffer D2H
+                watch.observe_batch([e.name for e in entries],
+                                    [e.array for e in entries],
+                                    results, codec)
             return results
         if fused:
             for e in entries:
@@ -1955,6 +1994,12 @@ class Engine:
         if fused:
             for e in entries:
                 tl.activity_end(e.name)
+        if watch is not None and watch.sampling:
+            # observed as RECEIVED, pre-sentry (the consensus framing):
+            # a sentry rewrite is downstream of this measurement
+            watch.observe_batch([e.name for e in entries],
+                                [e.array for e in entries], results,
+                                codec)
         return results
 
     # -- fused reduce+apply (docs/tensor-fusion.md §fused apply) --------------
@@ -2068,6 +2113,9 @@ class Engine:
         chaos = self._data_chaos
         if chaos is not None:
             chaos.begin_batch()  # same ordinal domain as plain batches
+        watch = self._tensorwatch
+        if watch is not None:
+            watch.begin_batch()  # same ordinal domain as plain batches
         rule, count = ctxs[0].rule, ctxs[0].count
         denom = self._size if ctxs[0].average and self._size > 1 else 1
         # census gate: for skip/zero/abort the program must not land a
@@ -2092,8 +2140,13 @@ class Engine:
         codec = self._downgrade_codec(entries[0], codec)
         for e in entries:
             tl.activity_start(e.name, "EXECUTE")
+        # the observatory measures the reduced gradients pre-apply, so a
+        # sampled apply-fused batch needs the host views too (one D2H on
+        # the device route, sampled steps only — documented in
+        # docs/tensorwatch.md; the plain route keeps the scalar probes)
         need_views = self._consensus_acc is not None or \
-            self._sentry is not None
+            self._sentry is not None or \
+            (watch is not None and watch.sampling)
         if self._plane is not None and self._plane.supports(
                 dtype_of(entries[0].array)):
             # device route: pack grad/param/slot buckets, ONE compiled
@@ -2183,6 +2236,12 @@ class Engine:
             for shape, n in zip(shapes, sizes):
                 views.append(red_host[off:off + n].reshape(shape))
                 off += n
+            if watch is not None and watch.sampling:
+                # numerics observatory: the reduced gradients as
+                # received, PRE-apply (the consensus framing)
+                watch.observe_batch(names,
+                                    [e.array for e in entries], views,
+                                    codec)
             # consensus FIRST, on the raw reduced bytes (pre-apply, the
             # docs/integrity.md contract), then the sentry's collective
             # verdict off the in-program two-scalar census
@@ -2350,7 +2409,12 @@ def start_subset_service(subset_ranks) -> None:
     bind_host = os.environ.get(_config.HOROVOD_CONTROLLER_BIND,
                                "127.0.0.1")
     use_native = native_controller_enabled(cfg)
-    autotuner = Autotuner(cfg, extended=not use_native) \
+    # local_observatory=False: this host runs NO engine, so nothing in
+    # this process could ever feed the numerics observatory's evidence
+    # gate — armed gating here would block the consented codec forever
+    # (docs/tensorwatch.md); it degrades to consent-only, warned once.
+    autotuner = Autotuner(cfg, extended=not use_native,
+                          local_observatory=False) \
         if cfg.autotune else None
     listen_fd = _adopt_controller_fd(use_native)
     if use_native:  # same decision the members make

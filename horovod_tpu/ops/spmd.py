@@ -301,6 +301,45 @@ def _quantized_axis_sum(x: jax.Array, axis: str, codec) -> jax.Array:
     return out.reshape(-1)[:n_elems].reshape(orig_shape)
 
 
+def codec_roundtrip(x: jax.Array, codec, size: int = 1):
+    """Collective-free local encode→decode through ``codec``'s block
+    math: quantize this contribution with its OWN block scales,
+    dequantize, return ``(signal_power, error_power)`` as two f32
+    scalars — the numerics observatory's decode-error measurement for
+    device-resident gradients (docs/tensorwatch.md; the PR 8 two-scalar
+    census pattern: a compiled probe syncs scalars, never buffers).
+
+    In-jit twin of ``Compression.*.roundtrip_error`` — the SAME
+    quantize formula as :func:`_quantized_axis_sum` step 2, with local
+    absmax standing in for the pmax-shared scales (no wire here), so
+    the measurement is the per-contribution floor of the wire's error.
+    ``size`` sets the block geometry the wire of that world size would
+    build (``codec.block_layout``); pinned equal to the numpy twin by
+    the tensorwatch tests."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    if n == 0:
+        return jnp.float32(0.0), jnp.float32(0.0)
+    block, padded = codec.block_layout(n, size)
+    if padded != n:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros_like(flat, shape=(padded - n,))])
+    blocks = flat.reshape(-1, block)
+    absmax = jnp.max(jnp.abs(blocks), axis=1)
+    scale = jnp.where(absmax > 0, absmax / codec.QMAX,
+                      jnp.ones_like(absmax)).astype(codec.SCALE_DTYPE)
+    inv = (1.0 / scale.astype(jnp.float32))[:, None]
+    wire_dt = codec.wire_dtype()
+    if jnp.issubdtype(wire_dt, jnp.floating):  # fp8: saturating cast
+        q = (blocks * inv).astype(wire_dt)
+    else:
+        q = jnp.clip(jnp.round(blocks * inv),
+                     -codec.QMAX, codec.QMAX).astype(wire_dt)
+    deq = q.astype(jnp.float32) * scale.astype(jnp.float32)[:, None]
+    err = deq - blocks
+    return jnp.sum(blocks * blocks), jnp.sum(err * err)
+
+
 def reduce_apply(grad: jax.Array, param: jax.Array, slots, rule,
                  count, axis_name: AxisName, average: bool = True,
                  codec=None):

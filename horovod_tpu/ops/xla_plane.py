@@ -554,6 +554,130 @@ class XlaDataPlane:
             ("trimrows", shape[1:], str(dt), rows, sizes), _build_trim)
         return trim(local)
 
+    def tensorwatch_stats(self, arr) -> dict:
+        """Device-side per-tensor numerics census for the gradient
+        observatory (docs/tensorwatch.md): ONE compiled collective-free
+        program per dtype computing norm², max|g|, nonzero count, the
+        coarse log₂-magnitude occupancy histogram, and the top-k
+        mass-coverage curve — so a sampled device-resident batch syncs
+        a handful of scalars (plus the fixed 32-bin histogram) instead
+        of pulling buffers to host (the ``nonfinite_counts`` two-scalar
+        census pattern). Sampled steps only; never fused into the
+        reduce program itself, which is what keeps the disabled-path
+        HLO audit trivially clean."""
+        def _build():
+            import jax
+            import jax.numpy as jnp
+
+            from ..obs.tensorwatch import (
+                LOG2_HIST_BINS,
+                LOG2_HIST_MIN,
+                TOPK_FRACTIONS,
+            )
+
+            def _stats(x):
+                flat = x.reshape(-1).astype(jnp.float32)
+                a = jnp.abs(flat)
+                absmax = jnp.max(a) if flat.shape[0] else jnp.float32(0)
+                # Scaled accumulation: the host twin sums squares in
+                # float64 ("norm² of an fp16-ish tensor must not
+                # overflow the measurement") but x64 is off in-program,
+                # so divide by absmax first — every term ≤ 1, the f32
+                # accumulator cannot overflow — and the host recombines
+                # absmax²·Σ in Python float64. The top-k fractions are
+                # ratios of the SAME scaled sums, so scaling cancels.
+                denom = jnp.where(absmax > 0, absmax, jnp.float32(1))
+                s = a / denom
+                a2 = s * s
+                norm2_scaled = jnp.sum(a2)
+                nnz = jnp.count_nonzero(flat)
+                e = jnp.clip(
+                    jnp.floor(jnp.log2(jnp.where(a > 0, a, 1.0))),
+                    LOG2_HIST_MIN, LOG2_HIST_MIN + LOG2_HIST_BINS - 1)
+                bins = jnp.where(a > 0,
+                                 (e - LOG2_HIST_MIN).astype(jnp.int32),
+                                 LOG2_HIST_BINS)
+                hist = jnp.bincount(bins,
+                                    length=LOG2_HIST_BINS + 1)[
+                    :LOG2_HIST_BINS]
+                order = jnp.sort(a2)[::-1]
+                cum = jnp.cumsum(order)
+                total = jnp.maximum(cum[-1], jnp.float32(1e-30))
+                n = flat.shape[0]
+                fracs = []
+                for _, q in TOPK_FRACTIONS:
+                    # n is trace-time static, so the top-k index is too
+                    k = max(0, min(n - 1, int(math.ceil(q * n)) - 1))
+                    fracs.append(cum[k] / total)
+                return (norm2_scaled, absmax, nnz, hist) + tuple(fracs)
+            return jax.jit(_stats)
+
+        from ..obs.tensorwatch import TOPK_FRACTIONS
+
+        fn = self._local_fn(
+            ("twstats", str(np.dtype(arr.dtype))), _build)
+        out = fn(arr)
+        norm2_scaled, absmax, nnz, hist = out[:4]
+        fracs = out[4:]
+        n = int(np.prod([int(s) for s in arr.shape] or [1],
+                        dtype=np.int64))
+        return {
+            "elems": n,
+            # recombined in Python float64 (see _stats)
+            "norm2": float(absmax) * float(absmax)
+            * float(norm2_scaled),
+            "absmax": float(absmax),
+            "nnz": int(nnz),
+            "log2_hist": [int(c) for c in np.asarray(hist)],
+            "topk": {key: float(f)
+                     for (key, _), f in zip(TOPK_FRACTIONS, fracs)},
+        }
+
+    def tensorwatch_norm2(self, arr) -> float:
+        """Device-side norm² alone — the observatory's PRE-reduce local
+        measurement needs only this scalar (the skew detector's input),
+        so a sampled step must not pay the full stats program twice per
+        tensor (docs/tensorwatch.md)."""
+        def _build():
+            import jax
+            import jax.numpy as jnp
+
+            def _norm2(x):
+                flat = x.reshape(-1).astype(jnp.float32)
+                a = jnp.abs(flat)
+                absmax = jnp.max(a) if flat.shape[0] else jnp.float32(0)
+                # scaled accumulation against f32 overflow, recombined
+                # on the host in float64 (see tensorwatch_stats)
+                denom = jnp.where(absmax > 0, absmax, jnp.float32(1))
+                s = a / denom
+                return absmax, jnp.sum(s * s)
+            return jax.jit(_norm2)
+
+        fn = self._local_fn(
+            ("twnorm2", str(np.dtype(arr.dtype))), _build)
+        absmax, scaled = fn(arr)
+        return float(absmax) * float(absmax) * float(scaled)
+
+    def codec_snr(self, arr, codec: str) -> Tuple[float, float]:
+        """Device-side decode-error measurement for the observatory:
+        the compiled ``ops.spmd.codec_roundtrip`` (collective-free,
+        local block scales) over this rank's contribution, returning
+        ``(signal_power, error_power)`` — two scalars synced, no D2H of
+        the buffer (docs/tensorwatch.md)."""
+        def _build():
+            import jax
+
+            from .compression import Compression
+            from .spmd import codec_roundtrip
+
+            c = Compression.lookup(codec)
+            size = self._size
+            return jax.jit(lambda x: codec_roundtrip(x, c, size))
+
+        fn = self._local_fn(("twsnr", codec), _build)
+        sp, ep = fn(arr)
+        return float(sp), float(ep)
+
     def nonfinite_counts(self, arr) -> Tuple[int, int]:
         """Device-side non-finite census for the gradient sentry
         (docs/integrity.md): one compiled ``(nan_count, inf_count)``

@@ -187,9 +187,19 @@ class TuningPolicy:
     def __init__(self, knobs: Sequence[Knob], window: int = 5,
                  cooldown: int = 5, tolerance: float = 0.05,
                  decision_sink: Optional[Callable[[dict], None]] = None,
-                 fault: str = "", reexplore_windows: int = 3) -> None:
+                 fault: str = "", reexplore_windows: int = 3,
+                 propose_gate=None) -> None:
         if not knobs:
             raise ValueError("TuningPolicy needs at least one knob")
+        # Evidence gate (docs/tensorwatch.md): a duck-typed object with
+        # ``allows(knob, value) -> bool`` and ``evidence(knob, value) ->
+        # dict|None``. Candidates a gate refuses are SKIPPED, not
+        # rejected — the numerics observatory may certify them later and
+        # the proposal then proceeds; admitted moves carry the gate's
+        # evidence record into the JSONL decision log. None (the
+        # default, and every world without the observatory) keeps the
+        # pre-gate behavior byte-identically.
+        self._propose_gate = propose_gate
         self._knobs: Dict[str, Knob] = {k.name: k for k in knobs}
         self._order = [k.name for k in knobs]
         self._window = max(int(window), 1)
@@ -343,9 +353,17 @@ class TuningPolicy:
             if knob.pinned:
                 continue
             for direction in (1, -1):
-                if knob.in_bounds(direction) and \
-                        (name, direction) not in self._rejected:
-                    candidates.append((name, direction))
+                if not knob.in_bounds(direction) or \
+                        (name, direction) in self._rejected:
+                    continue
+                if self._propose_gate is not None and \
+                        not self._propose_gate.allows(
+                            name, knob.values[knob.index + direction]):
+                    # evidence-gated candidate (the lossy codec): not
+                    # yet certified — skip without rejecting, so a
+                    # later certification re-opens the move
+                    continue
+                candidates.append((name, direction))
             if candidates:
                 break
         if not candidates:
@@ -371,15 +389,54 @@ class TuningPolicy:
         decision = Decision(action="retune", knob=name, value=knob.current,
                             score=score, best_score=self._best_score,
                             config=self.config())
-        self._audit(decision)
+        evidence = None
+        if self._propose_gate is not None:
+            # an evidence-gated admit ships the measured record that
+            # justified it into the decision log (docs/tensorwatch.md)
+            evidence = self._propose_gate.evidence(name, knob.current)
+        self._audit(decision, evidence=evidence)
         return decision
 
-    def _audit(self, decision: Decision) -> None:
+    def evidence_revert(self, name: str, value,
+                        evidence: Optional[dict] = None
+                        ) -> Optional[Decision]:
+        """Forced revert on collapsed evidence (docs/tensorwatch.md):
+        the numerics observatory measured an in-flight SNR collapse on
+        an admitted lossy codec, so the move's justification no longer
+        holds — roll the knob back to ``value`` through the same
+        bookkeeping the best-known-config guard uses (best-config
+        snapshot updated, the lossy direction rejected, cooldown
+        entered), audited as a ``revert`` carrying the evidence record.
+        No-op (None) when the knob is absent or already at ``value``."""
+        knob = self._knobs.get(name)
+        if knob is None or knob.current == value or \
+                value not in knob.values:
+            return None
+        old_index = knob.index
+        knob.index = knob.values.index(value)
+        self._best_config[name] = knob.index
+        self._rejected.add((name, 1 if old_index > knob.index else -1))
+        self._last_move = None
+        self._samples.clear()
+        self._cooldown_left = self._cooldown
+        self.reverts += 1
+        score = self._best_score if self._best_score is not None else 0.0
+        decision = Decision(action="revert", knob=name, value=value,
+                            score=score, best_score=score,
+                            config=self.config())
+        self._audit(decision, evidence=evidence)
+        return decision
+
+    def _audit(self, decision: Decision,
+               evidence: Optional[dict] = None) -> None:
         audit_decision(decision)
-        self._emit({"action": decision.action, "knob": decision.knob,
-                    "value": decision.value, "score": decision.score,
-                    "best_score": decision.best_score,
-                    "config": decision.config})
+        record = {"action": decision.action, "knob": decision.knob,
+                  "value": decision.value, "score": decision.score,
+                  "best_score": decision.best_score,
+                  "config": decision.config}
+        if evidence is not None:
+            record["evidence"] = evidence
+        self._emit(record)
 
     def _emit(self, record: dict) -> None:
         if self._sink is not None:

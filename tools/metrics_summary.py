@@ -96,6 +96,14 @@ SERVING_PREFIXES = ("horovod_serving_",)
 # dropped trace event is the same black-box-coverage question).
 FLIGHTREC_PREFIXES = ("horovod_flightrec_", "horovod_timeline_dropped_")
 
+# Numerics-observatory families (docs/tensorwatch.md): sampled batches,
+# the worst-K per-tensor gauges, the decode-SNR-by-codec gauges, and the
+# top-k sparse-readiness curve — the "is the lossy wire numerically
+# safe, and is the data skewed?" glance. Full table:
+# tools/tensorwatch_report.py or GET /v1/tensors.
+NUMERICS_PREFIXES = ("horovod_tensorwatch_", "horovod_tensor_",
+                     "horovod_codec_snr_db")
+
 
 def _render_section(title: str, families: Dict[str, dict], prefix: str,
                     out, skip: tuple = ()) -> None:
@@ -147,6 +155,16 @@ def _render_flightrec_section(families: Dict[str, dict], prefix: str,
     _render_section("flight recorder", flightrec, prefix, out)
 
 
+def _render_numerics_section(families: Dict[str, dict], prefix: str,
+                             out) -> None:
+    numerics = {n: f for n, f in families.items()
+                if n.startswith(NUMERICS_PREFIXES)
+                and n.startswith(prefix)}
+    if not numerics:
+        return  # observatory off in this snapshot: no empty section
+    _render_section("numerics plane", numerics, prefix, out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="pretty-print a saved /metrics.json or "
@@ -174,9 +192,11 @@ def main(argv=None) -> int:
     _render_integrity_section(world, args.family, sys.stdout)
     _render_serving_section(world, args.family, sys.stdout)
     _render_flightrec_section(world, args.family, sys.stdout)
+    _render_numerics_section(world, args.family, sys.stdout)
     _render_section("world", world, args.family, sys.stdout,
                     skip=TUNING_PREFIXES + INTEGRITY_PREFIXES
-                    + SERVING_PREFIXES + FLIGHTREC_PREFIXES)
+                    + SERVING_PREFIXES + FLIGHTREC_PREFIXES
+                    + NUMERICS_PREFIXES)
     # JSON round-trips rank keys as strings; accept either
     by_rank = {int(k): v for k, v in ranks.items()}
     wanted = sorted(by_rank) if args.all else (

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Worst-SNR / highest-spread tensor table: the numerics observatory's
+report (docs/tensorwatch.md).
+
+Reads a metrics document — a saved ``/metrics.json`` file, a live
+exposition URL, or a bare ``metrics_snapshot(world=True)`` dict — and
+folds the ``horovod_tensor_*`` / ``horovod_codec_snr_db`` families into
+the per-tensor numerics table: post-reduce norm², the cross-rank
+pre-reduce norm spread (the data-skew detector), the per-tensor decode
+SNR, plus the batch-level codec SNR and the top-k mass-coverage
+(sparse-readiness) curve:
+
+    curl -s http://127.0.0.1:$HOROVOD_METRICS_PORT/metrics.json > snap.json
+    python tools/tensorwatch_report.py snap.json
+    python tools/tensorwatch_report.py http://127.0.0.1:9090/metrics.json
+
+The registry only carries the worst-K tensors by the labeling contract;
+the FULL in-job table is ``hvd.tensor_report()`` / ``GET /v1/tensors``.
+The final stdout line is the report as one JSON object (the repo's tool
+contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# runnable straight from a checkout: `python tools/tensorwatch_report.py`
+# puts tools/ (not the repo root) on sys.path
+sys.path.insert(0, _REPO)
+
+
+def _load_fold():
+    """The report fold lives in horovod_tpu.obs.tensorwatch — but this
+    tool must analyze snapshots copied OFF a pod, on machines where
+    importing the package would pull in jax. tensorwatch.py keeps its
+    module level stdlib-only for exactly this (the straggler_report /
+    blackbox_report precedent): when the package import fails, load the
+    file directly — the fold is pure dict math."""
+    try:
+        from horovod_tpu.obs.tensorwatch import build_tensor_report
+
+        return build_tensor_report
+    except ImportError:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_tensorwatch_fold",
+            os.path.join(_REPO, "horovod_tpu", "obs", "tensorwatch.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.build_tensor_report
+
+
+build_tensor_report = _load_fold()
+
+
+def _load(source: str) -> dict:
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(source, timeout=10) as resp:
+            return json.loads(resp.read().decode())
+    with open(source, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _ranks_of(doc: dict) -> dict:
+    """Accept both emitted shapes (the straggler_report precedent): the
+    ``/metrics.json`` document or a bare families dict."""
+    if "ranks" in doc and isinstance(doc["ranks"], dict):
+        return {int(r): fams for r, fams in doc["ranks"].items()}
+    return {0: doc}
+
+
+def render(report: dict, out=sys.stdout) -> None:
+    w = out.write
+    w(f"# numerics observatory: {report['samples']:.0f} sampled "
+      f"batch(es), {report['tensor_count']} labeled tensor(s)\n")
+    if report["degraded"]:
+        w("DEGRADED: no tensorwatch families in this document — the "
+          "observatory is off (HOROVOD_TENSORWATCH_INTERVAL_STEPS=0), "
+          "the publisher never pushed, or this snapshot predates the "
+          "plane.\n")
+    if report["codec_snr_db"]:
+        parts = ", ".join(f"{c}: {v:.1f} dB" for c, v in
+                          sorted(report["codec_snr_db"].items()))
+        w(f"decode SNR (worst tensor of last sample): {parts}\n")
+    if report["topk_mass"]:
+        # the sparse-readiness curve (docs/tensorwatch.md): how much of
+        # the gradient energy a top-k wire at each k would carry
+        def pct(k):
+            v = report["topk_mass"].get(k)
+            return "-" if v is None else f"{100 * v:.2f}%"
+
+        w(f"sparse readiness (share of grad energy): top 0.1% -> "
+          f"{pct('0.1')}, top 1% -> {pct('1')}, top 10% -> "
+          f"{pct('10')}\n")
+    if report["tensors"]:
+        w("\n## worst tensors (lowest SNR first, then highest skew)\n")
+        w(f"{'tensor':<32} {'norm2':>12} {'snr dB':>8} "
+          f"{'skew x':>8}\n")
+        for row in report["tensors"]:
+            snr = row.get("worst_snr_db")
+            spread = row.get("spread")
+            w(f"{row['tensor']:<32.32} {row['norm2']:>12.4g} "
+              f"{'-' if snr is None else format(snr, '>8.1f'):>8} "
+              f"{'-' if spread is None else format(spread, '>8.2f'):>8}"
+              f"\n")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("source",
+                        help="/metrics.json file path or live URL")
+    parser.add_argument("--top", type=int, default=20,
+                        help="table rows to keep (worst first)")
+    args = parser.parse_args(argv)
+    try:
+        doc = _load(args.source)
+    except (OSError, ValueError) as exc:
+        print(f"cannot read metrics document {args.source!r}: {exc}",
+              file=sys.stderr)
+        return 1
+    report = build_tensor_report(_ranks_of(doc), top=args.top)
+    render(report)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,7 +75,11 @@ MESSAGE_FIELDS: Dict[str, str] = {
                      "bookkeeping in Python and stamps/splits responses; "
                      "the native controller wire drops it (engine "
                      "enqueue falls back to the full-precision wire, "
-                     "warned once)",
+                     "warned once). PR 16: the sparse \"topk\" tag rides "
+                     "the same field and the same degrade — the native "
+                     "data plane cannot carry indices+values payloads, "
+                     "so sparse requests reduce dense at full precision "
+                     "there, warned once",
     "Request.apply_fingerprint": "PR 13: negotiated like the codec; the "
                                  "native controller wire predates the "
                                  "field and drops it — the engine keeps "

@@ -409,6 +409,24 @@ class ChaosInjector:
         poisoned.reshape(-1)[0] = np.nan
         return poisoned
 
+    def on_sparse_indices(self, idx):
+        """flipbits fault, sparse wire variant: flip the lowest bit of
+        the first RECEIVED gathered index. The scatter-decode clips the
+        corrupt index into range, so the dropped/duplicated mass lands
+        in the wrong row on the armed rank only — exactly the silent
+        decode divergence consensus (which digests the decoded DENSE
+        result) must catch and attribute. Same arming kind as the dense
+        cell: one grammar, two wire shapes."""
+        rule = self._fire("flipbits")
+        if rule is None:
+            return idx
+        import numpy as np
+
+        out = np.array(idx, copy=True)
+        if out.size:
+            out.reshape(-1)[0] ^= 1
+        return out
+
     def on_reduce_output(self, buf):
         """flipbits fault: flip the lowest bit of the first byte of the
         RECEIVED reduced buffer — for little-endian floats a low mantissa

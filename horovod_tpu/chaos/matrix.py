@@ -47,12 +47,22 @@ ESCALATION_SPEC = "close@rank1:msg6,refuse@relaunch:999"
 # pinned (msg3) so healed cells can assert exact final values.
 DATA_POISON_ORDINAL = 3
 DATA_GRID = [
-    # (chaos spec, sentry policy, consensus interval, expected outcome)
-    (f"nan@rank1:msg{DATA_POISON_ORDINAL}", "skip", 0, "healed"),
-    (f"nan@rank1:msg{DATA_POISON_ORDINAL}", "zero", 0, "healed"),
-    (f"nan@rank1:msg{DATA_POISON_ORDINAL}", "warn", 0, "healed"),
-    (f"nan@rank1:msg{DATA_POISON_ORDINAL}", "abort", 0, "escalated"),
-    (f"flipbits@rank1:msg{DATA_POISON_ORDINAL}", "off", 1, "escalated"),
+    # (chaos spec, sentry policy, consensus interval, expected outcome,
+    #  wire codec)
+    (f"nan@rank1:msg{DATA_POISON_ORDINAL}", "skip", 0, "healed", "none"),
+    (f"nan@rank1:msg{DATA_POISON_ORDINAL}", "zero", 0, "healed", "none"),
+    (f"nan@rank1:msg{DATA_POISON_ORDINAL}", "warn", 0, "healed", "none"),
+    (f"nan@rank1:msg{DATA_POISON_ORDINAL}", "abort", 0, "escalated",
+     "none"),
+    (f"flipbits@rank1:msg{DATA_POISON_ORDINAL}", "off", 1, "escalated",
+     "none"),
+    # Sparse wire cell (docs/compression.md): the same flipbits arming
+    # lands on the gathered INDEX stream of the top-k codec — the
+    # armed rank's scatter-decode puts mass in the wrong row, and
+    # consensus (which digests the decoded DENSE result) must catch the
+    # divergence and name the injected rank.
+    (f"flipbits@rank1:msg{DATA_POISON_ORDINAL}", "off", 1, "escalated",
+     "topk"),
 ]
 
 
@@ -119,11 +129,14 @@ def _matrix_fn(steps: int, expect_escalation: bool):
 
 
 def _data_matrix_fn(steps: int, policy: str, poison_ordinal: int,
-                    expect_escalation: bool):
+                    expect_escalation: bool, codec: str = "none"):
     """Per-rank body for one data-plane integrity cell (shipped by value
     through runner.run's driver): one allreduce per step with
     step-dependent values, so the driver can pin what a healed world's
-    final accumulator must be bit-exactly."""
+    final accumulator must be bit-exactly. ``codec`` routes the batch
+    through a lossy wire instead ("topk": the sparse cell) — lossy
+    results carry no exactness contract, the cell's whole point is that
+    consensus still digests the decoded dense result bit-identically."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -134,13 +147,16 @@ def _data_matrix_fn(steps: int, policy: str, poison_ordinal: int,
 
     hvd.init()
     rank, size = hvd.rank(), hvd.size()
+    comp = hvd.Compression.lookup(codec)
     w = 0.0
     try:
         for step in range(steps):
             out = hvd.allreduce(
                 np.full((16,), float(rank + step + 1), np.float32),
-                average=False, name="chaos.data")
+                average=False, name="chaos.data", compression=comp)
             w += float(np.asarray(out)[0])
+            if codec != "none":
+                continue  # lossy wire: no bit-exactness to pin
             clean = float(sum(r + step + 1 for r in range(size)))
             if step + 1 == poison_ordinal:
                 # the poisoned batch: skip/zero hand back zeros, warn
@@ -175,7 +191,8 @@ def run_data_cell(spec: str, policy: str, consensus_interval: int,
                   native_core: Optional[int] = None,
                   np_: int = 2, steps: int = 6,
                   timeout_s: float = 120.0,
-                  deadline_s: float = 60.0) -> Dict:
+                  deadline_s: float = 60.0,
+                  codec: str = "none") -> Dict:
     """Run one data-plane integrity cell; classification mirrors
     ``run_cell``: healed / escalated / late-escalation / hang — plus the
     healed cells' EXACTNESS contract: under skip/zero the final
@@ -207,7 +224,7 @@ def run_data_cell(spec: str, policy: str, consensus_interval: int,
     try:
         results = run(_data_matrix_fn,
                       args=(steps, policy, DATA_POISON_ORDINAL,
-                            expect_escalation),
+                            expect_escalation, codec),
                       np=np_, timeout_s=timeout_s, start_timeout_s=120.0)
         if any(r.get("outcome") == "escalated" for r in results):
             cell = {"outcome": "escalated", "results": results}
@@ -218,7 +235,11 @@ def run_data_cell(spec: str, policy: str, consensus_interval: int,
                         for s in range(steps))
             poisoned_contrib = sum(
                 r + DATA_POISON_ORDINAL for r in range(size))
-            if "nan@" not in spec:
+            if codec != "none":
+                # lossy wire: there is no bit-exactness contract to
+                # audit; a healed classification stands on its own
+                pass
+            elif "nan@" not in spec:
                 # no sentry-visible poison: full-exactness contract. A
                 # flipbits cell WITHOUT consensus lands here too and
                 # honestly classifies wrong-results — that silent
@@ -260,6 +281,7 @@ def run_data_cell(spec: str, policy: str, consensus_interval: int,
     cell["spec"] = spec
     cell["policy"] = policy
     cell["consensus_interval"] = consensus_interval
+    cell["codec"] = codec
     cell["elapsed_s"] = round(time.monotonic() - t0, 2)
     if cell["outcome"] == "escalated" and cell["elapsed_s"] > deadline_s:
         cell["outcome"] = "late-escalation"
@@ -684,11 +706,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         failed = 0
         blackbox = _BlackboxCheck() if args.blackbox else None
         try:
-            for spec, policy, consensus, expect in DATA_GRID:
+            for spec, policy, consensus, expect, codec in DATA_GRID:
                 def _cell(spec=spec, policy=policy, consensus=consensus,
-                          expect=expect):
+                          expect=expect, codec=codec):
                     return run_data_cell(spec, policy, consensus, expect,
-                                         np_=args.np_, steps=args.steps)
+                                         np_=args.np_, steps=args.steps,
+                                         codec=codec)
                 cell = blackbox.run(_cell) if blackbox is not None \
                     else _cell()
                 ok = cell["outcome"] == expect
@@ -699,7 +722,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 if not ok:
                     failed += 1
                 label = f"{spec} sentry={policy}" + (
-                    f" consensus={consensus}" if consensus else "")
+                    f" consensus={consensus}" if consensus else "") + (
+                    f" codec={codec}" if codec != "none" else "")
                 print(f"data-cell {'OK ' if ok else 'BAD'} "
                       f"outcome={cell['outcome']:<15} "
                       f"{cell['elapsed_s']:6.1f}s  {label}{bb}", flush=True)

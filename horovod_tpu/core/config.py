@@ -298,6 +298,29 @@ HOROVOD_SERVING_CHAOS = "HOROVOD_SERVING_CHAOS"
 # (default 0) — the serving twin of HOROVOD_ELASTIC_FAULT.
 HOROVOD_SERVING_FAULT = "HOROVOD_SERVING_FAULT"
 
+# --- sparse top-k gradient wire (ops/sparse.py; ours, docs/compression.md) ---
+# Top-k fraction of the "topk" sparse codec, as a PERCENT key matching the
+# tensorwatch sparse-readiness curve: "0.1" / "1" / "10" (default "1") —
+# each fused allreduce entry ships its k = ceil(f * n) largest-magnitude
+# entries as (index, value) pairs over the reference allgather shape and
+# every rank decodes the dense mean locally. Unknown keys fail loudly at
+# codec construction (ops/sparse.py), never silently rescale.
+HOROVOD_SPARSE_TOPK = "HOROVOD_SPARSE_TOPK"
+# Evidence floor of the sparse codec's gate: the fraction (0..1) of
+# gradient energy the top-k selection must certifiably cover (the
+# horovod_tensorwatch_topk_mass curve, energy-weighted per batch) for
+# HOROVOD_TENSORWATCH_SNR_WINDOW consecutive samples before the autotuner
+# may propose the "topk" codec; a sampled coverage below the floor while
+# the codec is applied triggers the audited collapse revert.
+HOROVOD_SPARSE_COVERAGE_FLOOR = "HOROVOD_SPARSE_COVERAGE_FLOOR"
+# Error feedback (residual accumulation): "1" (default) keeps the dropped
+# (non-top-k) mass in a persistent per-rank residual buffer that re-enters
+# the next step's selection — the convergence-preserving memory of the
+# sparse wire. "0" disables it (each step's dropped mass is lost), which
+# demonstrably breaks convergence parity; exposed so that claim is
+# testable, not as an operational mode.
+HOROVOD_SPARSE_ERROR_FEEDBACK = "HOROVOD_SPARSE_ERROR_FEEDBACK"
+
 # Generation-ordered sub-buffer flush (docs/tensor-fusion.md; ours, the
 # T3-style compute/collective overlap on the eager plane): cut each cycle
 # tick's pending queue into up to N arrival-ordered sub-buffers that
@@ -426,6 +449,10 @@ class Config:
     tensorwatch_snr_floor_db: float = 20.0
     tensorwatch_snr_window: int = 5
     tensorwatch_worst_k: int = 8
+    # sparse top-k gradient wire (docs/compression.md §sparse)
+    sparse_topk: str = "1"
+    sparse_coverage_floor: float = 0.95
+    sparse_error_feedback: bool = True
     # True when HOROVOD_CACHE_CAPACITY was set explicitly: the tuner then
     # treats the capacity knob as pinned (same contract as
     # fusion_threshold_explicit below).
@@ -517,6 +544,13 @@ class Config:
                 _env_int(HOROVOD_TENSORWATCH_SNR_WINDOW, 5), 1),
             tensorwatch_worst_k=max(
                 _env_int(HOROVOD_TENSORWATCH_WORST, 8), 1),
+            sparse_topk=(os.environ.get(HOROVOD_SPARSE_TOPK, "1")
+                         .strip() or "1"),
+            sparse_coverage_floor=_env_float(
+                HOROVOD_SPARSE_COVERAGE_FLOOR, 0.95),
+            sparse_error_feedback=os.environ.get(
+                HOROVOD_SPARSE_ERROR_FEEDBACK, "1").strip().lower()
+            not in ("0", "false"),
             cache_capacity_explicit=bool(
                 os.environ.get(HOROVOD_CACHE_CAPACITY)),
             start_timeout_s=_env_float(

@@ -75,6 +75,15 @@ CODEC_KNOB = "codec"
 # test): the observatory measures decode SNR only where a decode exists.
 QUANTIZED_CODECS = ("int8", "fp8")
 
+# The sparse (top-k) codec tags — a deliberate small copy of the
+# ``Compression.topk`` sparse set (cross-pinned by test). Their
+# "decode error" is the SELECTION error — the energy the top-k wire
+# drops — so the measured SNR is exactly ``-10·log₁₀(1 - coverage)``
+# of the topk-mass curve at the configured k, and the evidence gate's
+# per-codec floor for them derives from the coverage floor
+# (``coverage_floor_db``), not the quantized dB floor.
+SPARSE_CODECS = ("topk",)
+
 # Top-k mass-coverage curve points: fraction of ‖g‖² in the top q of
 # entries (the ROADMAP sparse-wire item's k ∈ {0.1%, 1%, 10%} design
 # points). Keys are the label values of FAMILY_TOPK.
@@ -126,18 +135,30 @@ def snr_db(signal_power: float, error_power: float) -> float:
     return min(10.0 * math.log10(signal_power / error_power), SNR_CAP_DB)
 
 
+def coverage_floor_db(coverage: float) -> float:
+    """Topk-mass coverage floor (fraction of gradient energy the top-k
+    selection must keep, ``HOROVOD_SPARSE_COVERAGE_FLOOR``) → the
+    equivalent selection-SNR floor in dB: dropping ``1 - c`` of the
+    energy is an SNR of ``-10·log₁₀(1 - c)``, so the sparse codec rides
+    the SAME evidence-gate machinery as the quantized ones, with its
+    floor derived from coverage instead of the quantized dB knob."""
+    c = min(max(float(coverage), 0.0), 1.0)
+    return snr_db(1.0, 1.0 - c)
+
+
 def watch_codecs(cfg) -> Tuple[str, ...]:
-    """The quantized codecs the observatory measures for a Config: the
-    active ``HOROVOD_COMPRESSION`` codec when it is a quantized one,
+    """The lossy codecs the observatory measures for a Config: the
+    active ``HOROVOD_COMPRESSION`` codec when it is quantized or sparse,
     plus every ``HOROVOD_AUTOTUNE_CODECS`` consent candidate — measured
     BEFORE the tuner may apply them, which is what the evidence gate
     certifies on."""
+    lossy = QUANTIZED_CODECS + SPARSE_CODECS
     out: List[str] = []
     active = getattr(cfg, "compression", "none")
-    if active in QUANTIZED_CODECS:
+    if active in lossy:
         out.append(active)
     for codec in getattr(cfg, "autotune_codecs", ()) or ():
-        if codec in QUANTIZED_CODECS and codec not in out:
+        if codec in lossy and codec not in out:
             out.append(codec)
     return tuple(out)
 
@@ -206,7 +227,8 @@ def _np_codec_snr(arr, codec_name: str, size: int) -> Optional[float]:
     from ..ops.compression import Compression
 
     codec = Compression.lookup(codec_name)
-    if not getattr(codec, "quantized", False):
+    if not (getattr(codec, "quantized", False)
+            or getattr(codec, "sparse", False)):
         return None
     flat = np.asarray(arr).reshape(-1)
     if not np.issubdtype(flat.dtype, np.floating) or flat.size == 0:
@@ -237,17 +259,30 @@ class EvidenceGate:
         self._certified: Dict[str, bool] = {}
         self._certified_at: Dict[str, int] = {}
         self._collapsed: Dict[str, bool] = {}
+        self._floors: Dict[str, float] = {}
         self.samples = 0
         self.floor_misses = 0
 
+    def set_floor(self, codec: str, floor_db: float) -> None:
+        """Per-codec floor override: the sparse codec certifies against
+        its coverage-derived floor (``coverage_floor_db``) on the same
+        gate the quantized codecs use the dB knob for."""
+        with self._lock:
+            self._floors[codec] = float(floor_db)
+
+    def floor_for(self, codec: str) -> float:
+        with self._lock:
+            return self._floors.get(codec, self.floor_db)
+
     def observe(self, codec: str, value_db: float) -> None:
         with self._lock:
+            floor = self._floors.get(codec, self.floor_db)
             self.samples += 1
             hist = self._history.get(codec)
             if hist is None:
                 hist = self._history[codec] = deque(maxlen=self.window)
             hist.append(float(value_db))
-            if value_db < self.floor_db:
+            if value_db < floor:
                 self.floor_misses += 1
                 if self._certified.get(codec):
                     # in-flight collapse: the evidence that admitted the
@@ -256,7 +291,7 @@ class EvidenceGate:
                 self._certified[codec] = False
             elif not self._certified.get(codec) and \
                     len(hist) == self.window and \
-                    all(v >= self.floor_db for v in hist):
+                    all(v >= floor for v in hist):
                 self._certified[codec] = True
                 self._certified_at[codec] = self.samples
                 self._collapsed.pop(codec, None)
@@ -278,7 +313,7 @@ class EvidenceGate:
             hist = self._history.get(codec)
             return {
                 "codec": codec,
-                "floor_db": self.floor_db,
+                "floor_db": self._floors.get(codec, self.floor_db),
                 "window": self.window,
                 "snr_db_window": [round(v, 3) for v in hist] if hist
                 else [],
@@ -360,11 +395,18 @@ def evidence_gate() -> Optional[EvidenceGate]:
 
             interval = max(_env_int(HOROVOD_TENSORWATCH_INTERVAL, 0), 0)
             if interval > 0:
+                from ..core.config import HOROVOD_SPARSE_COVERAGE_FLOOR
+
                 _gate = EvidenceGate(
                     _env_float(HOROVOD_TENSORWATCH_SNR_FLOOR,
                                DEFAULT_SNR_FLOOR_DB),
                     max(_env_int(HOROVOD_TENSORWATCH_SNR_WINDOW,
                                  DEFAULT_SNR_WINDOW), 1))
+                # Sparse codecs certify against their coverage floor
+                # (selection SNR, dB-equivalent) on the same gate.
+                cov = _env_float(HOROVOD_SPARSE_COVERAGE_FLOOR, 0.95)
+                for c in SPARSE_CODECS:
+                    _gate.set_floor(c, coverage_floor_db(cov))
             _gate_built = True
         return _gate
 
@@ -499,7 +541,9 @@ class TensorWatch:
         self.rank = int(rank)
         self.snr_floor_db = float(snr_floor_db)
         self.worst_k = max(int(worst_k), 1)
-        self.codecs = tuple(c for c in codecs if c in QUANTIZED_CODECS)
+        self.codecs = tuple(
+            c for c in codecs
+            if c in QUANTIZED_CODECS or c in SPARSE_CODECS)
         self._probe = probe
         self._snr_probe = snr_probe
         self._norm2_probe = norm2_probe
@@ -577,7 +621,7 @@ class TensorWatch:
         self.samples += 1
         fams["samples"].inc()
         measured = []
-        if codec in QUANTIZED_CODECS:
+        if codec in QUANTIZED_CODECS or codec in SPARSE_CODECS:
             measured.append(codec)
         for cand in self.codecs:
             if cand not in measured:
@@ -639,11 +683,14 @@ class TensorWatch:
                     round(batch_topk[key] / batch_norm2, 6))
         for c, value in batch_min_snr.items():
             fams["codec_snr"].labels(codec=c).set(round(value, 3))
+            floor = self.snr_floor_db
             if self._gate is not None:
                 self._gate.observe(c, value)
-            if value < self.snr_floor_db:
+                # the sparse codec's floor is its coverage bound in dB
+                floor = self._gate.floor_for(c)
+            if value < floor:
                 fams["floor_misses"].labels(codec=c).inc()
-            if value < self.snr_floor_db + NEAR_MISS_MARGIN_DB:
+            if value < floor + NEAR_MISS_MARGIN_DB:
                 from . import flightrec as _flightrec
 
                 _flightrec.record(_flightrec.EV_TENSORWATCH,
@@ -739,14 +786,21 @@ def from_config(cfg, size: int = 1, rank: int = 0, probe=None,
     interval = getattr(cfg, "tensorwatch_interval_steps", 0)
     if interval <= 0:
         return None
+    codecs = watch_codecs(cfg)
+    gate = ensure_gate(cfg.tensorwatch_snr_floor_db,
+                       cfg.tensorwatch_snr_window)
+    # The sparse codec's admit/revert floor is its coverage knob mapped
+    # to dB (selection SNR = -10*log10(1-coverage)) — same gate, same
+    # window, its own floor.
+    cov = getattr(cfg, "sparse_coverage_floor", 0.95)
+    for c in SPARSE_CODECS:
+        gate.set_floor(c, coverage_floor_db(cov))
     return TensorWatch(
         interval, size=size, rank=rank,
         snr_floor_db=cfg.tensorwatch_snr_floor_db,
         worst_k=cfg.tensorwatch_worst_k,
-        codecs=watch_codecs(cfg), probe=probe, snr_probe=snr_probe,
-        norm2_probe=norm2_probe, timeline=timeline,
-        gate=ensure_gate(cfg.tensorwatch_snr_floor_db,
-                         cfg.tensorwatch_snr_window))
+        codecs=codecs, probe=probe, snr_probe=snr_probe,
+        norm2_probe=norm2_probe, timeline=timeline, gate=gate)
 
 
 def tensor_report() -> dict:

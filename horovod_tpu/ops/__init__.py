@@ -87,11 +87,13 @@ def _submit(op: RequestType, tensor: Any, name: Optional[str],
             "axis_name= to use the SPMD collective instead.")
     name = _auto_name(OP_NAMES[op], name)
     compressed, comp_ctx = compression.compress(tensor)
-    # Quantized codecs compress INSIDE the collective (shared block scales
-    # need a cross-rank pmax, impossible pre-submit); the negotiation
-    # metadata carries the codec tag so every rank picks the same wire.
+    # Quantized and sparse codecs compress INSIDE the collective (shared
+    # block scales need a cross-rank pmax, top-k pairs need the gather —
+    # impossible pre-submit); the negotiation metadata carries the codec
+    # tag so every rank picks the same wire.
     codec = getattr(compression, "codec_name", "none") \
-        if getattr(compression, "quantized", False) else "none"
+        if (getattr(compression, "quantized", False)
+            or getattr(compression, "sparse", False)) else "none"
     if _is_jax(compressed):
         # JAX arrays stay device-resident: the engine fuses and reduces
         # them with on-chip programs (no host round-trip) whenever the
@@ -189,6 +191,14 @@ def allreduce(tensor: Any, average: bool = True, name: Optional[str] = None,
             return spmd.quantized_allreduce(tensor, axis_name,
                                             average=average,
                                             codec=compression)
+        if getattr(compression, "sparse", False):
+            # top-k sparse wire: select -> gather pairs -> scatter-add,
+            # see spmd.sparse_allreduce (error feedback is the caller's
+            # state to thread — call spmd.sparse_allreduce directly with
+            # ``residual=`` to carry it)
+            return spmd.sparse_allreduce(tensor, axis_name,
+                                         average=average,
+                                         codec=compression)
         compressed, ctx = compression.compress(tensor)
         reduced = spmd.allreduce(compressed, axis_name, average=average)
         return compression.decompress(reduced, ctx)
@@ -239,7 +249,8 @@ def fused_apply_async(grad: Any, param: Any, slots, rule, count: int,
             f"leaves, got {len(slots)}")
     name = _auto_name("allreduce", name)
     codec = getattr(compression, "codec_name", "none") \
-        if getattr(compression, "quantized", False) else "none"
+        if (getattr(compression, "quantized", False)
+            or getattr(compression, "sparse", False)) else "none"
     arr = _device_snapshot(grad) if _is_jax(grad) else _to_numpy(grad)
     engine = get_engine()
     handle = engine.enqueue(

@@ -34,9 +34,13 @@ class Compressor:
 
     # Negotiation tag + routing flags, uniform across every codec so the
     # ops layer can duck-type (the TF front-end mirrors these on its own
-    # Compression classes without importing jax).
+    # Compression classes without importing jax). ``quantized`` routes the
+    # dense block-scaled wire; ``sparse`` routes the top-k indices+values
+    # wire — both compress INSIDE the collective, so both ride the codec
+    # negotiation tag rather than compress()/decompress().
     codec_name = "none"
     quantized = False
+    sparse = False
 
     @staticmethod
     def compress(tensor):
@@ -260,25 +264,140 @@ class FP8Compressor(_BlockQuantCompressor):
         return jnp.float8_e4m3fn
 
 
+class TopKCompressor(Compressor):
+    """Top-k sparse wire with error feedback (docs/compression.md §sparse):
+    each rank ships the ``k = max(1, ceil(f * n))`` largest-magnitude
+    entries of its (residual-corrected) contribution as ``int32`` flat
+    indices + ``float32`` values over the reference allgather shape
+    (Horovod's only sparse path, ``tensorflow/__init__.py:72-83``), and
+    every rank scatter-adds all ranks' pairs back into the dense result.
+    The dropped ``n - k`` entries accumulate in a persistent per-rank
+    residual buffer (``ops.engine``) and re-enter the next step's
+    selection — the error-feedback memory that preserves convergence.
+
+    Like the quantized codecs, ``compress``/``decompress`` are identity:
+    selection needs the residual state and the decode needs every rank's
+    pairs, so the whole cycle lives inside the collective and only the
+    ``codec_name`` negotiation tag rides the control plane. The active
+    fraction is NOT part of the tag — it is the ``HOROVOD_SPARSE_TOPK``
+    knob, pinned process-wide via :meth:`set_fraction_key` (the launcher's
+    uniform env export keeps it identical on every rank, the same
+    contract as ``HOROVOD_CACHE_CAPACITY``)."""
+
+    codec_name = "topk"
+    sparse = True
+    INDEX_DTYPE = jnp.int32
+    VALUE_DTYPE = jnp.float32
+    # percent keys match the tensorwatch sparse-readiness curve
+    # (obs.tensorwatch.TOPK_FRACTIONS — cross-pinned by tests) so the
+    # topk-mass coverage the observatory already measures IS the evidence
+    # the gate certifies k against.
+    FRACTIONS = {"0.1": 0.001, "1": 0.01, "10": 0.1}
+    FRACTION_KEY = "1"
+
+    @classmethod
+    def set_fraction_key(cls, key) -> str:
+        """Pin the active top-k fraction (the ``HOROVOD_SPARSE_TOPK``
+        value). Unknown keys fail loudly — a silently rescaled k would
+        change the wire on one rank only."""
+        key = str(key).strip()
+        if key not in cls.FRACTIONS:
+            raise ValueError(
+                f"bad HOROVOD_SPARSE_TOPK value {key!r}; expected one of "
+                f"{', '.join(sorted(cls.FRACTIONS, key=float))} (percent "
+                f"of entries kept)")
+        cls.FRACTION_KEY = key
+        return key
+
+    @classmethod
+    def fraction(cls, key=None) -> float:
+        key = cls.FRACTION_KEY if key is None else str(key).strip()
+        if key not in cls.FRACTIONS:
+            raise ValueError(
+                f"bad HOROVOD_SPARSE_TOPK value {key!r}; expected one of "
+                f"{', '.join(sorted(cls.FRACTIONS, key=float))}")
+        return cls.FRACTIONS[key]
+
+    @classmethod
+    def k_of(cls, n_elems: int, key=None) -> int:
+        """Entries kept for an ``n_elems`` payload at the active (or
+        given) fraction key; never 0 — an empty contribution would make
+        the gathered wire shape degenerate."""
+        n = int(n_elems)
+        if n <= 0:
+            return 0
+        f = cls.fraction(key)
+        return min(n, max(1, -(-int(round(n * f * 1000)) // 1000)))
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+    @classmethod
+    def wire_cost(cls, n_elems: int, size: int,
+                  in_itemsize: int = 4) -> tuple:
+        """Sparse wire: this rank's contribution leg is ``k`` (index,
+        value) pairs — 8 bytes each — against the dense leg's
+        ``n * in_itemsize`` (the same per-rank-contribution convention
+        the dense codecs charge). The gathered RECEIVE side is ``size``
+        times the post cost (the reference allgather shape); the
+        benchmark's auditor measures that side directly."""
+        k = cls.k_of(n_elems)
+        return (n_elems * in_itemsize,
+                k * (jnp.dtype(cls.INDEX_DTYPE).itemsize
+                     + jnp.dtype(cls.VALUE_DTYPE).itemsize))
+
+    @classmethod
+    def roundtrip_error(cls, flat, size: int = 1) -> tuple:
+        """``(signal_power, error_power)`` of one LOCAL top-k selection
+        leg: the kept entries are exact, so the error power is exactly
+        the dropped mass ``sum(x_dropped**2)`` and ``1 -
+        err_power/sig_power`` is the codec's energy coverage — the same
+        quantity the tensorwatch topk-mass curve reports at this key.
+        ``size`` is accepted for signature uniformity with the quantized
+        codecs (selection is per-contribution; the world size only scales
+        the gathered wire, not the local error)."""
+        import numpy as np
+
+        flat = np.asarray(flat, dtype=np.float32).reshape(-1)
+        n = int(flat.size)
+        if n == 0:
+            return 0.0, 0.0
+        k = cls.k_of(n)
+        mag = np.abs(flat)
+        # partition, not sort: only the threshold membership matters
+        keep = np.argpartition(mag, n - k)[n - k:]
+        dropped = flat.astype(np.float64)
+        dropped[keep] = 0.0
+        sig = flat.astype(np.float64)
+        return float((sig * sig).sum()), float((dropped * dropped).sum())
+
+
 class Compression:
     """Optional gradient compression algorithm used during allreduce
     (``compression.py:67-74``; ``int8``/``fp8`` extend the reference
-    surface with the EQuARX quantized wire)."""
+    surface with the EQuARX quantized wire, ``topk`` with the sparse
+    top-k + error-feedback wire)."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
     int8 = Int8Compressor
     fp8 = FP8Compressor
+    topk = TopKCompressor
 
     @staticmethod
     def lookup(name):
         """Codec by negotiation tag (the ``HOROVOD_COMPRESSION`` values):
-        none / fp16 / bf16 / int8 / fp8."""
+        none / fp16 / bf16 / int8 / fp8 / topk."""
         codec = getattr(Compression, (name or "none").strip().lower(), None)
         if codec is None or not (isinstance(codec, type)
                                  and issubclass(codec, Compressor)):
             raise ValueError(
                 f"unknown compression codec {name!r}; expected one of "
-                f"none, fp16, bf16, int8, fp8")
+                f"none, fp16, bf16, int8, fp8, topk")
         return codec

@@ -1120,8 +1120,20 @@ class ControllerService:
         combined = _combine(resp, slot)
         if self._consensus_authority is not None and \
                 resp.response_type == ResponseType.ALLREDUCE:
+            observed = combined
+            if _sparse_codec(getattr(resp, "tensor_codec", "none")):
+                # Sparse wire: the authority digests the DECODED DENSE
+                # result — what training consumes — via the SAME shared
+                # decode the ranks run (bit-identical float scatter
+                # order), so a corrupt pair on one rank's receive leg
+                # still names that rank (docs/compression.md §sparse).
+                from . import sparse_wire
+
+                observed = sparse_wire.decode_sum(
+                    combined, resp.payload_bytes // 4,
+                    len(slot)).tobytes()
             self._consensus_authority.observe_combine(resp.tensor_names,
-                                                      combined)
+                                                      observed)
         return combined
 
     def _current_cycle(self, rank: int) -> int:
@@ -1384,10 +1396,16 @@ class ControllerService:
             # every rank executes the identical quantized program. Only
             # default-wire allreduces of the large tensor class are
             # eligible; explicitly quantized traffic keeps its codec.
+            sparse_tuned = _sparse_codec(self._applied_codec)
             for resp in response_list.responses:
                 if resp.response_type == ResponseType.ALLREDUCE and \
                         resp.tensor_codec == "none" and \
-                        resp.payload_bytes >= self._codec_min_bytes:
+                        resp.payload_bytes >= self._codec_min_bytes and \
+                        (not sparse_tuned
+                         or resp.tensor_dtype == DataType.FLOAT32):
+                    # the sparse wire is f32-only by layout: stamping a
+                    # non-f32 batch would only trip the engine's
+                    # deterministic downgrade (and its warning) per step
                     resp.tensor_codec = self._applied_codec
         ack = None
         if self._cache is not None:
@@ -1531,12 +1549,27 @@ class ControllerService:
             return self._world_shutdown or self._abort_fired
 
 
+def _sparse_codec(codec: str) -> bool:
+    """Whether a negotiated codec tag names the top-k sparse wire."""
+    if not codec or codec == "none":
+        return False
+    from .compression import Compression
+
+    return bool(getattr(Compression.lookup(codec), "sparse", False))
+
+
 def _combine(resp: Response, slot: Dict[int, bytes]) -> bytes:
     """Host-mode data plane: the numpy reduction the coordinator applies to
     the gathered per-rank payloads. Only used for CPU test worlds; the TPU
     data plane is XLA collectives (SURVEY §2.10: "host fallback via numpy
     only for tests")."""
     if resp.response_type == ResponseType.ALLREDUCE:
+        if _sparse_codec(getattr(resp, "tensor_codec", "none")):
+            # Top-k sparse wire (docs/compression.md §sparse): equal-K
+            # rank payloads concatenate rank-ordered — the reference
+            # allgather shape (Horovod ``tensorflow/__init__.py:72-83``);
+            # every rank scatter-adds the pairs to the dense sum itself.
+            return b"".join(slot[rank] for rank in sorted(slot))
         dtype = numpy_dtype(resp.tensor_dtype)
         total: Optional[np.ndarray] = None
         for rank in sorted(slot):

@@ -49,6 +49,7 @@ from .controller import (
 )
 from .messages import (
     OP_NAMES as _OP_NAMES,
+    DataType,
     Request,
     RequestList,
     RequestType,
@@ -57,6 +58,17 @@ from .messages import (
     ResponseType,
     dtype_of,
 )
+
+
+def _is_sparse_codec(codec: str) -> bool:
+    """Whether a negotiated codec tag names the top-k sparse wire
+    (docs/compression.md §sparse) — the routing fork shared by the
+    plain and apply-fused allreduce paths."""
+    if codec == "none":
+        return False
+    from .compression import Compression
+
+    return bool(getattr(Compression.lookup(codec), "sparse", False))
 
 # Observability plane (docs/tracing.md): time spent turning negotiated
 # responses into results — the "execute" half of the straggler report's
@@ -769,6 +781,20 @@ class Engine:
         # cycle k's allreduce on the flush worker. 1 (default) keeps
         # today's single-flush barrier byte-identically: no worker, no
         # data channel, the untouched loop body.
+        # Sparse top-k error-feedback residuals (docs/compression.md
+        # §sparse): the dropped (non-top-k) mass of every sparse batch,
+        # carried per tensor name so it re-enters the next step's
+        # selection. Stamped with the elastic world epoch — a relaunch
+        # restarts from committed state, so pre-relaunch residuals must
+        # never replay into it (pinned by tests/test_zzsparse.py). The
+        # fraction key is validated loudly at init, not at first batch.
+        from .compression import TopKCompressor
+
+        TopKCompressor.set_fraction_key(cfg.sparse_topk)
+        self._sparse_residuals: Dict[str, Any] = {}
+        self._sparse_epoch = basics.world_epoch()
+        self._sparse_error_feedback = cfg.sparse_error_feedback
+
         self._subbuffers = cfg.fusion_subbuffers
         # Fused reduce+apply plane (docs/tensor-fusion.md §fused apply):
         # execution strategy for apply-capable batches — True runs the
@@ -1065,6 +1091,22 @@ class Engine:
         compatible."""
         if codec == "none":
             return codec
+        if _is_sparse_codec(codec):
+            # The sparse indices+values wire is float32-only by layout,
+            # but unlike the quantized wire it has a REAL host-plane
+            # transport (the coordinator's reference allgather combine),
+            # so a plane-less world keeps the codec; only a non-f32
+            # batch degrades — still decided from negotiated metadata.
+            if dtype_of(entry.array) == DataType.FLOAT32:
+                return codec
+            if ("codec", codec) not in self._host_fallback_warned:
+                self._host_fallback_warned.add(("codec", codec))
+                LOG.warning(
+                    "sparse allreduce (%s) requested for a non-float32 "
+                    "batch; reducing dense at full precision (the "
+                    "sparse wire's value block is float32 by layout).",
+                    codec)
+            return "none"
         if self._plane is not None and self._plane.supports_quantized(
                 dtype_of(entry.array)):
             return codec
@@ -1214,10 +1256,10 @@ class Engine:
             if codec not in self._host_fallback_warned:
                 self._host_fallback_warned.add(codec)
                 LOG.warning(
-                    "quantized allreduce (%s) is not carried by the native "
-                    "controller wire; reducing at full precision. Set "
-                    "HOROVOD_NATIVE_CONTROLLER=0 to use the quantized "
-                    "eager data plane.", codec)
+                    "compressed allreduce (%s) is not carried by the "
+                    "native controller wire; reducing dense at full "
+                    "precision. Set HOROVOD_NATIVE_CONTROLLER=0 to use "
+                    "the compressed eager data plane.", codec)
             codec = "none"
         with self._lock:
             if self._stop_requested:
@@ -1906,6 +1948,12 @@ class Engine:
         # Ineligible dtypes and plane-less (host TCP) worlds deterministically
         # ride the full-precision wire.
         codec = self._downgrade_codec(entries[0], codec)
+        if _is_sparse_codec(codec):
+            # Top-k sparse wire (docs/compression.md §sparse): its own
+            # select → gather → scatter-decode route; the branch reads
+            # only the negotiated codec, identical on every rank.
+            return self._run_sparse_allreduce(idx, entries, codec,
+                                              cycle_no=cycle_no)
         device_in = all(_is_jax_array(e.array) for e in entries)
         if device_in and self._client is None:
             # World of one, device tensors: sum over a single rank without
@@ -2002,6 +2050,159 @@ class Engine:
                                 codec)
         return results
 
+    def _run_sparse_allreduce(self, idx: int,
+                              entries: List[TensorTableEntry],
+                              codec: str,
+                              cycle_no: Optional[int] = None) -> List:
+        """Fused allreduce over the top-k sparse indices+values wire
+        (docs/compression.md §sparse): per-tensor top-k selection of
+        this rank's contribution (+ carried error-feedback residual),
+        the pairs shipped over the reference allgather shape — the
+        coordinator concatenates equal-K rank payloads; the XLA plane
+        runs two tiled all_gathers per entry — and scatter-added back
+        to the dense SUM on every rank.  Dropped mass lands in the
+        per-tensor residual (``self._sparse_residuals``) and re-enters
+        the next step's selection, which is what preserves convergence.
+
+        Consensus digests the DECODED DENSE result: the rank side via
+        ``_screen_reduced`` over these results, the coordinator side
+        via the same ``sparse_wire.decode_sum`` over the combined
+        payload — bit-identical float scatter order by construction."""
+        import math as _math
+
+        from . import sparse_wire
+        from .compression import Compression
+
+        tl = self.timeline
+        chaos = self._data_chaos
+        watch = self._tensorwatch
+        comp = Compression.lookup(codec)
+        feedback = self._sparse_error_feedback
+        epoch = basics.world_epoch()
+        if epoch != self._sparse_epoch:
+            # elastic relaunch: the restored world restarted from
+            # committed state, so replaying pre-relaunch residuals would
+            # double-count the mass they carry
+            self._sparse_residuals.clear()
+            self._sparse_epoch = epoch
+        fused = len(entries) > 1
+        names = [e.name for e in entries]
+        if self._plane is not None:
+            # Device plane (host-fed entries ride it too, like the dense
+            # path): compiled per-entry select/decode around the shared
+            # tiled all_gather program — no full-buffer D2H, residuals
+            # stay device-resident. Plane presence is world-uniform
+            # (the XLA plane requires one JAX process per rank), so
+            # every rank issues the same collective sequence.
+            for e in entries:
+                tl.activity_start(e.name, "EXECUTE")
+            residuals = [self._sparse_residuals.get(e.name)
+                         if feedback else None for e in entries]
+            results, new_res, stats = self._device_call(
+                self._plane.sparse_allreduce_onchip,
+                [e.array for e in entries], residuals, comp, feedback)
+            if feedback:
+                for e, r in zip(entries, new_res):
+                    self._sparse_residuals[e.name] = r
+            sparse_wire.account_batch(
+                stats["selected"], stats["dropped"], stats["wire_bytes"],
+                _math.sqrt(stats["residual_norm2"]), "onchip")
+            for e in entries:
+                tl.activity_end(e.name)
+            if watch is not None and watch.sampling:
+                watch.observe_batch(names, [e.array for e in entries],
+                                    results, codec)
+            return results
+        # Host path: numpy select over the fused corrected buffer, the
+        # wire over the coordinator's payload exchange (or local for a
+        # world of one — still lossy, the codec's semantics don't change
+        # with world size).
+        spans, off = [], 0
+        for e in entries:
+            n = int(e.array.size)
+            spans.append((off, n))
+            off += n
+        n_dense = off
+        if fused:
+            for e in entries:
+                tl.activity_start(e.name, "MEMCPY_IN_FUSION_BUFFER")
+        parts = []
+        for e, (start, n) in zip(entries, spans):
+            flat = np.asarray(e.array).ravel().astype(np.float32,
+                                                      copy=False)
+            if feedback:
+                r = self._sparse_residuals.get(e.name)
+                if r is not None:
+                    flat = flat + r
+            parts.append(flat)
+        buf = np.concatenate(parts) if fused \
+            else np.ascontiguousarray(parts[0])
+        if fused:
+            for e in entries:
+                tl.activity_end(e.name)
+        if chaos is not None:
+            # nan faults poison a COPY of the local input pre-selection,
+            # the same boundary as the dense path (docs/integrity.md)
+            buf = chaos.on_reduce_input(buf)
+        for e in entries:
+            tl.activity_start(e.name, "EXECUTE")
+        idx_parts, val_parts = [], []
+        new_res: Dict[str, np.ndarray] = {}
+        k_total = 0
+        res_norm2 = 0.0
+        for e, (start, n) in zip(entries, spans):
+            seg = buf[start:start + n]
+            k = comp.k_of(n)
+            sidx, svals = sparse_wire.topk_select(seg, k)
+            idx_parts.append(
+                (sidx.astype(np.int64) + start).astype(np.int32))
+            val_parts.append(svals)
+            if feedback:
+                r = np.array(seg, dtype=np.float32, copy=True)
+                r[sidx] = 0.0
+                new_res[e.name] = r
+                res_norm2 += float(np.dot(r, r))
+            k_total += k
+        payload = sparse_wire.pack_pairs(np.concatenate(idx_parts),
+                                         np.concatenate(val_parts))
+        if self._client is None:
+            combined, size = payload, 1
+        else:
+            combined = self._client.payload(self._rank, idx, payload,
+                                            cycle_no=cycle_no)
+            size = self._size
+        g_idx, g_vals = sparse_wire.unpack_wire(combined, size)
+        if chaos is not None:
+            # flipbits faults corrupt THIS rank's received sparse INDEX
+            # stream — a flipped index lands mass on the wrong row, the
+            # decoded-dense divergence the consensus digests exist to
+            # catch (docs/integrity.md; residual bookkeeping above used
+            # the ORIGINAL selected indices, never the flipped ones)
+            g_idx = chaos.on_sparse_indices(g_idx)
+        out = sparse_wire.scatter_sum(g_idx, g_vals, n_dense)
+        if feedback:
+            # commit only after a successful exchange: a wire failure
+            # must not half-advance the residual state
+            self._sparse_residuals.update(new_res)
+        sparse_wire.account_batch(k_total, n_dense - k_total,
+                                  len(payload), _math.sqrt(res_norm2),
+                                  "host")
+        for e in entries:
+            tl.activity_end(e.name)
+        results = []
+        if fused:
+            for e in entries:
+                tl.activity_start(e.name, "MEMCPY_OUT_FUSION_BUFFER")
+        for e, (start, n) in zip(entries, spans):
+            results.append(out[start:start + n].reshape(e.array.shape))
+        if fused:
+            for e in entries:
+                tl.activity_end(e.name)
+        if watch is not None and watch.sampling:
+            watch.observe_batch(names, [e.array for e in entries],
+                                results, codec)
+        return results
+
     # -- fused reduce+apply (docs/tensor-fusion.md §fused apply) --------------
 
     def _warn_apply_once(self, key: str, msg: str, *args) -> None:
@@ -2069,6 +2270,19 @@ class Engine:
             {(c.rule.fingerprint, c.count, c.average)
              for c in ctxs if c is not None}) == 1
         fused = bool(fingerprint) and uniform and self._fused_apply_exec
+        if fused and _is_sparse_codec(
+                getattr(resp, "tensor_codec", "none")):
+            # Sparse batches downgrade to the two-dispatch split (the
+            # existing _downgrade_codec composition rule): the sparse
+            # decode is a gather+scatter, not a psum, so it cannot ride
+            # the donated reduce+apply program. Negotiated-codec
+            # decision — every rank splits the same batches.
+            self._warn_apply_once(
+                "sparse-split",
+                "fused reduce+apply degrades to the split "
+                "reduce-then-apply execution for sparse (top-k) "
+                "batches; applied parameters still land.")
+            fused = False
         # flight recorder (docs/blackbox.md): the negotiated fused-apply
         # strategy and fingerprint for this batch — the evidence a
         # postmortem needs when one rank applied and another reduced.

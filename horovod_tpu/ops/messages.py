@@ -84,11 +84,12 @@ class Request:
     # (``common.h:109``: CPU_DEVICE_ID=-1); on TPU all eager tensors live on
     # the process's device set, so this only distinguishes cpu/tpu paths.
     device: str = "cpu"
-    # Wire-compression codec tag ("none"/"int8"/"fp8"): quantized codecs
-    # change the collective PROGRAM every rank must issue, so the codec is
-    # negotiated like the dtype — mismatches become coordinator errors,
-    # and fusion only batches same-codec tensors. Cast codecs (fp16/bf16)
-    # stay "none" here: they already changed tensor_type itself.
+    # Wire-compression codec tag ("none"/"int8"/"fp8"/"topk"): quantized
+    # and sparse codecs change the collective PROGRAM every rank must
+    # issue, so the codec is negotiated like the dtype — mismatches
+    # become coordinator errors, and fusion only batches same-codec
+    # tensors. Cast codecs (fp16/bf16) stay "none" here: they already
+    # changed tensor_type itself.
     codec: str = "none"
     # Fused reduce+apply fingerprint (docs/tensor-fusion.md §fused
     # apply): the ApplyRule identity this tensor's reduction should land

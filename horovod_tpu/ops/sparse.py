@@ -51,8 +51,15 @@ def allreduce_sparse(slices: IndexedSlices, average: bool = True,
         if average:
             from jax import lax
 
-            gathered_values = gathered_values / lax.axis_size(
-                axis_name if isinstance(axis_name, str) else axis_name[0])
+            # Divide by the product of ALL named axis sizes: a tuple
+            # axis_name gathers size(a)·size(b)·… contributions, so
+            # scaling by only the first axis under-divides multi-axis
+            # meshes (pinned by tests/test_zzsparse.py).
+            denom = 1
+            for ax in ((axis_name,) if isinstance(axis_name, str)
+                       else tuple(axis_name)):
+                denom = denom * lax.axis_size(ax)
+            gathered_values = gathered_values / denom
         return IndexedSlices(gathered_indices, gathered_values,
                              slices.dense_shape)
 

@@ -1,0 +1,159 @@
+"""Host-side wire math of the top-k sparse gradient codec.
+
+ONE module holds the byte layout and the decode arithmetic of the sparse
+wire, because two very different callers must agree bit-for-bit on both:
+
+* the engine's host-fed fused path (``ops/engine.py``) decodes the
+  gathered wire into the dense sum each step, and
+* the coordinator's consensus authority (``ops/controller.py``) decodes
+  the SAME gathered bytes to digest the *decoded dense* result — the
+  integrity contract of docs/compression.md §sparse: consensus screens
+  what training actually consumed, not the transport bytes.
+
+If the two decodes ever drift (different scatter order, different
+clipping), every healthy rank would disagree with the authority and a
+single corrupt rank could no longer be named.  Hence: numpy only, no jax,
+no imports from the ``ops`` package itself (the engine imports this
+module while ``ops/__init__`` is still initializing).
+
+Wire layout (per fused ALLREDUCE batch, float32 only):
+
+    payload(rank) = int32 idx[K] ++ float32 vals[K]     (little-endian)
+
+where ``K = Σᵢ k_of(nᵢ)`` over the batch's entries and each entry's
+indices are OFFSET into the fused buffer.  Every rank's payload has the
+same K (k is a function of the negotiated shapes), so the coordinator
+combines by rank-ordered concatenation — the reference allgather shape
+(Horovod ``tensorflow/__init__.py:72-83``) — and decode is a single
+scatter-add of all ``size·K`` pairs into ``zeros(n_dense)``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ..obs.registry import registry as _metrics
+
+PAIR_BYTES = 8  # int32 index + float32 value
+
+# Sparse-codec families (docs/metrics.md §sparse): how much of the wire
+# the selection kept vs dropped, how much deferred mass the error-feedback
+# residual is carrying, and what actually went on the wire.  Rendered as
+# their own section by tools/metrics_summary.py.
+_SPARSE_SELECTED = _metrics().counter(
+    "horovod_sparse_selected_total",
+    "Gradient entries selected into the top-k sparse wire")
+_SPARSE_DROPPED = _metrics().counter(
+    "horovod_sparse_dropped_total",
+    "Gradient entries dropped by top-k selection (mass goes to residual)")
+_SPARSE_RESIDUAL_NORM = _metrics().gauge(
+    "horovod_sparse_residual_norm",
+    "L2 norm of this rank's error-feedback residual after the last "
+    "sparse batch")
+_SPARSE_WIRE = _metrics().counter(
+    "horovod_sparse_wire_bytes_total",
+    "Bytes this rank contributed to the sparse indices+values wire",
+    labels=("path",))
+
+
+def account_batch(selected: int, dropped: int, wire_bytes: int,
+                  residual_norm: float, path: str) -> None:
+    """Charge one sparse fused batch to the ``horovod_sparse_*`` families."""
+    _SPARSE_SELECTED.inc(selected)
+    _SPARSE_DROPPED.inc(dropped)
+    _SPARSE_WIRE.labels(path=path).inc(wire_bytes)
+    _SPARSE_RESIDUAL_NORM.set(float(residual_norm))
+
+
+def topk_select(flat: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Indices and values of the ``k`` largest-magnitude entries.
+
+    Deterministic: descending |x| with ascending-index tie break, so a
+    rank's selection is a pure function of its bytes (replayable by the
+    flight recorder and stable across numpy versions — ``argpartition``'s
+    boundary tie-breaking is implementation-defined)."""
+    n = flat.size
+    k = min(int(k), n)
+    if k <= 0:
+        return (np.empty((0,), np.int32), np.empty((0,), np.float32))
+    mag = np.abs(flat)
+    order = np.lexsort((np.arange(n), -mag))[:k]
+    idx = np.asarray(order, dtype=np.int32)
+    return idx, np.ascontiguousarray(flat[order], dtype=np.float32)
+
+
+def pack_pairs(idx: np.ndarray, vals: np.ndarray) -> bytes:
+    """One rank's wire payload: the int32 index block then the float32
+    value block (little-endian, matching the dense wire's numpy bytes)."""
+    return (np.ascontiguousarray(idx, dtype="<i4").tobytes()
+            + np.ascontiguousarray(vals, dtype="<f4").tobytes())
+
+
+def unpack_wire(combined: bytes,
+                size: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Split the rank-ordered concatenation of ``size`` equal payloads
+    back into (indices, values), both ``size·K`` long, rank-major — the
+    exact order ``scatter_sum`` accumulates in."""
+    if size <= 0 or len(combined) % (size * PAIR_BYTES):
+        raise ValueError(
+            f"sparse wire of {len(combined)} bytes does not split into "
+            f"{size} equal int32+float32 payloads")
+    seg = len(combined) // size
+    k = seg // PAIR_BYTES
+    idx_parts, val_parts = [], []
+    for r in range(size):
+        block = combined[r * seg:(r + 1) * seg]
+        idx_parts.append(np.frombuffer(block, dtype="<i4", count=k))
+        val_parts.append(
+            np.frombuffer(block, dtype="<f4", offset=4 * k, count=k))
+    return (np.concatenate(idx_parts) if size > 1 else idx_parts[0],
+            np.concatenate(val_parts) if size > 1 else val_parts[0])
+
+
+def scatter_sum(idx: np.ndarray, vals: np.ndarray,
+                n_dense: int) -> np.ndarray:
+    """Dense float32 sum of the gathered pairs.
+
+    Indices are CLIPPED into range, not validated: a corrupt index (the
+    chaos plane's flipbits fault, or a real wire flip) must land mass on
+    the wrong row — a *divergence* every rank and the authority decode
+    identically, so consensus can vote and name the culprit — rather than
+    raise asymmetrically and kill one side of the exchange.
+
+    ``np.add.at`` accumulates pairs strictly in array order, so every
+    caller of this function sees the identical float addition order —
+    the bit-identity the consensus digest depends on."""
+    out = np.zeros((n_dense,), dtype=np.float32)
+    if idx.size:
+        np.add.at(out, np.clip(idx, 0, n_dense - 1),
+                  vals.astype(np.float32, copy=False))
+    return out
+
+
+def decode_sum(combined: bytes, n_dense: int, size: int) -> np.ndarray:
+    """Gathered wire bytes → dense float32 SUM over all ranks.  The ONE
+    decode definition shared by the engine (training result) and the
+    consensus authority (digest of the decoded dense bytes)."""
+    idx, vals = unpack_wire(combined, size)
+    return scatter_sum(idx, vals, n_dense)
+
+
+def select_with_feedback(flat: np.ndarray, residual, k: int,
+                         error_feedback: bool = True):
+    """Top-k select of ``flat`` (+ carried residual) for one tensor.
+
+    Returns ``(idx, vals, new_residual)``: the selected pairs, and the
+    dropped mass to carry into the next step (``None`` when error
+    feedback is off — dropped mass is simply lost, the ablation arm of
+    docs/compression.md §sparse)."""
+    corrected = np.asarray(flat, dtype=np.float32)
+    if error_feedback and residual is not None:
+        corrected = corrected + np.asarray(residual, dtype=np.float32)
+    idx, vals = topk_select(corrected, k)
+    if not error_feedback:
+        return idx, vals, None
+    new_residual = np.array(corrected, dtype=np.float32, copy=True)
+    new_residual[idx] = 0.0
+    return idx, vals, new_residual

@@ -301,6 +301,67 @@ def _quantized_axis_sum(x: jax.Array, axis: str, codec) -> jax.Array:
     return out.reshape(-1)[:n_elems].reshape(orig_shape)
 
 
+def sparse_allreduce(x: jax.Array, axis_name: AxisName,
+                     average: bool = True, codec=None, residual=None):
+    """Allreduce whose wire is top-k (indices, values) pairs — the in-jit
+    twin of the eager engine's sparse codec path (docs/compression.md
+    §sparse): each shard selects its k largest-magnitude entries
+    (``lax.top_k`` over |x|, k from ``codec.k_of``), all-gathers the
+    pairs over the reference allgather shape (Horovod
+    ``tensorflow/__init__.py:72-83``), and scatter-adds every shard's
+    contribution back to the dense sum — ``k·8`` wire bytes per
+    contribution instead of ``n·4``.
+
+    ``residual`` opts into error feedback: pass the carried residual
+    array (same shape as ``x``; zeros on step one) and the call returns
+    ``(out, new_residual)`` — the dropped mass of ``x + residual`` —
+    to thread into the next step. Without it the call returns ``out``
+    alone and dropped mass is simply lost (the ablation arm).
+
+    Non-float inputs and pre-summed cotangents (vma tracking, see
+    :func:`allreduce`) fall back to dense :func:`allreduce` semantics —
+    trace-time static, so every rank lowers the same program."""
+    from .compression import Compression
+
+    _SPMD_LOWERINGS.labels(op="sparse_allreduce").inc()
+    codec = codec or Compression.topk
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        out = allreduce(x, axis_name, average=average)
+        return out if residual is None else (out, residual)
+    if _vma_tracking_active(axis_name) and not _varies_over(x, axis_name):
+        # already reduced by the shard_map transpose (see allreduce)
+        out = x / _axis_size(axis_name) if average else x
+        return out if residual is None else (out, residual)
+    orig_shape, orig_dt = x.shape, x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    if n == 0:
+        out = x
+        return out if residual is None else (out, residual)
+    corrected = flat
+    if residual is not None:
+        corrected = flat + residual.reshape(-1).astype(jnp.float32)
+    k = codec.k_of(n)
+    pre_b, post_b = codec.wire_cost(n, 1)
+    _SPMD_WIRE_PRE.inc(pre_b)
+    _SPMD_WIRE_POST.inc(post_b)
+    _, idx = lax.top_k(jnp.abs(corrected), k)
+    vals = corrected[idx]
+    g_idx, g_vals = idx, vals
+    for a in _axes(axis_name):
+        g_idx = lax.all_gather(g_idx, a, axis=0, tiled=True)
+        g_vals = lax.all_gather(g_vals, a, axis=0, tiled=True)
+    out = jnp.zeros((n,), jnp.float32).at[g_idx].add(g_vals)
+    if average:
+        out = out / _axis_size(axis_name)
+    out = _maybe_sentry(out, flat, axis_name).astype(orig_dt).reshape(
+        orig_shape)
+    if residual is None:
+        return out
+    new_residual = corrected.at[idx].set(0.0)
+    return out, new_residual.astype(orig_dt).reshape(orig_shape)
+
+
 def codec_roundtrip(x: jax.Array, codec, size: int = 1):
     """Collective-free local encode→decode through ``codec``'s block
     math: quantize this contribution with its OWN block scales,

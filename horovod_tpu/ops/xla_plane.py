@@ -143,6 +143,14 @@ class XlaDataPlane:
         return self.supports(dt) and dt in (
             DataType.FLOAT32, DataType.FLOAT16, DataType.BFLOAT16)
 
+    def supports_sparse(self, dt: DataType) -> bool:
+        """Deterministic eligibility for the top-k sparse indices+values
+        wire (docs/compression.md §sparse), decided from the NEGOTIATED
+        dtype like ``supports_quantized``. float32 only: the wire's value
+        block is f32 by layout (``ops.sparse_wire``), and widening other
+        floats through it would launder precision invisibly."""
+        return self.supports(dt) and dt == DataType.FLOAT32
+
     def _wire_parts(self, dtype) -> Tuple[object, object]:
         """(wire dtype, result dtype). CPU gloo lacks 16-bit float reductions,
         so f16/bf16 upcast to f32 on the wire — numerically strictly better
@@ -554,6 +562,104 @@ class XlaDataPlane:
             ("trimrows", shape[1:], str(dt), rows, sizes), _build_trim)
         return trim(local)
 
+    # -- sparse top-k wire (docs/compression.md §sparse) ----------------------
+
+    def _sparse_select_fn(self, n: int, k: int, feedback: bool):
+        """Per-ENTRY compiled top-k select (collective-free): corrected =
+        x (+ residual), ``lax.top_k`` over |corrected| → (idx, vals) and,
+        with error feedback, the new residual (corrected with the selected
+        rows zeroed). Keyed (n, k) — per-entry like the pack/unpack
+        programs, NOT per batch composition, so steady state is all cache
+        hits (the measured-100x-collapse precedent)."""
+        def _build():
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            if feedback:
+                def _sel(x, res):
+                    corrected = x.reshape(-1).astype(jnp.float32) + res
+                    _, idx = lax.top_k(jnp.abs(corrected), k)
+                    return (idx, corrected[idx],
+                            corrected.at[idx].set(0.0))
+            else:
+                def _sel(x):
+                    corrected = x.reshape(-1).astype(jnp.float32)
+                    _, idx = lax.top_k(jnp.abs(corrected), k)
+                    return idx, corrected[idx]
+            return jax.jit(_sel)
+        return self._local_fn(("sptopk", n, k, feedback), _build)
+
+    def _sparse_decode_fn(self, n: int, shape, out_dt):
+        """Per-ENTRY compiled scatter-add decode of the gathered pairs:
+        ``zeros(n).at[clip(idx)].add(vals)`` — the SAME clipping rule as
+        the host decode (``sparse_wire.scatter_sum``): a corrupt index
+        diverges, it never raises asymmetrically."""
+        def _build():
+            import jax
+            import jax.numpy as jnp
+
+            def _dec(g_idx, g_vals):
+                dense = jnp.zeros((n,), jnp.float32).at[
+                    jnp.clip(g_idx, 0, n - 1)].add(g_vals)
+                return dense.astype(out_dt).reshape(shape)
+            return jax.jit(_dec)
+        return self._local_fn(
+            ("spdec", n, tuple(shape), str(out_dt)), _build)
+
+    def sparse_allreduce_onchip(self, arrays: Sequence, residuals,
+                                codec, feedback: bool):
+        """Fused sparse allreduce with ZERO full-buffer host transfers:
+        per entry, the compiled select program picks the top-k pairs on
+        device, the pairs ride the SAME tiled all_gather program the
+        dense allgather path issues (idx then vals — two gathers per
+        entry, launch-order identical on every rank because k and n are
+        functions of the negotiated shapes), and the compiled scatter-add
+        decodes back to the dense SUM.  Residuals stay device-resident.
+
+        Returns ``(results, new_residuals, stats)`` where stats carries
+        the batch's selected/dropped/wire-byte/residual-norm² tallies
+        for the ``horovod_sparse_*`` families."""
+        jax = self._jax
+        import jax.numpy as jnp
+
+        results, new_residuals = [], []
+        total_k = total_n = wire = 0
+        res_norm2 = 0.0
+        for a, res in zip(arrays, residuals):
+            shape = tuple(int(s) for s in a.shape)
+            n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            k = codec.k_of(n)
+            dev = jax.device_put(a, self._local_device)
+            if feedback:
+                r = res if res is not None else np.zeros((n,), np.float32)
+                r_dev = jax.device_put(r, self._local_device)
+                idx_a, vals_a, nres = self._sparse_select_fn(
+                    n, k, True)(dev, r_dev)
+                new_residuals.append(nres)
+                res_norm2 += float(jnp.vdot(nres, nres))
+            else:
+                idx_a, vals_a = self._sparse_select_fn(n, k, False)(dev)
+                new_residuals.append(None)
+            g_idx = self._fn("gather")(self._global_put(idx_a))
+            g_vals = self._fn("gather")(self._global_put(vals_a))
+            results.append(self._sparse_decode_fn(
+                n, shape, np.dtype(a.dtype))(
+                g_idx.addressable_shards[0].data,
+                g_vals.addressable_shards[0].data))
+            total_k += k
+            total_n += n
+            wire += k * 8
+        # Direct accounting, not _account_allreduce: the sparse gathers
+        # are exact-size (k per entry), never bucket-padded, so charging
+        # a power-of-two bucket would overstate the wire.
+        _EAGER_BATCHES.labels(path="sparse").inc()
+        _EAGER_PRE.labels(path="sparse").inc(total_n * 4)
+        _EAGER_POST.labels(path="sparse").inc(wire)
+        stats = {"selected": total_k, "dropped": total_n - total_k,
+                 "wire_bytes": wire, "residual_norm2": res_norm2}
+        return results, new_residuals, stats
+
     def tensorwatch_stats(self, arr) -> dict:
         """Device-side per-tensor numerics census for the gradient
         observatory (docs/tensorwatch.md): ONE compiled collective-free
@@ -666,12 +772,27 @@ class XlaDataPlane:
         the buffer (docs/tensorwatch.md)."""
         def _build():
             import jax
+            import jax.numpy as jnp
+            from jax import lax
 
             from .compression import Compression
             from .spmd import codec_roundtrip
 
             c = Compression.lookup(codec)
             size = self._size
+            if getattr(c, "sparse", False):
+                # Sparse "decode error" is SELECTION error: the energy
+                # the top-k pass drops. k is static at trace time (the
+                # jit re-specializes per input shape), so top_k compiles
+                # exact-size — no roundtrip buffer needed.
+                def _rt(x):
+                    flat = x.reshape(-1).astype(jnp.float32)
+                    k = max(c.k_of(flat.shape[0]), 1)
+                    sig = jnp.sum(flat * flat)
+                    vals, _ = lax.top_k(jnp.abs(flat), k)
+                    return sig, jnp.maximum(
+                        sig - jnp.sum(vals * vals), 0.0)
+                return jax.jit(_rt)
             return jax.jit(lambda x: codec_roundtrip(x, c, size))
 
         fn = self._local_fn(("twsnr", codec), _build)

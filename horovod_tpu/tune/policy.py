@@ -52,7 +52,7 @@ KNOB_SERVING_EDGES = "serving_bucket_edges"
 
 # Prometheus gauges are numeric; the codec knob reports this id mapping
 # (documented in docs/autotune.md).
-CODEC_IDS = {"none": 0, "int8": 1, "fp8": 2}
+CODEC_IDS = {"none": 0, "int8": 1, "fp8": 2, "topk": 3}
 
 _RETUNES = _metrics().counter(
     "horovod_autotune_retunes_total",
@@ -68,7 +68,7 @@ _DISCARDS = _metrics().counter(
 _KNOB_GAUGE = _metrics().gauge(
     "horovod_autotune_knob",
     "Current value of each tuned knob (codec reported as its id: "
-    "none=0 int8=1 fp8=2)", labels=("knob",))
+    "none=0 int8=1 fp8=2 topk=3)", labels=("knob",))
 
 
 @dataclass

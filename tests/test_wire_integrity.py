@@ -436,6 +436,6 @@ def test_mp_data_grid_slow(cell_idx):
     abort); the skip and consensus cells run in tier-1 above."""
     from horovod_tpu.chaos.matrix import DATA_GRID, run_data_cell
 
-    spec, policy, consensus, expect = DATA_GRID[cell_idx]
-    cell = run_data_cell(spec, policy, consensus, expect)
+    spec, policy, consensus, expect, codec = DATA_GRID[cell_idx]
+    cell = run_data_cell(spec, policy, consensus, expect, codec=codec)
     assert cell["outcome"] == expect, cell

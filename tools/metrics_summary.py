@@ -104,6 +104,14 @@ FLIGHTREC_PREFIXES = ("horovod_flightrec_", "horovod_timeline_dropped_")
 NUMERICS_PREFIXES = ("horovod_tensorwatch_", "horovod_tensor_",
                      "horovod_codec_snr_db")
 
+# Sparse-wire families (docs/compression.md §sparse): selected/dropped
+# entry counters, the per-rank residual-norm gauge, and wire bytes by
+# path — the "how much mass is the top-k wire shipping vs banking?"
+# glance. A growing residual norm beside a healthy selected/dropped
+# ratio is the error-feedback loop working; a runaway one is the
+# collapse signal the evidence gate reverts on.
+SPARSE_PREFIXES = ("horovod_sparse_",)
+
 
 def _render_section(title: str, families: Dict[str, dict], prefix: str,
                     out, skip: tuple = ()) -> None:
@@ -165,6 +173,15 @@ def _render_numerics_section(families: Dict[str, dict], prefix: str,
     _render_section("numerics plane", numerics, prefix, out)
 
 
+def _render_sparse_section(families: Dict[str, dict], prefix: str,
+                           out) -> None:
+    sparse = {n: f for n, f in families.items()
+              if n.startswith(SPARSE_PREFIXES) and n.startswith(prefix)}
+    if not sparse:
+        return  # no sparse wire in this snapshot: no empty section
+    _render_section("sparse wire", sparse, prefix, out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="pretty-print a saved /metrics.json or "
@@ -193,10 +210,11 @@ def main(argv=None) -> int:
     _render_serving_section(world, args.family, sys.stdout)
     _render_flightrec_section(world, args.family, sys.stdout)
     _render_numerics_section(world, args.family, sys.stdout)
+    _render_sparse_section(world, args.family, sys.stdout)
     _render_section("world", world, args.family, sys.stdout,
                     skip=TUNING_PREFIXES + INTEGRITY_PREFIXES
                     + SERVING_PREFIXES + FLIGHTREC_PREFIXES
-                    + NUMERICS_PREFIXES)
+                    + NUMERICS_PREFIXES + SPARSE_PREFIXES)
     # JSON round-trips rank keys as strings; accept either
     by_rank = {int(k): v for k, v in ranks.items()}
     wanted = sorted(by_rank) if args.all else (

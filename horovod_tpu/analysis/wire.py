@@ -15,6 +15,14 @@ Cross-references the wire-compat registry
 * HVL403: registry entry names a tag/field the code no longer has, or
   carries no degrade text — the registry only stays authoritative if it
   cannot rot.
+
+Since the checkpoint plane the same scan covers the elastic driver
+service (``elastic/health.py:ElasticService`` vs ``ELASTIC_RPC_TAGS``)
+and the serving coordinator (``serving/plane.py:ServingPlane`` vs
+``SERVING_RPC_TAGS``): their wires grew real vocabularies (chunked
+commit streams, journal persistence, weight-swap acks) and a tag
+shipped without its degrade story is the same HVL401 no matter which
+service dispatches it — findings carry the service class name.
 """
 
 from __future__ import annotations
@@ -26,6 +34,8 @@ from .base import Finding, SourceModule, const_str
 
 CONTROLLER_REL = "horovod_tpu/ops/controller.py"
 MESSAGES_REL = "horovod_tpu/ops/messages.py"
+ELASTIC_REL = "horovod_tpu/elastic/health.py"
+SERVING_REL = "horovod_tpu/serving/plane.py"
 MESSAGE_CLASSES = ("Request", "RequestList", "Response", "CacheRequest")
 
 
@@ -125,6 +135,38 @@ def check(controller_mod: SourceModule, messages_mod: SourceModule,
     return findings
 
 
+def check_service(mod: SourceModule, service_class: str,
+                  registry: Dict[str, str]) -> List[Finding]:
+    """HVL401/HVL403 for one driver-side service wire (ElasticService,
+    ServingPlane): same scan and codes as the controller, finding keys
+    namespaced by the service class so the baselines cannot collide."""
+    findings: List[Finding] = []
+    tags = scan_rpc_tags(mod, service_class=service_class)
+    registry_rel = "horovod_tpu/analysis/wire_registry.py"
+    for tag, line in sorted(tags.items()):
+        if tag not in registry:
+            findings.append(Finding(
+                code="HVL401", path=mod.rel, line=line,
+                message=f"RPC tag {tag!r} handled by {service_class} has "
+                        "no wire-compat registry entry naming its "
+                        "old-peer degrade",
+                key=f"rpc:{service_class}:{tag}"))
+    for tag, note in sorted(registry.items()):
+        if tag not in tags:
+            findings.append(Finding(
+                code="HVL403", path=registry_rel, line=0,
+                message=f"registry RPC tag {tag!r} is not dispatched by "
+                        f"{service_class} any more — delete the entry",
+                key=f"stale-rpc:{service_class}:{tag}"))
+        elif not str(note).strip():
+            findings.append(Finding(
+                code="HVL403", path=registry_rel, line=0,
+                message=f"registry RPC tag {tag!r} ({service_class}) has "
+                        "an empty degrade note",
+                key=f"empty-rpc:{service_class}:{tag}"))
+    return findings
+
+
 def run(root: str, modules: List[SourceModule]) -> List[Finding]:
     del root
     from . import wire_registry
@@ -138,5 +180,20 @@ def run(root: str, modules: List[SourceModule]) -> List[Finding]:
             message="controller/messages module missing — wire-compat "
                     "lint cannot run",
             key="wire-scan-missing")]
-    return check(controller, messages, wire_registry.RPC_TAGS,
-                 wire_registry.MESSAGE_FIELDS)
+    findings = check(controller, messages, wire_registry.RPC_TAGS,
+                     wire_registry.MESSAGE_FIELDS)
+    for rel, service_class, registry in (
+            (ELASTIC_REL, "ElasticService",
+             wire_registry.ELASTIC_RPC_TAGS),
+            (SERVING_REL, "ServingPlane",
+             wire_registry.SERVING_RPC_TAGS)):
+        mod = next((m for m in modules if m.rel == rel), None)
+        if mod is None:
+            findings.append(Finding(
+                code="HVL403", path=rel, line=0,
+                message=f"{service_class} module missing — its "
+                        "wire-compat lint cannot run",
+                key=f"wire-scan-missing:{service_class}"))
+            continue
+        findings.extend(check_service(mod, service_class, registry))
+    return findings

@@ -13,6 +13,15 @@ cross-references this dict against the AST of ``ops/controller.py`` and
 re-derived by hand: "the native wire predates the field → deterministic
 degrade, warned once".
 
+Since the checkpoint plane (docs/checkpoint.md) the same discipline
+covers the two OTHER driver-side services that grew real wire
+vocabularies: ``ELASTIC_RPC_TAGS`` (``elastic/health.py``'s
+``ElasticService`` — heartbeats, the commit store, the chunked ckpt
+streams and the ticket journal) and ``SERVING_RPC_TAGS``
+(``serving/plane.py``'s ``ServingPlane`` — dispatch, the result
+rendezvous, weight-swap acks), checked by the same scanner under the
+same HVL401/HVL403 codes with the service class named in the finding.
+
 ``ERROR_CLASSES`` plays the same role for the error taxonomy
 (HVL603): a ``HorovodInternalError`` subclass defined outside
 ``core/status.py`` must be registered with the story of how its
@@ -52,6 +61,69 @@ RPC_TAGS: Dict[str, str] = {
     "flightrec": "Python controller only (PR 14): native wire predates "
                  "the incident-push RPC — the flight recorder degrades "
                  "to a rank-local blackbox dump, warned once",
+}
+
+# RPC tags dispatched by ElasticService._handle (elastic/health.py) —
+# scanned since the checkpoint plane (docs/checkpoint.md) grew this wire
+# past the original beat/commit vocabulary. The native C++ controller
+# never speaks this service at all (the elastic driver is pure Python),
+# so the degrade story is about OLD-DRIVER peers: a worker whose driver
+# predates a tag gets ValueError'd at dispatch, which the sender treats
+# as the documented fallback.
+ELASTIC_RPC_TAGS: Dict[str, str] = {
+    "beat": "baseline elastic wire: liveness heartbeat since PR 2",
+    "goodbye": "baseline elastic wire: clean-exit deregistration",
+    "commit": "baseline elastic wire: the legacy synchronous whole-tree "
+              "state push (rank 0)",
+    "fetch": "baseline elastic wire: restore fetch of the legacy store",
+    "advise_evict": "PR 12: a driver that predates the tag errors the "
+                    "advisory request; the coordinator's detector warns "
+                    "once and keeps training (advisory-only degrade)",
+    "ckpt_begin": "checkpoint plane: a driver that predates the plane "
+                  "errors the stream open; the AsyncCommitter drops the "
+                  "stream with a warning and the rank's commits degrade "
+                  "to the legacy synchronous push (HOROVOD_CKPT_ASYNC "
+                  "should be unset against old drivers)",
+    "ckpt_chunk": "checkpoint plane: same stream as ckpt_begin — an "
+                  "old driver never sees chunks because the begin "
+                  "already failed; a lost chunk leaves the commit "
+                  "unsealed, which restore treats as 'never happened'",
+    "ckpt_end": "checkpoint plane: the digest vote + seal ack; without "
+                "it a commit can never seal, so restore falls back to "
+                "the last sealed (or legacy) commit — the safe default",
+    "ckpt_fetch": "checkpoint plane: sealed-epoch restore; on any error "
+                  "State._fetch_sealed falls back to the legacy "
+                  "('fetch',) store, warned once",
+    "ckpt_journal_put": "checkpoint plane: gateway ticket journal "
+                        "persistence; an old driver errors the put and "
+                        "the journal degrades to gateway-process memory "
+                        "(requests survive relaunches but not driver "
+                        "restarts)",
+    "ckpt_journal_get": "checkpoint plane: journal lookup twin of "
+                        "ckpt_journal_put, same in-memory degrade",
+    "ckpt_journal_del": "checkpoint plane: journal cleanup twin of "
+                        "ckpt_journal_put, same in-memory degrade",
+}
+
+# RPC tags dispatched by ServingPlane._handle (serving/plane.py) — the
+# serving coordinator wire (PR 11), scanned since the checkpoint plane
+# added hot-swap frames to it. Same peer model as the elastic service:
+# Python-only coordinator, so degrades are about version-skewed workers.
+SERVING_RPC_TAGS: Dict[str, str] = {
+    "shello": "baseline serving wire (PR 11): rank identification + "
+              "epoch fence at connect",
+    "infer": "baseline serving wire (PR 11): the batch dispatch "
+             "broadcast; since the checkpoint plane its answer may also "
+             "be a ('swap', ...) frame — a worker that predates swaps "
+             "fails its `assert resp[0] == 'batch'`, raises "
+             "ServingAbortedError, and the elastic driver relaunches it "
+             "(loud, never torn weights)",
+    "result": "baseline serving wire (PR 11): the digest rendezvous",
+    "swap_ack": "checkpoint plane: weight-swap receipt; a plane that "
+                "predates the tag ValueErrors the ack, the worker's "
+                "ServingAbortedError tears the world down and the "
+                "relaunch re-arms both sides at the same version — "
+                "acks can be lost, weights can never tear",
 }
 
 # Fields of the negotiation messages (ops/messages.py): the rank ->

@@ -82,6 +82,26 @@ SERVING_GRID = [
 ]
 
 
+# Checkpoint-plane grid (docs/checkpoint.md): the async commit pipeline
+# under the two kill shapes that matter to sealing. Cells are
+# (HOROVOD_ELASTIC_FAULT, HOROVOD_CKPT_FAULT, expected outcome), all
+# with HOROVOD_CKPT_ASYNC=1 and a chunk size small enough that every
+# commit streams multiple chunks. The contract: a kill ANYWHERE in the
+# commit path (before the snapshot, or between two chunks of the
+# stream) relaunches and restores the last SEALED commit bit-exactly —
+# never a torn/partial one — and a clean run never relaunches at all.
+CHECKPOINT_GRID = [
+    ("", "", "clean"),
+    # rank 1 dies right before commit 2: commit 1 is sealed, restore
+    # adopts it
+    ("1:2", "", "recovered"),
+    # rank 0's streaming thread dies between chunk 0 and chunk 1 of
+    # commit 2: the partial stream must never seal; restore adopts
+    # sealed commit 1
+    ("", "0:2:1", "recovered"),
+]
+
+
 def _matrix_fn(steps: int, expect_escalation: bool):
     """Per-rank body (shipped by value through runner.run's driver)."""
     import jax
@@ -492,6 +512,140 @@ def _finish_serving_cell(cell: Dict, spec: str, fault: str,
     return cell
 
 
+def _ckpt_world_fn(total_steps):
+    """Per-rank body for one checkpoint cell (shipped by value through
+    the elastic driver): integer-valued accumulation so bit-exact
+    restore IS the fault-free result, with ≥4 KiB of state so the cell's
+    1 KiB chunk knob forces a real multi-chunk stream. Each step commits
+    then drains the async stream — the drain is what makes the
+    kill-between-chunks fault deterministic (commit N's stream is fully
+    sealed before commit N+1 starts)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.basics import world_epoch
+    from horovod_tpu.elastic import State
+
+    hvd.init()
+    state = State(w=np.zeros(1024, np.float32), step=0)
+
+    def train(state):
+        while state.step < total_steps:
+            grad = hvd.allreduce(
+                np.full(1024, float(state.step + 1), np.float32),
+                average=False, name=f"chaos.ck.{state.step}")
+            state.w = state.w + np.asarray(grad)
+            state.step += 1
+            state.commit()
+            state.flush_commits()
+        return {"step": state.step, "w0": float(state.w[0]),
+                "epoch": world_epoch(),
+                "restore": state.restore_source,
+                "restore_no": state.restore_commit_no}
+
+    out = state.run(train)
+    hvd.shutdown()
+    return out
+
+
+def run_checkpoint_cell(elastic_fault: str, ckpt_fault: str, expect: str,
+                        native_core: Optional[int] = None,
+                        np_: int = 2, steps: int = 3,
+                        timeout_s: float = 240.0,
+                        deadline_s: float = 120.0) -> Dict:
+    """Run one checkpoint cell: a 2-proc elastic world on the async
+    commit pipeline under one kill. Outcomes: ``clean`` (no fault, no
+    relaunch, exact result), ``recovered`` (relaunched AND restored from
+    a SEALED commit bit-exactly), ``wrong-restore`` (finished with the
+    wrong numbers, or restored from something other than the sealed
+    ledger), ``hang``, ``escalated``."""
+    import os
+
+    from horovod_tpu.runner import run_elastic
+
+    env = {
+        "HOROVOD_ELASTIC_FAULT": elastic_fault,
+        "HOROVOD_CKPT_FAULT": ckpt_fault,
+        "HOROVOD_CKPT_ASYNC": "1",
+        "HOROVOD_CKPT_CHUNK_BYTES": "1024",
+        "HOROVOD_PLATFORM": "cpu",
+        "HOROVOD_CYCLE_TIME": "2",
+    }
+    if native_core is not None:
+        env["HOROVOD_NATIVE_CORE"] = str(native_core)
+    t0 = time.monotonic()
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        results = run_elastic(
+            _ckpt_world_fn, args=(steps,), np=np_, min_np=np_,
+            max_restarts=2, backoff_s=0.2, timeout_s=timeout_s,
+            start_timeout_s=120.0, heartbeat_interval_s=0.5,
+            heartbeat_miss_limit=6, env_extra=dict(env))
+        cell = _classify_checkpoint_results(results, elastic_fault,
+                                            ckpt_fault, np_, steps)
+    except TimeoutError as exc:
+        cell = {"outcome": "hang", "error": str(exc)[:500]}
+    except Exception as exc:  # noqa: BLE001 - classified as escalation
+        cell = {"outcome": "escalated",
+                "error": f"{type(exc).__name__}: {exc}"[:500]}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    cell["elastic_fault"] = elastic_fault
+    cell["ckpt_fault"] = ckpt_fault
+    cell["native_core"] = native_core
+    cell["elapsed_s"] = round(time.monotonic() - t0, 2)
+    if cell["outcome"] == "recovered" and cell["elapsed_s"] > deadline_s:
+        cell["outcome"] = "late-recovery"
+    return cell
+
+
+def _classify_checkpoint_results(results, elastic_fault: str,
+                                 ckpt_fault: str, np_: int,
+                                 steps: int) -> Dict:
+    """Bit-exact-or-name-the-failure: the unfailed run's numbers are
+    computable in closed form (integer sums in float32), so equality IS
+    the restored-correctly contract."""
+    expected_w0 = float(np_ * sum(range(1, steps + 1)))
+    faulted = bool(elastic_fault or ckpt_fault)
+    if len(results) != np_:
+        return {"outcome": "escalated",
+                "error": f"expected {np_} results, got {results!r}"[:500]}
+    for r in results:
+        if r.get("step") != steps or r.get("w0") != expected_w0:
+            return {"outcome": "wrong-restore",
+                    "error": f"expected step={steps} w0={expected_w0}, "
+                             f"got {results!r}"[:500]}
+    epochs = {r.get("epoch") for r in results}
+    if not faulted:
+        if epochs != {0}:
+            return {"outcome": "escalated",
+                    "error": f"clean cell relaunched: epochs {epochs}"}
+        return {"outcome": "clean", "results": results}
+    if epochs == {0}:
+        return {"outcome": "escalated",
+                "error": "fault cell never relaunched (fault did not "
+                         "fire?)"}
+    # only root fetches the store; the sealed provenance lives on the
+    # rank that adopted the commit and broadcast it
+    sources = {r.get("restore") for r in results}
+    if "sealed" not in sources:
+        return {"outcome": "wrong-restore",
+                "error": f"relaunch restored from {sources} — not the "
+                         f"sealed ledger"}
+    restore_no = next(r.get("restore_no") for r in results
+                      if r.get("restore") == "sealed")
+    return {"outcome": "recovered", "results": results,
+            "restore_no": restore_no}
+
+
 def run_cell(spec: str,
              native_controller: Optional[int] = None,
              native_core: Optional[int] = None,
@@ -686,7 +840,32 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "200-bit-exact, kill-rank-mid-batch must "
                              "relaunch with every request 200 or a "
                              "structured 503 — never a hang")
+    parser.add_argument("--checkpoint", action="store_true",
+                        help="run the checkpoint-plane grid instead "
+                             "(docs/checkpoint.md): kill-before-commit "
+                             "and kill-between-chunks must relaunch and "
+                             "restore the last SEALED commit bit-exactly; "
+                             "a clean async run must never relaunch")
     args = parser.parse_args(argv)
+    if args.checkpoint:
+        failed = 0
+        for elastic_fault, ckpt_fault, expect in CHECKPOINT_GRID:
+            cell = run_checkpoint_cell(elastic_fault, ckpt_fault, expect,
+                                       np_=args.np_)
+            ok = cell["outcome"] == expect
+            if not ok:
+                failed += 1
+            label = (f"elastic={elastic_fault}" if elastic_fault
+                     else f"ckpt={ckpt_fault}" if ckpt_fault else "clean")
+            sealed = (f"  sealed_no={cell['restore_no']}"
+                      if "restore_no" in cell else "")
+            print(f"ckpt-cell {'OK ' if ok else 'BAD'} "
+                  f"outcome={cell['outcome']:<13} "
+                  f"{cell['elapsed_s']:6.1f}s  {label}{sealed}",
+                  flush=True)
+            if not ok:
+                print(f"  {cell.get('error', '')}", flush=True)
+        return 1 if failed else 0
     if args.serving:
         failed = 0
         for spec, fault, expect in SERVING_GRID:

@@ -1,46 +1,17 @@
-"""Checkpoint/resume helpers: rank-0 storage + broadcast-consistent restore.
+"""DEPRECATED location — the checkpoint plane owns checkpoint I/O now.
 
-The reference delegates checkpoint I/O to the framework and contributes the
-consistency contract (SURVEY §5.4): save only on rank 0 (README Usage step
-6; ``examples/tensorflow_mnist.py`` passes checkpoint_dir=None off rank 0)
-and push rank-0 state to every rank after restore
-(``BroadcastGlobalVariablesHook`` / ``broadcast_parameters``). Storage here
-is orbax — the JAX-native checkpointer — wrapped so both halves of that
-contract are one call.
+This module is a compatibility shim: the rank-0 orbax storage +
+broadcast-consistent restore helpers moved verbatim to
+``horovod_tpu/ckpt/files.py`` when the checkpoint plane landed
+(docs/checkpoint.md), so there is exactly one checkpoint implementation.
+``save``/``restore`` keep working from here unchanged; new code should
+import :mod:`horovod_tpu.ckpt` — which also carries what this module
+never had: the async in-training commit pipeline, digest-sealed epochs,
+and the train-to-serve hot-swap path.
 """
 
 from __future__ import annotations
 
-import os
-from typing import Any, Optional
+from .ckpt.files import restore, save  # noqa: F401
 
-from . import basics
-from .state_bcast import broadcast_parameters
-
-
-def _checkpointer():
-    import orbax.checkpoint as ocp
-
-    return ocp.PyTreeCheckpointer()
-
-
-def save(path: str, state: Any, force: bool = True) -> None:
-    """Write ``state`` (any pytree) from rank 0 only; other ranks no-op
-    (the reference's checkpoint_dir=None convention)."""
-    if basics.rank() != 0:
-        return
-    _checkpointer().save(os.path.abspath(os.path.expanduser(path)), state,
-                         force=force)
-
-
-def restore(path: str, template: Optional[Any] = None,
-            root_rank: int = 0, broadcast: bool = True) -> Any:
-    """Restore on every rank and broadcast root's copy so all ranks start
-    identical even if their filesystems disagree (rank-0 truth, exactly the
-    post-restore broadcast the reference prescribes)."""
-    restored = _checkpointer().restore(
-        os.path.abspath(os.path.expanduser(path)), item=template)
-    if broadcast and basics.size() > 1:
-        restored = broadcast_parameters(
-            restored, root_rank=root_rank, name_prefix="checkpoint_restore")
-    return restored
+__all__ = ["save", "restore"]

@@ -1,0 +1,22 @@
+"""Checkpoint plane (docs/checkpoint.md): async sharded commits,
+digest-sealed epochs, live train-to-serve weight swaps.
+
+Three coordinated pieces, all on the existing control-plane machinery:
+
+* :mod:`~horovod_tpu.ckpt.committer` — the rank-side
+  :class:`AsyncCommitter`: ``State.commit()`` stalls for O(snapshot)
+  and a background thread streams the chunked tree over its own
+  identified connection (the PR-9 second-connection pattern).
+* :mod:`~horovod_tpu.ckpt.store` — the driver-side :class:`SealLedger`
+  (a commit is *sealed* only when every rank's shard digest arrived and
+  agrees and the payload is complete; restore always lands on the last
+  sealed commit, bit-exactly) and the gateway :class:`TicketJournal`.
+* :mod:`~horovod_tpu.ckpt.files` — the filesystem leg (rank-0 orbax
+  save + broadcast-consistent restore), relocated from the legacy
+  top-level ``horovod_tpu/checkpoint.py``.
+"""
+
+from .committer import (AsyncCommitter, observe_commit_stall,  # noqa: F401
+                        parse_ckpt_fault)
+from .files import restore, save  # noqa: F401
+from .store import SealLedger, TicketJournal  # noqa: F401

@@ -1,0 +1,236 @@
+"""Rank-side async commit pipeline: snapshot → background chunk stream.
+
+The :class:`AsyncCommitter` is the per-rank half of the checkpoint plane
+(docs/checkpoint.md). ``State.commit()`` hands it the already-snapshotted
+host tree and RETURNS — the stall the training loop pays is O(snapshot),
+independent of state size — while a daemon streaming thread pickles the
+tree, digests it, and ships ``ckpt_begin`` / ``ckpt_chunk`` / ``ckpt_end``
+frames to the driver's :class:`~horovod_tpu.ckpt.store.SealLedger`.
+
+The stream rides its OWN identified ``BasicClient`` connection — the
+PR-9 second-connection pattern: a parked multi-megabyte commit stream
+must never hold the wire the negotiation cycle (or the heartbeat) is
+waiting on.
+
+Supersession is latest-wins: the pending slot holds ONE tree, and a new
+``submit`` while the thread is still streaming the previous commit
+replaces it — under backpressure the plane ships the freshest state
+instead of queueing a convoy (each skip is counted). Rank 0 streams the
+payload; every other rank ships only begin + digest vote, which is what
+lets the ledger seal = verify across the world (PR-8 bar) without
+shipping the model N times.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from typing import Optional, Tuple
+
+from ..core import config as _config
+from ..core.config import _env_float, _env_int
+from ..basics import world_epoch
+from ..core.logging import LOG
+from ..integrity.consensus import tree_digest
+from ..obs.registry import registry as _metrics
+from ..runner.network import BasicClient, default_secret
+
+_COMMITS = _metrics().counter(
+    "horovod_ckpt_commits_total",
+    "Async checkpoint commits submitted to the streaming thread")
+_SKIPPED = _metrics().counter(
+    "horovod_ckpt_skipped_total",
+    "Pending commits superseded before their stream started (latest-wins "
+    "backpressure: the plane ships the freshest state, never a convoy)")
+_CHUNKS = _metrics().counter(
+    "horovod_ckpt_chunks_total",
+    "Checkpoint payload chunk frames streamed to the driver ledger")
+_BYTES = _metrics().counter(
+    "horovod_ckpt_bytes_total",
+    "Checkpoint payload bytes streamed to the driver ledger")
+_STREAM_S = _metrics().histogram(
+    "horovod_ckpt_stream_seconds",
+    "Wall time of one background commit stream (pickle + digest + frames)")
+_STALL_S = _metrics().histogram(
+    "horovod_ckpt_commit_stall_seconds",
+    "Commit-path stall the TRAINING LOOP paid per State.commit() — the "
+    "bench headline: ~flat vs state size when async, linear when "
+    "synchronous")
+
+
+def parse_ckpt_fault(spec: str) -> Optional[Tuple[int, int, int]]:
+    """``"rank:ckpt[:chunk]"`` → ``(rank, ckpt_no, chunk_seq)`` or None.
+
+    The kill-between-chunks twin of ``elastic.state.parse_fault_spec``:
+    the victim rank dies with ``os._exit`` in its STREAMING thread right
+    before sending chunk ``chunk_seq`` (0-based, default 0) of commit
+    ``ckpt``, leaving that commit unsealed at the ledger. Malformed
+    specs parse to None, like the elastic twin.
+    """
+    if not spec:
+        return None
+    parts = spec.split(":")
+    if len(parts) not in (2, 3):
+        return None
+    try:
+        rank = int(parts[0])
+        ckpt_no = int(parts[1])
+        chunk = int(parts[2]) if len(parts) == 3 else 0
+    except ValueError:
+        return None
+    return rank, ckpt_no, chunk
+
+
+def _maybe_inject_ckpt_fault(rank: int, ckpt_no: int, chunk_seq: int) -> None:
+    """Kill-between-chunks drill (HOROVOD_CKPT_FAULT): epoch-0 only so
+    the fault never re-fires after the relaunch restores."""
+    fault = parse_ckpt_fault(os.environ.get(_config.HOROVOD_CKPT_FAULT, ""))
+    if fault is None or world_epoch() != 0:
+        return
+    f_rank, f_ckpt, f_chunk = fault
+    if rank == f_rank and ckpt_no == f_ckpt and chunk_seq == f_chunk:
+        LOG.warning(
+            "HOROVOD_CKPT_FAULT firing: rank %d dying before chunk %d of "
+            "commit %d (the commit stays unsealed)", rank, chunk_seq, ckpt_no)
+        os._exit(13)
+
+
+class AsyncCommitter:
+    """One background streaming thread + one dedicated wire per rank."""
+
+    def __init__(self, addr: Tuple[str, int], rank: int, world: int,
+                 secret: Optional[bytes] = None,
+                 chunk_bytes: Optional[int] = None,
+                 timeout_s: Optional[float] = None) -> None:
+        self._addr = addr
+        self._rank = int(rank)
+        self._world = int(world)
+        self._secret = secret if secret is not None else default_secret()
+        self._chunk_bytes = max(int(
+            chunk_bytes if chunk_bytes is not None else
+            _env_int(_config.HOROVOD_CKPT_CHUNK_BYTES, 1 << 20)), 1)
+        self._timeout_s = float(
+            timeout_s if timeout_s is not None else
+            _env_float(_config.HOROVOD_CKPT_PUSH_TIMEOUT_S, 60.0))
+        self._client: Optional[BasicClient] = None
+        self._cond = threading.Condition()
+        # latest-wins pending slot: (ckpt_no, tree, epoch) or None
+        self._pending: Optional[Tuple[int, object, int]] = None
+        self._streaming = False
+        self._closed = False
+        self.last_sealed = -1  # last seal ack observed on the wire
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-committer", daemon=True)
+        self._thread.start()
+
+    # -- training-loop side (the O(snapshot) path) -----------------------------
+
+    def submit(self, ckpt_no: int, tree, epoch: int) -> None:
+        """Hand a snapshotted host tree to the stream; returns at once."""
+        with self._cond:
+            if self._closed:
+                return
+            if self._pending is not None:
+                _SKIPPED.inc()
+            self._pending = (int(ckpt_no), tree, int(epoch))
+            _COMMITS.inc()
+            self._cond.notify_all()
+
+    def wait_idle(self, timeout_s: float = 30.0) -> bool:
+        """Block until the pending slot drained AND the stream finished
+        (tests and clean shutdowns; the training loop never calls this)."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._pending is not None or self._streaming:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(timeout=min(left, 0.2))
+        return True
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._drop_client()
+
+    # -- streaming thread ------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closed:
+                    self._cond.wait(timeout=0.2)
+                if self._closed and self._pending is None:
+                    return
+                ckpt_no, tree, epoch = self._pending
+                self._pending = None
+                self._streaming = True
+            try:
+                self._stream(ckpt_no, tree, epoch)
+            except Exception as exc:  # noqa: BLE001 - stream is best-effort
+                LOG.warning(
+                    "ckpt: async stream of commit %d failed: %s (the commit "
+                    "stays unsealed; recovery restores the previous sealed "
+                    "epoch)", ckpt_no, exc)
+                self._drop_client()
+            finally:
+                with self._cond:
+                    self._streaming = False
+                    self._cond.notify_all()
+
+    def _stream(self, ckpt_no: int, tree, epoch: int) -> None:
+        t0 = time.monotonic()
+        payload = pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL)
+        digest = tree_digest(tree)
+        meta = {"commit_no": ckpt_no, "world": self._world}
+        client = self._client_or_dial()
+        resp = client.request(("ckpt_begin", epoch, ckpt_no, self._rank,
+                               meta))
+        assert resp and resp[0] == "ok", resp
+        n_chunks = 0
+        if self._rank == 0:
+            # only the root ships bytes; the other ranks' digest votes
+            # are what turns the seal into a verification
+            step = self._chunk_bytes
+            n_chunks = max((len(payload) + step - 1) // step, 1)
+            for seq in range(n_chunks):
+                _maybe_inject_ckpt_fault(self._rank, ckpt_no, seq)
+                chunk = payload[seq * step:(seq + 1) * step]
+                resp = client.request(
+                    ("ckpt_chunk", epoch, ckpt_no, self._rank, seq, chunk))
+                assert resp and resp[0] == "ok", resp
+                _CHUNKS.inc()
+                _BYTES.inc(len(chunk))
+        resp = client.request(
+            ("ckpt_end", epoch, ckpt_no, self._rank, n_chunks, digest))
+        assert resp and resp[0] == "ok", resp
+        sealed_no = int(resp[1])
+        self.last_sealed = sealed_no
+        _STREAM_S.observe(time.monotonic() - t0)
+        if sealed_no >= ckpt_no:
+            from ..obs import flightrec
+            flightrec.record(flightrec.EV_CKPT_SEAL, ordinal=sealed_no)
+
+    def _client_or_dial(self) -> BasicClient:
+        if self._client is None:
+            self._client = BasicClient(
+                self._addr, secret=self._secret, attempts=3,
+                timeout_s=self._timeout_s)
+        return self._client
+
+    def _drop_client(self) -> None:
+        client, self._client = self._client, None
+        if client is not None:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def observe_commit_stall(seconds: float) -> None:
+    """State.commit() reports the stall the training loop actually paid
+    (both paths — the bench compares the two histograms)."""
+    _STALL_S.observe(seconds)

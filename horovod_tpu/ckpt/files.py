@@ -1,0 +1,55 @@
+"""File-format checkpoint I/O: rank-0 orbax storage + broadcast restore.
+
+The filesystem leg of the checkpoint plane (docs/checkpoint.md). This is
+the former top-level ``horovod_tpu/checkpoint.py`` relocated verbatim —
+the plane owns every checkpoint implementation now, and the legacy
+module is a re-export shim — carrying the reference's consistency
+contract (SURVEY §5.4): save only on rank 0 (README Usage step 6;
+``examples/tensorflow_mnist.py`` passes checkpoint_dir=None off rank 0)
+and push rank-0 state to every rank after restore
+(``BroadcastGlobalVariablesHook`` / ``broadcast_parameters``). Storage
+is orbax — the JAX-native checkpointer — wrapped so both halves of that
+contract are one call.
+
+The reference repo's Keras ``ModelCheckpoint``-callback era hooks map
+here (docs/api-mapping.md): ``save`` is the rank-0-gated write, and the
+async in-training path those callbacks never had is
+``elastic.State.commit()`` over the :mod:`~horovod_tpu.ckpt.committer`
+pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from .. import basics
+from ..state_bcast import broadcast_parameters
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save(path: str, state: Any, force: bool = True) -> None:
+    """Write ``state`` (any pytree) from rank 0 only; other ranks no-op
+    (the reference's checkpoint_dir=None convention)."""
+    if basics.rank() != 0:
+        return
+    _checkpointer().save(os.path.abspath(os.path.expanduser(path)), state,
+                         force=force)
+
+
+def restore(path: str, template: Optional[Any] = None,
+            root_rank: int = 0, broadcast: bool = True) -> Any:
+    """Restore on every rank and broadcast root's copy so all ranks start
+    identical even if their filesystems disagree (rank-0 truth, exactly the
+    post-restore broadcast the reference prescribes)."""
+    restored = _checkpointer().restore(
+        os.path.abspath(os.path.expanduser(path)), item=template)
+    if broadcast and basics.size() > 1:
+        restored = broadcast_parameters(
+            restored, root_rank=root_rank, name_prefix="checkpoint_restore")
+    return restored

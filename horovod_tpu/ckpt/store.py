@@ -1,0 +1,321 @@
+"""Driver-resident checkpoint store: seal ledger + ticket journal.
+
+The :class:`SealLedger` is the coordinator half of the checkpoint plane
+(docs/checkpoint.md). It lives inside the elastic driver's
+``ElasticService`` — the process that survives world relaunches — and
+ingests the chunked commit streams the per-rank
+:class:`~horovod_tpu.ckpt.committer.AsyncCommitter` ships over its
+dedicated connection.
+
+Sealing semantics (the whole point): checkpoint commit N is **sealed**
+only when
+
+* every rank of the committing world announced N (``ckpt_begin``),
+* every rank's shard digest arrived (``ckpt_end``) and all digests
+  AGREE (PR-8 consensus bar: a sealed epoch is a verified epoch), and
+* rank 0's payload arrived complete (all ``n_chunks`` chunk frames).
+
+A kill mid-commit therefore leaves N unsealed — partial chunk state is
+dropped at the next ``begin_epoch`` — and restore always lands on the
+last *sealed* commit, bit-exactly. Seals are monotonic: a late or
+replayed stream for an already-superseded commit number is ignored.
+
+The :class:`TicketJournal` shares the store: the serving gateway
+journals in-flight request envelopes through it so a driver restart
+(``HOROVOD_CKPT_DIR`` set) resumes them instead of losing them to a
+world abort.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from ..core.logging import LOG
+from ..integrity.consensus import digest_bytes
+from ..obs.registry import registry as _metrics
+
+_SEALS = _metrics().counter(
+    "horovod_ckpt_seals_total",
+    "Checkpoint commits sealed by the driver ledger (every rank's shard "
+    "digest arrived and agreed, rank-0 payload complete)")
+_SEALED_NO = _metrics().gauge(
+    "horovod_ckpt_sealed_commit",
+    "Highest sealed checkpoint commit number (-1 until the first seal)")
+_DIGEST_MISMATCHES = _metrics().counter(
+    "horovod_ckpt_digest_mismatches_total",
+    "Checkpoint commits REFUSED a seal because per-rank shard digests "
+    "diverged (the commit stays unsealed; restore keeps the previous "
+    "sealed epoch)")
+_JOURNAL_ENTRIES = _metrics().gauge(
+    "horovod_ckpt_journal_entries",
+    "Live entries in the gateway ticket journal")
+
+# File names under HOROVOD_CKPT_DIR. The payload and its sidecar meta
+# are written first, the SEALED pointer last — a torn driver death
+# between the two leaves the pointer at the previous sealed commit,
+# which is exactly the restore contract.
+_SEALED_POINTER = "SEALED"
+_JOURNAL_FILE = "journal.json"
+
+
+class _Partial:
+    """One in-flight (unsealed) commit: chunk assembly + digest votes."""
+
+    __slots__ = ("meta", "world", "digests", "chunks", "n_chunks")
+
+    def __init__(self) -> None:
+        self.meta: dict = {}
+        self.world: int = 0
+        self.digests: Dict[int, str] = {}
+        self.chunks: Dict[int, bytes] = {}
+        self.n_chunks: int = -1
+
+    def complete(self) -> bool:
+        if self.world <= 0 or len(self.digests) < self.world:
+            return False
+        if self.n_chunks < 0 or len(self.chunks) < self.n_chunks:
+            return False
+        return True
+
+
+class SealLedger:
+    """Epoch-fenced ingest of chunked commit streams; seal on agreement.
+
+    ``dir`` (``HOROVOD_CKPT_DIR``) is optional: unset keeps the ledger
+    in driver memory (survives world relaunches, not a driver restart);
+    set, every seal is spilled to disk and a fresh ledger reloads the
+    last sealed commit, refusing a payload whose bytes digest does not
+    match its sidecar (a torn spill restores the previous epoch instead
+    of garbage).
+    """
+
+    def __init__(self, dir: Optional[str] = None,
+                 on_seal: Optional[Callable[[int, dict, bytes], None]] = None
+                 ) -> None:
+        self._lock = threading.Lock()
+        self._epoch = 0
+        self._partials: Dict[int, _Partial] = {}
+        self._sealed_no = -1
+        self._sealed_meta: dict = {}
+        self._sealed_payload: Optional[bytes] = None
+        self._dir = dir or None
+        self.on_seal = on_seal
+        self.journal = TicketJournal(dir=self._dir)
+        if self._dir:
+            os.makedirs(self._dir, exist_ok=True)
+            self._load_sealed()
+        _SEALED_NO.set(self._sealed_no)
+
+    # -- epoch fence -----------------------------------------------------------
+
+    def begin_epoch(self, epoch: int) -> None:
+        """New world attempt: drop partial streams (a kill mid-commit
+        leaves its commit unsealed forever), KEEP sealed state and the
+        ticket journal — they are exactly what the relaunch restores."""
+        with self._lock:
+            self._epoch = int(epoch)
+            self._partials.clear()
+
+    # -- ingest (ElasticService handler thread) --------------------------------
+
+    def ingest_begin(self, epoch: int, ckpt_no: int, rank: int,
+                     meta: dict) -> None:
+        with self._lock:
+            if not self._admit_locked(epoch, ckpt_no):
+                return
+            part = self._partials.setdefault(int(ckpt_no), _Partial())
+            if not part.meta:
+                part.meta = dict(meta or {})
+            part.world = max(part.world, int(meta.get("world", 0) or 0))
+
+    def ingest_chunk(self, epoch: int, ckpt_no: int, rank: int, seq: int,
+                     payload: bytes) -> None:
+        with self._lock:
+            if not self._admit_locked(epoch, ckpt_no):
+                return
+            part = self._partials.setdefault(int(ckpt_no), _Partial())
+            part.chunks[int(seq)] = bytes(payload)
+
+    def ingest_end(self, epoch: int, ckpt_no: int, rank: int, n_chunks: int,
+                   digest: str) -> int:
+        """Digest vote; returns the current sealed commit number (the
+        seal ack the committer checks to learn whether ITS commit
+        landed)."""
+        callback = None
+        with self._lock:
+            if self._admit_locked(epoch, ckpt_no):
+                part = self._partials.setdefault(int(ckpt_no), _Partial())
+                part.digests[int(rank)] = str(digest)
+                if rank == 0:
+                    part.n_chunks = int(n_chunks)
+                callback = self._maybe_seal_locked(int(ckpt_no))
+            sealed_no = self._sealed_no
+        if callback is not None:
+            callback()
+        return sealed_no
+
+    def _admit_locked(self, epoch: int, ckpt_no: int) -> bool:
+        # Epoch fence (the beat discipline): a stream from a previous
+        # world attempt is a ghost — acknowledged, ignored. Monotonic
+        # seal: a commit at or below the sealed watermark is history.
+        return int(epoch) == self._epoch and int(ckpt_no) > self._sealed_no
+
+    def _maybe_seal_locked(self, ckpt_no: int) -> Optional[Callable]:
+        part = self._partials.get(ckpt_no)
+        if part is None or not part.complete():
+            return None
+        votes = set(part.digests.values())
+        if len(votes) != 1:
+            _DIGEST_MISMATCHES.inc()
+            LOG.warning(
+                "ckpt: commit %d digest disagreement across ranks (%s) — "
+                "NOT sealed; restore keeps commit %d",
+                ckpt_no, sorted(votes), self._sealed_no)
+            del self._partials[ckpt_no]
+            return None
+        payload = b"".join(part.chunks[i] for i in range(part.n_chunks))
+        meta = dict(part.meta)
+        meta["digest"] = next(iter(votes))
+        meta["world"] = part.world
+        del self._partials[ckpt_no]
+        self._sealed_no = ckpt_no
+        self._sealed_meta = meta
+        self._sealed_payload = payload
+        _SEALS.inc()
+        _SEALED_NO.set(ckpt_no)
+        if self._dir:
+            self._spill_locked(ckpt_no, meta, payload)
+        cb = self.on_seal
+        if cb is None:
+            return None
+        # fire outside the lock (the hook may publish to a serving plane
+        # that takes its own locks)
+        return lambda: cb(ckpt_no, meta, payload)
+
+    # -- restore side ----------------------------------------------------------
+
+    def fetch_sealed(self) -> Tuple[int, dict, Optional[bytes]]:
+        with self._lock:
+            return self._sealed_no, dict(self._sealed_meta), \
+                self._sealed_payload
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "sealed_no": self._sealed_no,
+                "partials": sorted(self._partials),
+                "epoch": self._epoch,
+            }
+
+    # -- disk spill / reload ---------------------------------------------------
+
+    def _spill_locked(self, ckpt_no: int, meta: dict, payload: bytes) -> None:
+        try:
+            base = os.path.join(self._dir, "ckpt-%d" % ckpt_no)
+            with open(base + ".bin", "wb") as f:
+                f.write(payload)
+            sidecar = dict(meta)
+            sidecar["bytes_digest"] = digest_bytes(payload)
+            with open(base + ".json", "w") as f:
+                json.dump(sidecar, f)
+            pointer = os.path.join(self._dir, _SEALED_POINTER)
+            with open(pointer + ".tmp", "w") as f:
+                json.dump({"sealed_no": ckpt_no}, f)
+            os.replace(pointer + ".tmp", pointer)  # atomic pointer flip
+        except OSError as exc:  # pragma: no cover - disk-full etc.
+            LOG.warning("ckpt: spill of commit %d failed: %s", ckpt_no, exc)
+
+    def _load_sealed(self) -> None:
+        pointer = os.path.join(self._dir, _SEALED_POINTER)
+        try:
+            with open(pointer) as f:
+                ckpt_no = int(json.load(f)["sealed_no"])
+            base = os.path.join(self._dir, "ckpt-%d" % ckpt_no)
+            with open(base + ".json") as f:
+                meta = json.load(f)
+            with open(base + ".bin", "rb") as f:
+                payload = f.read()
+        except (OSError, ValueError, KeyError):
+            return  # no sealed state on disk: fresh ledger
+        if digest_bytes(payload) != meta.get("bytes_digest"):
+            LOG.warning(
+                "ckpt: on-disk commit %d fails its bytes digest — refusing "
+                "the torn spill, starting unsealed", ckpt_no)
+            return
+        self._sealed_no = ckpt_no
+        self._sealed_meta = meta
+        self._sealed_payload = payload
+        LOG.info("ckpt: reloaded sealed commit %d from %s (digest ok)",
+                 ckpt_no, self._dir)
+
+
+class TicketJournal:
+    """Crash-durable journal of in-flight gateway requests.
+
+    Entries are small JSON-serializable envelopes keyed by the client's
+    ``X-Request-Id``. In-memory by default; with ``dir`` set every
+    mutation rewrites ``journal.json`` (entries are request-sized, the
+    journal is capped, and a rewrite is atomic via ``os.replace`` — the
+    boring durable choice over an append log that needs compaction).
+    """
+
+    def __init__(self, dir: Optional[str] = None,
+                 max_entries: int = 1024,
+                 filename: str = _JOURNAL_FILE) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[str, dict] = {}
+        self._max = max(int(max_entries), 1)
+        self._file = filename
+        self._dir = dir or None
+        if self._dir:
+            os.makedirs(self._dir, exist_ok=True)
+            self._load()
+        _JOURNAL_ENTRIES.set(len(self._entries))
+
+    def put(self, key: str, entry: dict) -> None:
+        with self._lock:
+            self._entries[str(key)] = dict(entry)
+            while len(self._entries) > self._max:  # drop-oldest cap
+                self._entries.pop(next(iter(self._entries)))
+            self._persist_locked()
+            _JOURNAL_ENTRIES.set(len(self._entries))
+
+    def get(self, key: str) -> Optional[dict]:
+        with self._lock:
+            entry = self._entries.get(str(key))
+            return dict(entry) if entry is not None else None
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(str(key), None)
+            self._persist_locked()
+            _JOURNAL_ENTRIES.set(len(self._entries))
+
+    def entries(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._entries.items()}
+
+    def _persist_locked(self) -> None:
+        if not self._dir:
+            return
+        path = os.path.join(self._dir, self._file)
+        try:
+            with open(path + ".tmp", "w") as f:
+                json.dump(self._entries, f)
+            os.replace(path + ".tmp", path)
+        except (OSError, TypeError, ValueError) as exc:
+            LOG.warning("ckpt: journal persist failed: %s", exc)
+
+    def _load(self) -> None:
+        path = os.path.join(self._dir, self._file)
+        try:
+            with open(path) as f:
+                loaded = json.load(f)
+        except (OSError, ValueError):
+            return
+        if isinstance(loaded, dict):
+            self._entries = {str(k): dict(v) for k, v in loaded.items()
+                             if isinstance(v, dict)}

@@ -163,6 +163,43 @@ HOROVOD_HEARTBEAT_INTERVAL = "HOROVOD_HEARTBEAT_INTERVAL"
 # docs/elastic.md.
 HOROVOD_ELASTIC_FAULT = "HOROVOD_ELASTIC_FAULT"
 
+# --- checkpoint plane (horovod_tpu.ckpt; ours, docs/checkpoint.md) -----------
+# Per-request timeout (seconds) of elastic.State's commit push / fetch
+# client. The seed hard-coded 60 s because one synchronous commit frame
+# carried the whole model; the chunked async pipeline makes a generous
+# whole-model timeout both wrong and a silent-hang window, so the bound
+# is a declared knob (default keeps the historical 60 s for the legacy
+# synchronous path).
+HOROVOD_CKPT_PUSH_TIMEOUT_S = "HOROVOD_CKPT_PUSH_TIMEOUT_S"
+# "1" arms the async commit pipeline: every rank hands its committed
+# tree to a background streaming thread (its OWN identified connection —
+# the PR-9 second-connection pattern) that ships chunked frames to the
+# elastic driver's seal ledger while training keeps stepping; commit
+# stall becomes O(snapshot), independent of state size. Unset/"0"
+# (default) keeps the synchronous rank-0 whole-tree push bit-exactly.
+HOROVOD_CKPT_ASYNC = "HOROVOD_CKPT_ASYNC"
+# Chunk size (bytes) of the async commit stream (default 1 MiB): bounds
+# the largest single frame a parked commit stream can occupy the wire
+# with, and is the granularity the kill-between-chunks fault keys on.
+HOROVOD_CKPT_CHUNK_BYTES = "HOROVOD_CKPT_CHUNK_BYTES"
+# Fault-injection hook for the async pipeline: "rank:ckpt[:chunk]" kills
+# that rank with os._exit right BEFORE its streaming thread sends chunk
+# number `chunk` (0-based, default 0) of commit `ckpt` — the
+# kill-between-chunks drill. Epoch-0 only, so the fault never re-fires
+# after the relaunch (the HOROVOD_ELASTIC_FAULT convention).
+HOROVOD_CKPT_FAULT = "HOROVOD_CKPT_FAULT"
+# Directory the driver's seal ledger spills sealed epochs and the
+# gateway's ticket journal into. Unset (default) keeps both in driver
+# memory — they then survive world relaunches but not a driver restart;
+# set, a restarted driver reloads the last sealed epoch (bytes-digest
+# verified) and resumes journaled in-flight requests.
+HOROVOD_CKPT_DIR = "HOROVOD_CKPT_DIR"
+# Commit cadence of State.maybe_commit(): commit every Nth call
+# (default 1 = every call). Also the checkpoint plane's knob on the
+# autotune ladder (tune.policy.ckpt_interval_knob); an explicitly-set
+# env pins it, per the standard pin rule.
+HOROVOD_CKPT_INTERVAL_STEPS = "HOROVOD_CKPT_INTERVAL_STEPS"
+
 # --- chaos plane + self-healing control plane (ours; docs/chaos.md) ----------
 # Deterministic fault-injection spec for the controller wire, e.g.
 # "drop@rank1:msg12,delay@rank0:50ms:every7,seed:7" (grammar in
@@ -453,6 +490,13 @@ class Config:
     sparse_topk: str = "1"
     sparse_coverage_floor: float = 0.95
     sparse_error_feedback: bool = True
+    # checkpoint plane (docs/checkpoint.md)
+    ckpt_push_timeout_s: float = 60.0
+    ckpt_async: bool = False
+    ckpt_chunk_bytes: int = 1 << 20
+    ckpt_interval_steps: int = 1
+    ckpt_interval_explicit: bool = False
+    ckpt_dir: str = ""
     # True when HOROVOD_CACHE_CAPACITY was set explicitly: the tuner then
     # treats the capacity knob as pinned (same contract as
     # fusion_threshold_explicit below).
@@ -551,6 +595,15 @@ class Config:
             sparse_error_feedback=os.environ.get(
                 HOROVOD_SPARSE_ERROR_FEEDBACK, "1").strip().lower()
             not in ("0", "false"),
+            ckpt_push_timeout_s=_env_float(HOROVOD_CKPT_PUSH_TIMEOUT_S, 60.0),
+            ckpt_async=_env_bool(HOROVOD_CKPT_ASYNC),
+            ckpt_chunk_bytes=max(
+                _env_int(HOROVOD_CKPT_CHUNK_BYTES, 1 << 20), 1),
+            ckpt_interval_steps=max(
+                _env_int(HOROVOD_CKPT_INTERVAL_STEPS, 1), 1),
+            ckpt_interval_explicit=bool(
+                os.environ.get(HOROVOD_CKPT_INTERVAL_STEPS)),
+            ckpt_dir=os.environ.get(HOROVOD_CKPT_DIR, ""),
             cache_capacity_explicit=bool(
                 os.environ.get(HOROVOD_CACHE_CAPACITY)),
             start_timeout_s=_env_float(

@@ -160,7 +160,8 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                 slot_fail_limit: int = 2,
                 stall_shutdown_s: float = 30.0,
                 straggler_evict: Optional[str] = None,
-                serving_plane=None) -> List[Any]:
+                serving_plane=None,
+                on_seal=None) -> List[Any]:
     """Fault-tolerant ``runner.run``: relaunch on worker death.
 
     ``np`` slots are launched initially; a slot that fails
@@ -192,7 +193,13 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
     failed attempt's ``plane.world_down`` drains or structurally errors
     every in-flight ticket — requests issued DURING a relaunch either
     complete after the plane re-arms or fail with a structured 503
-    carrying the relaunch epoch, never a hang."""
+    carrying the relaunch epoch, never a hang.
+
+    ``on_seal`` is the checkpoint plane's train-to-serve hook
+    (docs/checkpoint.md): ``on_seal(ckpt_no, meta, payload)`` fires in
+    the driver each time the seal ledger seals a commit — every rank's
+    shard digest arrived and agreed — which is the natural place to
+    ``serving_plane.publish_weights(...)`` the freshly verified state."""
     from ..tune.detector import MODES
 
     if not 1 <= min_np <= np:
@@ -209,6 +216,8 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
     service = ElasticService(bytes.fromhex(secret),
                              heartbeat_interval_s=heartbeat_interval_s,
                              miss_limit=heartbeat_miss_limit)
+    if on_seal is not None:
+        service.ckpt.on_seal = on_seal
     fail_counts: Dict[int, int] = {slot: 0 for slot in range(np)}
     epoch = 0
     last_err: Optional[BaseException] = None

@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..ckpt.store import SealLedger
 from ..core import config as _config
 from ..core.logging import LOG
 from ..runner.network import BasicClient, BasicService, default_secret
@@ -38,6 +39,18 @@ class ElasticService:
       ("fetch",)                         -> ("commit", meta, payload | None)
       ("advise_evict", epoch, rank, info)-> ("ok",)  # straggler advisory
                                                      # (docs/autotune.md)
+
+    plus the checkpoint plane's chunked commit streams and the gateway
+    ticket journal (docs/checkpoint.md), ingested into the
+    :class:`~horovod_tpu.ckpt.store.SealLedger` at ``self.ckpt``:
+      ("ckpt_begin", epoch, no, rank, meta)        -> ("ok",)
+      ("ckpt_chunk", epoch, no, rank, seq, bytes)  -> ("ok",)   # rank 0 only
+      ("ckpt_end", epoch, no, rank, n_chunks, dig) -> ("ok", sealed_no)
+      ("ckpt_fetch",)                     -> ("ckpt", sealed_no, meta,
+                                              payload | None)
+      ("ckpt_journal_put", key, entry)    -> ("ok",)
+      ("ckpt_journal_get", key)           -> ("entry", entry | None)
+      ("ckpt_journal_del", key)           -> ("ok",)
 
     Beats are tagged with the world epoch so a straggler from a torn-down
     attempt cannot resurrect itself into the successor world's liveness
@@ -58,6 +71,11 @@ class ElasticService:
         self._evict_advisories: Dict[int, dict] = {}
         self._commit: Optional[bytes] = None
         self._commit_meta: Optional[dict] = None
+        # checkpoint plane (docs/checkpoint.md): the seal ledger lives
+        # with the service — the driver process outlives every world
+        # attempt, and with HOROVOD_CKPT_DIR set it outlives the driver
+        self.ckpt = SealLedger(
+            dir=os.environ.get(_config.HOROVOD_CKPT_DIR) or None)
         self._service = BasicService("horovod-elastic", self._handle,
                                      secret=secret)
         self.port = self._service.port
@@ -90,6 +108,38 @@ class ElasticService:
         if kind == "fetch":
             with self._lock:
                 return ("commit", self._commit_meta, self._commit)
+        if kind == "ckpt_begin":
+            # Checkpoint-plane stream frames (docs/checkpoint.md). The
+            # ledger applies its own epoch fence — a torn-down world's
+            # straggling stream is acknowledged and ignored, like beats.
+            _, epoch, ckpt_no, rank, meta = req
+            self.ckpt.ingest_begin(epoch, ckpt_no, rank, meta)
+            return ("ok",)
+        if kind == "ckpt_chunk":
+            _, epoch, ckpt_no, rank, seq, payload = req
+            self.ckpt.ingest_chunk(epoch, ckpt_no, rank, seq, payload)
+            return ("ok",)
+        if kind == "ckpt_end":
+            # the response doubles as the seal ack: the committer learns
+            # whether its commit (or a later one) actually sealed
+            _, epoch, ckpt_no, rank, n_chunks, digest = req
+            sealed_no = self.ckpt.ingest_end(epoch, ckpt_no, rank,
+                                             n_chunks, digest)
+            return ("ok", sealed_no)
+        if kind == "ckpt_fetch":
+            sealed_no, meta, payload = self.ckpt.fetch_sealed()
+            return ("ckpt", sealed_no, meta, payload)
+        if kind == "ckpt_journal_put":
+            _, key, entry = req
+            self.ckpt.journal.put(key, entry)
+            return ("ok",)
+        if kind == "ckpt_journal_get":
+            _, key = req
+            return ("entry", self.ckpt.journal.get(key))
+        if kind == "ckpt_journal_del":
+            _, key = req
+            self.ckpt.journal.delete(key)
+            return ("ok",)
         if kind == "advise_evict":
             # Persistent-straggler advisory from the coordinator's
             # detector (horovod_tpu.tune.detector; docs/autotune.md).
@@ -109,6 +159,9 @@ class ElasticService:
             self._last_beat = {}
             self._departed = set()
             self._evict_advisories = {}
+        # drop partial ckpt streams (a kill mid-commit leaves its commit
+        # unsealed forever); sealed state and the journal survive
+        self.ckpt.begin_epoch(epoch)
 
     def evict_advisories(self) -> Dict[int, dict]:
         """This epoch's straggler eviction advisories (world rank → the

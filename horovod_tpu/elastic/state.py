@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -32,6 +33,7 @@ import numpy as np
 from .. import basics, state_bcast
 from ..basics import world_epoch
 from ..core import config as _config
+from ..core.config import _env_bool, _env_float, _env_int
 from ..core.logging import LOG
 from ..runner.network import BasicClient, default_secret
 
@@ -113,8 +115,15 @@ class State:
             setattr(self, key, value)
         self._commit_no = 0
         self._sync_no = 0
+        self._maybe_no = 0
         self._synced = False
         self._store: Optional[BasicClient] = None
+        self._committer = None  # lazy ckpt.AsyncCommitter (async path)
+        # restore provenance, set by _fetch_commit for tests/postmortems:
+        # "sealed" (checkpoint-plane ledger) or "legacy" (synchronous
+        # whole-tree store), plus the adopted commit number
+        self.restore_source: Optional[str] = None
+        self.restore_commit_no: Optional[int] = None
         self._committed = self._snapshot()
 
     # -- snapshots ------------------------------------------------------------
@@ -130,9 +139,17 @@ class State:
     def commit(self) -> None:
         """Snapshot the current values as the recovery point; rank 0 also
         persists the snapshot to the elastic driver's store (when this
-        world was launched by ``run_elastic``). The fault-injection hook
-        fires before anything is saved, so an injected death always rolls
-        back to the PREVIOUS commit — a real mid-step crash."""
+        world was launched by ``run_elastic``). With
+        ``HOROVOD_CKPT_ASYNC=1`` the persist rides the checkpoint plane
+        instead (docs/checkpoint.md): EVERY rank hands the snapshot to a
+        background :class:`~horovod_tpu.ckpt.committer.AsyncCommitter`
+        (rank 0 streams the chunked payload, the others ship the digest
+        votes that let the driver seal = verify the commit) and this
+        call returns in O(snapshot), independent of state size. The
+        fault-injection hook fires before anything is saved, so an
+        injected death always rolls back to the PREVIOUS commit — a
+        real mid-step crash."""
+        t0 = time.monotonic()
         self._commit_no += 1
         _maybe_inject_fault(self._commit_no)
         self._committed = self._snapshot()
@@ -151,8 +168,37 @@ class State:
 
         _flightrec.record(_flightrec.EV_COMMIT, self._commit_no,
                           aux=basics.world_epoch())
-        if basics.rank() == 0:
+        if self._async_enabled():
+            self._submit_async()
+        elif basics.rank() == 0:
             self._push_commit()
+        # both paths report the stall the TRAINING LOOP paid — the bench
+        # headline (docs/checkpoint.md): ~flat vs state size when async,
+        # linear when synchronous
+        from ..ckpt.committer import observe_commit_stall
+
+        observe_commit_stall(time.monotonic() - t0)
+
+    def maybe_commit(self) -> bool:
+        """Commit every ``HOROVOD_CKPT_INTERVAL_STEPS``-th call (default
+        1 = every call) — the cadence knob the autotune ladder owns
+        (``tune.policy.ckpt_interval_knob``). Returns True when a commit
+        actually ran."""
+        self._maybe_no += 1
+        interval = max(_env_int(_config.HOROVOD_CKPT_INTERVAL_STEPS, 1), 1)
+        if self._maybe_no % interval != 0:
+            return False
+        self.commit()
+        return True
+
+    def flush_commits(self, timeout_s: float = 30.0) -> bool:
+        """Drain the async commit stream (no-op on the synchronous
+        path). Call before a clean exit so the last commit has reached
+        the driver's ledger; the chaos drills also use it to serialize
+        streams against the kill-between-chunks fault."""
+        if self._committer is None:
+            return True
+        return self._committer.wait_idle(timeout_s=timeout_s)
 
     def restore(self) -> None:
         """Rewind the live attributes to the last committed snapshot."""
@@ -170,10 +216,14 @@ class State:
             return None
         if self._store is None:
             addr = os.environ.get(_config.HOROVOD_ELASTIC_ADDR, "127.0.0.1")
-            # generous timeout: one commit can carry the whole model
-            self._store = BasicClient((addr, int(port)),
-                                      secret=default_secret(),
-                                      attempts=3, timeout_s=60.0)
+            # HOROVOD_CKPT_PUSH_TIMEOUT_S (docs/checkpoint.md): the
+            # 60 s default assumes one synchronous commit frame can
+            # carry the whole model; the chunked async pipeline never
+            # needs that and jobs on it should tighten the bound
+            self._store = BasicClient(
+                (addr, int(port)), secret=default_secret(), attempts=3,
+                timeout_s=_env_float(_config.HOROVOD_CKPT_PUSH_TIMEOUT_S,
+                                     60.0))
         return self._store
 
     def _drop_store_client(self) -> None:
@@ -185,6 +235,27 @@ class State:
             except Exception:  # noqa: BLE001
                 pass
             self._store = None
+
+    def _async_enabled(self) -> bool:
+        return _env_bool(_config.HOROVOD_CKPT_ASYNC) and \
+            bool(os.environ.get(_config.HOROVOD_ELASTIC_PORT))
+
+    def _submit_async(self) -> None:
+        """Hand the committed snapshot to the background stream (every
+        rank — the ledger needs the full world's digest votes to seal)."""
+        from ..ckpt.committer import AsyncCommitter
+        from ..obs import flightrec as _flightrec
+
+        if self._committer is None:
+            addr = os.environ.get(_config.HOROVOD_ELASTIC_ADDR, "127.0.0.1")
+            port = int(os.environ.get(_config.HOROVOD_ELASTIC_PORT))
+            self._committer = AsyncCommitter(
+                (addr, port), rank=basics.rank(), world=basics.size(),
+                secret=default_secret())
+        self._committer.submit(self._commit_no, self._committed,
+                               world_epoch())
+        _flightrec.record(_flightrec.EV_CKPT_SUBMIT, self._commit_no,
+                          aux=world_epoch())
 
     def _push_commit(self) -> None:
         client = self._store_client()
@@ -204,6 +275,9 @@ class State:
         client = self._store_client()
         if client is None:
             return None
+        sealed = self._fetch_sealed(client)
+        if sealed is not None:
+            return sealed
         try:
             resp = client.request(("fetch",))
         except Exception as exc:  # noqa: BLE001
@@ -215,14 +289,61 @@ class State:
         if payload is None:
             return None
         committed = pickle.loads(payload)
-        if sorted(committed) != self._keys:
-            LOG.warning("stored elastic commit has keys %s but this State "
-                        "has %s; ignoring the stored commit",
-                        sorted(committed), self._keys)
+        if not self._keys_match(committed):
             return None
+        self.restore_source = "legacy"
+        self.restore_commit_no = (meta or {}).get("commit_no")
         LOG.info("elastic restore: adopting driver commit %s",
                  (meta or {}).get("commit_no"))
         return committed
+
+    def _fetch_sealed(self, client: BasicClient) -> Optional[Dict[str, Any]]:
+        """Checkpoint-plane restore: adopt the driver ledger's last
+        SEALED commit (docs/checkpoint.md). Verified on the way in —
+        the restored tree must reproduce the digest the world's ranks
+        agreed on at seal time, or the adoption is refused and restore
+        falls back to the legacy synchronous store."""
+        try:
+            resp = client.request(("ckpt_fetch",))
+        except Exception as exc:  # noqa: BLE001 - older driver or wire hiccup
+            self._drop_store_client()
+            LOG.warning("ckpt fetch failed: %s (falling back to the "
+                        "legacy commit store)", exc)
+            return None
+        _, sealed_no, meta, payload = resp
+        if payload is None:
+            return None
+        committed = pickle.loads(payload)
+        if not self._keys_match(committed):
+            return None
+        from ..integrity.consensus import tree_digest
+
+        want = (meta or {}).get("digest")
+        got = tree_digest(committed)
+        if want and got != want:
+            LOG.warning(
+                "sealed commit %s fails its digest (%s != %s) — refusing "
+                "it, falling back to the legacy commit store",
+                sealed_no, got, want)
+            return None
+        self.restore_source = "sealed"
+        self.restore_commit_no = (meta or {}).get("commit_no", sealed_no)
+        from ..obs import flightrec as _flightrec
+
+        _flightrec.record(_flightrec.EV_CKPT_RESTORE,
+                          int(self.restore_commit_no or -1),
+                          detail="sealed")
+        LOG.info("elastic restore: adopting SEALED commit %s (digest ok)",
+                 sealed_no)
+        return committed
+
+    def _keys_match(self, committed: Dict[str, Any]) -> bool:
+        if sorted(committed) == self._keys:
+            return True
+        LOG.warning("stored elastic commit has keys %s but this State "
+                    "has %s; ignoring the stored commit",
+                    sorted(committed), self._keys)
+        return False
 
     # -- sync -----------------------------------------------------------------
 

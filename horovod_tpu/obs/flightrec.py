@@ -71,6 +71,11 @@ EV_ELASTIC_RELAUNCH = "elastic_relaunch"  # ordinal=new epoch
 EV_SERVING_BATCH = "serving_batch"      # ordinal=batch ordinal
 EV_SERVING_DIGEST = "serving_digest"    # ordinal=batch ordinal
 EV_SERVING_DISPATCH = "serving_dispatch"  # ordinal=batch ordinal (driver)
+EV_CKPT_SUBMIT = "ckpt_submit"    # ordinal=ckpt commit number (async submit)
+EV_CKPT_SEAL = "ckpt_seal"        # ordinal=sealed commit number
+EV_CKPT_RESTORE = "ckpt_restore"  # ordinal=restored commit number,
+#                                   detail=sealed/legacy source
+EV_SERVING_SWAP = "serving_swap"  # ordinal=weights version (hot swap)
 EV_FUSED_APPLY = "fused_apply"    # ordinal=cycle, detail=fused/split
 EV_TENSORWATCH = "tensorwatch"    # ordinal=batch, detail=codec:SNRdb —
 #                                   a sampled decode SNR near or below

@@ -12,8 +12,20 @@ this gateway share by construction):
   mirrors the request's encoding; every response carries
   ``X-Serving-Epoch``.
 * ``GET /v1/healthz`` — plane state (armed, epoch, queue depth, knobs).
+* ``GET /v1/result?id=...`` — the journaled outcome of a request that
+  carried an ``X-Request-Id`` header (docs/checkpoint.md): 200 with the
+  stored outputs once done, 202 while pending (journaled, will be
+  re-submitted when the plane re-arms), 404 for an unknown id.
 * ``GET /metrics`` / ``/metrics.json`` — this (driver) process's
   registry, where every ``horovod_serving_*`` family lives.
+
+Requests that opt in with ``X-Request-Id`` are journaled through the
+checkpoint plane's :class:`~horovod_tpu.ckpt.store.TicketJournal`
+(crash-durable with ``HOROVOD_CKPT_DIR``): a driver restart reloads the
+journal and :meth:`_resume_journal` (wired to ``plane.on_armed``)
+re-submits every still-pending envelope when the serving world arms, so
+in-flight requests survive a restart instead of vanishing with it —
+their clients poll ``/v1/result`` for the outcome.
 
 Status contract (the SLO semantics table in docs/serving.md): 200 with
 the output row; 400 malformed; 429 + ``Retry-After`` when admission's
@@ -26,6 +38,7 @@ NEVER outwait its budget no matter what the world is doing.
 
 from __future__ import annotations
 
+import base64
 import json
 import time
 from typing import Dict, Optional
@@ -65,11 +78,15 @@ class Gateway:
         routes = {
             ("POST", "/v1/infer"): self._infer,
             ("GET", "/v1/healthz"): self._healthz,
+            ("GET", "/v1/result"): self._result,
         }
         routes.update(metrics_routes(lambda: registry().snapshot()))
         self._httpd = LoopbackHTTPD("horovod-serving-gateway", port,
                                     routes, bind_host=bind_host)
         self.port = self._httpd.port
+        # journal resume (docs/checkpoint.md): when the plane (re-)arms,
+        # re-submit every still-pending journaled request
+        plane.on_armed = self._resume_journal
 
     def close(self) -> None:
         self._httpd.close()
@@ -79,6 +96,25 @@ class Gateway:
     def _healthz(self, _query, _headers, _body):
         return HttpResponse(200, "application/json",
                             healthz_doc(self._plane))
+
+    def _result(self, query, _headers, _body):
+        """Journaled outcome lookup for X-Request-Id requests."""
+        req_id = (query.get("id") or [None])[0]
+        if not req_id:
+            raise self._error(400, "GET /v1/result needs ?id=<request id>",
+                              self._plane.current_epoch)
+        entry = self._plane.journal.get(req_id)
+        if entry is None:
+            raise self._error(404, f"unknown request id {req_id!r}",
+                              self._plane.current_epoch)
+        state = entry.get("state")
+        if state == "pending":
+            body = json.dumps({"state": "pending", "id": req_id}).encode()
+            return HttpResponse(202, "application/json", body)
+        _REQUESTS.labels(code="200").inc()
+        return HttpResponse(
+            200, "application/json",
+            json.dumps(dict(entry, id=req_id)).encode())
 
     def _error(self, status: int, message: str, epoch: int,
                retry_after_s: Optional[float] = None):
@@ -137,9 +173,26 @@ class Gateway:
             raise self._error(400, f"malformed X-Serving-Deadline-Ms "
                                    f"{deadline_ms!r}",
                               plane.current_epoch)
+        req_id = _header(headers, "X-Request-Id")
+        if req_id:
+            # journal the envelope BEFORE admission (docs/checkpoint.md):
+            # a driver that dies anywhere past this line re-submits the
+            # request when it restarts and the world re-arms; the client
+            # polls GET /v1/result?id= for the outcome
+            plane.journal.put(req_id, {
+                "state": "pending", "name": name,
+                "dtype": str(array.dtype),
+                "shape": list(array.shape),
+                "inputs_b64": base64.b64encode(
+                    np.ascontiguousarray(array).tobytes()).decode(),
+                "deadline_ms": deadline_s * 1e3,
+            })
         try:
             ticket = plane.submit(name, array, deadline_s=deadline_s)
         except AdmissionError as exc:
+            # a journaled envelope STAYS pending across an admission
+            # reject: the re-arm resume is exactly for requests that
+            # arrived while no world was attached
             raise self._error(exc.status, exc.message, exc.epoch,
                               exc.retry_after_s)
         # Wait out OUR deadline, then claim the ticket ourselves: the
@@ -148,12 +201,26 @@ class Gateway:
         if not ticket.closed:
             ticket.claim_timeout(epoch=plane.current_epoch)
         if ticket.state != "done":
+            if req_id:
+                plane.journal.put(req_id, {
+                    "state": "failed", "status": ticket.status or 503,
+                    "error": ticket.error or "request failed",
+                    "epoch": ticket.epoch if ticket.epoch is not None
+                    else plane.current_epoch,
+                })
             raise self._error(ticket.status or 503,
                               ticket.error or "request failed",
                               ticket.epoch if ticket.epoch is not None
                               else plane.current_epoch,
                               ticket.retry_after_s)
         output = ticket.output
+        if req_id:
+            plane.journal.put(req_id, {
+                "state": "done",
+                "outputs": np.asarray(output).tolist(),
+                "dtype": str(np.asarray(output).dtype),
+                "epoch": plane.current_epoch,
+            })
         latency = time.monotonic() - ticket.t0
         _REQUESTS.labels(code="200").inc()
         _LATENCY.observe(latency)
@@ -171,3 +238,50 @@ class Gateway:
             json.dumps({"outputs": np.asarray(output).tolist(),
                         "epoch": plane.current_epoch}).encode(),
             epoch_headers)
+
+    # -- journal resume (docs/checkpoint.md) ----------------------------------
+
+    def _resume_journal(self) -> None:
+        """Re-submit every still-pending journaled request. Runs on the
+        plane's ``on_armed`` hook (a daemon thread, never the RPC
+        handler): after a driver restart or an elastic relaunch the
+        in-flight requests a dead gateway thread was carrying complete
+        here, and their clients find the outcome at ``/v1/result``."""
+        plane = self._plane
+        for req_id, entry in sorted(plane.journal.entries().items()):
+            if entry.get("state") != "pending":
+                continue
+            try:
+                array = np.frombuffer(
+                    base64.b64decode(entry["inputs_b64"]),
+                    dtype=np.dtype(entry["dtype"])).reshape(entry["shape"])
+                ticket = plane.submit(
+                    entry["name"], array,
+                    deadline_s=float(entry.get("deadline_ms", 1e4)) / 1e3)
+            except AdmissionError:
+                return  # not armed after all / queue full: next re-arm
+            except Exception as exc:  # noqa: BLE001 - corrupt envelope
+                plane.journal.put(req_id, {
+                    "state": "failed", "status": 400,
+                    "error": f"journal envelope unusable: {exc}",
+                    "epoch": plane.current_epoch})
+                continue
+            ticket.wait(max(ticket.deadline - time.monotonic(), 0.0) + 0.05)
+            if not ticket.closed:
+                ticket.claim_timeout(epoch=plane.current_epoch)
+            if ticket.state == "done":
+                out = np.asarray(ticket.output)
+                plane.journal.put(req_id, {
+                    "state": "done", "outputs": out.tolist(),
+                    "dtype": str(out.dtype),
+                    "epoch": plane.current_epoch})
+            else:
+                # leave it pending on a structural 503 (world went down
+                # again mid-resume — the next re-arm retries); journal a
+                # terminal failure otherwise
+                if ticket.status == 503:
+                    continue
+                plane.journal.put(req_id, {
+                    "state": "failed", "status": ticket.status or 500,
+                    "error": ticket.error or "request failed",
+                    "epoch": plane.current_epoch})

@@ -67,6 +67,12 @@ _MISMATCHES = _metrics().counter(
 _DISPATCHED = _metrics().counter(
     "horovod_serving_dispatched_batches_total",
     "Batches broadcast to the serving world")
+_SWAPS = _metrics().counter(
+    "horovod_serving_weight_swaps_total",
+    "Weight hot-swaps published to the serving world "
+    "(docs/checkpoint.md: delivered between micro-batches, digest-"
+    "verified by every rank, acked before the next batch cuts — old-or-"
+    "new atomically, never torn)")
 
 # A requeued ticket needs this much deadline headroom to be worth
 # re-dispatching after a relaunch; anything tighter fails 503 at drain.
@@ -182,6 +188,23 @@ class ServingPlane:
         self._ema_batch_s: Optional[float] = None
         self._dispatched_total = 0
         self._max_batch_real = 0
+        # weight hot swap (docs/checkpoint.md): the pending swap frame
+        # (version, digest, framed bytes), the ranks that applied+acked
+        # it, and the last fully-applied version
+        self._swap: Optional[Tuple[int, str, bytes]] = None
+        self._swap_acks: set = set()
+        self._weights_version: Optional[int] = None
+        # fires (daemon thread) each time the plane (re-)arms — the
+        # gateway resumes its journaled in-flight requests here
+        self.on_armed = None
+        # crash-durable in-flight request journal (docs/checkpoint.md);
+        # in-memory unless HOROVOD_CKPT_DIR is set. Own filename: the
+        # elastic seal ledger's wire-backed journal may share the dir.
+        from ..ckpt.store import TicketJournal
+
+        self.journal = TicketJournal(
+            dir=os.environ.get(_config.HOROVOD_CKPT_DIR) or None,
+            filename="tickets.json")
 
         self._policy = None
         if autotune:
@@ -249,6 +272,9 @@ class ServingPlane:
                 "ema_batch_s": self._ema_batch_s,
                 "stopping": self._stopping,
                 "down_reason": self._down_reason,
+                "weights_version": self._weights_version,
+                "swap_pending": self._swap[0] if self._swap is not None
+                                else None,
             }
 
     def config_snapshot(self) -> dict:
@@ -264,6 +290,42 @@ class ServingPlane:
     @property
     def current_epoch(self) -> int:
         return self._epoch
+
+    @property
+    def weights_version(self) -> Optional[int]:
+        return self._weights_version
+
+    def publish_weights(self, version: int, tree=None,
+                        payload: Optional[bytes] = None) -> None:
+        """Hot-swap the serving world to new weights between micro-batches
+        (docs/checkpoint.md swap atomicity). The frame is delivered to
+        each rank the next time it asks for a batch and no batch is
+        already dispatched for its ordinal; every rank digest-verifies
+        the payload, applies it, and acks — and the batch cut gate stays
+        closed until ALL ranks acked, so every dispatched batch runs
+        entirely on old or entirely on new weights, never torn. Requests
+        in flight across the swap observe one or the other atomically;
+        none are dropped. The natural caller is ``run_elastic``'s
+        ``on_seal`` hook: publish each freshly sealed (= world-verified)
+        checkpoint."""
+        import pickle
+
+        from ..integrity.consensus import digest_bytes
+
+        if payload is None:
+            payload = pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL)
+        digest = digest_bytes(payload)
+        frame = self._service.wire.frame(
+            ("swap", int(version), digest, payload))
+        with self._cond:
+            self._swap = (int(version), digest, frame)
+            self._swap_acks = set()
+            self._cond.notify_all()
+        _SWAPS.inc()
+        from ..obs import flightrec as _flightrec
+
+        _flightrec.record(_flightrec.EV_SERVING_SWAP, int(version),
+                          aux=len(payload))
 
     # -- admission (the gateway's entry point) --------------------------------
 
@@ -345,6 +407,10 @@ class ServingPlane:
         self._conn_ranks.clear()
         self._rank_conns.clear()
         self._pending_reconnect.clear()
+        # a dead world's swap acks are void, but the PENDING swap frame
+        # survives: the relaunched world receives it before its first
+        # batch, so a relaunch can never resurrect stale weights
+        self._swap_acks = set()
         epoch = self._epoch
         now = time.monotonic()
         requeue: List[Ticket] = []
@@ -398,7 +464,26 @@ class ServingPlane:
             _, rank, epoch, ordinal, digest, payload, error = req
             return self._result(int(rank), int(epoch), int(ordinal),
                                 digest, payload, error)
+        if kind == "swap_ack":
+            _, rank, epoch, version = req
+            return self._swap_ack(int(rank), int(epoch), int(version))
         raise ValueError(f"unknown serving request {kind!r}")
+
+    def _swap_ack(self, rank: int, epoch: int, version: int):
+        """A rank digest-verified and applied the published weights; the
+        batch cut gate reopens when the whole world acked."""
+        with self._cond:
+            self._check_epoch_locked(epoch)
+            if self._swap is None or version != self._swap[0]:
+                return ("ok",)  # superseded swap: the ack is history
+            self._swap_acks.add(rank)
+            if self._world is not None and \
+                    len(self._swap_acks) >= self._world:
+                self._weights_version = version
+                self._swap = None
+                self._swap_acks = set()
+                self._cond.notify_all()
+            return ("ok",)
 
     def _shello(self, req, sock):
         _, rank, size, epoch, world_id = req
@@ -431,12 +516,19 @@ class ServingPlane:
             self._conn_ranks[id(sock)] = rank
             self._pending_reconnect.pop(rank, None)
             self._hellos.add(int(rank))
+            armed_now = False
             if len(self._hellos) == self._world and not self._armed:
                 self._armed = True
                 self._down_reason = None
                 _ARMS.inc()
+                armed_now = True
                 self._cond.notify_all()
-            return ("ok", self._epoch)
+        if armed_now and self.on_armed is not None:
+            # outside the lock and off the RPC handler thread: the hook
+            # (gateway journal resume) re-enters submit()
+            threading.Thread(target=self.on_armed,
+                             name="serving-on-armed", daemon=True).start()
+        return ("ok", self._epoch)
 
     def _check_epoch_locked(self, epoch: int) -> None:
         if epoch != self._epoch or self._down_reason is not None:
@@ -455,22 +547,39 @@ class ServingPlane:
                 # in-flight batch or the result rendezvous strands its
                 # peers (completing in-flight work IS the clean drain —
                 # only the NEXT ordinal answers "stop")
+                # already-dispatched frames FIRST, before any pending
+                # swap: every rank must run batch k with the weights it
+                # was cut under before applying new ones, or the result
+                # digests would tear (docs/checkpoint.md swap atomicity)
                 frame = self._dispatch.get(ordinal)
                 if frame is not None:
                     return Preserialized(frame)
                 if self._stopping:
                     return ("stop",)
                 self._check_epoch_locked(epoch)
-                if not self._cutting and ordinal == self._next_ordinal:
+                if self._swap is not None and rank not in self._swap_acks:
+                    # deliver the pending weights at the batch boundary;
+                    # the worker applies, acks, and re-requests ordinal
+                    return Preserialized(self._swap[2])
+                if not self._cutting and self._swap is None and \
+                        ordinal == self._next_ordinal:
+                    # the cut gate stays closed while a swap is pending:
+                    # a batch cut mid-swap could mix old- and new-weight
+                    # ranks in one rendezvous
                     self._cutting = True
                     break
                 self._cond.wait(timeout=0.2)
         try:
-            return self._cut(epoch, ordinal)
+            result = self._cut(epoch, ordinal)
         finally:
             with self._cond:
                 self._cutting = False
                 self._cond.notify_all()
+        if result is None:
+            # a swap landed while the batch was being cut: the tickets
+            # went back to the queue — park again and deliver the swap
+            return self._infer(rank, epoch, ordinal)
+        return result
 
     def _cut(self, epoch: int, ordinal: int):
         while True:
@@ -497,6 +606,15 @@ class ServingPlane:
                     if self._stopping:
                         return ("stop",)
                     self._check_epoch_locked(epoch)
+                if self._swap is not None:
+                    # a weight swap was published between the cut and
+                    # registration: dispatching this batch would race the
+                    # swap delivery across ranks (torn batch). Requeue
+                    # the not-yet-dispatched tickets and let the callers
+                    # re-park; the swap drains first, then a fresh cut.
+                    self._batcher.requeue(
+                        sorted(tickets, key=lambda t: t.t0))
+                    return None
                 for ticket in tickets:
                     ticket.mark_dispatched()
                 self._dispatch[ordinal] = frame

@@ -25,6 +25,14 @@ Protocol (all under the ``#rpc`` dedup envelope; docs/serving.md):
   output payload); the coordinator verifies the digests agree before any
   ticket completes — replicated dispatch is only worth broadcasting if
   divergence is caught, not averaged away.
+* an ``infer`` may also answer ``("swap", version, digest, payload)`` —
+  a weight hot-swap delivered at the batch boundary (docs/checkpoint.md):
+  the rank verifies the payload digest, hands the unpickled tree to the
+  caller's ``on_weights`` hook, drops its compiled steps (the next batch
+  retraces against the new weights), acks with
+  ``("swap_ack", rank, epoch, version)`` and re-requests the SAME
+  ordinal. The coordinator's cut gate stays closed until every rank
+  acked, so no batch ever mixes old- and new-weight ranks.
 
 The forward step is pre-compiled per padding bucket: with ``jit=True``
 each ``(name, batch_shape, dtype)`` compiles once (``jax.jit``) and
@@ -108,7 +116,8 @@ def serve_worker(models: Dict[str, Callable],
                  world_id: str = "",
                  jit: bool = True,
                  warmup: Tuple[Tuple[str, Tuple[int, ...], str], ...] = (),
-                 connect_attempts: int = 100) -> dict:
+                 connect_attempts: int = 100,
+                 on_weights: Optional[Callable] = None) -> dict:
     """Serve until the coordinator says stop; returns this rank's stats.
 
     Defaults come from the environment the driver exported
@@ -116,9 +125,13 @@ def serve_worker(models: Dict[str, Callable],
     rank/size from the launcher, epoch from the elastic driver).
     ``warmup`` pre-compiles ``(name, example_shape, dtype)`` buckets
     across every padding edge BEFORE the hello, so the first live batch
-    never pays a compile. Clean stop returns
-    ``{"outcome": "stopped", ...}``; any world-level failure raises
-    :class:`ServingAbortedError` so the elastic driver relaunches."""
+    never pays a compile. ``on_weights(version, tree)`` receives each
+    digest-verified weight hot-swap the plane publishes
+    (docs/checkpoint.md) — install the tree wherever the forward fns
+    close over it; the dropped compile cache retraces against it. Clean
+    stop returns ``{"outcome": "stopped", ...}``; any world-level
+    failure raises :class:`ServingAbortedError` so the elastic driver
+    relaunches."""
     from ..chaos import injector_from_env
     from ..runner.network import BasicClient, WireError
 
@@ -189,7 +202,8 @@ def serve_worker(models: Dict[str, Callable],
 
     shello = ("shello", rank, size, epoch, world_id)
     stats = {"rank": rank, "epoch": epoch, "batches": 0, "requests": 0,
-             "compiled_buckets": 0, "outcome": "stopped"}
+             "compiled_buckets": 0, "outcome": "stopped",
+             "swaps": 0, "weights_version": None}
     client = BasicClient(addr, secret=secret, timeout_s=None,
                          attempts=connect_attempts, chaos=chaos)
     # Re-identify after every transparent reconnect BEFORE the resent
@@ -203,6 +217,30 @@ def serve_worker(models: Dict[str, Callable],
             resp = client.request(("infer", rank, epoch, ordinal))
             if resp[0] == "stop":
                 break
+            if resp[0] == "swap":
+                # weight hot-swap at the batch boundary: verify, apply,
+                # ack, re-request the SAME ordinal (docs/checkpoint.md)
+                import pickle
+
+                from ..integrity.consensus import digest_bytes
+                from ..obs import flightrec as _flightrec
+
+                _, version, want_digest, payload = resp
+                if digest_bytes(payload) != want_digest:
+                    raise ServingAbortedError(
+                        f"weight swap v{version} payload fails its digest "
+                        f"on rank {rank} — refusing torn weights")
+                tree = pickle.loads(payload)
+                if on_weights is not None:
+                    on_weights(version, tree)
+                # the compiled steps closed over the old weights: retrace
+                compiled.clear()
+                stats["swaps"] += 1
+                stats["weights_version"] = version
+                _flightrec.record(_flightrec.EV_SERVING_SWAP, version,
+                                  aux=rank)
+                client.request(("swap_ack", rank, epoch, version))
+                continue
             assert resp[0] == "batch", resp
             _, got_ordinal, key, n_real, payload = resp
             assert got_ordinal == ordinal, (got_ordinal, ordinal)

@@ -49,6 +49,9 @@ KNOB_FUSED_APPLY = "fused_apply"
 # ServingPlane's own policy instance, scored by batch payload throughput.
 KNOB_SERVING_BATCH = "serving_batch_max"
 KNOB_SERVING_EDGES = "serving_bucket_edges"
+# Checkpoint-plane knob (docs/checkpoint.md): how many maybe_commit()
+# calls between actual commits, tuned against commit-stall overhead.
+KNOB_CKPT_INTERVAL = "ckpt_interval_steps"
 
 # Prometheus gauges are numeric; the codec knob reports this id mapping
 # (documented in docs/autotune.md).
@@ -536,3 +539,14 @@ def serving_knobs(batch_max: int, edge_ratio: float,
     knobs.append(Knob(KNOB_SERVING_EDGES, values, index,
                       pinned=edges_explicit))
     return knobs
+
+
+def ckpt_interval_knob(current: int, explicit: bool = False) -> Knob:
+    """The checkpoint plane's commit cadence knob (docs/checkpoint.md):
+    how many ``State.maybe_commit()`` calls elapse between actual
+    commits. Numerics-neutral — skipping a commit changes durability
+    (how much progress a relaunch replays), never training math — so no
+    consent gate. The usual pin rule applies: an interval set explicitly
+    via ``HOROVOD_CKPT_INTERVAL_STEPS`` never moves."""
+    values, index = _ladder(current, [1, 2, 5, 10, 25, 50, 100])
+    return Knob(KNOB_CKPT_INTERVAL, values, index, pinned=explicit)

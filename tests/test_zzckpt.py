@@ -1,0 +1,578 @@
+"""Checkpoint plane (docs/checkpoint.md).
+
+Named ``test_zz*`` past the 870 s tier-1 truncation point on purpose
+(the PR 11–16 convention): the ledger/journal/committer units are cheap,
+but the kill-mid-commit worlds each spawn 2-process elastic runs.
+
+Coverage per the ISSUE-17 battery: seal-ledger semantics (world digest
+vote, chunk completeness, digest disagreement, epoch fence, monotonic
+watermark, disk spill/reload with torn-spill refusal), ticket-journal
+durability, the async committer (fault grammar, latest-wins
+supersession, chunked wire roundtrip against a REAL ElasticService),
+``State`` integration (commit cadence knob, push-timeout satellite,
+sealed restore provenance), the train-to-serve hot swap, the
+wire-compat registry, the metrics-summary section, and — slow tier —
+the kill-mid-commit chaos cells on both negotiation cores plus the
+2-proc ``dryrun_ckpt`` certification.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.ckpt.committer import AsyncCommitter, parse_ckpt_fault
+from horovod_tpu.ckpt.store import SealLedger, TicketJournal
+from horovod_tpu.core.config import (
+    HOROVOD_CKPT_CHUNK_BYTES,
+    HOROVOD_CKPT_INTERVAL_STEPS,
+    HOROVOD_CKPT_PUSH_TIMEOUT_S,
+    HOROVOD_ELASTIC_ADDR,
+    HOROVOD_ELASTIC_PORT,
+)
+from horovod_tpu.integrity.consensus import digest_bytes, tree_digest
+
+pytestmark = pytest.mark.ckpt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- seal ledger ---------------------------------------------------------------
+
+
+def _feed(ledger, ckpt_no, tree, world=2, epoch=0, ranks=None,
+          chunk_bytes=64):
+    """Stream one commit into the ledger the way the wire would: rank 0
+    ships chunks, every rank votes the tree digest. Returns the payload."""
+    payload = pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL)
+    digest = tree_digest(tree)
+    meta = {"commit_no": ckpt_no, "world": world}
+    sealed = -1
+    for rank in (ranks if ranks is not None else range(world)):
+        ledger.ingest_begin(epoch, ckpt_no, rank, meta)
+    n_chunks = max((len(payload) + chunk_bytes - 1) // chunk_bytes, 1)
+    for seq in range(n_chunks):
+        ledger.ingest_chunk(epoch, ckpt_no, 0, seq,
+                            payload[seq * chunk_bytes:(seq + 1) * chunk_bytes])
+    for rank in (ranks if ranks is not None else range(world)):
+        sealed = ledger.ingest_end(epoch, ckpt_no, rank, n_chunks, digest)
+    return payload, sealed
+
+
+def test_seal_requires_every_ranks_digest_vote():
+    ledger = SealLedger()
+    tree = {"w": np.arange(8, dtype=np.float32), "step": 3}
+    # only rank 0 of a world of 2 reported: no seal
+    payload, sealed = _feed(ledger, 1, tree, world=2, ranks=[0])
+    assert sealed == -1
+    assert ledger.fetch_sealed() == (-1, {}, None)
+    # rank 1's vote arrives: seals, bit-exact, digest-stamped meta
+    n_chunks = max((len(payload) + 63) // 64, 1)
+    sealed = ledger.ingest_end(0, 1, 1, 0, tree_digest(tree))
+    assert sealed == 1
+    no, meta, got = ledger.fetch_sealed()
+    assert (no, got) == (1, payload)
+    assert meta["digest"] == tree_digest(tree)
+    assert meta["world"] == 2
+    restored = pickle.loads(got)
+    np.testing.assert_array_equal(restored["w"], tree["w"])
+    assert n_chunks > 1  # the feed really was a multi-chunk stream
+
+
+def test_missing_chunk_never_seals():
+    ledger = SealLedger()
+    tree = {"w": np.zeros(64, np.float32)}
+    payload = pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL)
+    ledger.ingest_begin(0, 1, 0, {"commit_no": 1, "world": 1})
+    ledger.ingest_chunk(0, 1, 0, 0, payload[:64])  # chunk 1 lost
+    sealed = ledger.ingest_end(0, 1, 0, 2, tree_digest(tree))
+    assert sealed == -1
+    assert ledger.stats()["partials"] == [1]
+
+
+def test_digest_disagreement_never_seals_and_counts():
+    from horovod_tpu.obs.registry import registry
+
+    def mismatches():
+        fam = registry().snapshot().get(
+            "horovod_ckpt_digest_mismatches_total")
+        return sum(s["value"] for s in fam["samples"]) if fam else 0
+
+    before = mismatches()
+    ledger = SealLedger()
+    tree = {"w": np.ones(4, np.float32)}
+    payload = pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL)
+    for rank in range(2):
+        ledger.ingest_begin(0, 1, rank, {"commit_no": 1, "world": 2})
+    ledger.ingest_chunk(0, 1, 0, 0, payload)
+    ledger.ingest_end(0, 1, 0, 1, tree_digest(tree))
+    sealed = ledger.ingest_end(0, 1, 1, 0, "divergent-digest")
+    assert sealed == -1
+    assert mismatches() == before + 1
+    # the poisoned partial is dropped, not retried into a seal
+    assert ledger.stats()["partials"] == []
+
+
+def test_epoch_fence_and_monotonic_watermark():
+    ledger = SealLedger()
+    tree = {"step": 1}
+    _, sealed = _feed(ledger, 2, tree, world=1)
+    assert sealed == 2
+    # a ghost stream from a previous epoch is acknowledged and ignored
+    _, sealed = _feed(ledger, 5, {"step": 99}, world=1, epoch=7)
+    assert sealed == 2
+    # a commit at or below the watermark is history
+    _, sealed = _feed(ledger, 2, {"step": 88}, world=1)
+    assert sealed == 2
+    assert pickle.loads(ledger.fetch_sealed()[2]) == {"step": 1}
+
+
+def test_begin_epoch_drops_partials_keeps_sealed_and_journal():
+    ledger = SealLedger()
+    _feed(ledger, 1, {"step": 1}, world=1)
+    ledger.journal.put("req-1", {"state": "pending"})
+    # a partial (world 2, only rank 0 voted) is mid-flight when the
+    # world dies
+    _feed(ledger, 2, {"step": 2}, world=2, ranks=[0])
+    assert ledger.stats()["partials"] == [2]
+    ledger.begin_epoch(1)
+    assert ledger.stats() == {"sealed_no": 1, "partials": [], "epoch": 1}
+    assert ledger.journal.get("req-1") == {"state": "pending"}
+    # the NEW epoch's streams are admitted under the fence
+    _, sealed = _feed(ledger, 2, {"step": 2}, world=1, epoch=1)
+    assert sealed == 2
+
+
+def test_spill_reload_bit_exact_and_torn_spill_refused(tmp_path):
+    d = str(tmp_path / "ledger")
+    ledger = SealLedger(dir=d)
+    tree = {"w": np.arange(256, dtype=np.float32), "step": 9}
+    payload, sealed = _feed(ledger, 3, tree, world=1)
+    assert sealed == 3
+    # a fresh ledger (driver restart) reloads the sealed commit
+    reloaded = SealLedger(dir=d)
+    no, meta, got = reloaded.fetch_sealed()
+    assert (no, got) == (3, payload)
+    np.testing.assert_array_equal(pickle.loads(got)["w"], tree["w"])
+    # tear the spilled bytes: the reload must refuse, not restore garbage
+    path = os.path.join(d, "ckpt-3.bin")
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    torn = SealLedger(dir=d)
+    assert torn.fetch_sealed() == (-1, {}, None)
+
+
+def test_on_seal_hook_fires_with_sealed_commit():
+    seals = []
+    ledger = SealLedger(
+        on_seal=lambda no, meta, payload: seals.append((no, meta, payload)))
+    payload, sealed = _feed(ledger, 1, {"step": 1}, world=1)
+    assert sealed == 1
+    assert len(seals) == 1
+    no, meta, got = seals[0]
+    assert (no, got) == (1, payload)
+    assert meta["digest"] == tree_digest({"step": 1})
+
+
+# -- ticket journal ------------------------------------------------------------
+
+
+def test_ticket_journal_roundtrip_cap_and_persistence(tmp_path):
+    d = str(tmp_path / "journal")
+    journal = TicketJournal(dir=d, max_entries=3)
+    for i in range(5):
+        journal.put(f"req-{i}", {"state": "pending", "i": i})
+    # drop-oldest cap: only the 3 freshest survive
+    assert sorted(journal.entries()) == ["req-2", "req-3", "req-4"]
+    assert journal.get("req-0") is None
+    journal.delete("req-3")
+    assert journal.get("req-3") is None
+    # a fresh journal (driver restart) reloads from disk
+    reloaded = TicketJournal(dir=d, max_entries=3)
+    assert sorted(reloaded.entries()) == ["req-2", "req-4"]
+    assert reloaded.get("req-4") == {"state": "pending", "i": 4}
+
+
+# -- async committer -----------------------------------------------------------
+
+
+def test_parse_ckpt_fault_grammar():
+    assert parse_ckpt_fault("") is None
+    assert parse_ckpt_fault("0:2") == (0, 2, 0)  # chunk defaults to 0
+    assert parse_ckpt_fault("1:3:4") == (1, 3, 4)
+    # malformed specs parse to None (the elastic-twin convention: a typo
+    # must not take down production jobs)
+    assert parse_ckpt_fault("nope") is None
+    assert parse_ckpt_fault("a:b:c") is None
+    assert parse_ckpt_fault("1:2:3:4") is None
+
+
+def test_committer_latest_wins_supersession():
+    committer = AsyncCommitter(("127.0.0.1", 9), rank=0, world=1,
+                               secret=b"k")
+    streamed = []
+    gate = threading.Event()
+    started = threading.Event()
+
+    def slow_stream(ckpt_no, tree, epoch):
+        streamed.append(ckpt_no)
+        started.set()
+        gate.wait(timeout=10.0)
+
+    committer._stream = slow_stream
+    try:
+        committer.submit(1, {"step": 1}, 0)
+        assert started.wait(timeout=10.0)
+        # while commit 1 is still streaming, 2 is superseded by 3:
+        # latest-wins, never a convoy
+        committer.submit(2, {"step": 2}, 0)
+        committer.submit(3, {"step": 3}, 0)
+        gate.set()
+        assert committer.wait_idle(timeout_s=10.0)
+        assert streamed == [1, 3]
+    finally:
+        committer.close()
+
+
+@pytest.fixture()
+def elastic_service():
+    from horovod_tpu.elastic.health import ElasticService
+    from horovod_tpu.runner.network import make_secret
+
+    secret = bytes.fromhex(make_secret())
+    service = ElasticService(secret, heartbeat_interval_s=1.0,
+                             miss_limit=1000)
+    yield service, secret
+    service.shutdown()
+
+
+def test_async_commit_chunked_wire_roundtrip(elastic_service):
+    service, secret = elastic_service
+    addr = ("127.0.0.1", service.port)
+    tree = {"w": np.arange(1024, dtype=np.float32), "step": 5}
+    committers = [AsyncCommitter(addr, rank=r, world=2, secret=secret,
+                                 chunk_bytes=1024) for r in range(2)]
+    try:
+        for r, c in enumerate(committers):
+            c.submit(1, tree, 0)
+        deadline = time.monotonic() + 30.0
+        while service.ckpt.stats()["sealed_no"] < 1:
+            assert time.monotonic() < deadline, service.ckpt.stats()
+            time.sleep(0.05)
+    finally:
+        for c in committers:
+            assert c.wait_idle(timeout_s=30.0)
+            c.close()
+    no, meta, payload = service.ckpt.fetch_sealed()
+    assert no == 1
+    assert meta["digest"] == tree_digest(tree)
+    restored = pickle.loads(payload)
+    np.testing.assert_array_equal(restored["w"], tree["w"])
+    assert restored["step"] == 5
+    # 4 KiB payload over a 1 KiB chunk knob really streamed in chunks
+    assert len(payload) > 4096
+
+
+def test_journal_rpcs_over_wire(elastic_service):
+    from horovod_tpu.runner.network import BasicClient
+
+    service, secret = elastic_service
+    client = BasicClient(("127.0.0.1", service.port), secret=secret,
+                         attempts=3, timeout_s=10.0)
+    try:
+        assert client.request(("ckpt_journal_put", "req-1",
+                               {"state": "pending"})) == ("ok",)
+        assert client.request(("ckpt_journal_get", "req-1")) == \
+            ("entry", {"state": "pending"})
+        assert client.request(("ckpt_journal_del", "req-1")) == ("ok",)
+        assert client.request(("ckpt_journal_get", "req-1")) == \
+            ("entry", None)
+    finally:
+        client.close()
+
+
+# -- State integration ---------------------------------------------------------
+
+
+def test_state_maybe_commit_interval(hvd, monkeypatch):
+    from horovod_tpu.elastic import State
+
+    monkeypatch.delenv(HOROVOD_ELASTIC_PORT, raising=False)
+    monkeypatch.setenv(HOROVOD_CKPT_INTERVAL_STEPS, "3")
+    state = State(w=np.zeros(2, np.float32), step=0)
+    ran = [state.maybe_commit() for _ in range(7)]
+    assert ran == [False, False, True, False, False, True, False]
+    assert state._commit_no == 2
+    # flush on the synchronous path is a no-op that reports drained
+    assert state.flush_commits()
+
+
+def test_push_timeout_knob_reaches_both_clients(elastic_service,
+                                                monkeypatch):
+    from horovod_tpu.elastic import State
+
+    service, secret = elastic_service
+    monkeypatch.setenv(HOROVOD_CKPT_PUSH_TIMEOUT_S, "7.5")
+    monkeypatch.setenv(HOROVOD_ELASTIC_ADDR, "127.0.0.1")
+    monkeypatch.setenv(HOROVOD_ELASTIC_PORT, str(service.port))
+    monkeypatch.setenv("HOROVOD_SECRET_KEY", secret.hex())
+    state = State(w=np.zeros(2, np.float32), step=0)
+    client = state._store_client()
+    try:
+        assert client is not None and client._timeout_s == 7.5
+    finally:
+        state._drop_store_client()
+    committer = AsyncCommitter(("127.0.0.1", service.port), rank=0,
+                               world=1, secret=secret)
+    try:
+        assert committer._timeout_s == 7.5
+    finally:
+        committer.close()
+
+
+def test_state_restores_sealed_commit_with_provenance(hvd, elastic_service,
+                                                      monkeypatch):
+    from horovod_tpu.elastic import State
+
+    service, secret = elastic_service
+    monkeypatch.setenv(HOROVOD_ELASTIC_ADDR, "127.0.0.1")
+    monkeypatch.setenv(HOROVOD_ELASTIC_PORT, str(service.port))
+    monkeypatch.setenv("HOROVOD_SECRET_KEY", secret.hex())
+    tree = {"w": np.arange(16, dtype=np.float32) * 3.0, "step": 4}
+    committer = AsyncCommitter(("127.0.0.1", service.port), rank=0,
+                               world=1, secret=secret)
+    try:
+        committer.submit(4, tree, 0)
+        assert committer.wait_idle(timeout_s=30.0)
+    finally:
+        committer.close()
+    assert service.ckpt.stats()["sealed_no"] == 4
+    state = State(w=np.zeros(16, np.float32), step=0)
+    state.sync()
+    assert state.restore_source == "sealed"
+    assert state.restore_commit_no == 4
+    assert state.step == 4
+    np.testing.assert_array_equal(np.asarray(state.w), tree["w"])
+
+
+def test_state_refuses_sealed_commit_with_wrong_keys(hvd, elastic_service,
+                                                     monkeypatch):
+    from horovod_tpu.elastic import State
+
+    service, secret = elastic_service
+    monkeypatch.setenv(HOROVOD_ELASTIC_ADDR, "127.0.0.1")
+    monkeypatch.setenv(HOROVOD_ELASTIC_PORT, str(service.port))
+    monkeypatch.setenv("HOROVOD_SECRET_KEY", secret.hex())
+    committer = AsyncCommitter(("127.0.0.1", service.port), rank=0,
+                               world=1, secret=secret)
+    try:
+        committer.submit(1, {"other": 1}, 0)
+        assert committer.wait_idle(timeout_s=30.0)
+    finally:
+        committer.close()
+    state = State(w=np.zeros(4, np.float32), step=0)
+    state.sync()
+    # wrong key set: the stored commit is ignored, constructor state wins
+    assert state.restore_source is None
+    assert state.step == 0
+
+
+# -- train-to-serve hot swap ---------------------------------------------------
+
+
+def test_hot_swap_single_worker_old_or_new_never_torn():
+    from horovod_tpu.serving import ServingPlane
+    from horovod_tpu.serving.worker import serve_worker
+
+    w_old = np.eye(4, dtype=np.float32)
+    w_new = np.eye(4, dtype=np.float32) * 2.0
+    plane = ServingPlane(gateway_port=None, batch_max=2, slo_ms=10000.0,
+                         deadline_ms=30000.0, reconnect_window_s=2.0)
+    plane.begin_epoch(0, 1)
+    stats_box = []
+
+    def _worker():
+        weights = {"w": np.array(w_old)}
+        stats_box.append(serve_worker(
+            {"m": lambda x: x @ weights["w"]},
+            addr=("127.0.0.1", plane.service_port), secret=plane.secret,
+            rank=0, size=1, epoch=0, jit=False,
+            on_weights=lambda v, tree: weights.update(tree)))
+
+    worker = threading.Thread(target=_worker, daemon=True)
+    worker.start()
+    try:
+        deadline = time.monotonic() + 30.0
+        while not plane.stats()["armed"]:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        x = np.arange(4, dtype=np.float32)
+        t1 = plane.submit("m", x, deadline_s=20.0)
+        t1.wait(20.0)
+        assert t1.state == "done"
+        np.testing.assert_array_equal(np.asarray(t1.output), x @ w_old)
+        plane.publish_weights(1, tree={"w": np.array(w_new)})
+        t2 = plane.submit("m", x, deadline_s=20.0)
+        t2.wait(20.0)
+        assert t2.state == "done"
+        # strictly after the swap ack the result is the NEW weights —
+        # old-or-new atomically, and here provably new
+        np.testing.assert_array_equal(np.asarray(t2.output), x @ w_new)
+        assert plane.stats()["weights_version"] == 1
+        assert plane.stats()["swap_pending"] is None
+    finally:
+        plane.stop()
+        worker.join(timeout=30.0)
+        plane.close()
+    assert stats_box and stats_box[0]["swaps"] == 1
+    assert stats_box[0]["weights_version"] == 1
+    assert stats_box[0]["outcome"] == "stopped"
+
+
+def test_publish_weights_refuses_nothing_but_counts_and_arms_pending():
+    from horovod_tpu.serving import ServingPlane
+
+    plane = ServingPlane(gateway_port=None, batch_max=2)
+    try:
+        plane.begin_epoch(0, 2)
+        plane.publish_weights(7, tree={"w": [1, 2, 3]})
+        stats = plane.stats()
+        # no worker acked yet: pending, not applied
+        assert stats["swap_pending"] == 7
+        assert stats["weights_version"] is None
+        # a newer publish supersedes the pending one wholesale
+        plane.publish_weights(8, tree={"w": [4]})
+        assert plane.stats()["swap_pending"] == 8
+    finally:
+        plane.close()
+
+
+# -- registries / knobs / tooling ----------------------------------------------
+
+
+def test_wire_registry_names_every_ckpt_tag_with_degrade():
+    from horovod_tpu.analysis.wire_registry import (
+        ELASTIC_RPC_TAGS,
+        SERVING_RPC_TAGS,
+    )
+
+    for tag in ("ckpt_begin", "ckpt_chunk", "ckpt_end", "ckpt_fetch",
+                "ckpt_journal_put", "ckpt_journal_get",
+                "ckpt_journal_del"):
+        assert tag in ELASTIC_RPC_TAGS
+        assert ELASTIC_RPC_TAGS[tag].strip()
+    assert "swap_ack" in SERVING_RPC_TAGS
+    assert SERVING_RPC_TAGS["swap_ack"].strip()
+
+
+def test_wire_lint_clean_on_ckpt_and_serving_services():
+    from horovod_tpu.analysis.base import load_tree
+    from horovod_tpu.analysis.wire import run as wire_run
+
+    modules = load_tree(REPO, ["horovod_tpu"])
+    findings = [f for f in wire_run(REPO, modules)
+                if "ckpt" in f.key or "ServingPlane" in f.key
+                or "ElasticService" in f.key]
+    assert findings == [], [f.message for f in findings]
+
+
+def test_ckpt_interval_knob_ladder():
+    from horovod_tpu.tune.policy import KNOB_CKPT_INTERVAL, \
+        ckpt_interval_knob
+
+    knob = ckpt_interval_knob(5)
+    assert knob.name == KNOB_CKPT_INTERVAL
+    assert knob.current == 5.0
+    assert not knob.pinned
+    assert {1.0, 10.0, 100.0} <= set(knob.values)
+    # the live value splices into the ladder even off-candidate
+    off = ckpt_interval_knob(7, explicit=True)
+    assert off.current == 7.0 and off.pinned
+
+
+def test_checkpoint_shim_is_single_implementation():
+    import horovod_tpu.checkpoint as legacy
+    import horovod_tpu.ckpt.files as files
+
+    assert legacy.save is files.save
+    assert legacy.restore is files.restore
+
+
+def test_metrics_summary_renders_checkpoint_section(tmp_path):
+    from horovod_tpu.obs.registry import registry
+
+    from horovod_tpu.ckpt import committer as _c
+
+    _c.observe_commit_stall(0.001)
+    snap = registry().snapshot()
+    assert "horovod_ckpt_commit_stall_seconds" in snap, sorted(snap)
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(snap))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "metrics_summary.py"), str(path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "checkpoint plane" in proc.stdout
+    assert "horovod_ckpt_commit_stall_seconds" in proc.stdout
+
+
+def test_flightrec_declares_ckpt_events():
+    from horovod_tpu.obs import flightrec
+
+    assert flightrec.EV_CKPT_SUBMIT == "ckpt_submit"
+    assert flightrec.EV_CKPT_SEAL == "ckpt_seal"
+    assert flightrec.EV_CKPT_RESTORE == "ckpt_restore"
+    assert flightrec.EV_SERVING_SWAP == "serving_swap"
+
+
+# -- kill-mid-commit chaos cells (2-proc elastic worlds) -----------------------
+
+
+def test_chaos_kill_before_commit_restores_sealed():
+    from horovod_tpu.chaos.matrix import run_checkpoint_cell
+
+    cell = run_checkpoint_cell("1:2", "", "recovered")
+    assert cell["outcome"] == "recovered", cell
+    assert cell["restore_no"] == 1, cell
+
+
+def test_chaos_kill_between_chunks_restores_sealed():
+    from horovod_tpu.chaos.matrix import run_checkpoint_cell
+
+    cell = run_checkpoint_cell("", "0:2:1", "recovered")
+    assert cell["outcome"] == "recovered", cell
+    assert cell["restore_no"] == 1, cell  # the partial stream never sealed
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("native_core", [0, 1])
+def test_chaos_checkpoint_grid_full_sweep(native_core):
+    """The full grid on BOTH negotiation cores (the commit stream rides
+    the elastic service wire, which must be core-independent)."""
+    from horovod_tpu.chaos.matrix import CHECKPOINT_GRID, \
+        run_checkpoint_cell
+
+    for elastic_fault, ckpt_fault, expect in CHECKPOINT_GRID:
+        cell = run_checkpoint_cell(elastic_fault, ckpt_fault, expect,
+                                   native_core=native_core)
+        assert cell["outcome"] == expect, cell
+
+
+@pytest.mark.slow
+def test_dryrun_ckpt_certification():
+    sys.path.insert(0, REPO)
+    try:
+        from __graft_entry__ import dryrun_ckpt
+    finally:
+        sys.path.remove(REPO)
+    dryrun_ckpt()

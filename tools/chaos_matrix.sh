@@ -38,6 +38,26 @@ if [ "${1:-}" = "--serving" ]; then
   exit $rc
 fi
 
+# --checkpoint sweeps the checkpoint-plane grid (docs/checkpoint.md)
+# instead: kill-before-commit and kill-between-chunks on the async
+# commit pipeline must relaunch and restore the last SEALED commit
+# bit-exactly, and a clean async run must never relaunch — on both
+# negotiation cores (the commit stream rides the elastic service wire,
+# which is core-independent, so the sweep certifies exactly that).
+if [ "${1:-}" = "--checkpoint" ]; then
+  shift
+  rc=0
+  for core in 0 1; do
+    echo "=== checkpoint plane: HOROVOD_NATIVE_CORE=$core ==="
+    if ! JAX_PLATFORMS=cpu HOROVOD_NATIVE_CONTROLLER=0 \
+        HOROVOD_NATIVE_CORE=$core \
+        python -m horovod_tpu.chaos.matrix --checkpoint "$@"; then
+      rc=1
+    fi
+  done
+  exit $rc
+fi
+
 # --blackbox runs the flight-recorder assertion mode (docs/blackbox.md):
 # the escalation cell and the data-plane grid on both negotiation cores,
 # where every ESCALATED cell must also leave a classifiable

@@ -112,6 +112,14 @@ NUMERICS_PREFIXES = ("horovod_tensorwatch_", "horovod_tensor_",
 # collapse signal the evidence gate reverts on.
 SPARSE_PREFIXES = ("horovod_sparse_",)
 
+# Checkpoint-plane families (docs/checkpoint.md): commit/seal counters,
+# the sealed-commit watermark, digest mismatches, stream bytes/seconds,
+# the commit-stall histogram, and journal depth — the "is training
+# durable, and what does durability cost the step loop?" glance. A
+# sealed watermark that trails commits is the in-flight window a kill
+# would replay; any digest mismatch is a shard-divergence alarm.
+CKPT_PREFIXES = ("horovod_ckpt_",)
+
 
 def _render_section(title: str, families: Dict[str, dict], prefix: str,
                     out, skip: tuple = ()) -> None:
@@ -182,6 +190,15 @@ def _render_sparse_section(families: Dict[str, dict], prefix: str,
     _render_section("sparse wire", sparse, prefix, out)
 
 
+def _render_ckpt_section(families: Dict[str, dict], prefix: str,
+                         out) -> None:
+    ckpt = {n: f for n, f in families.items()
+            if n.startswith(CKPT_PREFIXES) and n.startswith(prefix)}
+    if not ckpt:
+        return  # no checkpoint plane in this snapshot: no empty section
+    _render_section("checkpoint plane", ckpt, prefix, out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="pretty-print a saved /metrics.json or "
@@ -211,10 +228,12 @@ def main(argv=None) -> int:
     _render_flightrec_section(world, args.family, sys.stdout)
     _render_numerics_section(world, args.family, sys.stdout)
     _render_sparse_section(world, args.family, sys.stdout)
+    _render_ckpt_section(world, args.family, sys.stdout)
     _render_section("world", world, args.family, sys.stdout,
                     skip=TUNING_PREFIXES + INTEGRITY_PREFIXES
                     + SERVING_PREFIXES + FLIGHTREC_PREFIXES
-                    + NUMERICS_PREFIXES + SPARSE_PREFIXES)
+                    + NUMERICS_PREFIXES + SPARSE_PREFIXES
+                    + CKPT_PREFIXES)
     # JSON round-trips rank keys as strings; accept either
     by_rank = {int(k): v for k, v in ranks.items()}
     wanted = sorted(by_rank) if args.all else (

@@ -564,6 +564,18 @@ def _parse_args(argv=None):
                              "provenance lands in the BENCH json. "
                              "Governs the eager control plane; SPMD "
                              "steps have no engine batches to sample.")
+    parser.add_argument("--hierarchy", default="",
+                        help="arm the hierarchical negotiation tree for "
+                             "this run (HOROVOD_HIERARCHY=auto|islands:N, "
+                             "docs/hierarchy.md): island heads merge "
+                             "their members' negotiation traffic and the "
+                             "root absorbs one submission per island per "
+                             "cycle; topology and root-message-count "
+                             "provenance lands in the BENCH json off the "
+                             "live registry. Needs the Python controller "
+                             "wire (armed alongside); a world the "
+                             "planner cannot split degrades to flat "
+                             "with a warning and honest zero counters.")
     parser.add_argument("--_measure", action="store_true",
                         help=argparse.SUPPRESS)  # internal: child mode
     parser.add_argument("--warm-init-cache", action="store_true",
@@ -633,7 +645,8 @@ def _supervise(args) -> None:
         (["--subbuffers", str(args.subbuffers)] if args.subbuffers else []) + \
         (["--fused-apply"] if args.fused_apply else []) + \
         (["--tensorwatch", str(args.tensorwatch)]
-         if args.tensorwatch else [])
+         if args.tensorwatch else []) + \
+        (["--hierarchy", args.hierarchy] if args.hierarchy else [])
     import signal
     import subprocess as sp
 
@@ -808,6 +821,19 @@ def main() -> None:
              f"HOROVOD_TENSORWATCH_INTERVAL_STEPS="
              f"{os.environ['HOROVOD_TENSORWATCH_INTERVAL_STEPS']} "
              f"(SNR/top-k provenance lands in the BENCH json)")
+
+    if args.hierarchy:
+        # Negotiation tree (docs/hierarchy.md): like --grad-sentry,
+        # BEFORE hvd.init() reads the config; setdefault so an
+        # operator's explicit pins win. The island RPCs ride the Python
+        # controller wire, so that is armed alongside — the native
+        # controller would silently degrade the run to flat and the
+        # capture would measure nothing tree-shaped.
+        os.environ.setdefault("HOROVOD_HIERARCHY", args.hierarchy)
+        os.environ.setdefault("HOROVOD_NATIVE_CONTROLLER", "0")
+        _log(f"negotiation tree armed: HOROVOD_HIERARCHY="
+             f"{os.environ['HOROVOD_HIERARCHY']} (topology and "
+             f"root-message provenance lands in the BENCH json)")
 
     if args.autotune:
         # Closed-loop tuning plane (docs/autotune.md): like --timeline-dir,
@@ -1004,6 +1030,8 @@ def main() -> None:
         provenance["fused_apply"] = True
     if args.tensorwatch:
         provenance["tensorwatch"] = args.tensorwatch
+    if args.hierarchy:
+        provenance["hierarchy"] = args.hierarchy
 
     for i in range(args.num_iters):
         t0 = time.perf_counter()
@@ -1135,6 +1163,25 @@ def main() -> None:
         if topk:
             result["tensorwatch_topk_mass"] = {
                 k: round(v, 4) for k, v in sorted(topk.items())}
+    if args.hierarchy:
+        # tree-plane audit beside the number (docs/hierarchy.md): the
+        # resolved topology and the root's absorbed message count off
+        # the LIVE registry — a degraded-to-flat run reports islands 0
+        # and zero root messages, never a guessed topology.
+        snap = hvd.metrics_snapshot()
+
+        def _hier_total(family):
+            fam = snap.get(family)
+            return sum(s["value"] for s in fam["samples"]) if fam else 0
+
+        result["hier_islands"] = int(
+            _hier_total("horovod_hier_islands"))
+        result["hier_root_messages"] = int(
+            _hier_total("horovod_hier_root_messages_total"))
+        result["hier_merged_cycles"] = int(
+            _hier_total("horovod_hier_merged_cycles_total"))
+        result["hier_raw_cycles"] = int(
+            _hier_total("horovod_hier_raw_cycles_total"))
     # cost_analysis() reports the per-device SPMD program's flops — and for
     # a lax.scan program it must count the loop BODY once, not times the
     # trip count, or mfu/tflops inflate by scan_batches. One body == one

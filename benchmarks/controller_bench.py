@@ -316,6 +316,102 @@ def steady_state_table(cores, sizes, n_cycles: int,
                   f"{cold_b:>11.0f} {warm_b:>11.0f}", flush=True)
 
 
+def _scaling_row(size: int, n_islands: int, n_cycles: int,
+                 tensors_per_cycle: int) -> dict:
+    """One simulated-world scaling row (docs/hierarchy.md): no sockets —
+    at 10^4 ranks the interesting quantities are what the ROOT must
+    absorb per cycle, and those are computable from the real message
+    pipeline. Flat: every rank's framed ``cycle`` RPC lands on the root.
+    Tree: each island's members land on their head, the head merges, and
+    the root absorbs ONE framed ``island_cycle`` per island. Bytes are
+    the actual wire framing (HMAC + length + pickle) of the actual
+    messages; cycles/sec times a real Negotiator fed the per-rank lists
+    (flat) vs fed the root-side expansions of the merged submissions
+    (tree) — the same compute the live root runs."""
+    from horovod_tpu.ops.hierarchy import merge_cycle, plan_topology
+    from horovod_tpu.ops.hierarchy import expand_submission
+    from horovod_tpu.runner.network import Wire
+
+    cfg = Config.from_env()
+    hier = plan_topology(size, f"islands:{n_islands}")
+    assert not hier.flat, (size, n_islands)
+    wire = Wire(SECRET)
+    lists = {
+        r: RequestList(rank=r, requests=[
+            _request(r, f"t{i}") for i in range(tensors_per_cycle)])
+        for r in range(size)
+    }
+    flat_bytes = sum(len(wire.frame(("cycle", r, lists[r])))
+                     for r in range(size))
+    subs = {i: merge_cycle(i, members,
+                           {r: lists[r] for r in members})
+            for i, members in hier.islands.items()}
+    assert all(s.raw is None for s in subs.values()), \
+        "symmetric workload must merge on every island"
+    tree_bytes = sum(
+        len(wire.frame(("island_cycle", min(members), i, subs[i])))
+        for i, members in hier.islands.items())
+
+    def cycles_per_s(feed) -> float:
+        neg = make_negotiator(size, cfg)
+        t0 = time.perf_counter()
+        for _ in range(n_cycles):
+            slot = feed()
+            for r in range(size):
+                neg.add_request_list(slot[r])
+            neg.construct_response_list()
+        return n_cycles / (time.perf_counter() - t0)
+
+    def tree_feed():
+        slot = {}
+        for sub in subs.values():
+            slot.update(expand_submission(sub))
+        return slot
+
+    return {"ranks": size, "islands": hier.n_islands,
+            "flat_root_msgs": size,
+            "tree_root_msgs": hier.n_islands,
+            "flat_root_bytes": flat_bytes,
+            "tree_root_bytes": tree_bytes,
+            "flat_cycles_per_s": round(cycles_per_s(lambda: lists), 2),
+            "tree_cycles_per_s": round(cycles_per_s(tree_feed), 2)}
+
+
+def scaling_table(sizes, n_cycles: int, tensors_per_cycle: int) -> None:
+    """The tentpole's acceptance table: root messages and bytes per cycle
+    must grow ~O(islands), not O(ranks), from 10^2 to 10^4 simulated
+    ranks. The last stdout line is the capture JSON
+    (``tools/bench_table.py`` renders it; the repo's tool contract)."""
+    import math
+
+    print(f"\n# negotiation-tree root load, simulated worlds, "
+          f"{tensors_per_cycle} tensors/cycle (cold RequestList shape), "
+          f"islands = floor(sqrt(ranks))")
+    print(f"{'ranks':>7} {'islands':>8} {'flat msgs/cyc':>14} "
+          f"{'tree msgs/cyc':>14} {'flat B/cyc':>12} {'tree B/cyc':>12} "
+          f"{'flat cyc/s':>11} {'tree cyc/s':>11}")
+    rows = []
+    for size in sizes:
+        row = _scaling_row(size, max(2, math.isqrt(size)), n_cycles,
+                           tensors_per_cycle)
+        rows.append(row)
+        print(f"{row['ranks']:>7} {row['islands']:>8} "
+              f"{row['flat_root_msgs']:>14} {row['tree_root_msgs']:>14} "
+              f"{row['flat_root_bytes']:>12} {row['tree_root_bytes']:>12} "
+              f"{row['flat_cycles_per_s']:>11.1f} "
+              f"{row['tree_cycles_per_s']:>11.1f}", flush=True)
+    last = rows[-1]
+    print(json.dumps({
+        "metric": "hier_root_message_reduction",
+        "value": round(last["flat_root_msgs"] / last["tree_root_msgs"],
+                       1),
+        "unit": "x",
+        "ranks": last["ranks"],
+        "hierarchy": {"rows": rows,
+                      "tensors_per_cycle": tensors_per_cycle}}),
+        flush=True)
+
+
 def _worker_main(args) -> None:
     ranks = range(args.base_rank, args.base_rank + args.n_ranks)
     # Free-running (no cross-process barrier): the controller's own
@@ -344,6 +440,15 @@ def main() -> None:
                              "small — the main-table scale tests budget "
                              "their subprocess timeout around it)")
     parser.add_argument("--steady-cycles", type=int, default=30)
+    parser.add_argument("--scaling", action="store_true",
+                        help="run ONLY the negotiation-tree root-load "
+                             "scaling table over simulated worlds "
+                             "(docs/hierarchy.md) — no sockets, so "
+                             "10^4-rank rows are cheap")
+    parser.add_argument("--scaling-sizes", default="100,1000,10000",
+                        help="simulated world sizes for --scaling")
+    parser.add_argument("--scaling-cycles", type=int, default=3,
+                        help="negotiation cycles timed per --scaling row")
     # internal worker mode
     parser.add_argument("--_worker", action="store_true",
                         help=argparse.SUPPRESS)
@@ -354,6 +459,11 @@ def main() -> None:
 
     if args._worker:
         _worker_main(args)
+        return
+
+    if args.scaling:
+        scaling_table([int(s) for s in args.scaling_sizes.split(",")],
+                      args.scaling_cycles, args.tensors_per_cycle)
         return
 
     impls = ["python", "native"] if args.impl == "both" else [args.impl]

@@ -36,7 +36,8 @@ CONTROLLER_REL = "horovod_tpu/ops/controller.py"
 MESSAGES_REL = "horovod_tpu/ops/messages.py"
 ELASTIC_REL = "horovod_tpu/elastic/health.py"
 SERVING_REL = "horovod_tpu/serving/plane.py"
-MESSAGE_CLASSES = ("Request", "RequestList", "Response", "CacheRequest")
+MESSAGE_CLASSES = ("Request", "RequestList", "Response", "CacheRequest",
+                   "IslandSubmission")
 
 
 def scan_rpc_tags(controller_mod: SourceModule,

@@ -61,6 +61,29 @@ RPC_TAGS: Dict[str, str] = {
     "flightrec": "Python controller only (PR 14): native wire predates "
                  "the incident-push RPC — the flight recorder degrades "
                  "to a rank-local blackbox dump, warned once",
+    "hello_island": "Python controller only (PR 18, docs/hierarchy.md): "
+                    "a sub-coordinator identifying itself and its "
+                    "member set to the root at connect; the native "
+                    "wire predates every island RPC, so HOROVOD_"
+                    "HIERARCHY degrades the whole world to flat, "
+                    "warned once on rank 0",
+    "island_cycle": "Python controller only (PR 18): one island's "
+                    "merged negotiation cycle (IslandSubmission) "
+                    "forwarded head→root; same flat degrade as "
+                    "hello_island",
+    "payload_island": "Python controller only (PR 18): the island's "
+                      "UNSUMMED per-member payload map forwarded on "
+                      "the head's second data connection — float "
+                      "addition is non-associative, so only the root "
+                      "combines; same flat degrade",
+    "sentry_island": "Python controller only (PR 18): the island's "
+                     "OR-folded gradient-sentry verdict bits forwarded "
+                     "on the head's dedicated sentry channel; same "
+                     "flat degrade",
+    "abort_island": "Python controller only (PR 18): a head's "
+                    "best-effort escalation naming a member rank that "
+                    "died mid-job, so the root can abort the world "
+                    "with the island named; same flat degrade",
 }
 
 # RPC tags dispatched by ElasticService._handle (elastic/health.py) —
@@ -204,6 +227,54 @@ MESSAGE_FIELDS: Dict[str, str] = {
     "CacheRequest.flush_ordinal": "PR 9: warm-path twin of "
                                   "RequestList.flush_ordinal; None "
                                   "skips the cross-check",
+    "Request.member_ranks": "PR 18 (docs/hierarchy.md): the global "
+                            "ranks a merged island request speaks for; "
+                            "None on every flat-wire request and on the "
+                            "root's re-expanded per-rank requests, so "
+                            "peers that predate the field never see it "
+                            "non-None",
+    "Request.gather_dim0s": "PR 18: per-member allgather first-dim "
+                            "sizes aligned to member_ranks, so one "
+                            "merged request preserves the ragged "
+                            "geometry; None except on merged ALLGATHER "
+                            "requests inside an IslandSubmission",
+    "IslandSubmission.island": "PR 18: which island this submission "
+                               "speaks for; head→root wire only — "
+                               "never reaches a member or the native "
+                               "wire",
+    "IslandSubmission.members": "PR 18: the island's global ranks; the "
+                                "root validates raw maps against it "
+                                "and names these ranks in abort texts",
+    "IslandSubmission.flush_ordinal": "PR 18: the HEAD's own upstream "
+                                      "cycle count — the per-LEVEL "
+                                      "PR 9 cross-check; a desynced "
+                                      "island fails loudly by name",
+    "IslandSubmission.cache": "PR 18: the AND-merged cache-bit form "
+                              "(PR 3 steady state) — set only when "
+                              "every member sent identical bits at one "
+                              "generation",
+    "IslandSubmission.requests": "PR 18: the congruence-merged cold "
+                                 "form; codec and apply_fingerprint "
+                                 "negotiated per level like dtypes",
+    "IslandSubmission.raw": "PR 18: verbatim per-member fallback when "
+                            "ANY member deviates — the root runs the "
+                            "flat path and produces byte-identical "
+                            "flat error texts",
+    "IslandSubmission.member_ordinals": "PR 18: members' own PR 9 flush "
+                                        "ordinals preserved through the "
+                                        "merge so the root's per-rank "
+                                        "cross-check still runs",
+    "IslandSubmission.digests": "PR 18: members' consensus digest "
+                                "windows (PR 8) preserved through the "
+                                "merge for the root's judge",
+    "IslandSubmission.fold": "PR 18: the head's digest-of-digests over "
+                             "the shipped windows; the root recomputes "
+                             "and a mismatch escalates as island-level "
+                             "wire corruption",
+    "IslandSubmission.shutdown_ranks": "PR 18: members draining toward "
+                                       "negotiated shutdown, forwarded "
+                                       "so the root's drain logic sees "
+                                       "global ranks",
 }
 
 # HorovodInternalError subclasses defined OUTSIDE core/status.py, with

@@ -102,6 +102,26 @@ CHECKPOINT_GRID = [
 ]
 
 
+# Hierarchical negotiation tree grid (docs/hierarchy.md): the same
+# acceptance contract as the flat grid, but the faults land on TREE
+# links. Cells are (chaos spec, np, HOROVOD_HIERARCHY, kill_rank,
+# expected outcome). The heal cells aim drop/delay/close at rank 1's
+# controller client — which in a tree world is the MEMBER-to-
+# SUB-COORDINATOR link — and must heal bit-exactly through the PR 4
+# reconnect/dedup envelopes, with the tree demonstrably live (the cell
+# asserts the hier gauge, so a silent flat degrade cannot certify).
+# The kill cell hard-kills rank 2 — island 1's sub-coordinator in a
+# 4-rank islands:2 world — and must escalate in-deadline as a
+# structured abort naming the island's member ranks.
+HIERARCHY_GRID = [
+    ("drop@rank1:msg6,drop@rank1:every9", 2, "islands:2", None, "healed"),
+    ("delay@rank1:40ms:every5", 2, "islands:2", None, "healed"),
+    ("close@rank1:msg8,refuse@relaunch:1", 2, "islands:2", None,
+     "healed"),
+    ("", 4, "islands:2", 2, "escalated"),
+]
+
+
 def _matrix_fn(steps: int, expect_escalation: bool):
     """Per-rank body (shipped by value through runner.run's driver)."""
     import jax
@@ -646,6 +666,199 @@ def _classify_checkpoint_results(results, elastic_fault: str,
             "restore_no": restore_no}
 
 
+def _hier_matrix_fn(steps: int, kill_rank, kill_step: int,
+                    expect_escalation: bool):
+    """Per-rank body for one hierarchy cell (shipped by value through
+    runner.run's driver): the flat grid's bit-exact-or-escalate loop,
+    plus (a) a hard mid-job exit on ``kill_rank`` — aimed at an island
+    HEAD, so the death must travel head→root→world as ONE structured
+    abort naming the island — and (b) proof the tree was live: a healed
+    cell reports the hier gauge and the island cycle counters off the
+    live registry, so a silently-flat degrade can never certify."""
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    try:
+        for step in range(steps):
+            if rank == kill_rank and step == kill_step:
+                os._exit(1)  # the sub-coordinator process dies mid-job
+            out = hvd.allreduce(
+                np.full((16,), float(rank + step + 1), np.float32),
+                average=False, name="chaos.hier")
+            if kill_rank is None:
+                np.testing.assert_array_equal(
+                    np.asarray(out),
+                    float(sum(r + step + 1 for r in range(size))))
+    except hvd.RanksAbortedError as exc:
+        assert expect_escalation, f"unexpected escalation: {exc}"
+        if kill_rank is not None:
+            # kill cells RE-RAISE: the abort text (the island-naming
+            # sub-coordinator attribution) must reach the driver's
+            # structured failure record, where run_hierarchy_cell checks
+            # it — a returned dict would be discarded when the killed
+            # rank's nonzero exit fails the whole run() call
+            raise
+        return {"rank": rank, "outcome": "escalated",
+                "aborted_ranks": exc.ranks, "error": str(exc)[:500]}
+    except hvd.HorovodInternalError as exc:
+        assert expect_escalation, f"unexpected world failure: {exc}"
+        if kill_rank is not None:
+            raise
+        return {"rank": rank, "outcome": "escalated", "aborted_ranks": [],
+                "error": str(exc)[:500]}
+    snap = hvd.metrics_snapshot()
+
+    def _val(name):
+        samples = (snap.get(name) or {}).get("samples") or []
+        return sum(s.get("value", 0) for s in samples)
+
+    hvd.shutdown()
+    return {"rank": rank, "outcome": "healed",
+            "hier_islands": _val("horovod_hier_islands"),
+            "merged_cycles": _val("horovod_hier_merged_cycles_total"),
+            "raw_cycles": _val("horovod_hier_raw_cycles_total")}
+
+
+def run_hierarchy_cell(spec: str, np_: int = 2,
+                       hierarchy: str = "islands:2",
+                       kill_rank=None, kill_step: int = 3,
+                       steps: int = 8,
+                       expect_escalation: bool = False,
+                       timeout_s: float = 120.0,
+                       deadline_s: float = 60.0) -> Dict:
+    """One hierarchy-grid cell: the ``run_cell`` env-pin pattern with the
+    tree armed (Python controller — the native wire predates the island
+    RPCs and would degrade the cell to a flat re-run). Healed cells
+    additionally require the tree to have been LIVE (every rank saw the
+    islands gauge at its planned value and the world's heads forwarded
+    at least one island cycle); escalated cells record whether the abort
+    text named the dead head's island (``island_named``)."""
+    from horovod_tpu.runner import run
+    from horovod_tpu.runner.run_api import WorkerFailedError, WorkerLostError
+    from horovod_tpu.runner.launcher import LaunchError
+
+    env = {
+        "HOROVOD_CHAOS": spec,
+        "HOROVOD_HIERARCHY": hierarchy,
+        "HOROVOD_NATIVE_CONTROLLER": "0",
+        "HOROVOD_PLATFORM": "cpu",
+        "HOROVOD_CYCLE_TIME": "2",
+        "HOROVOD_RECONNECT_ATTEMPTS": "4",
+        "HOROVOD_RECONNECT_BACKOFF_S": "0.05",
+        "HOROVOD_RECONNECT_WINDOW_S": "2",
+        "HOROVOD_STALL_WARNING_TIME": "2",
+        "HOROVOD_STALL_SHUTDOWN_TIME_S": "4",
+    }
+    t0 = time.monotonic()
+    import os
+
+    from horovod_tpu.core.config import HOROVOD_FLIGHTREC_DIR
+
+    # Kill cells judge the island attribution from the black-box dump:
+    # the surviving ranks' failure reports race the launcher's teardown
+    # of the world (the kill IS a launcher-visible death), but the
+    # flight recorder's evidence grace (docs/blackbox.md) deterministically
+    # lands the coordinator's merged incident — whose classified verdict
+    # must be the island-scoped one. Honors an outer --blackbox dir.
+    bb_dir = None
+    if kill_rank is not None and not os.environ.get(HOROVOD_FLIGHTREC_DIR):
+        import tempfile
+
+        bb_dir = tempfile.mkdtemp(prefix="hvd-hier-bb-")
+        env[HOROVOD_FLIGHTREC_DIR] = bb_dir
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        results = run(_hier_matrix_fn,
+                      args=(steps, kill_rank, kill_step,
+                            expect_escalation or kill_rank is not None),
+                      np=np_, timeout_s=timeout_s, start_timeout_s=120.0)
+        if any(r.get("outcome") == "escalated" for r in results):
+            cell = {"outcome": "escalated", "results": results}
+        else:
+            n_islands = int(hierarchy.split(":", 1)[1])
+            live = all(r.get("hier_islands") == n_islands
+                       for r in results) and any(
+                r.get("merged_cycles", 0) + r.get("raw_cycles", 0) > 0
+                for r in results)
+            cell = {"outcome": "healed" if live else "degraded-flat",
+                    "results": results}
+    except WorkerFailedError as exc:
+        cell = {"outcome": _classify_worker_failure(exc),
+                "error": str(exc)[:800],
+                # the island-naming attribution lives at the TAIL of a
+                # surviving rank's traceback (the exception message);
+                # keep those tails where the 800-char head would cut it
+                "record_errors": [str(r.get("traceback", ""))[-400:]
+                                  for r in exc.records.values()]}
+    except (WorkerLostError, LaunchError) as exc:
+        cell = {"outcome": "escalated", "error": str(exc)[:800]}
+    except TimeoutError as exc:
+        cell = {"outcome": "hang", "error": str(exc)[:500]}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    cell["spec"] = spec
+    cell["hierarchy"] = hierarchy
+    cell["kill_rank"] = kill_rank
+    cell["elapsed_s"] = round(time.monotonic() - t0, 2)
+    if cell["outcome"] == "escalated" and cell["elapsed_s"] > deadline_s:
+        cell["outcome"] = "late-escalation"
+    if kill_rank is not None:
+        texts = ([cell.get("error", "")]
+                 + list(cell.get("record_errors", []))
+                 + [str(r.get("error", ""))
+                    for r in cell.get("results", [])])
+        cell["island_named"] = any("sub-coordinator" in t for t in texts)
+        verdict_dir = bb_dir or os.environ.get(HOROVOD_FLIGHTREC_DIR)
+        if not cell["island_named"] and verdict_dir:
+            cell["blackbox_verdict"] = _island_verdict(verdict_dir)
+            cell["island_named"] = str(
+                cell["blackbox_verdict"] or "").startswith(
+                    "island-dead@island")
+    if bb_dir is not None:
+        import shutil
+
+        shutil.rmtree(bb_dir, ignore_errors=True)
+    return cell
+
+
+def _island_verdict(bb_dir: str) -> Optional[str]:
+    """Classify the cell's black-box dumps; the merged verdict is the
+    island-scoped one when the kill's attribution reached the recorder
+    (it deterministically does — the evidence grace holds the world open
+    long enough for the coordinator's incident push even when the killed
+    rank's nonzero exit beats the survivors' failure reports to the
+    launcher, which strips the island text from the driver's error)."""
+    import glob as _glob
+    import json as _json
+    import os
+
+    from horovod_tpu.obs.flightrec import classify_incident, merge_incidents
+
+    docs = []
+    for path in sorted(_glob.glob(os.path.join(bb_dir, "blackbox-*.json"))):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                docs.append(_json.load(fh))
+        except (OSError, ValueError):
+            continue
+    if not docs:
+        return None
+    return classify_incident(merge_incidents(docs)).get("verdict")
+
+
 def run_cell(spec: str,
              native_controller: Optional[int] = None,
              native_core: Optional[int] = None,
@@ -840,6 +1053,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "200-bit-exact, kill-rank-mid-batch must "
                              "relaunch with every request 200 or a "
                              "structured 503 — never a hang")
+    parser.add_argument("--hierarchy", action="store_true",
+                        help="run the negotiation-tree grid instead "
+                             "(docs/hierarchy.md): drop/delay/close on a "
+                             "member-to-sub-coordinator link must heal "
+                             "bit-exactly with the tree LIVE; a "
+                             "sub-coordinator kill must escalate "
+                             "in-deadline naming the island")
     parser.add_argument("--checkpoint", action="store_true",
                         help="run the checkpoint-plane grid instead "
                              "(docs/checkpoint.md): kill-before-commit "
@@ -847,6 +1067,44 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "restore the last SEALED commit bit-exactly; "
                              "a clean async run must never relaunch")
     args = parser.parse_args(argv)
+    if args.hierarchy:
+        failed = 0
+        blackbox = _BlackboxCheck() if args.blackbox else None
+        try:
+            for spec, np_, hierarchy, kill_rank, expect in HIERARCHY_GRID:
+                def _cell(spec=spec, np_=np_, hierarchy=hierarchy,
+                          kill_rank=kill_rank, expect=expect):
+                    return run_hierarchy_cell(
+                        spec, np_=np_, hierarchy=hierarchy,
+                        kill_rank=kill_rank, steps=args.steps,
+                        expect_escalation=(expect == "escalated"))
+                cell = blackbox.run(_cell) if blackbox is not None \
+                    else _cell()
+                ok = cell["outcome"] == expect
+                if kill_rank is not None:
+                    # an escalation that lost the island attribution is
+                    # a failing cell: the whole point of the head-death
+                    # path is a structured abort NAMING the island
+                    ok = ok and cell.get("island_named", False)
+                bb = ""
+                if blackbox is not None:
+                    bb, bb_ok = blackbox.assess(cell["outcome"])
+                    ok = ok and bb_ok
+                if not ok:
+                    failed += 1
+                label = (f"{hierarchy} np={np_} " +
+                         (f"kill-head@rank{kill_rank}" if kill_rank
+                          is not None else spec))
+                print(f"hier-cell {'OK ' if ok else 'BAD'} "
+                      f"outcome={cell['outcome']:<15} "
+                      f"{cell['elapsed_s']:6.1f}s  {label}{bb}",
+                      flush=True)
+                if not ok:
+                    print(f"  {cell.get('error', '')}", flush=True)
+        finally:
+            if blackbox is not None:
+                blackbox.cleanup()
+        return 1 if failed else 0
     if args.checkpoint:
         failed = 0
         for elastic_fault, ckpt_fault, expect in CHECKPOINT_GRID:

@@ -126,6 +126,22 @@ HOROVOD_CONTROLLER_PORT = "HOROVOD_CONTROLLER_PORT"
 # probe-then-rebind TOCTOU window where another process could steal the
 # advertised port between the launcher's probe and rank 0's bind.
 HOROVOD_CONTROLLER_FD = "HOROVOD_CONTROLLER_FD"
+# Hierarchical negotiation tree (docs/hierarchy.md): "flat" (default)
+# keeps the rank-0 coordinator star; "auto" derives one island per host
+# from the launcher's cross_size; "islands:N" forces N islands. Any
+# resolved 1-island split, size-1 world, or native-controller world
+# degrades deterministically to flat (warned once).
+HOROVOD_HIERARCHY = "HOROVOD_HIERARCHY"
+# Launcher -> rank plumbing for the negotiation tree (never set by hand;
+# the launcher derives them from HOROVOD_HIERARCHY): the rank's island
+# id, and the island sub-coordinator's address/port every member dials
+# instead of the root. Island heads additionally inherit their
+# pre-bound listener via HOROVOD_SUBCOORD_FD (same TOCTOU-closing
+# pattern as HOROVOD_CONTROLLER_FD above).
+HOROVOD_ISLAND = "HOROVOD_ISLAND"
+HOROVOD_SUBCOORD_ADDR = "HOROVOD_SUBCOORD_ADDR"
+HOROVOD_SUBCOORD_PORT = "HOROVOD_SUBCOORD_PORT"
+HOROVOD_SUBCOORD_FD = "HOROVOD_SUBCOORD_FD"
 HOROVOD_SECRET_KEY = "HOROVOD_SECRET_KEY"
 HOROVOD_START_TIMEOUT = "HOROVOD_START_TIMEOUT"
 # Force the JAX platform ("cpu", "tpu", ...) before any backend starts.
@@ -460,6 +476,9 @@ class Config:
     stall_warning_time_s: float = STALL_WARNING_TIME_S
     stall_shutdown_time_s: float = 0.0  # 0 = warn forever, never abort
     heartbeat_interval_s: float = 1.0
+    # hierarchical negotiation tree (docs/hierarchy.md): control-plane
+    # topology — "flat", "auto", or "islands:N" (validated at init)
+    hierarchy: str = "flat"
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
     compression: str = "none"
@@ -548,6 +567,8 @@ class Config:
             stall_shutdown_time_s=_env_float(HOROVOD_STALL_SHUTDOWN_TIME,
                                              0.0),
             heartbeat_interval_s=_env_float(HOROVOD_HEARTBEAT_INTERVAL, 1.0),
+            hierarchy=(os.environ.get(HOROVOD_HIERARCHY, "flat")
+                       .strip().lower() or "flat"),
             hierarchical_allreduce=_env_bool(HOROVOD_HIERARCHICAL_ALLREDUCE),
             hierarchical_allgather=_env_bool(HOROVOD_HIERARCHICAL_ALLGATHER),
             compression=(os.environ.get(HOROVOD_COMPRESSION, "none")

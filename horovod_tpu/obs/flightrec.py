@@ -103,6 +103,14 @@ _ABORTED_RE = re.compile(r"\[aborted ranks: ([0-9][0-9,\s]*)\]")
 _CONSENSUS_RE = re.compile(r"\[consensus mismatch: ranks ([0-9][0-9,\s]*)\]")
 _NONFINITE_RE = re.compile(r"\[non-finite grad: step (\d+)\]")
 _EXITED_RE = re.compile(r"rank (\d+) (?:exited mid-job|disconnected)")
+# hierarchical negotiation tree (docs/hierarchy.md): island-scoped abort
+# texts — a sub-coordinator death names the island's whole member roster,
+# an inter-level desync or digest-fold mismatch names the island, and the
+# postmortem verdict must surface that scope instead of a single rank
+_ISLAND_DEAD_RE = re.compile(
+    r"island (\d+) sub-coordinator \(rank (\d+)\) exited")
+_ISLAND_DESYNC_RE = re.compile(r"desync between islands: island (\d+)")
+_ISLAND_FOLD_RE = re.compile(r"island (\d+) consensus digest fold mismatch")
 
 DEFAULT_CAPACITY = 4096
 DEFAULT_DUMP_TIMEOUT_S = 5.0
@@ -659,6 +667,12 @@ def classify_incident(doc: dict) -> dict:
                 culprit = local[0]
         verdict = (f"nonfinite@rank{culprit} step {step}"
                    if culprit is not None else f"nonfinite step {step}")
+    elif _ISLAND_FOLD_RE.search(search) is not None:
+        island = _ISLAND_FOLD_RE.search(search).group(1)
+        verdict = f"consensus-fold@island{island}"
+    elif _ISLAND_DESYNC_RE.search(search) is not None:
+        island = _ISLAND_DESYNC_RE.search(search).group(1)
+        verdict = f"desync: island{island} flush_ordinal"
     elif "cycle stream desync" in search or "flush_ordinal" in search:
         verdict = "desync: flush_ordinal"
     elif "stalled past" in reason or "Stalled ops" in reason:
@@ -667,13 +681,20 @@ def classify_incident(doc: dict) -> dict:
         who = f"rank{stalled[0]}" if stalled else "rank?"
         verdict = f"stall@{who} cycle {cycle_s}"
     else:
+        m_isl = _ISLAND_DEAD_RE.search(search)
         named = _ABORTED_RE.search(reason)
         if named is None:
             named = _EXITED_RE.search(reason)
             dead = [int(named.group(1))] if named else []
         else:
             dead = _parse_int_list(named.group(1))
-        if dead:
+        if m_isl is not None:
+            # checked before the rank verdicts: the sub-coordinator text
+            # also matches _EXITED_RE, and the postmortem must lead with
+            # the TREE scope (a whole island's members went unreachable)
+            verdict = (f"island-dead@island{m_isl.group(1)} "
+                       f"cycle {cycle_s}")
+        elif dead:
             verdict = f"dead@rank{dead[0]} cycle {cycle_s}"
     return {
         "verdict": verdict,

@@ -234,16 +234,20 @@ def _histogram_quantile(bounds, buckets, q: float) -> Optional[float]:
     return None  # beyond the last finite bound
 
 
-def _sum_labeled_counter(families: dict, name: str) -> Dict[int, float]:
+def _sum_labeled_counter(families: dict, name: str,
+                         label: str = "rank") -> Dict[int, float]:
+    """Fold a labeled counter family by one label (summing across any
+    OTHER labels on the sample — the rank fold is island-agnostic and
+    the island fold rank-agnostic, so both read the same family)."""
     out: Dict[int, float] = {}
     fam = families.get(name)
     if not fam:
         return out
     for sample in fam.get("samples", []):
-        rank = sample.get("labels", {}).get("rank")
-        if rank is None:
+        key = sample.get("labels", {}).get(label)
+        if key is None:
             continue
-        out[int(rank)] = out.get(int(rank), 0.0) + sample.get("value", 0.0)
+        out[int(key)] = out.get(int(key), 0.0) + sample.get("value", 0.0)
     return out
 
 
@@ -275,12 +279,29 @@ def build_straggler_report(ranks: Dict[int, dict],
     noise."""
     last: Dict[int, float] = {}
     blame_s: Dict[int, float] = {}
+    # Hierarchical worlds (docs/hierarchy.md): the same two families fold
+    # a second way, by their ``island`` label — at the root the arrival
+    # spread is measured BETWEEN island heads, so island blame is the
+    # topology-level attribution (name the slow island before the slow
+    # rank: a DCN-side cause charges the whole island roughly equally,
+    # and the per-rank fold alone would smear it below the dominance
+    # gate). Flat worlds stamp island=0 everywhere, collapsing the fold
+    # to one row that can never dominate misleadingly (share == 1 needs
+    # mean spread > min_spread_s too, same as a 1-rank world).
+    island_last: Dict[int, float] = {}
+    island_blame_s: Dict[int, float] = {}
     spread = None
     for fams in ranks.values():
         for rank, v in _sum_labeled_counter(fams, FAMILY_LAST).items():
             last[rank] = last.get(rank, 0.0) + v
         for rank, v in _sum_labeled_counter(fams, FAMILY_BLAME_S).items():
             blame_s[rank] = blame_s.get(rank, 0.0) + v
+        for isl, v in _sum_labeled_counter(fams, FAMILY_LAST,
+                                           label="island").items():
+            island_last[isl] = island_last.get(isl, 0.0) + v
+        for isl, v in _sum_labeled_counter(fams, FAMILY_BLAME_S,
+                                           label="island").items():
+            island_blame_s[isl] = island_blame_s.get(isl, 0.0) + v
         s = _unlabeled_sample(fams, FAMILY_SPREAD)
         if s is not None and s.get("count"):
             if spread is None:
@@ -301,7 +322,18 @@ def build_straggler_report(ranks: Dict[int, dict],
         "blame": {},
         "per_rank": {},
         "dominant_rank": None,
+        "islands": {},
+        "dominant_island": None,
     }
+    island_total = sum(island_blame_s.values())
+    for isl in sorted(set(island_last) | set(island_blame_s)):
+        seconds = island_blame_s.get(isl, 0.0)
+        report["islands"][isl] = {
+            "last_arriver_cycles": int(island_last.get(isl, 0)),
+            "blame_seconds": seconds,
+            "blame_share": (seconds / island_total) if island_total
+            else 0.0,
+        }
     for rank in sorted(set(last) | set(blame_s)):
         seconds = blame_s.get(rank, 0.0)
         report["blame"][rank] = {
@@ -327,6 +359,15 @@ def build_straggler_report(ranks: Dict[int, dict],
             if report["blame"][top]["blame_share"] > 0.5 and \
                     mean > min_spread_s:
                 report["dominant_rank"] = top
+        if len(report["islands"]) > 1:
+            # same two gates as dominant_rank — and only when the world
+            # actually has islands to tell apart (one row is a flat
+            # world's island=0 default, not a finding)
+            top_i = max(report["islands"], key=lambda i:
+                        report["islands"][i]["blame_seconds"])
+            if report["islands"][top_i]["blame_share"] > 0.5 and \
+                    mean > min_spread_s:
+                report["dominant_island"] = top_i
     # Per-rank phase breakdown: where each rank's wall time went —
     # negotiation wait (client-observed cycle latency, straggler wait
     # included) vs executing negotiated responses.

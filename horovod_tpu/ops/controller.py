@@ -104,15 +104,19 @@ _RECONNECT_WINDOW_HEALS = _metrics().counter(
 # answer "who is costing the world time" — a rank late by microseconds
 # on every cycle must not outrank one late by 50 ms on a tenth of them.
 # rank labels are low-cardinality by the registry's contract (a world's
-# rank set, not tensor names).
+# rank set, not tensor names). The island label (docs/hierarchy.md) rides
+# the same families: flat worlds stamp island=0, hierarchy worlds stamp
+# the id of the DCN island the blamed rank lives in — the root charges
+# whole islands (rank = the island's head), heads charge their members —
+# so the report tool can name the slow ISLAND before the slow rank.
 _STRAGGLER_LAST = _metrics().counter(
     "horovod_straggler_last_arriver_total",
     "Negotiation cycles in which this rank arrived last at the "
-    "coordinator", labels=("rank",))
+    "coordinator", labels=("rank", "island"))
 _STRAGGLER_BLAME_S = _metrics().counter(
     "horovod_straggler_blame_seconds_total",
     "Arrival-spread seconds charged to this rank as the cycle's last "
-    "arriver", labels=("rank",))
+    "arriver", labels=("rank", "island"))
 _ARRIVAL_SPREAD = _metrics().histogram(
     "horovod_arrival_spread_seconds",
     "Per-cycle coordinator arrival spread (last arrival - first)")
@@ -492,6 +496,55 @@ class _Rendezvous:
                     from result
             return result
 
+    def submit_group(self, key: Any, items: Dict[int, Any],
+                     compute: Callable[[Dict[int, Any]], Any],
+                     timeout_s: Optional[float] = None,
+                     timeout_hint: str = "") -> Any:
+        """``submit`` for a handler thread carrying SEVERAL participants'
+        items at once (a forwarded island batch, docs/hierarchy.md).
+        Inserting them one ``submit()`` at a time would deadlock: the
+        first call parks waiting for the rest, which are queued behind it
+        on the same thread. All-or-nothing insert, ONE wait, and
+        ``len(items)`` deliveries consumed toward cleanup."""
+        if not items:
+            raise ValueError("submit_group requires at least one item")
+        with self._cond:
+            if self._aborted is not None:
+                raise RuntimeError(str(self._aborted)) from self._aborted
+            slot = self._slots.setdefault(key, {})
+            slot.update(items)
+            if len(slot) >= self._size and key not in self._results:
+                try:
+                    self._results[key] = ("ok", compute(slot))
+                except Exception as exc:  # noqa: BLE001 - poison for all
+                    self._results[key] = ("error", exc)
+                self._delivered[key] = 0
+                self._cond.notify_all()
+            elif key not in self._results:
+                arrived = self._cond.wait_for(
+                    lambda: key in self._results
+                    or self._aborted is not None,
+                    timeout=timeout_s)
+                if not arrived and key not in self._results and \
+                        self._aborted is None:
+                    missing = sorted(set(range(self._size)) - set(slot))
+                    raise RuntimeError(
+                        f"rendezvous {key!r} timed out after "
+                        f"{timeout_s:.0f}s waiting for ranks "
+                        f"{', '.join(map(str, missing))}. {timeout_hint}")
+            if key not in self._results:
+                raise RuntimeError(str(self._aborted)) from self._aborted
+            kind, result = self._results[key]
+            self._delivered[key] += len(items)
+            if self._delivered[key] >= self._size:
+                del self._slots[key], self._results[key], \
+                    self._delivered[key]
+            if kind == "error":
+                raise RuntimeError(
+                    f"coordinator-side collective failure: {result}") \
+                    from result
+            return result
+
     def abort(self, exc: BaseException) -> None:
         """Wake every waiter with ``exc`` and fail all future submits —
         the rendezvous can never complete once a participant is dead.
@@ -641,9 +694,31 @@ class ControllerService:
                  reconnect_window_s: Optional[float] = None,
                  straggler_detector=None,
                  codec_min_bytes: int = 4096,
-                 consensus_interval_steps: Optional[int] = None) -> None:
+                 consensus_interval_steps: Optional[int] = None,
+                 islands: Optional[Dict[int, Tuple[int, ...]]] = None
+                 ) -> None:
         self._negotiator = negotiator
         self._world_id = world_id
+        # Hierarchical negotiation tree (docs/hierarchy.md): when the
+        # world runs two-level, this service is the ROOT — it sees only
+        # the per-island sub-coordinators (one merged submission per
+        # island per cycle) and expands them back into the flat per-rank
+        # path below, keeping responses and error texts byte-identical.
+        # {island id -> sorted global member ranks}; learned from
+        # "hello_island" too so tooling-built services need no kwarg.
+        self._islands: Dict[int, Tuple[int, ...]] = \
+            {int(i): tuple(m) for i, m in islands.items()} if islands \
+            else {}
+        self._island_of: Dict[int, int] = {
+            r: i for i, mem in self._islands.items() for r in mem}
+        # per-rendezvous-key island bookkeeping: arrival times (island
+        # straggler attribution), the heads' own upstream flush ordinals
+        # (the per-LEVEL PR 9 cross-check), and expansion/fold errors
+        # deferred into the rendezvous compute so they poison the cycle
+        # for every island instead of wedging the others.
+        self._island_arrivals: Dict[Any, Dict[int, float]] = {}
+        self._island_ordinals: Dict[Any, Dict[int, Any]] = {}
+        self._island_errors: Dict[Any, List[str]] = {}
         # Self-healing grace (docs/chaos.md): a rank-bound connection that
         # drops is given this long to reconnect and supersede before the
         # drop is declared a rank death. 0 restores abort-on-first-drop.
@@ -841,8 +916,27 @@ class ControllerService:
         # The explicit tag makes the attribution machine-parseable even
         # from a survivor's stderr tail (strict parsing ignores the
         # bare "rank N exited" phrasing there — log text is noisy).
-        exc = RuntimeError(f"rank {rank} exited mid-job. {SHUT_DOWN_ERROR} "
-                           f"{format_aborted_ranks([rank])}")
+        # In a hierarchy world the only ranks bound HERE are the island
+        # heads: a head's death takes its whole island off the wire, so
+        # the structured reason names the island and every member rank
+        # (docs/hierarchy.md) — the aborted-ranks tag keeps the blackbox
+        # classifier and the elastic blacklist attribution working.
+        island = None
+        for i, mem in sorted(self._islands.items()):
+            if mem and rank == min(mem):
+                island = i
+                break
+        if island is not None:
+            members = self._islands[island]
+            exc = RuntimeError(
+                f"island {island} sub-coordinator (rank {rank}) exited "
+                f"mid-job; its member ranks "
+                f"{', '.join(map(str, members))} are unreachable. "
+                f"{SHUT_DOWN_ERROR} {format_aborted_ranks(members)}")
+        else:
+            exc = RuntimeError(
+                f"rank {rank} exited mid-job. {SHUT_DOWN_ERROR} "
+                f"{format_aborted_ranks([rank])}")
         self._cycles.abort(exc)  # first abort wins inside the rendezvous
         self._payloads.abort(exc)
         self._sentry_rv.abort(exc)  # a parked verdict can never complete
@@ -881,6 +975,8 @@ class ControllerService:
                 "tuned_knobs": dict(self._tuned_knobs)
                 if self._tuned_knobs else None,
                 "tuned_cycle_ms": self._tuned_cycle_ms,
+                "islands": {str(i): list(m) for i, m in
+                            self._islands.items()} or None,
             }
         snap["cache_generation"] = (self._cache.generation
                                     if self._cache is not None else None)
@@ -1014,8 +1110,17 @@ class ControllerService:
         # while anonymous connections (NIC reachability probes open and
         # close without sending) are never mistaken for dead ranks.
         rank = req[1]
-        if kind == "hello":
-            caller_wid = req[2] if len(req) > 2 else ""
+        if kind in ("hello", "hello_island"):
+            # "hello_island" is an island head identifying itself
+            # (docs/hierarchy.md): same gates as "hello" — the head IS a
+            # rank (its own global rank, never the island id, so the
+            # connection-binding map below stays rank-keyed) plus the
+            # island roster the root expands submissions against.
+            caller_wid = ""
+            if kind == "hello" and len(req) > 2:
+                caller_wid = req[2]
+            elif kind == "hello_island" and len(req) > 4:
+                caller_wid = req[4]
             if caller_wid and self._world_id and \
                     caller_wid != self._world_id:
                 # a co-scheduled different world's client (subset
@@ -1046,22 +1151,15 @@ class ControllerService:
                         reason += (" (predecessor world aborted: "
                                    f"{self._watch_reason})")
                     raise RuntimeError(reason)
-        with self._lock:
-            # A NEW connection for a rank SUPERSEDES any previous one
-            # (de-identified, not closed): a client that reconnects — its
-            # hello reply lost to a transient reset — must not have the
-            # stale connection's close attributed as its own death.
-            old = self._rank_conns.get(rank)
-            if old is not None and old != id(_sock):
-                self._conn_ranks.pop(old, None)
-            self._rank_conns[rank] = id(_sock)
-            self._conn_ranks[id(_sock)] = rank
-            healed = self._pending_reconnect.pop(rank, None)
-        if healed is not None:
-            _RECONNECT_WINDOW_HEALS.inc()
-            LOG.warning("rank %d reconnected within the window; the "
-                        "dropped connection is forgiven", rank)
+        self._bind_connection(rank, _sock)
         if kind == "hello":
+            return ("ok",)
+        if kind == "hello_island":
+            _, _, island, members = req[:4]
+            with self._lock:
+                self._islands[int(island)] = tuple(members)
+                self._island_of = {r: i for i, mem in
+                                   self._islands.items() for r in mem}
             return ("ok",)
         if kind == "cycle":
             _, _, request_list = req
@@ -1109,7 +1207,158 @@ class ControllerService:
                     "HOROVOD_GRAD_SENTRY must resolve identically on "
                     "every rank — a disarmed rank never joins the "
                     "verdict exchange."))
+        if kind == "island_cycle":
+            # One merged submission for a WHOLE island's cycle
+            # (docs/hierarchy.md): expand back into the flat per-rank
+            # slot and run the unchanged _run_cycle — validation, error
+            # texts, stall/consensus escalation, cache bookkeeping and
+            # response construction stay byte-identical with flat.
+            _, _, island, submission = req
+            return self._island_cycle(int(island), submission)
+        if kind == "payload_island":
+            # Host-plane payload forwarding: the head ships its members'
+            # raw buffers UNSUMMED ({rank: bytes}) — float addition is
+            # non-associative, so only the root's single sorted-rank
+            # combine keeps the result bit-identical with flat.
+            _, _, island, cycle_no, idx, datas = req
+            resp = self._history[cycle_no].responses[idx]
+            return self._payloads.submit_group(
+                ("payload", cycle_no, idx), dict(datas),
+                lambda slot: Preserialized(
+                    self._service.wire.frame(
+                        self._combine_payload(resp, slot))))
+        if kind == "sentry_island":
+            # Gradient-sentry verdict forwarding: per-member finite bits
+            # ({rank: bits}) folded at the root over the WORLD — the
+            # verdict must be the same OR-fold every flat rank computes.
+            from ..integrity.sentry import or_bits
+
+            _, _, island, ordinal, bit_map = req
+            return self._sentry_rv.submit_group(
+                ("sentry", ordinal), dict(bit_map),
+                lambda slot: or_bits(list(slot.values())),
+                timeout_s=60.0,
+                timeout_hint=(
+                    "HOROVOD_GRAD_SENTRY must resolve identically on "
+                    "every rank — a disarmed rank never joins the "
+                    "verdict exchange."))
+        if kind == "abort_island":
+            # A head detected one of ITS members dying and escalates the
+            # death upstream so the whole world tears down with the same
+            # flat attribution text (the head stays alive long enough to
+            # forward, so the root would otherwise only see the island's
+            # traffic stop).
+            _, _, island, dead_rank, _reason = req
+            self._abort_for_rank(int(dead_rank))
+            return ("ok",)
         raise ValueError(f"unknown controller request {kind!r}")
+
+    def _bind_connection(self, rank: int, sock: Any) -> None:
+        """Bind a connection to the rank it serves for failure detection.
+        A NEW connection for a rank SUPERSEDES any previous one
+        (de-identified, not closed): a client that reconnects — its
+        hello reply lost to a transient reset — must not have the stale
+        connection's close attributed as its own death."""
+        with self._lock:
+            old = self._rank_conns.get(rank)
+            if old is not None and old != id(sock):
+                self._conn_ranks.pop(old, None)
+            self._rank_conns[rank] = id(sock)
+            self._conn_ranks[id(sock)] = rank
+            healed = self._pending_reconnect.pop(rank, None)
+        if healed is not None:
+            _RECONNECT_WINDOW_HEALS.inc()
+            LOG.warning("rank %d reconnected within the window; the "
+                        "dropped connection is forgiven", rank)
+
+    def _island_cycle(self, island: int, submission: Any) -> Any:
+        """Root half of the two-level cycle: book island arrival and
+        per-level flush ordinal, expand the merged submission into the
+        per-global-rank slot, and group-submit it into the SAME cycle
+        rendezvous flat ranks use. Expansion or fold failures are
+        DEFERRED into the rendezvous compute — raising here would wedge
+        the other islands forever; poisoning the compute fails every
+        island loudly with the cause."""
+        from . import hierarchy as _hier
+
+        _hier.ROOT_MESSAGES.inc()
+        key = ("cycle", self._current_cycle(("island", island)))
+        now = time.monotonic()
+        with self._lock:
+            self._cycle_t0.setdefault(key, now)
+            self._island_arrivals.setdefault(key, {})[island] = now
+            self._island_ordinals.setdefault(key, {})[island] = \
+                getattr(submission, "flush_ordinal", None)
+        try:
+            expanded = _hier.expand_submission(submission)
+            fold_err = _hier.check_fold(submission)
+            if fold_err:
+                with self._lock:
+                    self._island_errors.setdefault(key, []).append(
+                        fold_err)
+        except Exception as exc:  # noqa: BLE001 - deferred, see above
+            with self._lock:
+                self._island_errors.setdefault(key, []).append(
+                    f"island {island} submission could not be expanded: "
+                    f"{exc}")
+            expanded = {r: RequestList(rank=r)
+                        for r in getattr(submission, "members", ())} \
+                or {0: RequestList(rank=0)}
+
+        def compute(slot: Dict[int, Any]) -> Any:
+            with self._lock:
+                errors = self._island_errors.pop(key, None)
+            if errors:
+                raise RuntimeError("; ".join(errors))
+            self._check_island_ordinals(key)
+            result = self._run_cycle(slot, key)
+            self._attribute_island_straggler(key)
+            return result
+
+        return self._cycles.submit_group(key, expanded, compute)
+
+    def _check_island_ordinals(self, key: Any) -> None:
+        """Per-LEVEL cycle-alignment cross-check (docs/hierarchy.md):
+        each head stamps its submission with its OWN upstream cycle
+        count, and all islands joined in one root rendezvous must name
+        the same cycle — relative, like the per-rank check, so a
+        desynced ISLAND fails loudly by name instead of smearing into
+        per-rank noise. (The members' own ordinals still ride the
+        expanded lists, so the flat per-rank check runs as well.)"""
+        with self._lock:
+            ordinals = self._island_ordinals.pop(key, None) or {}
+        stamped = {i: o for i, o in ordinals.items() if o is not None}
+        if len(set(stamped.values())) <= 1:
+            return
+        detail = ", ".join(
+            f"island {i} (ranks "
+            f"{', '.join(map(str, self._islands.get(i, ())))}) "
+            f"at cycle {o}" for i, o in sorted(stamped.items()))
+        raise RuntimeError(
+            f"negotiation cycle stream desync between islands: {detail} "
+            f"joined one rendezvous; every island head must forward "
+            f"every cycle exactly once and in order — a desynced island "
+            f"would silently misalign sentry ordinals, consensus "
+            f"windows, and cache-bit positions for all its members")
+
+    def _attribute_island_straggler(self, key: Any) -> None:
+        """Island-level straggler attribution: charge the cycle's
+        arrival spread to the LAST island (blamed rank = that island's
+        head) so the report tool can name the slow island before the
+        slow rank. The heads attribute their members island-locally."""
+        with self._lock:
+            arrivals = self._island_arrivals.pop(key, None)
+            n_islands = len(self._islands)
+        if arrivals is None or len(arrivals) < n_islands or \
+                n_islands <= 1:
+            return
+        last_island, last_t = max(arrivals.items(), key=lambda kv: kv[1])
+        spread = last_t - min(arrivals.values())
+        head = min(self._islands.get(last_island, (last_island,)))
+        _STRAGGLER_LAST.labels(rank=head, island=last_island).inc()
+        _STRAGGLER_BLAME_S.labels(rank=head,
+                                  island=last_island).inc(spread)
+        _ARRIVAL_SPREAD.observe(spread)
 
     def _combine_payload(self, resp: Response,
                          slot: Dict[int, bytes]) -> bytes:
@@ -1378,8 +1627,10 @@ class ControllerService:
             # teardown) would misattribute the missing rank's timing.
             last_rank, last_t = max(arrivals.items(), key=lambda kv: kv[1])
             spread = last_t - min(arrivals.values())
-            _STRAGGLER_LAST.labels(rank=last_rank).inc()
-            _STRAGGLER_BLAME_S.labels(rank=last_rank).inc(spread)
+            island = self._island_of.get(last_rank, 0)
+            _STRAGGLER_LAST.labels(rank=last_rank, island=island).inc()
+            _STRAGGLER_BLAME_S.labels(rank=last_rank,
+                                      island=island).inc(spread)
             _ARRIVAL_SPREAD.observe(spread)
             if self._straggler is not None and not response_list.shutdown:
                 # closed-loop mitigation: the detector folds the same

@@ -489,6 +489,11 @@ class Engine:
         self._clock_sync = None
 
         self._service: Optional[ControllerService] = None
+        # Hierarchical negotiation tree (docs/hierarchy.md): island heads
+        # additionally host their sub-coordinator beside (not instead of)
+        # anything else they run — rank 0 hosts BOTH the root service and
+        # island 0's head.
+        self._subcoord = None
         self._client: Optional[ControllerClient] = None
         self._negotiator = None
         self._native_controller = False  # set with use_native below
@@ -573,22 +578,72 @@ class Engine:
             from .controller import world_id_of
 
             world_id = world_id_of(topo.members, self._size)
+            # Hierarchical negotiation tree (docs/hierarchy.md): resolve
+            # the control-plane topology once, identically on every rank
+            # (pure arithmetic over size/mode/cross_size — no extra
+            # negotiation round). Every degrade below is DETERMINISTIC
+            # and warned once — a silently-flat world would fake the
+            # scaling the knob asked for, so only known-safe fallbacks
+            # stay quiet on non-zero ranks.
+            from .hierarchy import FLAT as _FLAT_HIER, plan_topology
+
+            hier = _FLAT_HIER
+            if cfg.hierarchy not in ("", "flat"):
+                if use_native:
+                    if topo.world_rank == 0:
+                        LOG.warning(
+                            "HOROVOD_HIERARCHY=%s degraded to flat: the "
+                            "native C++ controller wire predates the "
+                            "island RPCs; set HOROVOD_NATIVE_CONTROLLER=0 "
+                            "for the negotiation tree.", cfg.hierarchy)
+                elif topo.in_subset_world:
+                    if topo.world_rank == 0:
+                        LOG.warning(
+                            "HOROVOD_HIERARCHY=%s degraded to flat for "
+                            "this subset world: islands are planned over "
+                            "the full launcher world only.", cfg.hierarchy)
+                else:
+                    hier = plan_topology(self._size, cfg.hierarchy,
+                                         topo.cross_size)
+                    if not hier.flat and not os.environ.get(
+                            _config.HOROVOD_SUBCOORD_PORT):
+                        if topo.world_rank == 0:
+                            LOG.warning(
+                                "HOROVOD_HIERARCHY=%s degraded to flat: "
+                                "the launcher exported no island "
+                                "sub-coordinator listener "
+                                "(HOROVOD_SUBCOORD_PORT); launch via "
+                                "horovod_tpu.runner for the tree.",
+                                cfg.hierarchy)
+                        hier = _FLAT_HIER
+                    elif hier.flat and topo.world_rank == 0:
+                        LOG.warning(
+                            "HOROVOD_HIERARCHY=%s resolved to a single "
+                            "island; keeping the flat star (a 1-island "
+                            "tree is the star plus a pointless hop).",
+                            cfg.hierarchy)
+            if not hier.flat:
+                from .hierarchy import HIER_ISLANDS
+
+                HIER_ISLANDS.set(hier.n_islands)
+            # Self-healing grace for dropped rank connections: host-
+            # plane worlds only, unless the knob was set explicitly.
+            # With the XLA data plane a dead peer's in-flight compiled
+            # collective cannot be outlived safely — on the gloo CPU
+            # backend it can even complete with GARBAGE buffers before
+            # a delayed abort lands — so death attribution stays
+            # immediate there by default. (Hoisted from the rank-0
+            # branch: island heads apply the same window to their own
+            # member connections.)
+            window_s = cfg.reconnect_window_s if (
+                self._plane is None or cfg.reconnect_window_explicit
+            ) else 0.0
             if topo.world_rank == 0:
                 # Controller duty follows the launcher's advertised address
                 # (world rank 0), not the subset rank numbering.
                 bind_host = os.environ.get(
                     _config.HOROVOD_CONTROLLER_BIND, "127.0.0.1")
                 listen_fd = _adopt_controller_fd(use_native)
-                # Self-healing grace for dropped rank connections: host-
-                # plane worlds only, unless the knob was set explicitly.
-                # With the XLA data plane a dead peer's in-flight compiled
-                # collective cannot be outlived safely — on the gloo CPU
-                # backend it can even complete with GARBAGE buffers before
-                # a delayed abort lands — so death attribution stays
-                # immediate there by default.
-                window_s = cfg.reconnect_window_s if (
-                    self._plane is None or cfg.reconnect_window_explicit
-                ) else 0.0
                 if use_native:
                     if cfg.straggler_evict != "off":
                         LOG.warning(
@@ -626,8 +681,31 @@ class Engine:
                         straggler_detector=detector,
                         codec_min_bytes=cfg.autotune_codec_min_bytes,
                         consensus_interval_steps=(
-                            cfg.consensus_interval_steps))
+                            cfg.consensus_interval_steps),
+                        islands=hier.islands or None)
                 port = self._service.port
+            if not hier.flat and hier.is_head(topo.world_rank):
+                # This rank heads its island: host the sub-coordinator
+                # BEFORE dialing any client — members may dial the head
+                # the moment its launcher-bound listener is served, and
+                # rank 0 heads island 0 BESIDE the root service it just
+                # started (its head dials the freshly-bound root port).
+                from .hierarchy import SubCoordinatorService
+
+                sub_fd_env = os.environ.pop(
+                    _config.HOROVOD_SUBCOORD_FD, None)
+                island = hier.island_of[topo.world_rank]
+                root_addrs = [a.strip() for a in addr.split(",")
+                              if a.strip()]
+                self._subcoord = SubCoordinatorService(
+                    island, hier.islands[island],
+                    upstream_addr={a: (a, port) for a in root_addrs},
+                    secret=secret,
+                    port=int(os.environ.get(
+                        _config.HOROVOD_SUBCOORD_PORT, "0")),
+                    world_id=world_id,
+                    listen_fd=int(sub_fd_env) if sub_fd_env else None,
+                    reconnect_window_s=window_s)
             # The launcher may advertise several controller addresses
             # (comma-separated: every NIC of the controller host); the
             # client probes them and uses the first routable one.
@@ -639,6 +717,23 @@ class Engine:
             client_cls = (NativeControllerClient if use_native
                           else ControllerClient)
             addr_map = {a: (a, port) for a in addr_list}
+            if not hier.flat:
+                # Every rank's control-plane connection — cycle/payload/
+                # sentry client, metrics publisher, clock sync, flight-
+                # recorder push, watch — dials its ISLAND HEAD instead of
+                # the root; the head aggregates or relays. This address
+                # swap IS the tree from a member's point of view: no
+                # other rank-side code has a hierarchy branch, which is
+                # what keeps the member wire (and so the negotiated
+                # bytes) identical with flat.
+                sub_addrs = [s.strip() for s in os.environ.get(
+                    _config.HOROVOD_SUBCOORD_ADDR, "127.0.0.1"
+                ).split(",") if s.strip()] or ["127.0.0.1"]
+                sub_port = (self._subcoord.port
+                            if self._subcoord is not None else
+                            int(os.environ.get(
+                                _config.HOROVOD_SUBCOORD_PORT, "0")))
+                addr_map = {a: (a, sub_port) for a in sub_addrs}
             self._client = client_cls(
                 addr_map, secret=secret,
                 timeout_s=None, rank=self._rank, world_id=world_id,
@@ -1419,6 +1514,10 @@ class Engine:
                 # controller ignores the drop anyway, and on the crash path
                 # the drop is precisely what tells it this rank died.
                 self._client.close(detach=False)
+            if self._subcoord is not None:
+                # Island head duty: before the root service (rank 0 hosts
+                # both) so the head's upstream farewell can still land.
+                self._subcoord.shutdown()
             if self._service is not None:
                 self._service.shutdown()
             if self._autotuner is not None:

@@ -1,0 +1,632 @@
+"""Hierarchical negotiation tree: per-island sub-coordinators under one root.
+
+The flat controller is the reference Horovod coordinator star — O(world)
+messages into one socket loop on rank 0 every cycle, fine at 8 ranks and
+the dominant control-plane cost at thousand-rank scale (the MPI
+characterization study, arXiv 1810.11112, measures exactly this collapse;
+tree reductions scale sub-linearly). This module breaks the star into the
+two-level tree `parallel/hierarchical.py` already factors the DATA plane
+over: one sub-coordinator per DCN island accepts its members'
+RequestList/CacheRequest traffic, merges it locally — steady-state
+cache-bit vectors as a fixed-size AND (the PR 3 path), cold-path
+RequestLists by per-position congruence with codec and apply_fingerprint
+negotiated at the island level exactly like dtypes (PR 13) — and forwards
+ONE submission per cycle to the root, which expands it back into the flat
+per-rank path. Expansion-at-root is the load-bearing design decision:
+the root keeps a WORLD-size negotiator and runs the unchanged
+``_run_cycle``, so responses, validation errors, stall warnings,
+consensus verdicts and cache bookkeeping stay byte-identical with flat —
+the tree only changes WHO CARRIES the messages, never what they say.
+
+Interior nodes ride the existing wire machinery unchanged: PR 4
+reconnect/dedup envelopes heal head-to-root drops, PR 9's second
+identified data channel carries the payload forwarding, a per-LEVEL
+flush-ordinal cross-check fails a desynced island loudly by name, PR 8's
+consensus judge receives every member's digest windows through its head
+(with a per-level fold cross-check), and PR 14's blackbox collector sees
+relayed incident pushes so a world abort still yields ONE classified
+dump. Flat topology remains the byte-identical default; the native C++
+controller wire predates all of it (deterministic flat degrade, warned
+once — wire-registry rows per HVL401). See docs/hierarchy.md.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..analysis.witness import maybe_wrap as _witness_wrap
+from ..core.logging import LOG
+from ..core.status import SHUT_DOWN_ERROR, format_aborted_ranks
+from ..obs.registry import registry as _metrics
+from ..parallel.hierarchical import island_partition
+from ..runner.network import BasicClient, Preserialized
+from .controller import (
+    _ARRIVAL_SPREAD,
+    _STRAGGLER_BLAME_S,
+    _STRAGGLER_LAST,
+    ControllerService,
+    Negotiator,
+    connect_with_hello,
+    spawn_watch_thread,
+)
+from .messages import (
+    CacheRequest,
+    IslandSubmission,
+    Request,
+    RequestList,
+    RequestType,
+)
+from .response_cache import and_bits
+
+# Observability plane (docs/metrics.md §hierarchy plane): the numbers the
+# tree exists to move — root messages per cycle is the scaling headline
+# (~O(islands), not O(world)), merged-vs-raw is the head-side merge hit
+# rate (a raw cycle forwards every member's submission verbatim and buys
+# no fan-in), relayed counts the anonymous traffic heads pass through.
+HIER_ISLANDS = _metrics().gauge(
+    "horovod_hier_islands",
+    "Islands in the negotiation tree (0 = flat topology)")
+MERGED_CYCLES = _metrics().counter(
+    "horovod_hier_merged_cycles_total",
+    "Island cycles forwarded as ONE merged submission (cache-bit AND or "
+    "congruent RequestList merge)")
+RAW_CYCLES = _metrics().counter(
+    "horovod_hier_raw_cycles_total",
+    "Island cycles forwarded verbatim per-member (merge ineligible: "
+    "divergent names, codecs, fingerprints, shapes or generations)")
+ROOT_MESSAGES = _metrics().counter(
+    "horovod_hier_root_messages_total",
+    "Island cycle submissions received by the root coordinator")
+RELAYED = _metrics().counter(
+    "horovod_hier_relayed_total",
+    "Anonymous control messages (metrics/flightrec/clock) relayed "
+    "upstream by island heads")
+
+
+# -- topology planner ---------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Topology:
+    """Resolved control-plane topology: ``islands`` maps island id to its
+    sorted global member ranks ({} = flat star), ``island_of`` inverts
+    it. The head of an island is its lowest rank (deterministic on every
+    process with no extra negotiation)."""
+
+    mode: str
+    islands: Dict[int, Tuple[int, ...]]
+    island_of: Dict[int, int]
+
+    @property
+    def flat(self) -> bool:
+        return len(self.islands) <= 1
+
+    @property
+    def n_islands(self) -> int:
+        return len(self.islands)
+
+    def head_of(self, island: int) -> int:
+        return min(self.islands[island])
+
+    def is_head(self, rank: int) -> bool:
+        island = self.island_of.get(rank)
+        return island is not None and self.head_of(island) == rank
+
+    @property
+    def heads(self) -> List[int]:
+        return [self.head_of(i) for i in sorted(self.islands)]
+
+
+FLAT = Topology(mode="flat", islands={}, island_of={})
+
+
+def plan_topology(size: int, mode: Optional[str],
+                  cross_size: int = 1) -> Topology:
+    """Resolve ``HOROVOD_HIERARCHY`` into a Topology.
+
+    ``flat`` (or unset) keeps the star. ``auto`` derives one island per
+    host from the launcher's cross_size — a single-host world has no DCN
+    boundary to split on and stays flat. ``islands:N`` forces N
+    contiguous near-equal islands (capped at one rank per island). Any
+    resolved split of <= 1 island degrades to flat: a 1-island tree is
+    the star plus a pointless hop. Typos fail loudly — a silently-flat
+    "islnds:4" would erase the scaling the knob was set for."""
+    mode = (mode or "flat").strip()
+    if size <= 1 or mode in ("", "flat"):
+        return FLAT
+    if mode == "auto":
+        n = cross_size if cross_size and cross_size > 1 else 1
+    elif mode.startswith("islands:"):
+        try:
+            n = int(mode.split(":", 1)[1])
+        except ValueError:
+            raise ValueError(
+                f"HOROVOD_HIERARCHY={mode!r} is not a valid topology: "
+                f"expected flat, auto, or islands:<N>") from None
+        if n <= 0:
+            raise ValueError(
+                f"HOROVOD_HIERARCHY={mode!r}: island count must be "
+                f"positive")
+    else:
+        raise ValueError(
+            f"HOROVOD_HIERARCHY={mode!r} is not a valid topology: "
+            f"expected flat, auto, or islands:<N>")
+    n = min(n, size)
+    if n <= 1:
+        return FLAT
+    islands = island_partition(size, n)
+    island_of = {r: i for i, mem in islands.items() for r in mem}
+    return Topology(mode=f"islands:{n}", islands=islands,
+                    island_of=island_of)
+
+
+# -- head-side merge ----------------------------------------------------------
+
+
+def _congruent_requests(members: Tuple[int, ...],
+                        lists: Dict[int, RequestList]
+                        ) -> Optional[List[Request]]:
+    """Merge congruent member RequestLists into one request sequence, or
+    None when ANY member deviates (the raw fallback then lets the root's
+    flat negotiator produce its byte-identical error naming the actual
+    global ranks — island-level merging must never invent new error
+    surfaces). Congruent means: same LENGTH and same per-position
+    (name, op, dtype, codec, apply_fingerprint, root_rank, device) —
+    order matters, the negotiation table's ready-list ordering follows
+    arrival order within a list. Shapes must match exactly except
+    allgather, where members legally differ in dim0 (recorded per member
+    in ``gather_dim0s``, aligned to sorted members)."""
+    first = lists[members[0]].requests
+    length = len(first)
+    for r in members[1:]:
+        if len(lists[r].requests) != length:
+            return None
+    merged: List[Request] = []
+    for pos in range(length):
+        row = [lists[r].requests[pos] for r in members]
+        base = row[0]
+        for req in row[1:]:
+            if (req.tensor_name != base.tensor_name
+                    or req.request_type != base.request_type
+                    or req.tensor_type != base.tensor_type
+                    or getattr(req, "codec", "none")
+                    != getattr(base, "codec", "none")
+                    or getattr(req, "apply_fingerprint", "")
+                    != getattr(base, "apply_fingerprint", "")
+                    or req.root_rank != base.root_rank
+                    or req.device != base.device):
+                return None
+        gather_dim0s = None
+        if base.request_type == RequestType.ALLGATHER:
+            shapes = [tuple(req.tensor_shape) for req in row]
+            if any(len(s) != len(shapes[0]) or not s for s in shapes):
+                return None
+            if any(s[1:] != shapes[0][1:] for s in shapes):
+                return None
+            gather_dim0s = tuple(s[0] for s in shapes)
+        else:
+            if any(tuple(req.tensor_shape)
+                   != tuple(base.tensor_shape) for req in row):
+                return None
+        merged.append(Request(
+            request_rank=members[0], request_type=base.request_type,
+            tensor_name=base.tensor_name, tensor_type=base.tensor_type,
+            tensor_shape=tuple(base.tensor_shape),
+            root_rank=base.root_rank, device=base.device,
+            codec=getattr(base, "codec", "none"),
+            apply_fingerprint=getattr(base, "apply_fingerprint", ""),
+            member_ranks=members, gather_dim0s=gather_dim0s))
+    return merged
+
+
+def merge_cycle(island: int, members: Tuple[int, ...],
+                slot: Dict[int, Any]) -> IslandSubmission:
+    """Fold one island's cycle slot ({global rank -> RequestList or
+    CacheRequest}) into its upstream submission. Three outcomes:
+
+    * every member sent the SAME cache-bit vector under the same
+      generation → one CacheRequest whose bits are the (trivially equal)
+      fixed-size AND — the PR 3 steady state shrinks to one message;
+    * every member sent a congruent RequestList → one merged request
+      sequence (codec/apply_fingerprint negotiated at this level exactly
+      like dtypes: any mismatch is merge-ineligible);
+    * anything else → ``raw``: the members' submissions travel verbatim
+      and the root's flat path handles divergence with byte-identical
+      error texts (mixed cache generations, ragged bit vectors, codec
+      mismatches all land on their flat diagnostics).
+
+    Member flush ordinals and consensus digest windows always travel —
+    merged forms carry them in side maps (plus the head's fold over the
+    digests, the per-level PR 8 cross-check); raw items carry their own.
+    """
+    shutdown_ranks = tuple(
+        r for r in members
+        if getattr(slot[r], "shutdown", False))
+    ordinals = {r: getattr(slot[r], "flush_ordinal", None)
+                for r in members}
+    digests = {r: getattr(slot[r], "integrity_digest", None)
+               for r in members}
+    fold = None
+    if any(d is not None for d in digests.values()):
+        from ..integrity.consensus import fold_digest
+
+        fold = fold_digest(digests)
+    cache_items = {r: rl for r, rl in slot.items()
+                   if isinstance(rl, CacheRequest)}
+    if len(cache_items) == len(slot):
+        generations = {rl.generation for rl in cache_items.values()}
+        bit_lens = {len(rl.bits) for rl in cache_items.values()}
+        if len(generations) == 1 and len(bit_lens) == 1:
+            folded = and_bits([cache_items[r].bits for r in members])
+            if all(cache_items[r].bits == folded for r in members):
+                return IslandSubmission(
+                    island=island, members=members,
+                    cache=CacheRequest(rank=members[0], bits=folded,
+                                       generation=next(iter(generations))),
+                    member_ordinals=ordinals, digests=digests, fold=fold,
+                    shutdown_ranks=shutdown_ranks)
+        # divergent bits/generations: the root must see the per-member
+        # truth — flat expands each rank's own bit set (a partial-hit
+        # cycle), and generation desync has an exact flat error text
+        return IslandSubmission(island=island, members=members,
+                                raw={r: slot[r] for r in members})
+    if cache_items:
+        # mixed CacheRequest/RequestList cycle: flat handles it (some
+        # ranks warm, some cold) — forward verbatim
+        return IslandSubmission(island=island, members=members,
+                                raw={r: slot[r] for r in members})
+    merged = _congruent_requests(members, slot)
+    if merged is None:
+        return IslandSubmission(island=island, members=members,
+                                raw={r: slot[r] for r in members})
+    return IslandSubmission(
+        island=island, members=members, requests=merged,
+        member_ordinals=ordinals, digests=digests, fold=fold,
+        shutdown_ranks=shutdown_ranks)
+
+
+# -- root-side expansion ------------------------------------------------------
+
+
+def expand_submission(sub: IslandSubmission) -> Dict[int, Any]:
+    """Reconstruct the flat per-global-rank cycle slot an island
+    submission stands for — the inverse of :func:`merge_cycle`, feeding
+    the root's unchanged ``_run_cycle`` so negotiation, validation and
+    caching semantics stay byte-identical with the star topology."""
+    members = tuple(sub.members)
+    if not members:
+        raise ValueError(
+            f"island {sub.island} submission names no member ranks")
+    if sub.raw is not None:
+        if set(sub.raw) != set(members):
+            raise ValueError(
+                f"island {sub.island} raw submission covers ranks "
+                f"{sorted(sub.raw)} but the island roster is "
+                f"{list(members)}")
+        return dict(sub.raw)
+    ordinals = sub.member_ordinals or {}
+    digests = sub.digests or {}
+    if sub.cache is not None:
+        return {
+            r: CacheRequest(rank=r, bits=sub.cache.bits,
+                            generation=sub.cache.generation,
+                            integrity_digest=digests.get(r),
+                            flush_ordinal=ordinals.get(r))
+            for r in members}
+    if sub.requests is None:
+        raise ValueError(
+            f"island {sub.island} submission carries neither cache, "
+            f"requests, nor raw payload")
+    out: Dict[int, Any] = {}
+    for r in members:
+        requests: List[Request] = []
+        for req in sub.requests:
+            member_ranks = tuple(req.member_ranks or members)
+            shape = tuple(req.tensor_shape)
+            dim0s = getattr(req, "gather_dim0s", None)
+            if dim0s is not None:
+                shape = (dim0s[member_ranks.index(r)],) + shape[1:]
+            requests.append(Request(
+                request_rank=r, request_type=req.request_type,
+                tensor_name=req.tensor_name,
+                tensor_type=req.tensor_type, tensor_shape=shape,
+                root_rank=req.root_rank, device=req.device,
+                codec=getattr(req, "codec", "none"),
+                apply_fingerprint=getattr(req, "apply_fingerprint", "")))
+        out[r] = RequestList(rank=r, requests=requests,
+                             shutdown=r in sub.shutdown_ranks,
+                             integrity_digest=digests.get(r),
+                             flush_ordinal=ordinals.get(r))
+    return out
+
+
+def check_fold(sub: IslandSubmission) -> Optional[str]:
+    """Per-level consensus fold cross-check (docs/hierarchy.md): the head
+    stamped a digest-of-digests over the member windows it forwarded; the
+    root recomputes it over what ARRIVED. A mismatch means the windows
+    were corrupted between the levels — the per-rank judge could then
+    blame the wrong rank, so the error names the ISLAND instead. Returns
+    the error text, or None (including when nothing digested)."""
+    if sub.fold is None or sub.digests is None:
+        return None
+    from ..integrity.consensus import fold_digest
+
+    actual = fold_digest(sub.digests)
+    if actual == sub.fold:
+        return None
+    return (f"island {sub.island} consensus digest fold mismatch: head "
+            f"stamped {sub.fold}, root recomputed {actual} over the "
+            f"windows that arrived for ranks "
+            f"{', '.join(map(str, sub.members))} — the digest windows "
+            f"were corrupted between the island head and the root, so "
+            f"per-rank consensus attribution cannot be trusted this "
+            f"cycle")
+
+
+# -- the sub-coordinator service ----------------------------------------------
+
+
+class SubCoordinatorService(ControllerService):
+    """One island's head: a ControllerService whose rendezvous collects
+    the island's members, but whose cycle/payload/sentry computes FORWARD
+    upstream instead of negotiating/combining locally.
+
+    Subclassing buys the entire connection discipline for free — hello
+    binding and supersede, the PR 4 reconnect window and heal, watch
+    parking, bye/deregister, flush-ordinal cross-check — so a member
+    rank's client speaks to its head EXACTLY as it would to the root
+    (rank-side code has no hierarchy branch at all). The inherited
+    negotiator is never fed (``_run_cycle`` is overridden); the inherited
+    cache/autotuner/consensus state stays disabled — the ROOT owns all
+    global decisions, this node only aggregates and fans back out.
+
+    Payloads forward UNSUMMED ({rank: bytes}): float addition is
+    non-associative and only the root's single sorted-global-rank combine
+    is bit-identical with flat. Sentry bits forward per-member for the
+    same reason (the fold must run over the WORLD's items exactly once).
+    Anonymous traffic (metrics, flightrec, metrics_pull, clock_probe)
+    relays verbatim on a dedicated leaf-locked connection, so member
+    clock probes measure the ROOT's timebase (one global clock) and
+    member incident pushes land in the root's single merged dump."""
+
+    def __init__(self, island: int, members, upstream_addr,
+                 secret: Optional[bytes] = None, port: int = 0,
+                 bind_host: str = "127.0.0.1", world_id: str = "",
+                 listen_fd: Optional[int] = None,
+                 reconnect_window_s: Optional[float] = None,
+                 straggler_detector=None) -> None:
+        members = tuple(sorted(int(r) for r in members))
+        if not members:
+            raise ValueError("an island needs at least one member rank")
+        self._island = int(island)
+        self._members = members
+        self._head_rank = members[0]
+        self._upstream_addr = upstream_addr
+        self._up_cycle_no = 0
+        hello = ("hello_island", self._head_rank, self._island, members,
+                 world_id)
+
+        def _hello(client) -> None:
+            client.request(hello)
+
+        def _rehello(client) -> None:
+            # superseding re-identify after a transparent reconnect —
+            # the PR 4 heal, same contract as ControllerClient
+            client.bare_request(hello)
+
+        # Upstream channels BEFORE the local service goes live: members
+        # may dial the pre-bound listener the instant BasicService starts
+        # accepting, and their first cycle must find the uplink ready.
+        # Four separate connections because their parking domains differ:
+        # a cycle parked at the root (straggler wait) must never hold the
+        # connection a payload, a sentry verdict, or an abort relay needs
+        # — the same two-channel inversion PR 9 solved rank-side.
+        self._up = connect_with_hello(
+            upstream_addr, secret, None, 100, hello=_hello,
+            on_reconnect=_rehello)
+        self._up_data = connect_with_hello(
+            upstream_addr, secret, None, 100, hello=_hello,
+            on_reconnect=_rehello)
+        self._up_sentry = connect_with_hello(
+            upstream_addr, secret, None, 100, hello=_hello,
+            on_reconnect=_rehello)
+        self._up_relay = BasicClient(upstream_addr, secret=secret,
+                                     timeout_s=None, attempts=100)
+        self._up_lock = _witness_wrap(
+            threading.Lock(), "ops.hierarchy.SubCoordinatorService._up")
+        self._up_data_lock = _witness_wrap(
+            threading.Lock(),
+            "ops.hierarchy.SubCoordinatorService._up_data")
+        self._up_sentry_lock = _witness_wrap(
+            threading.Lock(),
+            "ops.hierarchy.SubCoordinatorService._up_sentry")
+        self._relay_lock = _witness_wrap(
+            threading.Lock(),
+            "ops.hierarchy.SubCoordinatorService._relay")
+        super().__init__(
+            size=len(members),
+            negotiator=Negotiator(len(members), 64 << 20),
+            secret=secret, port=port, bind_host=bind_host,
+            world_id=world_id, stall_shutdown_s=0.0,
+            listen_fd=listen_fd, cache_capacity=0,
+            reconnect_window_s=reconnect_window_s,
+            straggler_detector=straggler_detector,
+            consensus_interval_steps=0)
+
+        def _request_reason(client) -> Optional[str]:
+            resp = client.request(("watch", world_id))
+            if resp and resp[0] == "abort" and resp[1]:
+                return resp[1]
+            return None  # clean stop: nothing to deliver
+
+        # Root-abort fan-out: ONE parked watch per island (not per rank)
+        # — the root's abort reason re-parks here and every member
+        # watcher inherits it from the head's own watch event.
+        spawn_watch_thread(upstream_addr, secret, _request_reason,
+                           self._deliver_upstream_abort)
+
+    # -- downward abort fan-out ------------------------------------------------
+
+    def _deliver_upstream_abort(self, reason: str) -> None:
+        """The root's watch channel fired: fan the structured reason down
+        to every member parked in this head's rendezvous/watch."""
+        exc = RuntimeError(reason)
+        self._cycles.abort(exc)
+        self._payloads.abort(exc)
+        self._sentry_rv.abort(exc)
+        with self._lock:
+            self._abort_fired = True
+            if self._watch_reason is None:
+                self._watch_reason = reason
+        self._watch_event.set()
+
+    def _abort_for_rank(self, rank: int) -> None:
+        """A MEMBER died: escalate upstream (the root tears the world
+        down with the flat attribution text and owns the single blackbox
+        dump + world-abort count — an island must not double-count
+        either), then unpark this island's own rendezvous."""
+        with self._lock:
+            first = not self._abort_fired
+            self._abort_fired = True
+        exc = RuntimeError(
+            f"rank {rank} exited mid-job. {SHUT_DOWN_ERROR} "
+            f"{format_aborted_ranks([rank])}")
+        if first:
+            LOG.warning(
+                "island %d: rank %d disconnected before shutdown; "
+                "escalating the death to the root coordinator",
+                self._island, rank)
+            try:
+                with self._relay_lock:
+                    self._up_relay.bare_request(
+                        ("abort_island", self._head_rank, self._island,
+                         rank, str(exc)))
+            except Exception as up_exc:  # noqa: BLE001 - best effort
+                LOG.warning(
+                    "island %d: abort escalation to the root failed "
+                    "(%s); the root will detect the island via its own "
+                    "connection teardown", self._island, up_exc)
+        self._cycles.abort(exc)
+        self._payloads.abort(exc)
+        self._sentry_rv.abort(exc)
+        with self._lock:
+            if self._watch_reason is None:
+                self._watch_reason = str(exc)
+        self._watch_event.set()
+
+    def _flightrec_incident(self, reason: str) -> None:
+        """No-op by design: the ROOT owns the one merged blackbox dump
+        (docs/blackbox.md). Member incident pushes relay upstream
+        verbatim, so the head collecting too would tear the world's
+        single incident into per-island fragments."""
+        del reason
+
+    # -- the forwarding dispatch -----------------------------------------------
+
+    def _handle(self, req: Any, _sock: Any) -> Any:
+        kind = req[0]
+        if kind in ("metrics", "flightrec", "metrics_pull",
+                    "clock_probe"):
+            # verbatim relay: the root stays the single store for
+            # metrics snapshots and incident tails, and the single
+            # clock-probe timebase (the min-RTT filter rank-side absorbs
+            # the extra hop's latency like any other network jitter)
+            RELAYED.inc()
+            with self._relay_lock:
+                return self._up_relay.request(req)
+        if kind == "payload":
+            _, rank, cycle_no, idx, data = req
+            self._bind_connection(rank, _sock)
+            return self._payloads.submit(
+                ("payload", cycle_no, idx), rank, data,
+                lambda slot: self._forward_payload(cycle_no, idx, slot))
+        if kind == "sentry":
+            _, rank, ordinal, bits = req
+            self._bind_connection(rank, _sock)
+            return self._sentry_rv.submit(
+                ("sentry", ordinal), rank, bits,
+                lambda slot: self._forward_sentry(ordinal, slot),
+                timeout_s=60.0,
+                timeout_hint=(
+                    "HOROVOD_GRAD_SENTRY must resolve identically on "
+                    "every rank — a disarmed rank never joins the "
+                    "verdict exchange."))
+        # hello / bye / watch / cycle: the inherited protocol verbatim
+        # (cycle reaches the rendezvous whose compute is the OVERRIDDEN
+        # _run_cycle below)
+        return super()._handle(req, _sock)
+
+    def _forward_payload(self, cycle_no: int, idx: int,
+                         slot: Dict[int, bytes]) -> Preserialized:
+        with self._up_data_lock:
+            combined = self._up_data.request(
+                ("payload_island", self._head_rank, self._island,
+                 cycle_no, idx, dict(slot)))
+        # one frame serves every member (identical combined bytes)
+        return Preserialized(self._service.wire.frame(combined))
+
+    def _forward_sentry(self, ordinal: int,
+                        slot: Dict[int, bytes]) -> bytes:
+        with self._up_sentry_lock:
+            return self._up_sentry.request(
+                ("sentry_island", self._head_rank, self._island,
+                 ordinal, dict(slot)))
+
+    def _run_cycle(self, slot: Dict[int, Any],
+                   key: Any = None) -> Preserialized:
+        """The head's cycle compute: cross-check member ordinals, charge
+        island-local straggler blame, merge, forward ONE submission, and
+        re-frame the root's answer once for every member."""
+        try:
+            self._check_flush_ordinals(slot, key)
+        except RuntimeError as exc:
+            # the island id turns a per-rank desync diagnosis into one
+            # that names WHERE in the tree it happened
+            raise RuntimeError(f"island {self._island}: {exc}") from exc
+        with self._lock:
+            self._cycle_t0.pop(key, None)
+            arrivals = self._cycle_arrivals.pop(key, None)
+        if arrivals is not None and len(arrivals) == self._size > 1:
+            last_rank, last_t = max(arrivals.items(),
+                                    key=lambda kv: kv[1])
+            spread = last_t - min(arrivals.values())
+            _STRAGGLER_LAST.labels(rank=last_rank,
+                                   island=self._island).inc()
+            _STRAGGLER_BLAME_S.labels(rank=last_rank,
+                                      island=self._island).inc(spread)
+            _ARRIVAL_SPREAD.observe(spread)
+            if self._straggler is not None:
+                self._straggler.observe_cycle(last_rank, spread)
+        sub = merge_cycle(self._island, self._members, slot)
+        (RAW_CYCLES if sub.raw is not None else MERGED_CYCLES).inc()
+        with self._lock:
+            # the per-LEVEL flush ordinal: this head's own count of
+            # upstream cycles, cross-checked island-vs-island at the root
+            sub.flush_ordinal = self._up_cycle_no
+            self._up_cycle_no += 1
+        with self._up_lock:
+            resp = self._up.request(
+                ("island_cycle", self._head_rank, self._island, sub))
+        if getattr(resp, "shutdown", False):
+            # negotiated drain (or abort) reached this island: member
+            # disconnects after this cycle are expected teardown
+            with self._lock:
+                self._world_shutdown = True
+        with self._lock:
+            self._cycle_no += 1
+        return Preserialized(self._service.wire.frame(resp))
+
+    def shutdown(self) -> None:
+        for lock, client in ((self._up_lock, self._up),
+                             (self._up_data_lock, self._up_data),
+                             (self._up_sentry_lock, self._up_sentry),
+                             (self._relay_lock, self._up_relay)):
+            try:
+                with lock:
+                    client.farewell(("bye", self._head_rank))
+                    client.close()
+            except Exception:  # noqa: BLE001 - root may already be gone
+                pass
+        super().shutdown()

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 
 class DataType(enum.IntEnum):
@@ -102,6 +102,17 @@ class Request:
     # keeps its apply contexts rank-side and degrades to the split
     # reduce-then-apply execution there.
     apply_fingerprint: str = ""
+    # Hierarchy wire (docs/hierarchy.md): when an island head merged N
+    # congruent member requests into this one, the sorted global ranks it
+    # stands for — the root expands it back into one per-member request
+    # so the flat negotiation core (and its exact error texts) runs
+    # unchanged. None on every flat-topology request and on wires that
+    # predate the field.
+    member_ranks: Optional[Tuple[int, ...]] = None
+    # Per-member allgather first-dim sizes, aligned to ``member_ranks``
+    # (allgather is the one op where congruent member requests legally
+    # differ — in dim0). None for every other op and on flat wires.
+    gather_dim0s: Optional[Tuple[int, ...]] = None
 
 
 @dataclass
@@ -226,6 +237,42 @@ class CacheRequest:
     # sub-buffer flush ordinal (see RequestList.flush_ordinal): the warm
     # steady state keeps the cycle-alignment cross-check too
     flush_ordinal: Optional[int] = None
+
+
+@dataclass
+class IslandSubmission:
+    """ONE island's entire negotiation cycle, forwarded by its
+    sub-coordinator to the root (docs/hierarchy.md). Exactly one of the
+    three payload forms is set: ``cache`` when every member sent the SAME
+    cache-bit vector (the AND-merged steady state, PR 3 path), ``requests``
+    when every member's cold-path RequestList was congruent (merged
+    per-position, codec and apply_fingerprint negotiated at the island
+    level exactly like dtypes — ``[]`` is a valid merged idle cycle), or
+    ``raw`` (verbatim per-member RequestList/CacheRequest map) whenever
+    ANY member deviates — the root then runs the flat per-rank path and
+    produces byte-identical flat error texts naming actual global ranks.
+
+    ``flush_ordinal`` is the HEAD's own upstream cycle count (the
+    per-level PR 9 cross-check: the root compares islands against each
+    other and a desynced island fails loudly naming the island).
+    ``member_ordinals``/``digests`` preserve the members' own flush
+    ordinals and consensus digest windows for the merged forms so the
+    root's world-size cross-check and consensus judge still run per
+    GLOBAL rank; the raw form leaves them None (the items carry their
+    own). ``fold`` is the head's digest-of-digests over the shipped
+    windows (integrity.consensus.fold_digest) — the root recomputes it
+    and a mismatch escalates as island-level wire corruption."""
+
+    island: int
+    members: Tuple[int, ...]
+    flush_ordinal: Optional[int] = None
+    cache: Optional[CacheRequest] = None
+    requests: Optional[List[Request]] = None
+    raw: Optional[Dict[int, Any]] = None
+    member_ordinals: Optional[Dict[int, Optional[int]]] = None
+    digests: Optional[Dict[int, Any]] = None
+    fold: Optional[str] = None
+    shutdown_ranks: Tuple[int, ...] = ()
 
 
 @dataclass

@@ -129,6 +129,27 @@ def bits_of(positions: List[int], capacity: int) -> bytes:
     return bytes(out)
 
 
+def and_bits(chunks: List[bytes]) -> bytes:
+    """Fixed-size AND over equal-length cache-bit vectors — the island
+    head's steady-state merge (docs/hierarchy.md): positions EVERY member
+    hit. Raises on ragged inputs (capacity desync is a loud error on the
+    flat path too, never a silent truncation)."""
+    if not chunks:
+        return b""
+    length = len(chunks[0])
+    for chunk in chunks[1:]:
+        if len(chunk) != length:
+            raise ValueError(
+                f"cache-bit vectors differ in size ({len(chunk)} vs "
+                f"{length} bytes); HOROVOD_CACHE_CAPACITY must be "
+                f"identical on every rank")
+    out = bytearray(chunks[0])
+    for chunk in chunks[1:]:
+        for i, byte in enumerate(chunk):
+            out[i] &= byte
+    return bytes(out)
+
+
 def positions_of(bits: bytes) -> List[int]:
     out: List[int] = []
     for byte_idx, byte in enumerate(bits):

@@ -17,19 +17,48 @@ the knob meaningful on mixed ICI/DCN topologies.
 
 from __future__ import annotations
 
-import jax
-from jax import lax
+from typing import Dict, Tuple
+
+# jax is imported inside each collective: the module also hosts the pure
+# island-partition arithmetic the control-plane hierarchy planner
+# (ops/hierarchy.py) reuses — the negotiation tree mirrors the SAME
+# ICI-vs-DCN split these collectives factor over, and the coordinator
+# must be importable in processes that never touch jax.
 
 
-def hierarchical_allreduce(x: jax.Array, dcn_axis: str = "dcn",
+def island_partition(world_size: int,
+                     n_islands: int) -> Dict[int, Tuple[int, ...]]:
+    """Contiguous near-equal split of ``range(world_size)`` into
+    ``n_islands`` islands — the control-plane mirror of the (dcn, ici)
+    mesh factoring above: ranks within one island share the fast
+    interconnect, island heads talk to the root over the slow one. The
+    first ``world_size % n_islands`` islands take the extra rank
+    (jax.sharding convention for uneven meshes). Returns
+    {island id -> sorted global ranks}; every rank appears exactly once."""
+    if n_islands <= 0:
+        raise ValueError(f"n_islands must be positive, got {n_islands}")
+    n_islands = min(n_islands, world_size) if world_size > 0 else 1
+    base, extra = divmod(world_size, n_islands)
+    islands: Dict[int, Tuple[int, ...]] = {}
+    start = 0
+    for i in range(n_islands):
+        count = base + (1 if i < extra else 0)
+        islands[i] = tuple(range(start, start + count))
+        start += count
+    return islands
+
+
+def hierarchical_allreduce(x: "jax.Array", dcn_axis: str = "dcn",
                            ici_axis: str = "ici",
-                           average: bool = True) -> jax.Array:
+                           average: bool = True) -> "jax.Array":
     """reduce_scatter(ici) → allreduce(dcn) → all_gather(ici).
 
     The cross-slice leg moves |x| / |ici| bytes per chip instead of |x| —
     the factored form of ``operations.cc:1284-1436``. Requires the leading
     dimension be divisible by the ici axis size (pad upstream otherwise;
     the DistributedOptimizer flattens to 1-D multiples automatically)."""
+    from jax import lax
+
     shard = lax.psum_scatter(x, ici_axis, scatter_dimension=0, tiled=True)
     shard = lax.psum(shard, dcn_axis)
     out = lax.all_gather(shard, ici_axis, axis=0, tiled=True)
@@ -38,19 +67,21 @@ def hierarchical_allreduce(x: jax.Array, dcn_axis: str = "dcn",
     return out
 
 
-def hierarchical_allgather(x: jax.Array, dcn_axis: str = "dcn",
-                           ici_axis: str = "ici") -> jax.Array:
+def hierarchical_allgather(x: "jax.Array", dcn_axis: str = "dcn",
+                           ici_axis: str = "ici") -> "jax.Array":
     """all_gather(ici) then all_gather(dcn), concatenated in global rank
     order (node-local shared-memory gather + cross-node Allgatherv,
     ``operations.cc:929-1033``)."""
+    from jax import lax
+
     local = lax.all_gather(x, ici_axis, axis=0, tiled=True)
     return lax.all_gather(local, dcn_axis, axis=0, tiled=True)
 
 
-def hierarchical_quantized_allreduce(x: jax.Array, dcn_axis: str = "dcn",
+def hierarchical_quantized_allreduce(x: "jax.Array", dcn_axis: str = "dcn",
                                      ici_axis: str = "ici",
                                      average: bool = True,
-                                     codec=None) -> jax.Array:
+                                     codec=None) -> "jax.Array":
     """The EQuARX design point: compress exactly the bandwidth-bound link.
 
     Same factoring as :func:`hierarchical_allreduce`, but the cross-slice
@@ -60,6 +91,8 @@ def hierarchical_quantized_allreduce(x: jax.Array, dcn_axis: str = "dcn",
     FULL precision: ICI bandwidth is not the bottleneck the hierarchy
     exists to protect, and keeping them exact halves the quantization
     error relative to quantizing the whole reduction."""
+    from jax import lax
+
     from ..ops.spmd import quantized_allreduce
 
     shard = lax.psum_scatter(x, ici_axis, scatter_dimension=0, tiled=True)
@@ -79,7 +112,9 @@ def hierarchical_grad_allreduce(grads, dcn_axis: str = "dcn",
     ``codec`` (``Compression.int8`` / ``.fp8``) routes the DCN hop through
     :func:`hierarchical_quantized_allreduce`; float leaves only — integer
     leaves keep the exact full-precision route on both hops."""
+    import jax
     import jax.numpy as jnp
+    from jax import lax
 
     def reduce_leaf(g):
         flat = g.reshape(-1)

@@ -313,6 +313,28 @@ def launch(command: Sequence[str], np: int,
     listener = _bind_controller_listener()
     port = listener.getsockname()[1]
     secret = make_secret()
+    # Hierarchical negotiation tree (docs/hierarchy.md): resolve the
+    # topology HERE so each island's sub-coordinator listener gets the
+    # same TOCTOU-free pre-bind as the root above — the head inherits the
+    # live socket (HOROVOD_SUBCOORD_FD) and its members' early connects
+    # park in the backlog. The resolved "islands:N" form is exported so
+    # every rank plans the identical partition. This single-host launcher
+    # has no host boundary, so "auto" stays flat here by design.
+    hier = None
+    hier_mode = ((env_extra or {}).get(
+        _config.HOROVOD_HIERARCHY,
+        os.environ.get(_config.HOROVOD_HIERARCHY, "flat"))
+        or "flat").strip().lower()
+    if hier_mode not in ("", "flat"):
+        from ..ops.hierarchy import plan_topology
+
+        hier = plan_topology(np, hier_mode, cross_size=1)
+        if hier.flat:
+            hier = None
+    sub_listeners: Dict[int, socket.socket] = {}
+    if hier is not None:
+        for island_id in sorted(hier.islands):
+            sub_listeners[island_id] = _bind_controller_listener()
     procs: List[subprocess.Popen] = []
     stderr_files: Dict[int, Any] = {}
     try:
@@ -321,9 +343,25 @@ def launch(command: Sequence[str], np: int,
                                  host_data_plane=host_data_plane,
                                  env_extra=env_extra)
             popen_kwargs: Dict[str, Any] = {}
+            pass_fds: tuple = ()
             if rank == 0:
                 env[_config.HOROVOD_CONTROLLER_FD] = str(listener.fileno())
-                popen_kwargs["pass_fds"] = (listener.fileno(),)
+                pass_fds += (listener.fileno(),)
+            if hier is not None:
+                island_id = hier.island_of[rank]
+                sub = sub_listeners[island_id]
+                env[_config.HOROVOD_HIERARCHY] = hier.mode
+                env[_config.HOROVOD_ISLAND] = str(island_id)
+                env[_config.HOROVOD_SUBCOORD_ADDR] = "127.0.0.1"
+                env[_config.HOROVOD_SUBCOORD_PORT] = str(
+                    sub.getsockname()[1])
+                if hier.head_of(island_id) == rank:
+                    # the island head inherits its live listener (rank 0
+                    # carries BOTH the root's fd and island 0's)
+                    env[_config.HOROVOD_SUBCOORD_FD] = str(sub.fileno())
+                    pass_fds += (sub.fileno(),)
+            if pass_fds:
+                popen_kwargs["pass_fds"] = pass_fds
             if capture_stderr:
                 stderr_files[rank] = tempfile.TemporaryFile()
                 popen_kwargs["stderr"] = stderr_files[rank]
@@ -331,9 +369,12 @@ def launch(command: Sequence[str], np: int,
                 list(command), env=env,
                 start_new_session=True,  # own process group for clean kill
                 **popen_kwargs))
-        # rank 0 inherited the listening socket; drop the launcher's copy
-        # so service shutdown in the worker actually releases the port
+        # rank 0 / the heads inherited the listening sockets; drop the
+        # launcher's copies so service shutdown in the workers actually
+        # releases the ports
         listener.close()
+        for sub in sub_listeners.values():
+            sub.close()
         return _wait_all(procs, job_timeout_s, cancel_event,
                          stderr_files=stderr_files, exit_codes=exit_codes)
     finally:
@@ -341,6 +382,11 @@ def launch(command: Sequence[str], np: int,
             listener.close()
         except OSError:
             pass
+        for sub in sub_listeners.values():
+            try:
+                sub.close()
+            except OSError:
+                pass
         _terminate_all(procs)
         _replay_stderr(stderr_files)
         for fh in stderr_files.values():

@@ -1,0 +1,460 @@
+"""Hierarchical negotiation tree (docs/hierarchy.md).
+
+Named ``test_zz*`` past the 870 s tier-1 truncation point on purpose
+(the PR 11–17 convention): the planner/merge/expand/fold units are
+cheap, but the bit-exactness and degrade worlds each spawn 2-process
+runs and the dryrun certification spawns several.
+
+Coverage per the ISSUE-18 battery: the topology planner (flat default,
+``auto``/``islands:N`` resolution, degenerate splits degrading to flat,
+loud typos), head-side merge eligibility (cache-bit AND, congruent
+RequestList merge, every raw fallback: codec / apply-fingerprint /
+name / shape / generation divergence and mixed warm-cold cycles),
+root-side expansion as the exact inverse (ragged allgather dim0s,
+ordinal/digest/shutdown side maps, roster-mismatch refusal), the
+per-level consensus fold and flush-ordinal desync texts naming the
+ISLAND, the flight-recorder island verdicts, the wire-compat registry
+rows, the metrics-summary section, the scaling simulation's sub-linear
+root load — and, slow tier, the 2-process worlds: tree bit-exact vs
+flat, the native-controller flat degrade, and the full
+``dryrun_hierarchy`` certification (head-kill blackbox verdict +
+delay-chaos island blame).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import types
+
+import pytest
+
+from horovod_tpu.integrity.consensus import fold_digest
+from horovod_tpu.ops.hierarchy import (
+    FLAT,
+    check_fold,
+    expand_submission,
+    merge_cycle,
+    plan_topology,
+)
+from horovod_tpu.ops.messages import (
+    CacheRequest,
+    DataType,
+    IslandSubmission,
+    Request,
+    RequestList,
+    RequestType,
+)
+
+pytestmark = pytest.mark.hierarchy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- topology planner ----------------------------------------------------------
+
+
+def test_plan_topology_flat_default_and_degenerate_splits():
+    assert plan_topology(8, None) is FLAT
+    assert plan_topology(8, "") is FLAT
+    assert plan_topology(8, "flat") is FLAT
+    # a world of one has nothing to split
+    assert plan_topology(1, "islands:4") is FLAT
+    # a 1-island tree is the star plus a pointless hop
+    assert plan_topology(8, "islands:1") is FLAT
+    # auto without a DCN boundary (single host) stays flat
+    assert plan_topology(4, "auto", cross_size=1) is FLAT
+    assert FLAT.flat and FLAT.n_islands == 0 and FLAT.heads == []
+
+
+def test_plan_topology_islands_structure():
+    topo = plan_topology(8, "islands:2")
+    assert not topo.flat and topo.n_islands == 2
+    # every rank in exactly one island, island_of the exact inverse
+    assert sorted(r for mem in topo.islands.values()
+                  for r in mem) == list(range(8))
+    for island, members in topo.islands.items():
+        assert topo.head_of(island) == min(members)
+        for r in members:
+            assert topo.island_of[r] == island
+    assert topo.heads == [topo.head_of(i) for i in sorted(topo.islands)]
+    assert topo.is_head(topo.heads[-1])
+    assert not topo.is_head(max(topo.islands[0]))
+    # the island count caps at one rank per island
+    assert plan_topology(3, "islands:8").n_islands == 3
+
+
+def test_plan_topology_auto_follows_cross_size():
+    topo = plan_topology(8, "auto", cross_size=4)
+    assert topo.n_islands == 4
+    assert topo.mode == "islands:4"
+
+
+def test_plan_topology_typos_fail_loudly():
+    # a silently-flat "islnds:4" would erase the scaling the knob was
+    # set for — every malformed mode must raise, not degrade
+    for bad in ("islnds:4", "islands:x", "islands:0", "islands:-2",
+                "tree", "auto:2"):
+        with pytest.raises(ValueError):
+            plan_topology(8, bad)
+
+
+# -- head-side merge -----------------------------------------------------------
+
+
+def _req(rank, name, *, shape=(4,), op=RequestType.ALLREDUCE,
+         codec="none", fp="", root=-1):
+    return Request(request_rank=rank, request_type=op, tensor_name=name,
+                   tensor_type=DataType.FLOAT32, tensor_shape=shape,
+                   root_rank=root, codec=codec, apply_fingerprint=fp)
+
+
+def _slot(members, build, **rl_kwargs):
+    return {r: RequestList(rank=r, requests=build(r),
+                           flush_ordinal=rl_kwargs.get("ordinal", 3))
+            for r in members}
+
+
+def test_merge_congruent_requestlists():
+    members = (2, 3)
+    slot = _slot(members, lambda r: [_req(r, "grad/w"), _req(r, "grad/b")])
+    sub = merge_cycle(1, members, slot)
+    assert sub.raw is None and sub.cache is None
+    assert [q.tensor_name for q in sub.requests] == ["grad/w", "grad/b"]
+    assert all(q.member_ranks == members for q in sub.requests)
+    assert sub.member_ordinals == {2: 3, 3: 3}
+
+
+@pytest.mark.parametrize("deviant", [
+    lambda r: [_req(r, "grad/w", codec="fp16" if r == 3 else "none")],
+    lambda r: [_req(r, "grad/w", fp="sgd:1" if r == 3 else "")],
+    lambda r: [_req(r, "grad/w" if r == 2 else "grad/b")],
+    lambda r: [_req(r, "grad/w", shape=(4,) if r == 2 else (8,))],
+    lambda r: [_req(r, "grad/w")] * (1 if r == 2 else 2),
+    lambda r: [_req(r, "grad/w",
+                    op=(RequestType.ALLREDUCE if r == 2
+                        else RequestType.BROADCAST), root=0)],
+])
+def test_merge_divergence_falls_back_to_raw(deviant):
+    # codec and apply_fingerprint negotiate per level exactly like
+    # dtypes: ANY member deviating makes the cycle merge-ineligible and
+    # the root's flat path produces the byte-identical diagnostics
+    members = (2, 3)
+    slot = _slot(members, deviant)
+    sub = merge_cycle(1, members, slot)
+    assert sub.raw == slot and sub.requests is None
+
+
+def test_merge_allgather_records_ragged_dim0s():
+    members = (0, 1)
+    slot = _slot(members, lambda r: [
+        _req(r, "tok", shape=(2 + 3 * r, 5), op=RequestType.ALLGATHER)])
+    sub = merge_cycle(0, members, slot)
+    assert sub.raw is None
+    assert sub.requests[0].gather_dim0s == (2, 5)
+    # trailing dims must still agree exactly
+    slot = _slot(members, lambda r: [
+        _req(r, "tok", shape=(2, 5 + r), op=RequestType.ALLGATHER)])
+    assert merge_cycle(0, members, slot).raw is not None
+
+
+def test_merge_cache_bits_and():
+    members = (2, 3)
+    slot = {r: CacheRequest(rank=r, bits=b"\xff\x0f", generation=4,
+                            flush_ordinal=9) for r in members}
+    sub = merge_cycle(1, members, slot)
+    assert sub.raw is None and sub.requests is None
+    assert sub.cache.bits == b"\xff\x0f" and sub.cache.generation == 4
+    assert sub.member_ordinals == {2: 9, 3: 9}
+
+
+@pytest.mark.parametrize("other", [
+    CacheRequest(rank=3, bits=b"\xf0\x0f", generation=4),   # divergent bits
+    CacheRequest(rank=3, bits=b"\xff\x0f", generation=5),   # generation desync
+    RequestList(rank=3, requests=[_req(3, "grad/w")]),      # mixed warm/cold
+])
+def test_merge_cache_divergence_falls_back_to_raw(other):
+    slot = {2: CacheRequest(rank=2, bits=b"\xff\x0f", generation=4),
+            3: other}
+    sub = merge_cycle(1, (2, 3), slot)
+    assert sub.raw == slot
+
+
+# -- root-side expansion -------------------------------------------------------
+
+
+def test_expand_is_the_inverse_of_merge_cold_path():
+    members = (2, 3)
+    slot = _slot(members, lambda r: [
+        _req(r, "grad/w"),
+        _req(r, "tok", shape=(1 + r, 3), op=RequestType.ALLGATHER)])
+    slot[3].shutdown = True
+    slot[2].integrity_digest = [("w", "aa")]
+    sub = merge_cycle(1, members, slot)
+    assert sub.shutdown_ranks == (3,)
+    out = expand_submission(sub)
+    assert set(out) == set(members)
+    for r in members:
+        rl = out[r]
+        assert rl.rank == r and rl.flush_ordinal == 3
+        assert [q.request_rank for q in rl.requests] == [r, r]
+        # the ragged allgather dim0 is restored per member
+        assert tuple(rl.requests[1].tensor_shape) == (1 + r, 3)
+    assert out[3].shutdown and not out[2].shutdown
+    assert out[2].integrity_digest == [("w", "aa")]
+    assert out[3].integrity_digest is None
+
+
+def test_expand_cache_submission_to_per_rank_requests():
+    members = (2, 3)
+    slot = {r: CacheRequest(rank=r, bits=b"\x0f", generation=7,
+                            flush_ordinal=11) for r in members}
+    out = expand_submission(merge_cycle(1, members, slot))
+    for r in members:
+        assert isinstance(out[r], CacheRequest)
+        assert out[r].rank == r and out[r].bits == b"\x0f"
+        assert out[r].generation == 7 and out[r].flush_ordinal == 11
+
+
+def test_expand_refuses_malformed_submissions():
+    with pytest.raises(ValueError, match="no member ranks"):
+        expand_submission(IslandSubmission(island=1, members=()))
+    with pytest.raises(ValueError, match="roster"):
+        expand_submission(IslandSubmission(
+            island=1, members=(2, 3),
+            raw={2: RequestList(rank=2), 4: RequestList(rank=4)}))
+    with pytest.raises(ValueError, match="neither"):
+        expand_submission(IslandSubmission(island=1, members=(2, 3)))
+
+
+# -- per-level integrity cross-checks ------------------------------------------
+
+
+def test_check_fold_verifies_the_heads_digest_of_digests():
+    digests = {2: [("w", "aa"), ("b", "bb")], 3: None}
+    sub = IslandSubmission(island=1, members=(2, 3), requests=[],
+                           digests=digests, fold=fold_digest(digests))
+    assert check_fold(sub) is None
+    sub.fold = "deadbeefdeadbeef"
+    err = check_fold(sub)
+    assert "island 1 consensus digest fold mismatch" in err
+    assert "2, 3" in err
+    # nothing digested → nothing to check
+    assert check_fold(IslandSubmission(island=1, members=(2,),
+                                       requests=[])) is None
+
+
+def test_island_ordinal_desync_names_the_island():
+    from horovod_tpu.ops.controller import ControllerService
+
+    stub = types.SimpleNamespace(
+        _lock=threading.Lock(),
+        _island_ordinals={"k": {0: 5, 1: 7}},
+        _islands={0: (0, 1), 1: (2, 3)})
+    with pytest.raises(RuntimeError) as ei:
+        ControllerService._check_island_ordinals(stub, "k")
+    msg = str(ei.value)
+    assert "desync between islands" in msg
+    assert "island 1 (ranks 2, 3) at cycle 7" in msg
+    # aligned islands (and heads that stamped nothing) pass
+    stub._island_ordinals = {"k": {0: 5, 1: 5, 2: None}}
+    ControllerService._check_island_ordinals(stub, "k")
+
+
+def test_flightrec_classifies_island_texts():
+    from horovod_tpu.obs.flightrec import classify_incident
+
+    doc = {"reason": "island 1 sub-coordinator (rank 2) exited mid-job; "
+                     "its member ranks 2, 3 are unreachable.",
+           "ranks": {}}
+    assert classify_incident(doc)["verdict"].startswith(
+        "island-dead@island1")
+    doc = {"reason": "negotiation cycle stream desync between islands: "
+                     "island 0 (ranks 0, 1) at cycle 4, island 1 (ranks "
+                     "2, 3) at cycle 5 joined one rendezvous",
+           "ranks": {}}
+    assert classify_incident(doc)["verdict"].startswith("desync: island")
+    doc = {"reason": "island 1 consensus digest fold mismatch: head "
+                     "stamped aa, root recomputed bb over the windows "
+                     "that arrived for ranks 2, 3",
+           "ranks": {}}
+    assert classify_incident(doc)["verdict"] == "consensus-fold@island1"
+
+
+# -- registry / tooling rows ---------------------------------------------------
+
+
+def test_wire_registry_names_every_island_tag_and_field():
+    from horovod_tpu.analysis.wire_registry import MESSAGE_FIELDS, RPC_TAGS
+
+    for tag in ("hello_island", "island_cycle", "payload_island",
+                "sentry_island", "abort_island"):
+        assert tag in RPC_TAGS and RPC_TAGS[tag].strip()
+    for field in ("island", "members", "flush_ordinal", "cache",
+                  "requests", "raw", "member_ordinals", "digests",
+                  "fold", "shutdown_ranks"):
+        name = f"IslandSubmission.{field}"
+        assert name in MESSAGE_FIELDS and MESSAGE_FIELDS[name].strip()
+
+
+def test_metrics_summary_renders_hierarchy_section(tmp_path):
+    from horovod_tpu.obs.registry import registry
+    from horovod_tpu.ops import hierarchy as hier
+
+    hier.HIER_ISLANDS.set(2)
+    hier.MERGED_CYCLES.inc()
+    hier.ROOT_MESSAGES.inc()
+    snap = registry().snapshot()
+    assert "horovod_hier_islands" in snap, sorted(snap)
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(snap))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "metrics_summary.py"), str(path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "hierarchy plane" in proc.stdout
+    assert "horovod_hier_merged_cycles_total" in proc.stdout
+
+
+def test_scaling_simulation_root_load_is_sublinear(tmp_path):
+    # small sizes keep this in the quick tier; the acceptance-scale
+    # 10^2→10^4 sweep is the bench artifact, not a unit test
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "controller_bench.py"),
+         "--scaling", "--scaling-sizes", "16,64", "--scaling-cycles", "1"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads([ln for ln in proc.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert rec["metric"] == "hier_root_message_reduction"
+    rows = rec["hierarchy"]["rows"]
+    for row in rows:
+        assert row["tree_root_msgs"] == row["islands"]
+        assert row["tree_root_msgs"] < row["flat_root_msgs"]
+        assert row["tree_root_bytes"] < row["flat_root_bytes"]
+    # 64 ranks / 8 islands shrinks harder than 16 / 4: sub-linear growth
+    assert (rows[1]["flat_root_msgs"] / rows[1]["tree_root_msgs"]
+            > rows[0]["flat_root_msgs"] / rows[0]["tree_root_msgs"])
+    # and the capture renders through the shared table tool
+    (tmp_path / "hier.json").write_text(proc.stdout.splitlines()[-1])
+    table = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bench_table.py"),
+         str(tmp_path)], capture_output=True, text=True, timeout=60)
+    assert table.returncode == 0, table.stderr
+    assert "Negotiation-tree root load" in table.stdout
+
+
+# -- multi-process worlds (slow tier) ------------------------------------------
+
+
+def _mp_fn(steps):
+    """Per-rank body shipped through runner.run: the three collective
+    shapes on both cycle paths, plus the tree counters so a
+    silently-flat world cannot pass for a tree one."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank = hvd.rank()
+    outs = []
+    for step in range(steps):
+        r = hvd.allreduce(
+            np.arange(8, dtype=np.float32) * (rank + 1) + step,
+            average=False, name="zzhier.ar")
+        g = hvd.allgather(
+            np.full((rank + 1, 2), float(rank * 10 + step), np.float32),
+            name="zzhier.ag")
+        b = hvd.broadcast(
+            np.full((3,), float(rank + step), np.float32),
+            root_rank=1, name="zzhier.bc")
+        outs.append([np.asarray(r).tolist(), np.asarray(g).tolist(),
+                     np.asarray(b).tolist()])
+    snap = hvd.metrics_snapshot()
+
+    def _val(name):
+        samples = (snap.get(name) or {}).get("samples") or []
+        return sum(s.get("value", 0) for s in samples)
+
+    hvd.shutdown()
+    return {"rank": rank, "outs": outs,
+            "hier_islands": _val("horovod_hier_islands"),
+            "merged": _val("horovod_hier_merged_cycles_total"),
+            "raw": _val("horovod_hier_raw_cycles_total")}
+
+
+def _world(extra, np_, steps=4):
+    from horovod_tpu.runner import run
+
+    env = {"HOROVOD_CYCLE_TIME": "2", "HOROVOD_PLATFORM": "cpu",
+           "HOROVOD_CHAOS": "", **extra}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        results = run(_mp_fn, args=(steps,), np=np_, timeout_s=180.0,
+                      start_timeout_s=120.0)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return sorted(results, key=lambda r: r["rank"])
+
+
+def _expected_outs(np_, steps):
+    import numpy as np
+
+    outs = []
+    for step in range(steps):
+        ar = (np.arange(8, dtype=np.float32)
+              * sum(r + 1 for r in range(np_)) + np_ * step)
+        ag = np.concatenate([
+            np.full((r + 1, 2), float(r * 10 + step), np.float32)
+            for r in range(np_)])
+        bc = np.full((3,), float(1 + step), np.float32)
+        outs.append([ar.tolist(), ag.tolist(), bc.tolist()])
+    return outs
+
+
+@pytest.mark.slow
+def test_tree_world_bit_exact_vs_flat():
+    flat = _world({"HOROVOD_HIERARCHY": "flat",
+                   "HOROVOD_NATIVE_CONTROLLER": "0"}, 2)
+    tree = _world({"HOROVOD_HIERARCHY": "islands:2",
+                   "HOROVOD_NATIVE_CONTROLLER": "0"}, 2)
+    for f, t in zip(flat, tree):
+        assert f["outs"] == t["outs"] == _expected_outs(2, 4)
+    assert all(r["hier_islands"] == 0 for r in flat)
+    assert all(r["hier_islands"] == 2 for r in tree)
+    assert sum(r["merged"] for r in tree) > 0
+    assert sum(r["raw"] for r in tree) == 0
+
+
+@pytest.mark.slow
+def test_native_controller_degrades_to_flat_with_correct_results():
+    # the native wire predates the island RPCs: the tree request must
+    # degrade to a WORKING flat world, never a broken tree
+    tree = _world({"HOROVOD_HIERARCHY": "islands:2",
+                   "HOROVOD_NATIVE_CONTROLLER": "1"}, 2)
+    assert all(r["hier_islands"] == 0 for r in tree)
+    for r in tree:
+        assert r["outs"] == _expected_outs(2, 4)
+
+
+@pytest.mark.slow
+def test_dryrun_hierarchy_certification():
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from __graft_entry__ import dryrun_hierarchy
+
+    dryrun_hierarchy()

@@ -82,10 +82,36 @@ def _render_serving(rec: dict) -> None:
               f"|")
 
 
+def _render_hierarchy(rec: dict) -> None:
+    """The controller_bench.py --scaling final-line contract
+    (docs/hierarchy.md): simulated-world root-load rows rendered as the
+    docs table — flat vs tree root messages and bytes per cycle, with
+    the in-process Negotiator cycle rate alongside."""
+    rows = rec["hierarchy"].get("rows", [])
+    print()
+    print(f"Negotiation-tree root load "
+          f"({rec['hierarchy'].get('tensors_per_cycle', '?')} "
+          f"tensors/cycle, islands = floor(sqrt(ranks))) — "
+          f"{rec.get('value', '?')}x fewer root messages at "
+          f"{rec.get('ranks', '?')} ranks:")
+    print("| Ranks | Islands | flat msgs/cyc | tree msgs/cyc |"
+          " flat B/cyc | tree B/cyc | flat cyc/s | tree cyc/s |")
+    print("|---|---|---|---|---|---|---|---|")
+    for row in rows:
+        print(f"| {row.get('ranks', '—')} | {row.get('islands', '—')} "
+              f"| {row.get('flat_root_msgs', '—')} "
+              f"| {row.get('tree_root_msgs', '—')} "
+              f"| {row.get('flat_root_bytes', '—')} "
+              f"| {row.get('tree_root_bytes', '—')} "
+              f"| {row.get('flat_cycles_per_s', '—')} "
+              f"| {row.get('tree_cycles_per_s', '—')} |")
+
+
 def main() -> None:
     out_dir = sys.argv[1] if len(sys.argv) > 1 else "bench_results_r5"
     rows = []
     serving_recs = []
+    hier_recs = []
     for path in sorted(glob.glob(os.path.join(out_dir, "*.json"))):
         try:
             with open(path) as f:
@@ -98,13 +124,19 @@ def main() -> None:
             continue  # onchip bench etc. have their own tables
         if isinstance(rec.get("serving"), dict):
             serving_recs.append(rec)
+        if isinstance(rec.get("hierarchy"), dict):
+            # root-load capture, not a per-device-rate row — render its
+            # own table and keep it out of the throughput table
+            hier_recs.append(rec)
+            continue
         rows.append((os.path.basename(path), rec))
-    if not rows:
+    if not rows and not hier_recs:
         print(f"(no parseable captures in {out_dir})", file=sys.stderr)
         sys.exit(1)
-    print("| Config | per-device rate | TFLOP/s | MFU | vs reference |"
-          " live |")
-    print("|---|---|---|---|---|---|")
+    if rows:
+        print("| Config | per-device rate | TFLOP/s | MFU | vs reference |"
+              " live |")
+        print("|---|---|---|---|---|---|")
     for name, rec in rows:
         unit = rec.get("unit", "")
         tf = rec.get("tflops_per_device")
@@ -117,6 +149,8 @@ def main() -> None:
               f"{'yes' if rec.get('live', True) else 'watcher'} |")
     for rec in serving_recs:
         _render_serving(rec)
+    for rec in hier_recs:
+        _render_hierarchy(rec)
 
 
 if __name__ == "__main__":

@@ -82,6 +82,27 @@ if [ "${1:-}" = "--blackbox" ]; then
   exit $rc
 fi
 
+# --hierarchy sweeps the negotiation-tree grid (docs/hierarchy.md)
+# instead: member-link drop/delay/close under islands:2 must heal with
+# the tree LIVE and bit-exact results; a sub-coordinator kill must
+# escalate in-deadline with the island named in the abort (certified
+# through the black-box verdict when the killed rank's exit races the
+# survivors' reports). Tree RPCs need the Python controller, so only
+# HOROVOD_NATIVE_CORE varies.
+if [ "${1:-}" = "--hierarchy" ]; then
+  shift
+  rc=0
+  for core in 0 1; do
+    echo "=== negotiation tree: HOROVOD_NATIVE_CORE=$core ==="
+    if ! JAX_PLATFORMS=cpu HOROVOD_NATIVE_CONTROLLER=0 \
+        HOROVOD_NATIVE_CORE=$core \
+        python -m horovod_tpu.chaos.matrix --hierarchy "$@"; then
+      rc=1
+    fi
+  done
+  exit $rc
+fi
+
 if [ "${1:-}" = "--data-plane" ]; then
   shift
   rc=0

@@ -112,6 +112,15 @@ NUMERICS_PREFIXES = ("horovod_tensorwatch_", "horovod_tensor_",
 # collapse signal the evidence gate reverts on.
 SPARSE_PREFIXES = ("horovod_sparse_",)
 
+# Hierarchy-plane families (docs/hierarchy.md): the resolved island
+# gauge, merged-vs-raw island cycle counters, the root's absorbed
+# message count, and head pass-throughs — the "is the negotiation tree
+# live, and is it actually merging?" glance. A zero islands gauge under
+# HOROVOD_HIERARCHY is the degraded-to-flat tell; a raw counter pacing
+# the merged one means members' cycles keep deviating and the root is
+# absorbing near-flat load.
+HIER_PREFIXES = ("horovod_hier_",)
+
 # Checkpoint-plane families (docs/checkpoint.md): commit/seal counters,
 # the sealed-commit watermark, digest mismatches, stream bytes/seconds,
 # the commit-stall histogram, and journal depth — the "is training
@@ -199,6 +208,15 @@ def _render_ckpt_section(families: Dict[str, dict], prefix: str,
     _render_section("checkpoint plane", ckpt, prefix, out)
 
 
+def _render_hier_section(families: Dict[str, dict], prefix: str,
+                         out) -> None:
+    hier = {n: f for n, f in families.items()
+            if n.startswith(HIER_PREFIXES) and n.startswith(prefix)}
+    if not hier:
+        return
+    _render_section("hierarchy plane", hier, prefix, out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="pretty-print a saved /metrics.json or "
@@ -229,11 +247,12 @@ def main(argv=None) -> int:
     _render_numerics_section(world, args.family, sys.stdout)
     _render_sparse_section(world, args.family, sys.stdout)
     _render_ckpt_section(world, args.family, sys.stdout)
+    _render_hier_section(world, args.family, sys.stdout)
     _render_section("world", world, args.family, sys.stdout,
                     skip=TUNING_PREFIXES + INTEGRITY_PREFIXES
                     + SERVING_PREFIXES + FLIGHTREC_PREFIXES
                     + NUMERICS_PREFIXES + SPARSE_PREFIXES
-                    + CKPT_PREFIXES)
+                    + CKPT_PREFIXES + HIER_PREFIXES)
     # JSON round-trips rank keys as strings; accept either
     by_rank = {int(k): v for k, v in ranks.items()}
     wanted = sorted(by_rank) if args.all else (

@@ -92,12 +92,30 @@ def render(report: dict, out=sys.stdout) -> None:
         w(f"arrival spread: mean {spread['mean_s'] * 1e3:.2f} ms, "
           f"p50 {q(spread['p50_s'])}, p99 {q(spread['p99_s'])} over "
           f"{spread['count']} cycle(s)\n")
+    # Hierarchy plane (docs/hierarchy.md): name the slow ISLAND before
+    # the slow rank — at the root the spread is measured between island
+    # heads, so a DCN-side cause shows up here even when no single rank
+    # clears the per-rank dominance gate.
+    dom_island = report.get("dominant_island")
+    islands = report.get("islands") or {}
+    if dom_island is not None:
+        w(f"dominant island: {dom_island}\n")
+    elif len(islands) > 1:
+        w("dominant island: none (no island owns >50% of blame seconds "
+          "with spreads above the significance floor)\n")
     dom = report["dominant_rank"]
     if dom is not None:
         w(f"dominant rank: {dom}\n")
     else:
         w("dominant rank: none (no rank owns >50% of blame seconds with "
           "spreads above the significance floor)\n")
+    if len(islands) > 1:
+        w("\n## island blame (negotiation tree)\n")
+        w(f"{'island':>6} {'cycles':>8} {'blame s':>10} {'blame%':>8}\n")
+        for isl, b in sorted(islands.items()):
+            w(f"{isl:>6} {b['last_arriver_cycles']:>8} "
+              f"{b['blame_seconds']:>10.4f} "
+              f"{100 * b['blame_share']:>7.1f}%\n")
     if report["blame"]:
         w("\n## last-arriver blame\n")
         w(f"{'rank':>6} {'cycles':>8} {'cycle%':>8} "

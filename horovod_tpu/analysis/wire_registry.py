@@ -66,7 +66,13 @@ RPC_TAGS: Dict[str, str] = {
                     "member set to the root at connect; the native "
                     "wire predates every island RPC, so HOROVOD_"
                     "HIERARCHY degrades the whole world to flat, "
-                    "warned once on rank 0",
+                    "warned once on rank 0. Since the recovery plane "
+                    "(docs/recovery.md) it doubles as the SUCCESSION "
+                    "announcement: a hello from a NEW head rank "
+                    "supersedes the old head's reconnect window and "
+                    "rewrites the root's island-head map — the native "
+                    "degrade is the same flat world, where succession "
+                    "cannot arise",
     "island_cycle": "Python controller only (PR 18): one island's "
                     "merged negotiation cycle (IslandSubmission) "
                     "forwarded head→root; same flat degrade as "
@@ -126,6 +132,20 @@ ELASTIC_RPC_TAGS: Dict[str, str] = {
                         "ckpt_journal_put, same in-memory degrade",
     "ckpt_journal_del": "checkpoint plane: journal cleanup twin of "
                         "ckpt_journal_put, same in-memory degrade",
+    "recover": "recovery plane (docs/recovery.md): a warm survivor "
+               "parking in the driver's epoch-fenced recovery barrier "
+               "after a world fault; a driver that predates the tag "
+               "errors the park, elastic/recovery.maybe_recover returns "
+               "None and the survivor exits for the classic cold "
+               "relaunch — warm relaunch is additive, never required. "
+               "Native-controller worlds never send it: warm_enabled_env "
+               "forces the plane off there (the C++ service cannot be "
+               "rebuilt in-process), warned once by the driver",
+    "recover_poll": "recovery plane: the parked survivor's assignment "
+                    "poll — ('wait',), ('assign', env) or ('exit', "
+                    "reason); same old-driver degrade as 'recover' (any "
+                    "error while parked means cold exit, never a hang), "
+                    "and the same native-controller force-off",
 }
 
 # RPC tags dispatched by ServingPlane._handle (serving/plane.py) — the

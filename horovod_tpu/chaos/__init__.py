@@ -16,11 +16,17 @@ Spec grammar (comma-separated clauses)::
     clause   := kind "@" scope { ":" arg }    |  "seed" ":" INT
     kind     := drop | delay | corrupt | close | refuse    (control wire)
               | nan | flipbits                             (data plane)
+              | partition                                  (island domain)
     scope    := "rank" INT   (that rank's controller client only)
               | "all"        (every rank)
               | "relaunch"   (refuse's ONLY scope: reconnect attempts,
                               any rank — refuse@rankN/all are rejected,
                               a spec must inject exactly what it says)
+              | "island" INT (partition's ONLY scope: that island's
+                              head<->root hop, docs/recovery.md; trigger
+                              is "cycle" INT on the head's upstream-cycle
+                              ordinals — its own replay domain — and the
+                              second arg is the blackhole duration durS)
     trigger  := "msg" INT    (the INT-th request round trip, once)
               | "every" INT  (every INT-th request round trip)
               | "p" FLOAT    (per-request probability, seeded RNG)
@@ -96,9 +102,13 @@ class ChaosSpecError(ValueError):
 # Fault kinds by injection domain: wire kinds fire on the controller
 # client's request ordinals, data kinds on the engine's allreduce-batch
 # ordinals (docs/integrity.md). A rule's kind decides which hooks can
-# ever fire it — the two domains never cross-consume armings.
+# ever fire it — the two domains never cross-consume armings. Island
+# kinds (docs/recovery.md) fire on an island HEAD's upstream-cycle
+# ordinals — a third independent domain, consumed by
+# ``ops.hierarchy.SubCoordinatorService``, never by ``ChaosInjector``.
 WIRE_KINDS = ("drop", "delay", "corrupt", "close", "refuse")
 DATA_KINDS = ("nan", "flipbits")
+ISLAND_KINDS = ("partition",)
 
 
 @dataclass
@@ -114,6 +124,9 @@ class FaultRule:
     def describe(self) -> str:
         if self.kind == "refuse":  # relaunch is refuse's only scope
             return f"refuse@relaunch:{self.refusals}"
+        if self.kind == "partition":  # island scope, cycle trigger
+            return (f"partition@island{self.rank}:cycle{self.ordinal}"
+                    f":dur{self.delay_s:g}s")
         scope = "all" if self.rank is None else f"rank{self.rank}"
         trig = (f"msg{self.ordinal}" if self.ordinal is not None
                 else f"every{self.every}" if self.every is not None
@@ -166,9 +179,57 @@ def parse_chaos_spec(spec: str) -> ChaosPlan:
         kind, rest = clause.split("@", 1)
         toks = rest.split(":")
         scope, args = toks[0], toks[1:]
-        if kind not in WIRE_KINDS + DATA_KINDS:
+        if kind not in WIRE_KINDS + DATA_KINDS + ISLAND_KINDS:
             raise ChaosSpecError(f"unknown fault kind {kind!r} in {clause!r}")
         rule = FaultRule(kind=kind, rank=None)
+        if kind == "partition":
+            # partition@islandN:cycleK:durS (docs/recovery.md): island is
+            # partition's ONLY scope — it blackholes one island<->root
+            # hop, so rank/all scopes would promise something the fault
+            # cannot deliver. The rule's ``rank`` field carries the
+            # ISLAND id and its ordinal the head's upstream-cycle count.
+            if not scope.startswith("island"):
+                raise ChaosSpecError(
+                    f"partition scope must be 'islandN' in {clause!r}")
+            try:
+                rule.rank = int(scope[len("island"):])
+            except ValueError as exc:
+                raise ChaosSpecError(f"bad island in {clause!r}") from exc
+            if len(args) != 2:
+                raise ChaosSpecError(
+                    f"partition takes cycleK:durS in {clause!r}")
+            trig, dur = args
+            if not trig.startswith("cycle"):
+                raise ChaosSpecError(
+                    f"partition trigger must be 'cycleK' in {clause!r}")
+            try:
+                rule.ordinal = int(trig[len("cycle"):])
+            except ValueError as exc:
+                raise ChaosSpecError(f"bad cycle in {clause!r}") from exc
+            if rule.ordinal < 0:
+                raise ChaosSpecError(
+                    f"partition cycle must be >= 0 in {clause!r}")
+            if not dur.startswith("dur"):
+                raise ChaosSpecError(
+                    f"partition duration must be 'durS' in {clause!r}")
+            dur = dur[len("dur"):]
+            try:
+                if dur.endswith("ms"):
+                    rule.delay_s = float(dur[:-2]) / 1000.0
+                elif dur.endswith("s"):
+                    rule.delay_s = float(dur[:-1])
+                else:
+                    raise ChaosSpecError(
+                        f"partition duration needs ms/s suffix in "
+                        f"{clause!r}")
+            except ValueError as exc:
+                raise ChaosSpecError(
+                    f"bad duration in {clause!r}") from exc
+            if rule.delay_s <= 0:
+                raise ChaosSpecError(
+                    f"partition duration must be > 0 in {clause!r}")
+            plan.rules.append(rule)
+            continue
         if kind == "refuse":
             # relaunch is refuse's ONLY scope: a rank/all-scoped refuse
             # would parse as if it meant something narrower than it does
@@ -263,8 +324,11 @@ class ChaosInjector:
         self.ordinal = 0
         self.data_ordinal = 0
         self.events: List[Tuple[str, int]] = []
+        # partition rules live in the island domain: their ``rank`` field
+        # is an ISLAND id, so the per-rank filter must never adopt them
         self._rules = [r for r in plan.rules
-                       if r.rank is None or r.rank == rank]
+                       if r.kind not in ISLAND_KINDS
+                       and (r.rank is None or r.rank == rank)]
         self._rng = random.Random(plan.seed ^ (rank + 1) * 0x9E3779B1)
         # independent draw stream per domain: adding a data clause must
         # not shift the wire clauses' probabilistic replay (and vice
@@ -444,6 +508,32 @@ class ChaosInjector:
         # get writable results by contract (see _run_allreduce)
         return np.frombuffer(bytes(raw),
                              dtype=buf.dtype).reshape(buf.shape).copy()
+
+
+def partition_for_island(island: int,
+                         env: str = HOROVOD_CHAOS
+                         ) -> Optional[Tuple[int, float]]:
+    """The (cycle, duration_s) of the first partition clause targeting
+    ``island`` in the process's chaos spec, or None. Consumed by the
+    island head's sub-coordinator (docs/recovery.md) — the island
+    domain's faults never route through ``ChaosInjector``."""
+    import os
+
+    spec = os.environ.get(env, "")
+    if not spec:
+        return None
+    for rule in parse_chaos_spec(spec).rules:
+        if rule.kind == "partition" and rule.rank == int(island):
+            return (int(rule.ordinal or 0), float(rule.delay_s))
+    return None
+
+
+def note_injection(kind: str, detail: str = "", ordinal: int = 0) -> None:
+    """Record a fault fired OUTSIDE a ``ChaosInjector`` (the island
+    domain) on the same counter + flight-recorder trail, so the replay
+    proof and the operational signal stay unified across domains."""
+    _CHAOS_INJECTIONS.labels(kind=kind).inc()
+    _flightrec.record(_flightrec.EV_CHAOS, ordinal, detail=detail or kind)
 
 
 def injector_from_env(rank: Optional[int] = None,

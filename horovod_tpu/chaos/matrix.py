@@ -16,6 +16,8 @@ Run directly::
     python -m horovod_tpu.chaos.matrix --spec "drop@rank1:every3"
     python -m horovod_tpu.chaos.matrix --data-plane   # integrity grid
                                                       # (docs/integrity.md)
+    python -m horovod_tpu.chaos.matrix --recovery     # recovery plane
+                                                      # (docs/recovery.md)
 """
 
 from __future__ import annotations
@@ -119,6 +121,38 @@ HIERARCHY_GRID = [
     ("close@rank1:msg8,refuse@relaunch:1", 2, "islands:2", None,
      "healed"),
     ("", 4, "islands:2", 2, "escalated"),
+]
+
+
+# Recovery-plane grid (docs/recovery.md): every cell is a 4-rank elastic
+# world on the async checkpoint pipeline, and every cell must land in
+# exactly ONE bucket — ``healed`` (bit-exact, zero relaunches),
+# ``recovered`` (warm relaunch from the last SEALED epoch with survivor
+# PIDs unchanged, classified verdict like ``recovered@epoch1
+# survivors=3/4``), or a structured failure label — never a hang.
+#   kill-rank-warm      rank 1 dies before commit 2; the other three park
+#                       in the recovery barrier and re-enter warm
+#   partition-heal      island 1's uplink blackholed for LESS than the
+#                       root's reconnect window: dedup heals bit-exact,
+#                       zero relaunches
+#   partition-escalate  the same blackhole held PAST the window: the root
+#                       aborts the island in-deadline, the world
+#                       warm-recovers (nobody died, so island 0's
+#                       processes — at least — keep their PIDs)
+#   head-kill           island 1's HEAD dies; warm recovery with the
+#                       island rejoining under the driver's planned
+#                       successor (HOROVOD_ISLAND_HEADS) and one merged
+#                       blackbox verdict for the epoch-0 abort
+#   succession-live     headstop drill on island 1's primary: members
+#                       fail over to the standby MID-JOB — bit-exact,
+#                       zero relaunches, the successions counter proves
+#                       the standby served
+RECOVERY_GRID = [
+    ("kill-rank-warm", "recovered"),
+    ("partition-heal", "healed"),
+    ("partition-escalate", "recovered"),
+    ("head-kill", "recovered"),
+    ("succession-live", "healed"),
 ]
 
 
@@ -859,6 +893,269 @@ def _island_verdict(bb_dir: str) -> Optional[str]:
     return classify_incident(merge_incidents(docs)).get("verdict")
 
 
+def _recovery_world_fn(total_steps, kill_rank, kill_step, piddir):
+    """Per-rank body for one recovery cell (shipped by value through the
+    elastic driver): the checkpoint grid's integer-exact commit loop,
+    plus the evidence the recovery ladder is judged on — a per-epoch PID
+    file (warm survivors write the SAME pid under two epochs; a cold
+    fork cannot), the island-subcoordinator duty this rank ended up
+    holding, and the local successions counter. ``kill_rank`` hard-kills
+    that rank at ``kill_step`` in epoch 0 only — the epoch is re-read at
+    fire time, so a warm-recovered survivor never re-fires it."""
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.basics import world_epoch
+    from horovod_tpu.core import config as _config
+    from horovod_tpu.elastic import State
+
+    hvd.init()
+    rank = hvd.rank()
+    with open(os.path.join(piddir,
+                           f"epoch{world_epoch()}.rank{rank}"),
+              "w", encoding="utf-8") as fh:
+        fh.write(str(os.getpid()))
+    state = State(w=np.zeros(64, np.float32), step=0)
+
+    def train(state):
+        while state.step < total_steps:
+            if rank == kill_rank and state.step == kill_step and \
+                    world_epoch() == 0:
+                os._exit(1)
+            grad = hvd.allreduce(
+                np.full(64, float(state.step + 1), np.float32),
+                average=False, name=f"chaos.rec.{state.step}")
+            state.w = state.w + np.asarray(grad)
+            state.step += 1
+            state.commit()
+            state.flush_commits()
+        from horovod_tpu.ops.engine import get_engine
+
+        engine = get_engine()
+        sub = getattr(engine, "_subcoord", None)
+        snap = hvd.metrics_snapshot()
+
+        def _val(name):
+            samples = (snap.get(name) or {}).get("samples") or []
+            return sum(s.get("value", 0) for s in samples)
+
+        return {"rank": rank, "pid": os.getpid(), "step": state.step,
+                "w0": float(state.w[0]), "epoch": world_epoch(),
+                "restore": state.restore_source,
+                "restore_no": state.restore_commit_no,
+                "subcoord_island": (getattr(sub, "_island", None)
+                                    if sub is not None else None),
+                "successions": _val(
+                    "horovod_recovery_successions_total"),
+                "heads_env": os.environ.get(
+                    _config.HOROVOD_ISLAND_HEADS, "")}
+
+    out = state.run(train)
+    hvd.shutdown()
+    return out
+
+
+def _recovery_pids(piddir: str) -> Dict[Tuple[int, int], int]:
+    """{(epoch, rank): pid} from the worker-written evidence files."""
+    import os
+    import re
+
+    pids: Dict[Tuple[int, int], int] = {}
+    for name in os.listdir(piddir):
+        m = re.fullmatch(r"epoch(\d+)\.rank(\d+)", name)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(piddir, name), encoding="utf-8") as fh:
+                pids[(int(m.group(1)), int(m.group(2)))] = int(
+                    fh.read().strip())
+        except (OSError, ValueError):
+            continue
+    return pids
+
+
+def run_recovery_cell(cell: str, native_core: Optional[int] = None,
+                      steps: int = 4, timeout_s: float = 240.0,
+                      deadline_s: float = 150.0) -> Dict:
+    """Run one recovery-plane cell (docs/recovery.md). Outcomes:
+    ``healed`` (bit-exact, zero relaunches), ``recovered`` (exactly one
+    warm relaunch, restored from the sealed ledger where one existed,
+    survivor PIDs unchanged — the cell's ``verdict`` reads like
+    ``recovered@epoch1 survivors=3/4``), ``wrong-results`` /
+    ``wrong-restore`` / ``cold-relaunch`` / ``escalated`` (a structured
+    wrong bucket), ``hang``. Never an unclassified exit."""
+    import os
+    import shutil
+    import tempfile
+
+    from horovod_tpu.runner import run_elastic
+
+    np_ = 4
+    kill_rank = kill_step = None
+    env = {
+        "HOROVOD_PLATFORM": "cpu",
+        "HOROVOD_NATIVE_CONTROLLER": "0",
+        "HOROVOD_CYCLE_TIME": "2",
+        "HOROVOD_CKPT_ASYNC": "1",
+        "HOROVOD_RECOVERY_WINDOW_S": "20",
+        "HOROVOD_RECONNECT_ATTEMPTS": "4",
+        "HOROVOD_RECONNECT_BACKOFF_S": "0.05",
+        "HOROVOD_RECONNECT_WINDOW_S": "2",
+        "HOROVOD_STALL_WARNING_TIME": "2",
+        "HOROVOD_STALL_SHUTDOWN_TIME_S": "8",
+    }
+    if cell == "kill-rank-warm":
+        env["HOROVOD_ELASTIC_FAULT"] = "1:2"
+    elif cell == "partition-heal":
+        env["HOROVOD_HIERARCHY"] = "islands:2"
+        env["HOROVOD_CHAOS"] = "partition@island1:cycle3:dur0.4s"
+        env["HOROVOD_RECONNECT_WINDOW_S"] = "4"
+    elif cell == "partition-escalate":
+        # cycle4, not earlier: commit 1 must SEAL before the blackhole
+        # lands, or the warm relaunch has no sealed epoch to prove
+        # bit-exact restore against
+        env["HOROVOD_HIERARCHY"] = "islands:2"
+        env["HOROVOD_CHAOS"] = "partition@island1:cycle4:dur30s"
+    elif cell == "head-kill":
+        env["HOROVOD_HIERARCHY"] = "islands:2"
+        kill_rank, kill_step = 2, 2
+    elif cell == "succession-live":
+        env["HOROVOD_HIERARCHY"] = "islands:2"
+        env["HOROVOD_RECOVERY_FAULT"] = "headstop@island1:cycle2"
+    else:
+        raise ValueError(f"unknown recovery cell {cell!r}")
+    if native_core is not None:
+        env["HOROVOD_NATIVE_CORE"] = str(native_core)
+    piddir = tempfile.mkdtemp(prefix="hvd-rec-pids-")
+    t0 = time.monotonic()
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        results = run_elastic(
+            _recovery_world_fn, args=(steps, kill_rank, kill_step,
+                                      piddir),
+            np=np_, min_np=np_, max_restarts=2, backoff_s=0.2,
+            timeout_s=timeout_s, start_timeout_s=120.0,
+            heartbeat_interval_s=0.5, heartbeat_miss_limit=6,
+            env_extra=dict(env))
+        cell_out = _classify_recovery_results(
+            cell, results, _recovery_pids(piddir), np_, steps)
+    except TimeoutError as exc:
+        cell_out = {"outcome": "hang", "error": str(exc)[:500]}
+    except Exception as exc:  # noqa: BLE001 - classified as escalation
+        cell_out = {"outcome": "escalated",
+                    "error": f"{type(exc).__name__}: {exc}"[:500]}
+    finally:
+        shutil.rmtree(piddir, ignore_errors=True)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    cell_out["cell"] = cell
+    cell_out["native_core"] = native_core
+    cell_out["elapsed_s"] = round(time.monotonic() - t0, 2)
+    if cell_out["outcome"] == "recovered" and \
+            cell_out["elapsed_s"] > deadline_s:
+        # a recovery that only lands because some teardown timer fired
+        # is a wedge, not a recovery
+        cell_out["outcome"] = "late-recovery"
+    return cell_out
+
+
+def _classify_recovery_results(cell: str, results, pids, np_: int,
+                               steps: int) -> Dict:
+    """Exactly-one-bucket classification: bit-exact numbers first (the
+    integer commit loop's end state is computable in closed form), then
+    the relaunch count, then the recovery ladder's own evidence — PID
+    preservation for warm cells, the successor's island duty for the
+    head-kill cell, the successions counter for the live drill."""
+    expected_w0 = float(np_ * sum(range(1, steps + 1)))
+    if len(results) != np_:
+        return {"outcome": "escalated",
+                "error": f"expected {np_} results, got {results!r}"[:500]}
+    for r in results:
+        if r.get("step") != steps or r.get("w0") != expected_w0:
+            return {"outcome": "wrong-results",
+                    "error": f"expected step={steps} w0={expected_w0}, "
+                             f"got {results!r}"[:500]}
+    epochs = {r.get("epoch") for r in results}
+    heal_cell = cell in ("partition-heal", "succession-live")
+    if heal_cell:
+        if epochs != {0}:
+            return {"outcome": "escalated",
+                    "error": f"heal cell relaunched: epochs {epochs}"}
+        out = {"outcome": "healed", "results": results}
+        if cell == "succession-live":
+            successions = sum(r.get("successions") or 0 for r in results)
+            if successions < 1:
+                return {"outcome": "escalated",
+                        "error": "headstop drill fired but no standby "
+                                 "recorded a succession"}
+            out["verdict"] = "island-head-succeeded@island1"
+        return out
+    if epochs == {0}:
+        return {"outcome": "escalated",
+                "error": "fault cell never relaunched (fault did not "
+                         "fire?)"}
+    if epochs != {1}:
+        return {"outcome": "escalated",
+                "error": f"expected exactly one relaunch, epochs "
+                         f"{epochs}"}
+    # Warm proof: a survivor wrote the SAME pid under both epochs. The
+    # dead rank (if any) must have a fresh pid; ranks the driver was
+    # forced to cold-fork (a parking race) show up here honestly.
+    dead = {1} if cell == "kill-rank-warm" else \
+        {2} if cell == "head-kill" else set()
+    preserved = {r for r in range(np_)
+                 if (0, r) in pids and pids.get((0, r)) == pids.get((1, r))}
+    if dead & preserved:
+        return {"outcome": "cold-relaunch",
+                "error": f"dead rank(s) {sorted(dead)} kept their pid "
+                         f"({pids}) — the kill did not fire"}
+    must_survive = ({0, 2, 3} if cell == "kill-rank-warm" else
+                    {0, 1, 3} if cell == "head-kill" else
+                    {0, 1})  # partition-escalate: island 0 at minimum
+    if not must_survive <= preserved:
+        return {"outcome": "cold-relaunch",
+                "error": f"survivors {sorted(must_survive - preserved)} "
+                         f"were cold-forked, not parked ({pids})"}
+    # Restored-from-sealed proof: some rank must carry the sealed
+    # provenance (commit 1 seals before any cell's fault fires).
+    sources = {r.get("restore") for r in results}
+    if "sealed" not in sources:
+        return {"outcome": "wrong-restore",
+                "error": f"relaunch restored from {sources} — not the "
+                         f"sealed ledger"}
+    out = {"outcome": "recovered", "results": results,
+           "survivors": sorted(preserved),
+           "verdict": f"recovered@epoch1 "
+                      f"survivors={len(preserved)}/{np_}"}
+    if cell == "head-kill":
+        # the island must be SERVING under the planned successor: rank 3
+        # (island 1's standby) hosts the primary sub-coordinator in
+        # epoch 1, and every rank's plan carries the 1:3 override
+        successor = [r for r in results
+                     if r.get("subcoord_island") == 1]
+        if [r.get("rank") for r in successor] != [3]:
+            return {"outcome": "escalated",
+                    "error": f"island 1 not under the planned successor "
+                             f"after relaunch: {results!r}"[:500]}
+        if any("1:3" not in (r.get("heads_env") or "") for r in results):
+            return {"outcome": "escalated",
+                    "error": "HOROVOD_ISLAND_HEADS succession override "
+                             "missing from the relaunched world"}
+        out["verdict"] = ("recovered@epoch1 "
+                          f"survivors={len(preserved)}/{np_} "
+                          "island-head-succeeded@island1")
+    return out
+
+
 def run_cell(spec: str,
              native_controller: Optional[int] = None,
              native_core: Optional[int] = None,
@@ -1066,7 +1363,53 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "and kill-between-chunks must relaunch and "
                              "restore the last SEALED commit bit-exactly; "
                              "a clean async run must never relaunch")
+    parser.add_argument("--recovery", action="store_true",
+                        help="run the recovery-plane grid instead "
+                             "(docs/recovery.md): kill-one-rank and "
+                             "partition-past-the-window must WARM-relaunch "
+                             "(survivor PIDs unchanged, sealed restore "
+                             "bit-exact), partition-inside-the-window and "
+                             "the headstop succession drill must heal "
+                             "with zero relaunches — never a hang")
     args = parser.parse_args(argv)
+    if args.recovery:
+        failed = 0
+        blackbox = _BlackboxCheck() if args.blackbox else None
+        try:
+            for cell_name, expect in RECOVERY_GRID:
+                def _cell(cell_name=cell_name):
+                    return run_recovery_cell(cell_name, steps=args.steps)
+                cell = blackbox.run(_cell) if blackbox is not None \
+                    else _cell()
+                ok = cell["outcome"] == expect
+                bb = ""
+                if blackbox is not None:
+                    # a RECOVERED cell rode a world abort too: it owes a
+                    # classifiable incident dump exactly like an
+                    # escalation (the PR 14 no-undiagnosed-abort contract)
+                    if cell["outcome"] in ("recovered", "escalated",
+                                           "late-recovery"):
+                        verdict = blackbox.verdict()
+                        if verdict is None:
+                            bb = ("  blackbox=MISSING (abort left no "
+                                  "dump)")
+                            ok = False
+                        else:
+                            bb = f"  blackbox={verdict!r}"
+                if not ok:
+                    failed += 1
+                verdict_str = (f"  {cell['verdict']}"
+                               if "verdict" in cell else "")
+                print(f"recovery-cell {'OK ' if ok else 'BAD'} "
+                      f"outcome={cell['outcome']:<15} "
+                      f"{cell['elapsed_s']:6.1f}s  "
+                      f"{cell_name}{verdict_str}{bb}", flush=True)
+                if not ok:
+                    print(f"  {cell.get('error', '')}", flush=True)
+        finally:
+            if blackbox is not None:
+                blackbox.cleanup()
+        return 1 if failed else 0
     if args.hierarchy:
         failed = 0
         blackbox = _BlackboxCheck() if args.blackbox else None

@@ -109,6 +109,15 @@ class SealLedger:
             self._load_sealed()
         _SEALED_NO.set(self._sealed_no)
 
+    @property
+    def sealed_no(self) -> int:
+        """Current sealed watermark (-1 = nothing sealed yet). The elastic
+        driver reads this cheaply per epoch to decide whether an attempt
+        made checkpoint progress (backoff-ladder reset, docs/recovery.md)
+        without paying ``fetch_sealed``'s payload copy."""
+        with self._lock:
+            return self._sealed_no
+
     # -- epoch fence -----------------------------------------------------------
 
     def begin_epoch(self, epoch: int) -> None:

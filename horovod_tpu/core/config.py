@@ -179,6 +179,43 @@ HOROVOD_HEARTBEAT_INTERVAL = "HOROVOD_HEARTBEAT_INTERVAL"
 # docs/elastic.md.
 HOROVOD_ELASTIC_FAULT = "HOROVOD_ELASTIC_FAULT"
 
+# --- surgical recovery plane (ours; docs/recovery.md) ------------------------
+# "1" (default) arms warm-survivor relaunch: on a world fault, surviving
+# worker processes park in the driver's recovery barrier instead of
+# exiting, re-enter the next epoch in-process (keeping the process, its
+# devices, and its compiled-program caches), and only dead slots are
+# cold-forked. "0" restores the SIGTERM-everything cold relaunch.
+# Degrades to cold (warned once) under the native controller and for
+# rank-shifted survivors (a warm process cannot re-pin devices).
+HOROVOD_RECOVERY_WARM = "HOROVOD_RECOVERY_WARM"
+# Seconds the driver waits for survivors of a failed epoch to park in
+# the recovery barrier before giving up on reusing them (a survivor that
+# never parks is terminated and its slot cold-forked).
+HOROVOD_RECOVERY_WINDOW_S = "HOROVOD_RECOVERY_WINDOW_S"
+# Slot-blacklist forgiveness (docs/recovery.md): seconds after which a
+# failure strike against a slot decays and the slot re-enters the pool.
+# 0 (default) keeps the historical life sentence. A StragglerEvictError
+# VERDICT is never forgiven regardless of this knob — eviction is a
+# measured judgment, not a transient fault.
+HOROVOD_BLACKLIST_FORGIVE_S = "HOROVOD_BLACKLIST_FORGIVE_S"
+# Island head-rank overrides ("island:rank,island:rank"): planned
+# successors published by the elastic driver's warm path when a head
+# rank died, so the relaunched island rejoins under its planned
+# successor instead of re-electing min(members). Never set by hand.
+HOROVOD_ISLAND_HEADS = "HOROVOD_ISLAND_HEADS"
+# Launcher -> successor plumbing for live head succession: the standby
+# listener every island member fails over to when the head's service
+# dies but its rank survives (bound by the launcher beside the primary;
+# the planned successor adopts it via HOROVOD_SUBCOORD_STANDBY_FD).
+HOROVOD_SUBCOORD_STANDBY_PORT = "HOROVOD_SUBCOORD_STANDBY_PORT"
+HOROVOD_SUBCOORD_STANDBY_FD = "HOROVOD_SUBCOORD_STANDBY_FD"
+# Deterministic fault hook for the succession drill ("headstop@cycleK"):
+# the primary island head stops its sub-coordinator SERVICE (process and
+# rank survive as an ordinary member) right before forwarding its Kth
+# upstream island cycle — the service-death-without-rank-death shape
+# live succession exists for. Epoch-0 only, the ELASTIC_FAULT convention.
+HOROVOD_RECOVERY_FAULT = "HOROVOD_RECOVERY_FAULT"
+
 # --- checkpoint plane (horovod_tpu.ckpt; ours, docs/checkpoint.md) -----------
 # Per-request timeout (seconds) of elastic.State's commit push / fetch
 # client. The seed hard-coded 60 s because one synchronous commit frame

@@ -40,6 +40,7 @@ from ..runner.run_api import (
     _execute_world,
 )
 from .health import ElasticService
+from .recovery import recovery_window_s, warm_enabled_env
 
 
 # Observability plane (docs/metrics.md): driver-process families (the
@@ -50,6 +51,21 @@ _ELASTIC_FAILURES = _metrics().counter(
 _ELASTIC_RELAUNCHES = _metrics().counter(
     "horovod_elastic_relaunches_total",
     "Worlds relaunched by run_elastic after a failed attempt")
+# Surgical recovery plane (docs/recovery.md).
+_RECOVERY_WARM = _metrics().counter(
+    "horovod_recovery_warm_relaunches_total",
+    "Relaunches that reused parked survivor processes (warm path)")
+_RECOVERY_COLD = _metrics().counter(
+    "horovod_recovery_cold_relaunches_total",
+    "Relaunches that cold-forked the whole world (no survivors reused)")
+_RECOVERY_SURVIVORS = _metrics().counter(
+    "horovod_recovery_survivors_reused_total",
+    "Survivor processes re-entered warm across all relaunches")
+_RECOVERY_MTTR = _metrics().histogram(
+    "horovod_recovery_mttr_seconds",
+    "Fault to world-fully-beating-again latency per relaunch, by recovery "
+    "mode", labels=("mode",),
+    buckets=(0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 40.0, 80.0, 160.0))
 _STRAGGLER_EVICTIONS = _metrics().counter(
     "horovod_straggler_evictions_total",
     "Straggler eviction advisories the elastic driver received, by mode "
@@ -149,6 +165,93 @@ def _failed_ranks(exc: BaseException) -> List[int]:
     return []
 
 
+class _SlotLedger:
+    """Timestamped slot strikes with optional forgiveness decay.
+
+    ``HOROVOD_BLACKLIST_FORGIVE_S`` (docs/recovery.md): with forgiveness 0
+    (the default) a slot that collects ``limit`` strikes is banned for the
+    job — the original PR 2 semantics. A positive forgiveness ages strikes
+    out after that many seconds, so a long job survives transient slot
+    flakiness without permanently shrinking below ``min_np``. An enforced
+    :class:`StragglerEvictError` verdict is an ``evict``, not a strike —
+    it is NEVER forgiven (the detector already proved persistence)."""
+
+    def __init__(self, np: int, limit: int, forgive_s: float = 0.0) -> None:
+        self._np = int(np)
+        self._limit = int(limit)
+        self._forgive_s = max(0.0, float(forgive_s))
+        self._strikes: Dict[int, List[float]] = {s: [] for s in range(np)}
+        self._evicted: set = set()
+
+    def strike(self, slot: int, now: Optional[float] = None) -> None:
+        self._strikes[slot].append(
+            time.monotonic() if now is None else now)
+
+    def evict(self, slot: int) -> None:
+        self._evicted.add(slot)
+
+    def _live_strikes(self, slot: int, now: float) -> int:
+        strikes = self._strikes[slot]
+        if self._forgive_s > 0.0:
+            strikes[:] = [t for t in strikes if now - t < self._forgive_s]
+        return len(strikes)
+
+    def active(self, now: Optional[float] = None) -> List[int]:
+        now = time.monotonic() if now is None else now
+        return [s for s in range(self._np)
+                if s not in self._evicted
+                and self._live_strikes(s, now) < self._limit]
+
+    def blacklisted(self, now: Optional[float] = None) -> List[int]:
+        alive = set(self.active(now))
+        return sorted(s for s in range(self._np) if s not in alive)
+
+
+def _blacklist_forgive_s() -> float:
+    raw = os.environ.get(_config.HOROVOD_BLACKLIST_FORGIVE_S, "")
+    try:
+        return float(raw) if raw else 0.0
+    except ValueError:
+        return 0.0
+
+
+def _plan_successions(overrides: Dict[int, int], failed: set, world: int,
+                      env: Dict[str, str]) -> Dict[int, int]:
+    """Standby island-head succession (docs/recovery.md): when a failed
+    rank was serving as an island head, plan the island's deterministic
+    successor as its head for the relaunch — the surviving members rejoin
+    under a head that never died, and the respawned rank comes back as a
+    plain member."""
+    mode = ((env.get(_config.HOROVOD_HIERARCHY) or
+             os.environ.get(_config.HOROVOD_HIERARCHY, "flat")) or
+            "flat").strip().lower()
+    if mode in ("", "flat"):
+        return overrides
+    try:
+        from ..ops.hierarchy import plan_topology
+
+        topo = plan_topology(world, mode, cross_size=1,
+                             head_overrides=overrides)
+    except Exception:  # noqa: BLE001 - planning must not mask the fault
+        return overrides
+    if topo.flat:
+        return overrides
+    out = dict(overrides)
+    for island, members in sorted(topo.islands.items()):
+        head = topo.head_of(island)
+        if head not in failed or len(members) < 2:
+            continue
+        successor = next(
+            (m for m in sorted(members) if m not in failed), None)
+        if successor is None or successor == head:
+            continue
+        out[island] = successor
+        LOG.warning(
+            "island %d head (rank %d) died; planning succession to rank "
+            "%d for the relaunch", island, head, successor)
+    return out
+
+
 def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                 np: int = 1, min_np: int = 1,
                 max_restarts: int = 3, backoff_s: float = 1.0,
@@ -218,21 +321,93 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                              miss_limit=heartbeat_miss_limit)
     if on_seal is not None:
         service.ckpt.on_seal = on_seal
-    fail_counts: Dict[int, int] = {slot: 0 for slot in range(np)}
+    ledger = _SlotLedger(np, slot_fail_limit,
+                         forgive_s=_blacklist_forgive_s())
     epoch = 0
+    ladder = 0  # backoff exponent; resets on checkpoint progress
     last_err: Optional[BaseException] = None
+    # Surgical recovery plane (docs/recovery.md): warm relaunch reuses the
+    # parked survivor processes of the failed epoch whenever the slot list
+    # did not shift (rank-preserving reuse only — a shifted mapping would
+    # hand a survivor a different rank than its warm caches were built
+    # for). Eligibility is resolved against the env the workers will see.
+    probe_env = dict(os.environ)
+    probe_env.update(env_extra or {})
+    warm_ok = warm_enabled_env(probe_env)
+    window = recovery_window_s(probe_env)
+    # (failed_epoch, active list, world, fault_t, failed world ranks) of
+    # the attempt that just died — consumed by the next iteration.
+    last_fault: Optional[tuple] = None
+    head_overrides: Dict[int, int] = {}
+    overrides_for: Optional[List[int]] = None  # active list they fit
+    mttr_pending: Optional[Tuple[str, float]] = None
     try:
         while True:
-            active = [slot for slot in range(np)
-                      if fail_counts[slot] < slot_fail_limit]
+            active = ledger.active()
             if len(active) < min_np:
                 raise ElasticExhaustedError(
                     f"only {len(active)} healthy slot(s) left of {np} "
                     f"(min_np={min_np}); blacklisted: "
-                    f"{sorted(s for s in range(np) if s not in active)}. "
+                    f"{ledger.blacklisted()}. "
                     f"Last failure: {last_err}") from last_err
             world = len(active)
             service.begin_epoch(epoch)
+            if overrides_for is not None and active != overrides_for:
+                # the slot list shifted: planned successions no longer
+                # name the right world ranks — fall back to a full
+                # re-plan (cold semantics for the hierarchy)
+                head_overrides = {}
+                overrides_for = None
+            warm_ranks: Dict[int, int] = {}
+            spawn_ranks: Optional[List[int]] = None
+            warm_env_cb = None
+            if last_fault is not None:
+                f_epoch, f_active, f_world, _fault_t, f_failed = last_fault
+                if warm_ok and active == f_active:
+                    expected = set(range(f_world)) - f_failed
+                    got = service.wait_parked(f_epoch, expected, window)
+                    if got:
+                        # Attributed-but-alive ranks (a partitioned
+                        # island's members, say) park moments after the
+                        # blamed abort lands on them; a short settle
+                        # scoops them into the warm set instead of
+                        # cold-forking twins beside live processes.
+                        time.sleep(0.3)
+                        got = service.parked(f_epoch)
+                    warm_ranks = {r: pid for r, pid in got.items()
+                                  if 0 <= r < world}
+                if warm_ranks:
+                    spawn_ranks = [r for r in range(world)
+                                   if r not in warm_ranks]
+                    need = set(warm_ranks)
+                    collected: Dict[int, dict] = {}
+
+                    def warm_env_cb(rank: int, env: dict,
+                                    _epoch=f_epoch, _need=need,
+                                    _got=collected) -> None:
+                        # the launcher hands every non-spawned rank's env
+                        # block here; once the set is complete, publish
+                        # the failed epoch's recovery verdicts in one shot
+                        _got[int(rank)] = env
+                        if _need.issubset(_got):
+                            service.publish_recovery(_epoch, dict(_got))
+
+                    _RECOVERY_WARM.inc()
+                    _RECOVERY_SURVIVORS.inc(len(warm_ranks))
+                    mttr_pending = ("warm", _fault_t)
+                    LOG.warning(
+                        "warm relaunch for epoch %d: reusing %d parked "
+                        "survivor(s) %s; cold-forking rank(s) %s",
+                        epoch, len(warm_ranks), sorted(warm_ranks),
+                        spawn_ranks)
+                else:
+                    # cold: tell every parked survivor of the failed
+                    # epoch to exit (slot list shifted, warm disabled,
+                    # or nobody managed to park in the window)
+                    service.publish_recovery(f_epoch, {})
+                    _RECOVERY_COLD.inc()
+                    mttr_pending = ("cold", _fault_t)
+                last_fault = None
             merged_env = {
                 _config.HOROVOD_ELASTIC_EPOCH: str(epoch),
                 _config.HOROVOD_ELASTIC_ADDR: "127.0.0.1",
@@ -256,9 +431,22 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                 serving_plane.begin_epoch(epoch, world)
             if env_extra:
                 merged_env.update(env_extra)
+            if head_overrides:
+                from ..ops.hierarchy import format_head_overrides
+
+                merged_env[_config.HOROVOD_ISLAND_HEADS] = \
+                    format_head_overrides(head_overrides)
+                overrides_for = list(active)
             seen_advisories: Dict[int, Any] = {}  # rank -> last seq seen
 
             def _health_check() -> None:
+                nonlocal mttr_pending
+                if mttr_pending is not None and \
+                        service.beating_count() >= world:
+                    mode, fault_t = mttr_pending
+                    _RECOVERY_MTTR.labels(mode=mode).observe(
+                        time.monotonic() - fault_t)
+                    mttr_pending = None
                 dead = service.dead_ranks()
                 if dead:
                     raise WorkerDeadError(dead, heartbeat_interval_s,
@@ -289,6 +477,8 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                 if evict_mode == "enforce":
                     raise StragglerEvictError(sorted(fresh), fresh)
 
+            sealed_at_start = service.ckpt.sealed_no
+            this_epoch = epoch
             try:
                 if epoch > 0:
                     LOG.warning(
@@ -298,7 +488,12 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                     fn, args, kwargs or {}, world, timeout_s,
                     start_timeout_s, use_host_data_plane,
                     env_extra=merged_env, extra_abort_check=_health_check,
-                    secret=secret)
+                    secret=secret, spawn_ranks=spawn_ranks,
+                    warm_env_cb=warm_env_cb,
+                    spare_pids_fn=(
+                        (lambda: service.parked_pids(this_epoch))
+                        if warm_ok else None),
+                    spare_grace_s=(window if warm_ok else 0.0))
             except (LaunchError, StragglerEvictError, WorkerDeadError,
                     WorkerFailedError, WorkerLostError,
                     TimeoutError) as exc:
@@ -334,14 +529,21 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                     # An enforced eviction is a VERDICT, not a strike:
                     # the slot is blacklisted outright — re-scheduling
                     # onto a persistently slow host until it "fails
-                    # enough" would tax every relaunch on the way there.
+                    # enough" would tax every relaunch on the way there
+                    # (and the forgiveness decay NEVER applies: the
+                    # detector already proved persistence).
                     for rank in failed:
                         if 0 <= rank < world:
-                            fail_counts[active[rank]] = slot_fail_limit
+                            ledger.evict(active[rank])
                 else:
                     for rank in failed:
                         if 0 <= rank < world:
-                            fail_counts[active[rank]] += 1
+                            ledger.strike(active[rank])
+                failed_world = {r for r in failed if 0 <= r < world}
+                head_overrides = _plan_successions(
+                    head_overrides, failed_world, world, merged_env)
+                last_fault = (this_epoch, list(active), world,
+                              time.monotonic(), failed_world)
                 LOG.warning(
                     "elastic attempt %d failed (%s: %s); failed world "
                     "rank(s) %s -> slot(s) %s",
@@ -355,9 +557,24 @@ def run_elastic(fn, args: Tuple = (), kwargs: Optional[dict] = None,
                         f"failure: {exc}") from exc
                 _ELASTIC_RELAUNCHES.inc()
                 _flightrec.record(_flightrec.EV_ELASTIC_RELAUNCH, epoch)
-                delay = backoff_s * (2.0 ** (epoch - 1))
-                LOG.warning("elastic backoff: %.1fs before relaunch",
-                            delay)
+                # Backoff ladder (docs/recovery.md): an attempt that made
+                # checkpoint progress — the seal watermark advanced, i.e.
+                # it survived past HOROVOD_CKPT_INTERVAL_STEPS worth of
+                # steps — resets the exponent: progress means the world is
+                # basically healthy and the next fault deserves a fast
+                # relaunch, not a doubled one.
+                progressed = service.ckpt.sealed_no > sealed_at_start
+                ladder = 0 if progressed else ladder + 1
+                delay = backoff_s * (2.0 ** max(0, ladder - 1))
+                LOG.warning("elastic backoff: %.1fs before relaunch%s",
+                            delay,
+                            " (ladder reset: epoch sealed a commit)"
+                            if progressed else "")
                 time.sleep(delay)
     finally:
+        # Orphan sweep: any survivor still parked gets the explicit
+        # 'everyone out' verdict before the service dies, so it exits now
+        # instead of waiting out its poll deadline.
+        for stale_epoch in service.parked_epochs():
+            service.publish_recovery(stale_epoch, {})
         service.shutdown()

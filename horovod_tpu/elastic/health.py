@@ -39,6 +39,12 @@ class ElasticService:
       ("fetch",)                         -> ("commit", meta, payload | None)
       ("advise_evict", epoch, rank, info)-> ("ok",)  # straggler advisory
                                                      # (docs/autotune.md)
+      ("recover", epoch, rank, pid)      -> ("ok",)  # survivor parks in the
+                                                     # recovery barrier
+                                                     # (docs/recovery.md)
+      ("recover_poll", epoch, rank)      -> ("wait",)
+                                          | ("assign", env)   # warm re-entry
+                                          | ("exit", reason)  # cold: exit now
 
     plus the checkpoint plane's chunked commit streams and the gateway
     ticket journal (docs/checkpoint.md), ingested into the
@@ -71,6 +77,15 @@ class ElasticService:
         self._evict_advisories: Dict[int, dict] = {}
         self._commit: Optional[bytes] = None
         self._commit_meta: Optional[dict] = None
+        # Surgical recovery barrier (docs/recovery.md): survivors of a
+        # world fault park here instead of exiting, keyed by the epoch
+        # that FAILED — the PR 2/PR 7 fencing convention keeps a torn-down
+        # attempt's late park from joining the wrong recovery round.
+        # {failed epoch -> {rank -> pid}}; plans mirror the keying with
+        # the driver's verdict per rank (an env block = warm re-entry,
+        # absence after the plan publishes = exit).
+        self._parked: Dict[int, Dict[int, int]] = {}
+        self._recovery_plans: Dict[int, Dict[int, dict]] = {}
         # checkpoint plane (docs/checkpoint.md): the seal ledger lives
         # with the service — the driver process outlives every world
         # attempt, and with HOROVOD_CKPT_DIR set it outlives the driver
@@ -140,6 +155,25 @@ class ElasticService:
             _, key = req
             self.ckpt.journal.delete(key)
             return ("ok",)
+        if kind == "recover":
+            # No epoch gate against self._epoch: survivors of epoch E park
+            # while the driver may already be preparing epoch E+1 — the
+            # barrier is keyed by the epoch they FELL OUT OF, and stale
+            # epochs age out in begin_epoch.
+            _, epoch, rank, pid = req
+            with self._lock:
+                self._parked.setdefault(int(epoch), {})[int(rank)] = int(pid)
+            return ("ok",)
+        if kind == "recover_poll":
+            _, epoch, rank = req
+            with self._lock:
+                plan = self._recovery_plans.get(int(epoch))
+                if plan is None:
+                    return ("wait",)
+                env = plan.get(int(rank))
+            if env is None:
+                return ("exit", "slot not reused in the successor world")
+            return ("assign", env)
         if kind == "advise_evict":
             # Persistent-straggler advisory from the coordinator's
             # detector (horovod_tpu.tune.detector; docs/autotune.md).
@@ -159,6 +193,12 @@ class ElasticService:
             self._last_beat = {}
             self._departed = set()
             self._evict_advisories = {}
+            # age out recovery rounds two epochs back: epoch E's survivors
+            # park while begin_epoch(E+1) runs, so E must survive this
+            # call — anything older is a finished (or abandoned) round
+            for store in (self._parked, self._recovery_plans):
+                for old in [e for e in store if e < epoch - 1]:
+                    del store[old]
         # drop partial ckpt streams (a kill mid-commit leaves its commit
         # unsealed forever); sealed state and the journal survive
         self.ckpt.begin_epoch(epoch)
@@ -176,6 +216,57 @@ class ElasticService:
         with self._lock:
             return sorted(r for r, t in self._last_beat.items()
                           if now - t > deadline and r not in self._departed)
+
+    # -- recovery barrier (docs/recovery.md) ----------------------------------
+
+    def parked(self, epoch: int) -> Dict[int, int]:
+        """Survivors parked in epoch ``epoch``'s recovery barrier
+        (rank → pid)."""
+        with self._lock:
+            return dict(self._parked.get(epoch, {}))
+
+    def wait_parked(self, epoch: int, expected: set,
+                    deadline_s: float) -> Dict[int, int]:
+        """Wait (bounded) for ``expected`` ranks to park in epoch
+        ``epoch``'s barrier; returns whatever parked by the deadline. The
+        driver calls this AFTER the world teardown, by which point
+        survivors have usually parked already — the wait only pays out
+        when a survivor is slow through its own crash path."""
+        deadline = time.monotonic() + max(deadline_s, 0.0)
+        while True:
+            got = self.parked(epoch)
+            if expected.issubset(got) or time.monotonic() >= deadline:
+                return got
+            time.sleep(0.05)
+
+    def parked_pids(self, epoch: int) -> set:
+        """PIDs parked for ``epoch`` — the launcher's spare set during
+        teardown (a parked survivor must outlive _terminate_all)."""
+        with self._lock:
+            return set(self._parked.get(epoch, {}).values())
+
+    def publish_recovery(self, epoch: int,
+                         assignments: Dict[int, dict]) -> None:
+        """Publish epoch ``epoch``'s recovery verdicts: ranks in
+        ``assignments`` get their warm re-entry env block, every other
+        parked rank is told to exit. Publishing an empty dict is the
+        explicit 'everyone out' verdict (cold relaunch / job over)."""
+        with self._lock:
+            self._recovery_plans[int(epoch)] = {
+                int(r): dict(env) for r, env in assignments.items()}
+
+    def beating_count(self) -> int:
+        """Ranks currently beating in the live epoch — the MTTR probe's
+        'world is back' signal."""
+        with self._lock:
+            return len(self._last_beat)
+
+    def parked_epochs(self) -> List[int]:
+        """Epochs with survivors still parked — the driver's shutdown path
+        publishes the 'everyone out' verdict for each so no orphan waits
+        out its poll deadline."""
+        with self._lock:
+            return sorted(e for e, ranks in self._parked.items() if ranks)
 
     @property
     def last_commit_meta(self) -> Optional[dict]:

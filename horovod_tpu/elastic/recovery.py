@@ -1,0 +1,131 @@
+"""Worker-side half of the surgical recovery plane (docs/recovery.md).
+
+When a world fault aborts an epoch, the cold path exits every surviving
+process and pays jax import + XLA compiles + warmup again per slot. This
+module is the warm alternative: a survivor parks in the elastic driver's
+recovery barrier (``("recover", epoch, rank, pid)`` — the PR 2/PR 7
+epoch-fencing convention), tears down ONLY the control plane (the engine
+singleton; its connections and caches epoch-invalidate anyway), keeps the
+process with its devices and compiled-program caches, and polls for the
+driver's verdict:
+
+* ``("assign", env)`` — warm re-entry: apply the successor epoch's
+  ``HOROVOD_*`` env block in-process and re-run the training fn. The fn
+  object itself is REUSED (never re-fetched): jit caches key on function
+  identity, and preserving it is the whole point of staying warm.
+* ``("exit", reason)`` — the slot was not reused (rank mapping shifted,
+  warm disabled for the round, job over): exit like the cold path.
+
+The worker decides eligibility locally from env — warm must be opt-out-able
+per process and must never engage for non-elastic jobs, user-code faults
+(``world_fault`` False), or the native controller (whose binary wire has no
+re-hello path; docs/recovery.md degrade matrix).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from ..core import config as _config
+from ..core.logging import LOG
+from ..obs import flightrec as _flightrec
+from ..runner.network import BasicClient, default_secret
+
+
+def warm_enabled_env(env=os.environ) -> bool:
+    """HOROVOD_RECOVERY_WARM gate (default ON), minus the documented
+    degrades: native controller worlds go cold."""
+    raw = env.get(_config.HOROVOD_RECOVERY_WARM, "1").strip().lower()
+    if raw in ("", "0", "false"):
+        return False
+    if env.get(_config.HOROVOD_NATIVE_CONTROLLER, "").strip() not in ("", "0"):
+        return False
+    return True
+
+
+def recovery_window_s(env=os.environ) -> float:
+    raw = env.get(_config.HOROVOD_RECOVERY_WINDOW_S, "")
+    try:
+        return float(raw) if raw else 15.0
+    except ValueError:
+        return 15.0
+
+
+def maybe_recover(rank: int, record: dict) -> Optional[dict]:
+    """Park this survivor in the recovery barrier and wait for a verdict.
+
+    Returns the warm re-entry env block, or None when this process should
+    exit (ineligible, told to exit, or the driver went silent past the
+    poll deadline — the hang-proofing bound; a dead driver also ends us
+    via the parent-death watchdog)."""
+    if not warm_enabled_env():
+        return None
+    port = os.environ.get(_config.HOROVOD_ELASTIC_PORT)
+    if not port:
+        return None  # not an elastic job: nobody to park with
+    if not record.get("world_fault"):
+        return None  # user-code failure: fail fast, never relaunch
+    epoch = int(os.environ.get(_config.HOROVOD_ELASTIC_EPOCH, "0"))
+    addr = os.environ.get(_config.HOROVOD_ELASTIC_ADDR, "127.0.0.1")
+    # Tear down the control plane NOW, before parking: the successor epoch
+    # must never find a half-alive engine, and survivors unwinding their
+    # services promptly is what lets peers' reconnect windows resolve.
+    from .. import basics
+
+    try:
+        basics.shutdown()
+    except Exception:  # noqa: BLE001 - already down on most crash paths
+        pass
+    try:
+        client = BasicClient((addr, int(port)), secret=default_secret(),
+                             attempts=3, timeout_s=10.0)
+    except Exception:  # noqa: BLE001 - driver gone: cold exit
+        return None
+    try:
+        client.request(("recover", epoch, rank, os.getpid()))
+        _flightrec.record(_flightrec.EV_RECOVER_PARK, epoch)
+        LOG.warning("rank %d parked in the recovery barrier for epoch %d "
+                    "(pid %d kept warm)", rank, epoch, os.getpid())
+        # The verdict can trail the fault by the driver's survivor-wait
+        # window PLUS its relaunch backoff ladder; the deadline is a
+        # hang-proofing bound well past both.
+        deadline = time.monotonic() + 4 * recovery_window_s() + 120.0
+        while time.monotonic() < deadline:
+            try:
+                resp = client.request(("recover_poll", epoch, rank))
+            except Exception:  # noqa: BLE001 - service shut down: job over
+                return None
+            if resp[0] == "assign":
+                return dict(resp[1])
+            if resp[0] == "exit":
+                LOG.warning("rank %d leaving the recovery barrier: %s",
+                            rank, resp[1])
+                return None
+            time.sleep(0.25)
+        LOG.warning("rank %d recovery poll deadline expired; exiting cold",
+                    rank)
+        return None
+    finally:
+        client.close()
+
+
+def apply_assignment(env: dict) -> int:
+    """Apply a warm re-entry env block in-process and return the new rank.
+
+    Only ``HOROVOD_*`` / ``TPU_*`` keys are touched; keys of those
+    prefixes present in the process env but ABSENT from the block are
+    removed — critically the launcher-inherited listener fds
+    (``HOROVOD_CONTROLLER_FD`` and friends), which point at sockets the
+    dead epoch already closed and must not be adopted again."""
+    managed = ("HOROVOD_", "TPU_")
+    for key in [k for k in os.environ
+                if k.startswith(managed) and k not in env]:
+        del os.environ[key]
+    for key, val in env.items():
+        if key.startswith(managed):
+            os.environ[key] = str(val)
+    new_epoch = int(os.environ.get(_config.HOROVOD_ELASTIC_EPOCH, "0"))
+    _flightrec.record(_flightrec.EV_RECOVER_WARM, new_epoch)
+    return int(os.environ[_config.HOROVOD_RANK])

@@ -82,6 +82,10 @@ EV_TENSORWATCH = "tensorwatch"    # ordinal=batch, detail=codec:SNRdb —
 #                                   the evidence floor (docs/tensorwatch.md)
 EV_ESCALATE = "escalate"          # coordinator escalation, detail=reason
 EV_ABORT = "abort"                # rank-side abort, detail=reason
+# surgical recovery plane (docs/recovery.md)
+EV_RECOVER_PARK = "recover_park"  # ordinal=failed epoch (survivor parks)
+EV_RECOVER_WARM = "recover_warm"  # ordinal=new epoch (warm re-entry)
+EV_SUCCESSION = "succession"      # ordinal=island id (standby activates)
 
 # Cycle-ordinal-bearing kinds: the classifier's cross-rank alignment
 # ground truth (every rank joins every cycle exactly once and in order).

@@ -711,6 +711,13 @@ class ControllerService:
             else {}
         self._island_of: Dict[int, int] = {
             r: i for i, mem in self._islands.items() for r in mem}
+        # Which rank SERVES each island right now (docs/recovery.md):
+        # seeded with the planned head (lowest member), updated by every
+        # "hello_island" — after a standby succession the successor's
+        # hello re-homes the island here, so head-death attribution in
+        # _abort_for_rank tracks the LIVE head, not the plan.
+        self._island_heads: Dict[int, int] = {
+            i: min(mem) for i, mem in self._islands.items() if mem}
         # per-rendezvous-key island bookkeeping: arrival times (island
         # straggler attribution), the heads' own upstream flush ordinals
         # (the per-LEVEL PR 9 cross-check), and expansion/fold errors
@@ -922,10 +929,11 @@ class ControllerService:
         # (docs/hierarchy.md) — the aborted-ranks tag keeps the blackbox
         # classifier and the elastic blacklist attribution working.
         island = None
-        for i, mem in sorted(self._islands.items()):
-            if mem and rank == min(mem):
-                island = i
-                break
+        with self._lock:
+            for i, mem in sorted(self._islands.items()):
+                if mem and rank == self._island_heads.get(i, min(mem)):
+                    island = i
+                    break
         if island is not None:
             members = self._islands[island]
             exc = RuntimeError(
@@ -1156,10 +1164,26 @@ class ControllerService:
             return ("ok",)
         if kind == "hello_island":
             _, _, island, members = req[:4]
+            succeeded_from = None
             with self._lock:
                 self._islands[int(island)] = tuple(members)
                 self._island_of = {r: i for i, mem in
                                    self._islands.items() for r in mem}
+                prev = self._island_heads.get(int(island))
+                self._island_heads[int(island)] = rank
+                if prev is not None and prev != rank:
+                    # standby succession (docs/recovery.md): the island is
+                    # re-homed under the successor, so the old head's
+                    # pending reconnect-window verdict is superseded — it
+                    # is an island MEMBER now, served behind the new head
+                    # and invisible here; letting its timer expire would
+                    # declare a healthy world dead.
+                    self._pending_reconnect.pop(prev, None)
+                    succeeded_from = prev
+            if succeeded_from is not None:
+                LOG.warning(
+                    "island %s head succession: rank %d took over from "
+                    "rank %d", island, rank, succeeded_from)
             return ("ok",)
         if kind == "cycle":
             _, _, request_list = req
@@ -1354,7 +1378,8 @@ class ControllerService:
             return
         last_island, last_t = max(arrivals.items(), key=lambda kv: kv[1])
         spread = last_t - min(arrivals.values())
-        head = min(self._islands.get(last_island, (last_island,)))
+        head = self._island_heads.get(
+            last_island, min(self._islands.get(last_island, (last_island,))))
         _STRAGGLER_LAST.labels(rank=head, island=last_island).inc()
         _STRAGGLER_BLAME_S.labels(rank=head,
                                   island=last_island).inc(spread)
@@ -1837,7 +1862,8 @@ def _combine(resp: Response, slot: Dict[int, bytes]) -> bytes:
 
 
 def connect_with_hello(addr, secret, timeout_s, connect_attempts,
-                       hello, chaos=None, on_reconnect=None) -> BasicClient:
+                       hello, chaos=None, on_reconnect=None,
+                       fallback=None) -> BasicClient:
     """Connect and identify, retrying the connect+hello PAIR as a unit.
 
     ``on_reconnect`` is armed on the client BEFORE the hello runs: if the
@@ -1882,7 +1908,8 @@ def connect_with_hello(addr, secret, timeout_s, connect_attempts,
             # the same time-based windows as a lost hello instead of
             # escaping them (round-4 advisor).
             client = BasicClient(addr, secret=secret, timeout_s=timeout_s,
-                                 attempts=connect_attempts, chaos=chaos)
+                                 attempts=connect_attempts, chaos=chaos,
+                                 fallback=fallback)
             client.on_reconnect = on_reconnect
             hello(client)
             return client
@@ -1915,7 +1942,8 @@ def connect_with_hello(addr, secret, timeout_s, connect_attempts,
         f"controller hello failed after retries: {last}") from last
 
 
-def spawn_watch_thread(addr, secret, request_reason, on_abort) -> None:
+def spawn_watch_thread(addr, secret, request_reason, on_abort,
+                       fallback=None) -> None:
     """Shared scaffolding for both controller clients' failure-push
     channel: a daemon thread opens a second, anonymous connection and
     performs one deferred-response request via ``request_reason(client)``
@@ -1941,7 +1969,7 @@ def spawn_watch_thread(addr, secret, request_reason, on_abort) -> None:
             client = None
             try:
                 client = BasicClient(addr, secret=secret, timeout_s=None,
-                                     attempts=10)
+                                     attempts=10, fallback=fallback)
                 client.enable_keepalive()
                 failures = 0
                 reason = request_reason(client)
@@ -2018,9 +2046,16 @@ class ControllerClient:
                  timeout_s: Optional[float] = None,
                  connect_attempts: int = 100,
                  rank: Optional[int] = None,
-                 world_id: str = "") -> None:
+                 world_id: str = "",
+                 fallback=None) -> None:
+        # ``fallback``: the island's standby-head candidate set
+        # (docs/recovery.md) — reconnects that exhaust the primary fail
+        # over to the planned successor; the standby answers the
+        # re-identify hello and the request retry replays under the same
+        # seq against its fresh dedup slots.
         self._addr = addr
         self._secret = secret
+        self._fallback = fallback
         self._cycle_no = 0
         self._last_cycle = 0  # parity with the native client: the
         # last_cycle property must read 0 (not raise) before a first
@@ -2056,7 +2091,8 @@ class ControllerClient:
             self._client = connect_with_hello(
                 addr, secret, timeout_s, connect_attempts,
                 hello=lambda c: c.request(("hello", rank, world_id)),
-                chaos=self._chaos, on_reconnect=self._reconnect_hello)
+                chaos=self._chaos, on_reconnect=self._reconnect_hello,
+                fallback=fallback)
         # Sub-buffer flush pipelining (docs/tensor-fusion.md): a second,
         # dedicated connection for the DATA-side exchanges (payload /
         # sentry) so an in-flight flush parked in a coordinator rendezvous
@@ -2088,7 +2124,8 @@ class ControllerClient:
             self._connect_attempts,
             hello=lambda c: c.request(("hello", self._rank,
                                        self._world_id)),
-            chaos=data_chaos, on_reconnect=self._reconnect_hello)
+            chaos=data_chaos, on_reconnect=self._reconnect_hello,
+            fallback=self._fallback)
 
     def _reconnect_hello(self, client) -> None:
         """Re-identify after a transparent reconnect: the superseding
@@ -2210,7 +2247,7 @@ class ControllerClient:
             return None  # clean stop
 
         spawn_watch_thread(self._addr, self._secret, _request_reason,
-                           on_abort)
+                           on_abort, fallback=self._fallback)
 
     def close(self, detach: bool = True) -> None:
         """``detach=True`` (tooling/tests): clean goodbye, the departure is

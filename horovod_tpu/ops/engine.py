@@ -492,8 +492,11 @@ class Engine:
         # Hierarchical negotiation tree (docs/hierarchy.md): island heads
         # additionally host their sub-coordinator beside (not instead of)
         # anything else they run — rank 0 hosts BOTH the root service and
-        # island 0's head.
+        # island 0's head. The planned successor hosts a STANDBY twin
+        # (docs/recovery.md) that serves members only after they fail
+        # over to it.
         self._subcoord = None
+        self._standby_subcoord = None
         self._client: Optional[ControllerClient] = None
         self._negotiator = None
         self._native_controller = False  # set with use_native below
@@ -603,8 +606,17 @@ class Engine:
                             "this subset world: islands are planned over "
                             "the full launcher world only.", cfg.hierarchy)
                 else:
-                    hier = plan_topology(self._size, cfg.hierarchy,
-                                         topo.cross_size)
+                    # Head overrides (docs/recovery.md): the elastic
+                    # driver's succession verdict after a head death.
+                    # Parsed on EVERY rank from the same exported string
+                    # so the plan stays rank-identical.
+                    from .hierarchy import parse_head_overrides
+
+                    hier = plan_topology(
+                        self._size, cfg.hierarchy, topo.cross_size,
+                        head_overrides=parse_head_overrides(
+                            os.environ.get(
+                                _config.HOROVOD_ISLAND_HEADS, "")))
                     if not hier.flat and not os.environ.get(
                             _config.HOROVOD_SUBCOORD_PORT):
                         if topo.world_rank == 0:
@@ -705,7 +717,40 @@ class Engine:
                         _config.HOROVOD_SUBCOORD_PORT, "0")),
                     world_id=world_id,
                     listen_fd=int(sub_fd_env) if sub_fd_env else None,
-                    reconnect_window_s=window_s)
+                    reconnect_window_s=window_s,
+                    # After a succession the serving head may not be the
+                    # lowest member — its upstream hello must carry ITS
+                    # rank so the root's head map tracks reality.
+                    head_rank=topo.world_rank)
+            if not hier.flat and not hier.is_head(topo.world_rank) and (
+                    hier.successor_of(hier.island_of[topo.world_rank])
+                    == topo.world_rank):
+                # Planned standby head (docs/recovery.md): host a dormant
+                # twin of the island service on the standby listener the
+                # launcher pre-bound. It holds NO upstream channels until
+                # the first member request lands — a failover that never
+                # happens costs one idle listener and nothing else.
+                from .hierarchy import SubCoordinatorService
+
+                standby_fd_env = os.environ.pop(
+                    _config.HOROVOD_SUBCOORD_STANDBY_FD, None)
+                standby_port_env = os.environ.get(
+                    _config.HOROVOD_SUBCOORD_STANDBY_PORT)
+                if standby_fd_env or standby_port_env:
+                    island = hier.island_of[topo.world_rank]
+                    root_addrs = [a.strip() for a in addr.split(",")
+                                  if a.strip()]
+                    self._standby_subcoord = SubCoordinatorService(
+                        island, hier.islands[island],
+                        upstream_addr={a: (a, port) for a in root_addrs},
+                        secret=secret,
+                        port=int(standby_port_env or "0"),
+                        world_id=world_id,
+                        listen_fd=(int(standby_fd_env)
+                                   if standby_fd_env else None),
+                        reconnect_window_s=window_s,
+                        head_rank=topo.world_rank,
+                        standby=True)
             # The launcher may advertise several controller addresses
             # (comma-separated: every NIC of the controller host); the
             # client probes them and uses the first routable one.
@@ -717,6 +762,7 @@ class Engine:
             client_cls = (NativeControllerClient if use_native
                           else ControllerClient)
             addr_map = {a: (a, port) for a in addr_list}
+            client_fallback = None
             if not hier.flat:
                 # Every rank's control-plane connection — cycle/payload/
                 # sentry client, metrics publisher, clock sync, flight-
@@ -734,13 +780,33 @@ class Engine:
                             int(os.environ.get(
                                 _config.HOROVOD_SUBCOORD_PORT, "0")))
                 addr_map = {a: (a, sub_port) for a in sub_addrs}
+                # Head succession (docs/recovery.md): every island rank —
+                # the head included, whose own service a headstop drill
+                # kills under it — arms the island's planned STANDBY
+                # listener as the cycle client's fallback candidate.
+                # Tried only once every reconnect round against the
+                # primary fails, so a live head never loses a member to
+                # it. Cycle/payload/sentry wire only: the metrics
+                # publisher, clock sync, and flightrec push channels stay
+                # primary-only (their loss is a documented degrade, not a
+                # correctness hazard).
+                standby_port = (
+                    self._standby_subcoord.port
+                    if self._standby_subcoord is not None else
+                    int(os.environ.get(
+                        _config.HOROVOD_SUBCOORD_STANDBY_PORT, "0")
+                        or 0))
+                if standby_port and standby_port != sub_port:
+                    client_fallback = {
+                        a: (a, standby_port) for a in sub_addrs}
             self._client = client_cls(
                 addr_map, secret=secret,
                 timeout_s=None, rank=self._rank, world_id=world_id,
                 **({"log_stalls": self._rank == 0,
                     "stall_shutdown_s": cfg.stall_shutdown_time_s,
                     "stall_warning_s": cfg.stall_warning_time_s}
-                   if use_native else {}))
+                   if use_native else
+                   {"fallback": client_fallback}))
             if not use_native:
                 # Metrics publisher (docs/metrics.md): pushes this rank's
                 # registry snapshot to the coordinator's store on an
@@ -1518,6 +1584,11 @@ class Engine:
                 # Island head duty: before the root service (rank 0 hosts
                 # both) so the head's upstream farewell can still land.
                 self._subcoord.shutdown()
+            if self._standby_subcoord is not None:
+                # A never-activated standby farewells nothing (it holds
+                # no upstream channels); an activated one farewells like
+                # the primary it replaced.
+                self._standby_subcoord.shutdown()
             if self._service is not None:
                 self._service.shutdown()
             if self._autotuner is not None:

@@ -32,11 +32,14 @@ once — wire-registry rows per HVL401). See docs/hierarchy.md.
 
 from __future__ import annotations
 
+import os
 import threading
-from dataclasses import dataclass
+import time
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..analysis.witness import maybe_wrap as _witness_wrap
+from ..core import config as _config
 from ..core.logging import LOG
 from ..core.status import SHUT_DOWN_ERROR, format_aborted_ranks
 from ..obs.registry import registry as _metrics
@@ -83,6 +86,10 @@ RELAYED = _metrics().counter(
     "horovod_hier_relayed_total",
     "Anonymous control messages (metrics/flightrec/clock) relayed "
     "upstream by island heads")
+SUCCESSIONS = _metrics().counter(
+    "horovod_recovery_successions_total",
+    "Standby island-head activations: a successor took over serving an "
+    "island whose head's service died (docs/recovery.md)")
 
 
 # -- topology planner ---------------------------------------------------------
@@ -93,11 +100,14 @@ class Topology:
     """Resolved control-plane topology: ``islands`` maps island id to its
     sorted global member ranks ({} = flat star), ``island_of`` inverts
     it. The head of an island is its lowest rank (deterministic on every
-    process with no extra negotiation)."""
+    process with no extra negotiation) — unless ``head_overrides`` names
+    a different member, the elastic driver's succession verdict after a
+    head death (``HOROVOD_ISLAND_HEADS``, docs/recovery.md)."""
 
     mode: str
     islands: Dict[int, Tuple[int, ...]]
     island_of: Dict[int, int]
+    head_overrides: Dict[int, int] = field(default_factory=dict)
 
     @property
     def flat(self) -> bool:
@@ -108,11 +118,22 @@ class Topology:
         return len(self.islands)
 
     def head_of(self, island: int) -> int:
+        override = self.head_overrides.get(island)
+        if override is not None and override in self.islands[island]:
+            return override
         return min(self.islands[island])
 
     def is_head(self, rank: int) -> bool:
         island = self.island_of.get(rank)
         return island is not None and self.head_of(island) == rank
+
+    def successor_of(self, island: int) -> Optional[int]:
+        """The island's planned standby head: its lowest member that is
+        NOT the current head (deterministic at plan time on every
+        process), or None for a single-member island."""
+        head = self.head_of(island)
+        others = [r for r in self.islands[island] if r != head]
+        return min(others) if others else None
 
     @property
     def heads(self) -> List[int]:
@@ -122,8 +143,32 @@ class Topology:
 FLAT = Topology(mode="flat", islands={}, island_of={})
 
 
+def parse_head_overrides(raw: Optional[str]) -> Dict[int, int]:
+    """Parse ``HOROVOD_ISLAND_HEADS`` ("island:rank,island:rank") — the
+    driver-published succession plan; never set by hand. Malformed
+    entries are skipped (the env only ever carries driver output, and a
+    torn value must degrade to the planned heads, not crash launch)."""
+    out: Dict[int, int] = {}
+    for part in (raw or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            island, rank = part.split(":", 1)
+            out[int(island)] = int(rank)
+        except ValueError:
+            continue
+    return out
+
+
+def format_head_overrides(overrides: Dict[int, int]) -> str:
+    return ",".join(f"{i}:{r}" for i, r in sorted(overrides.items()))
+
+
 def plan_topology(size: int, mode: Optional[str],
-                  cross_size: int = 1) -> Topology:
+                  cross_size: int = 1,
+                  head_overrides: Optional[Dict[int, int]] = None
+                  ) -> Topology:
     """Resolve ``HOROVOD_HIERARCHY`` into a Topology.
 
     ``flat`` (or unset) keeps the star. ``auto`` derives one island per
@@ -158,8 +203,13 @@ def plan_topology(size: int, mode: Optional[str],
         return FLAT
     islands = island_partition(size, n)
     island_of = {r: i for i, mem in islands.items() for r in mem}
+    # sanitize succession overrides: only keep ones naming a real member
+    # of a real island (a stale override from a differently-sized world
+    # must degrade to the planned head, not misroute the tree)
+    overrides = {i: r for i, r in (head_overrides or {}).items()
+                 if i in islands and r in islands[i]}
     return Topology(mode=f"islands:{n}", islands=islands,
-                    island_of=island_of)
+                    island_of=island_of, head_overrides=overrides)
 
 
 # -- head-side merge ----------------------------------------------------------
@@ -396,44 +446,29 @@ class SubCoordinatorService(ControllerService):
                  bind_host: str = "127.0.0.1", world_id: str = "",
                  listen_fd: Optional[int] = None,
                  reconnect_window_s: Optional[float] = None,
-                 straggler_detector=None) -> None:
+                 straggler_detector=None,
+                 head_rank: Optional[int] = None,
+                 standby: bool = False) -> None:
         members = tuple(sorted(int(r) for r in members))
         if not members:
             raise ValueError("an island needs at least one member rank")
         self._island = int(island)
         self._members = members
-        self._head_rank = members[0]
+        self._head_rank = members[0] if head_rank is None else int(head_rank)
         self._upstream_addr = upstream_addr
-        self._up_cycle_no = 0
-        hello = ("hello_island", self._head_rank, self._island, members,
-                 world_id)
-
-        def _hello(client) -> None:
-            client.request(hello)
-
-        def _rehello(client) -> None:
-            # superseding re-identify after a transparent reconnect —
-            # the PR 4 heal, same contract as ControllerClient
-            client.bare_request(hello)
-
-        # Upstream channels BEFORE the local service goes live: members
-        # may dial the pre-bound listener the instant BasicService starts
-        # accepting, and their first cycle must find the uplink ready.
-        # Four separate connections because their parking domains differ:
-        # a cycle parked at the root (straggler wait) must never hold the
-        # connection a payload, a sentry verdict, or an abort relay needs
-        # — the same two-channel inversion PR 9 solved rank-side.
-        self._up = connect_with_hello(
-            upstream_addr, secret, None, 100, hello=_hello,
-            on_reconnect=_rehello)
-        self._up_data = connect_with_hello(
-            upstream_addr, secret, None, 100, hello=_hello,
-            on_reconnect=_rehello)
-        self._up_sentry = connect_with_hello(
-            upstream_addr, secret, None, 100, hello=_hello,
-            on_reconnect=_rehello)
-        self._up_relay = BasicClient(upstream_addr, secret=secret,
-                                     timeout_s=None, attempts=100)
+        self._up_secret = secret
+        self._up_world_id = world_id
+        self._standby = bool(standby)
+        # A standby starts with None ordinal and resyncs from its
+        # members' own ordinals on its first served cycle — the root
+        # skips None island ordinals for exactly this window.
+        self._up_cycle_no: Optional[int] = None if standby else 0
+        self._ordinal_resync = standby
+        self._cycles_seen = 0
+        self._headstop_cycle, self._partition_fault = \
+            (None, None) if standby else self._parse_recovery_faults()
+        self._up = self._up_data = self._up_sentry = None
+        self._up_relay = None
         self._up_lock = _witness_wrap(
             threading.Lock(), "ops.hierarchy.SubCoordinatorService._up")
         self._up_data_lock = _witness_wrap(
@@ -445,6 +480,18 @@ class SubCoordinatorService(ControllerService):
         self._relay_lock = _witness_wrap(
             threading.Lock(),
             "ops.hierarchy.SubCoordinatorService._relay")
+        self._activate_lock = _witness_wrap(
+            threading.Lock(),
+            "ops.hierarchy.SubCoordinatorService._activate")
+        if not standby:
+            # Upstream channels BEFORE the local service goes live:
+            # members may dial the pre-bound listener the instant
+            # BasicService starts accepting, and their first cycle must
+            # find the uplink ready. A STANDBY deliberately skips this —
+            # it must cost the root nothing until activation
+            # (docs/recovery.md), so its channels build lazily on the
+            # first member request that fails over to it.
+            self._connect_upstream()
         super().__init__(
             size=len(members),
             negotiator=Negotiator(len(members), 64 << 20),
@@ -454,6 +501,40 @@ class SubCoordinatorService(ControllerService):
             reconnect_window_s=reconnect_window_s,
             straggler_detector=straggler_detector,
             consensus_interval_steps=0)
+        if not standby:
+            self._start_upstream_watch()
+
+    def _connect_upstream(self) -> None:
+        hello = ("hello_island", self._head_rank, self._island,
+                 self._members, self._up_world_id)
+
+        def _hello(client) -> None:
+            client.request(hello)
+
+        def _rehello(client) -> None:
+            # superseding re-identify after a transparent reconnect —
+            # the PR 4 heal, same contract as ControllerClient
+            client.bare_request(hello)
+
+        # Four separate connections because their parking domains differ:
+        # a cycle parked at the root (straggler wait) must never hold the
+        # connection a payload, a sentry verdict, or an abort relay needs
+        # — the same two-channel inversion PR 9 solved rank-side.
+        self._up = connect_with_hello(
+            self._upstream_addr, self._up_secret, None, 100, hello=_hello,
+            on_reconnect=_rehello)
+        self._up_data = connect_with_hello(
+            self._upstream_addr, self._up_secret, None, 100, hello=_hello,
+            on_reconnect=_rehello)
+        self._up_sentry = connect_with_hello(
+            self._upstream_addr, self._up_secret, None, 100, hello=_hello,
+            on_reconnect=_rehello)
+        self._up_relay = BasicClient(self._upstream_addr,
+                                     secret=self._up_secret,
+                                     timeout_s=None, attempts=100)
+
+    def _start_upstream_watch(self) -> None:
+        world_id = self._up_world_id
 
         def _request_reason(client) -> Optional[str]:
             resp = client.request(("watch", world_id))
@@ -464,8 +545,91 @@ class SubCoordinatorService(ControllerService):
         # Root-abort fan-out: ONE parked watch per island (not per rank)
         # — the root's abort reason re-parks here and every member
         # watcher inherits it from the head's own watch event.
-        spawn_watch_thread(upstream_addr, secret, _request_reason,
-                           self._deliver_upstream_abort)
+        spawn_watch_thread(self._upstream_addr, self._up_secret,
+                           _request_reason, self._deliver_upstream_abort)
+
+    def _ensure_upstream(self) -> None:
+        """Standby activation (docs/recovery.md): the first member
+        request that fails over here builds the upstream channels, whose
+        ``hello_island`` under THIS head's rank supersedes the dead
+        head at the root (its reconnect-window verdict is cancelled —
+        the island lives on under its successor)."""
+        if self._up is not None:
+            return
+        with self._activate_lock:
+            if self._up is not None:
+                return
+            LOG.warning(
+                "island %d standby head (rank %d) activating: members "
+                "failed over from the dead primary", self._island,
+                self._head_rank)
+            self._connect_upstream()
+            self._start_upstream_watch()
+            SUCCESSIONS.inc()
+            from ..obs import flightrec as _flightrec
+
+            _flightrec.record(_flightrec.EV_SUCCESSION, self._island,
+                              detail=f"rank {self._head_rank}")
+            # Failover deadline: activation proves the primary's service
+            # is dead, and the succession hello just cancelled the old
+            # head's reconnect-window verdict at the root — so every
+            # member now owes THIS service a registration within the
+            # window. A live member's failover hello heals the parked
+            # verdict (the headstop drill, where the old head survives
+            # as a plain member); a member that never arrives died WITH
+            # the primary and must still abort the world, or its death
+            # has no attribution path left (docs/recovery.md).
+            window = max(self._reconnect_window_s, 0.5)
+            with self._lock:
+                deadline = time.monotonic() + window
+                missing = [r for r in self._members
+                           if r not in self._rank_conns
+                           and r not in self._pending_reconnect]
+                for r in missing:
+                    self._pending_reconnect[r] = deadline
+            for r in missing:
+                timer = threading.Timer(window + 0.05,
+                                        self._reconnect_deadline,
+                                        args=(r, deadline))
+                timer.daemon = True
+                timer.start()
+
+    def _parse_recovery_faults(self):
+        """Fault-injection hooks for the recovery chaos grid
+        (docs/recovery.md): ``HOROVOD_RECOVERY_FAULT=headstop@cycleK``
+        (or ``headstop@islandN:cycleK`` to aim at one island) stops THIS
+        island's service at upstream cycle K (primaries only; members
+        then fail over to the standby), and a
+        ``partition@islandN:cycleK:durS`` rule in ``HOROVOD_CHAOS``
+        blackholes the island<->root hop for durS seconds. Both are
+        epoch-0-only, re-checked at fire time: a warm-recovered process
+        carries the new epoch in-process and must not re-fire the fault
+        it just survived."""
+        headstop = None
+        raw = os.environ.get(_config.HOROVOD_RECOVERY_FAULT, "")
+        if raw.startswith("headstop@"):
+            body = raw[len("headstop@"):]
+            if body.startswith("island"):
+                isl, _, rest = body.partition(":")
+                try:
+                    target = int(isl[len("island"):])
+                except ValueError:
+                    target = None
+                body = rest if target == self._island else ""
+            if body.startswith("cycle"):
+                try:
+                    headstop = int(body[len("cycle"):])
+                except ValueError:
+                    headstop = None
+        partition = None
+        try:
+            from ..chaos import partition_for_island
+
+            partition = partition_for_island(self._island)
+        except Exception:  # noqa: BLE001 - a bad spec fails engine init,
+            # not here; this parse is only for the head's own trigger
+            partition = None
+        return headstop, partition
 
     # -- downward abort fan-out ------------------------------------------------
 
@@ -493,7 +657,7 @@ class SubCoordinatorService(ControllerService):
         exc = RuntimeError(
             f"rank {rank} exited mid-job. {SHUT_DOWN_ERROR} "
             f"{format_aborted_ranks([rank])}")
-        if first:
+        if first and self._up_relay is not None:
             LOG.warning(
                 "island %d: rank %d disconnected before shutdown; "
                 "escalating the death to the root coordinator",
@@ -527,12 +691,25 @@ class SubCoordinatorService(ControllerService):
 
     def _handle(self, req: Any, _sock: Any) -> Any:
         kind = req[0]
+        if self._up is None:
+            # A STANDBY's first member traffic: a member only dials here
+            # after the primary refused every reconnect round, so the
+            # arrival IS the succession verdict. Activate BEFORE
+            # dispatch — a cycle parked in the rendezvous below can only
+            # be unparked by the root's abort fan-out, which needs the
+            # upstream watch live NOW, not at the (possibly never-
+            # arriving) merged-cycle compute. An activation failure
+            # propagates as this request's error: the member's transport
+            # retry then classifies the world fault loudly instead of
+            # parking forever under a root-less standby.
+            self._ensure_upstream()
         if kind in ("metrics", "flightrec", "metrics_pull",
                     "clock_probe"):
             # verbatim relay: the root stays the single store for
             # metrics snapshots and incident tails, and the single
             # clock-probe timebase (the min-RTT filter rank-side absorbs
             # the extra hop's latency like any other network jitter)
+            self._ensure_upstream()
             RELAYED.inc()
             with self._relay_lock:
                 return self._up_relay.request(req)
@@ -560,6 +737,7 @@ class SubCoordinatorService(ControllerService):
 
     def _forward_payload(self, cycle_no: int, idx: int,
                          slot: Dict[int, bytes]) -> Preserialized:
+        self._ensure_upstream()
         with self._up_data_lock:
             combined = self._up_data.request(
                 ("payload_island", self._head_rank, self._island,
@@ -569,16 +747,88 @@ class SubCoordinatorService(ControllerService):
 
     def _forward_sentry(self, ordinal: int,
                         slot: Dict[int, bytes]) -> bytes:
+        self._ensure_upstream()
         with self._up_sentry_lock:
             return self._up_sentry.request(
                 ("sentry_island", self._head_rank, self._island,
                  ordinal, dict(slot)))
+
+    def _maybe_fire_recovery_faults(self) -> None:
+        """Fire any armed recovery-grid fault at the matching upstream
+        cycle (docs/recovery.md). Epoch gating happens HERE, not at
+        parse: a warm-recovered survivor carries the successor epoch
+        in-process, so the fault it already survived stays dark."""
+        cycle = self._cycles_seen
+        self._cycles_seen += 1
+        if self._headstop_cycle is None and self._partition_fault is None:
+            return
+        if int(os.environ.get(_config.HOROVOD_ELASTIC_EPOCH, "0") or 0):
+            return  # epoch-0 only
+        if self._headstop_cycle is not None and \
+                cycle >= self._headstop_cycle:
+            self._headstop_cycle = None
+            LOG.warning(
+                "island %d head (rank %d): HOROVOD_RECOVERY_FAULT "
+                "headstop firing at cycle %d — stopping the island "
+                "service (members fail over to the standby)",
+                self._island, self._head_rank, cycle)
+            # Farewell upstream FIRST (the root deregisters this head
+            # cleanly — a succession drill is not a death), then kill the
+            # local service and hard-close member connections so parked
+            # responses die on the wire: members see a transport fault,
+            # retry under the same seq, and fall over to the standby.
+            self.shutdown()
+            self._service.close_connections()
+            raise RuntimeError(
+                "recovery fault injection: island head service stopped "
+                "(headstop)")
+        if self._partition_fault is not None and \
+                cycle >= self._partition_fault[0]:
+            _, dur_s = self._partition_fault
+            self._partition_fault = None
+            from ..chaos import note_injection
+
+            note_injection("partition",
+                           f"island{self._island}:dur{dur_s}")
+            LOG.warning(
+                "island %d head (rank %d): chaos partition firing at "
+                "cycle %d — blackholing the island<->root hop for %.1fs",
+                self._island, self._head_rank, cycle, dur_s)
+            # Bidirectional blackhole: sever every upstream socket (the
+            # root sees EOF and starts this head's reconnect window) and
+            # hold every uplink lock for the duration so NOTHING flows on
+            # the hop — not even a member's relayed metrics push. The
+            # next upstream request after the window reconnects +
+            # re-hellos: durS inside the root's reconnect window heals
+            # bit-exact; past it the root aborts the island's members
+            # and the world warm-recovers from the last sealed epoch.
+            with self._up_lock, self._up_data_lock, \
+                    self._up_sentry_lock, self._relay_lock:
+                for client in (self._up, self._up_data, self._up_sentry,
+                               self._up_relay):
+                    try:
+                        client.sever()
+                    except Exception:  # noqa: BLE001 - broken is the goal
+                        pass
+                deadline = time.monotonic() + dur_s
+                while time.monotonic() < deadline:
+                    with self._lock:
+                        aborted = self._abort_fired
+                    if aborted:
+                        raise RuntimeError(
+                            f"island {self._island} partitioned from the "
+                            f"root past the reconnect window. "
+                            f"{SHUT_DOWN_ERROR} "
+                            f"{format_aborted_ranks(list(self._members))}")
+                    time.sleep(0.05)
 
     def _run_cycle(self, slot: Dict[int, Any],
                    key: Any = None) -> Preserialized:
         """The head's cycle compute: cross-check member ordinals, charge
         island-local straggler blame, merge, forward ONE submission, and
         re-frame the root's answer once for every member."""
+        self._ensure_upstream()
+        self._maybe_fire_recovery_faults()
         try:
             self._check_flush_ordinals(slot, key)
         except RuntimeError as exc:
@@ -602,10 +852,22 @@ class SubCoordinatorService(ControllerService):
         sub = merge_cycle(self._island, self._members, slot)
         (RAW_CYCLES if sub.raw is not None else MERGED_CYCLES).inc()
         with self._lock:
+            if self._ordinal_resync:
+                # succession: this standby never saw the island's earlier
+                # upstream cycles — adopt the count from the members' own
+                # ordinals (each member cycle was one island cycle). With
+                # nothing to adopt, stay None: the root skips None island
+                # ordinals rather than fail a healthy successor.
+                cand = [o for o in (getattr(slot[r], "flush_ordinal", None)
+                                    for r in self._members)
+                        if o is not None]
+                self._up_cycle_no = max(cand) if cand else None
+                self._ordinal_resync = False
             # the per-LEVEL flush ordinal: this head's own count of
             # upstream cycles, cross-checked island-vs-island at the root
             sub.flush_ordinal = self._up_cycle_no
-            self._up_cycle_no += 1
+            if self._up_cycle_no is not None:
+                self._up_cycle_no += 1
         with self._up_lock:
             resp = self._up.request(
                 ("island_cycle", self._head_rank, self._island, sub))
@@ -623,6 +885,8 @@ class SubCoordinatorService(ControllerService):
                              (self._up_data_lock, self._up_data),
                              (self._up_sentry_lock, self._up_sentry),
                              (self._relay_lock, self._up_relay)):
+            if client is None:
+                continue  # never-activated standby has no uplink
             try:
                 with lock:
                     client.farewell(("bye", self._head_rank))

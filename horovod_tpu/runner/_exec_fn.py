@@ -51,19 +51,47 @@ def main() -> int:
     _, payload = client.request(("fn",))
     fn, args, kwargs = cloudpickle.loads(payload)
     try:
-        result = fn(*args, **kwargs)
-        client.request(("result", rank, True, pickle.dumps(result)))
-        return 0
-    except BaseException as exc:  # noqa: BLE001 - ship failure to driver
-        # Structured failure record: the abort attribution (e.g.
-        # RanksAbortedError.ranks) rides the wire as data, not as text
-        # the driver would have to regex out of the traceback.
-        from ..core.status import failure_record
+        # Warm-survivor loop (docs/recovery.md): each iteration is one
+        # world-epoch attempt. On a world fault this process parks in the
+        # recovery barrier instead of exiting; a warm re-entry verdict
+        # re-runs the SAME fn object (never re-fetched — jit caches key
+        # on function identity, and keeping them is the point) under the
+        # successor epoch's env, against the successor epoch's driver.
+        while True:
+            try:
+                result = fn(*args, **kwargs)
+                client.request(("result", rank, True, pickle.dumps(result)))
+                return 0
+            except BaseException as exc:  # noqa: BLE001 - ship to driver
+                # Structured failure record: the abort attribution (e.g.
+                # RanksAbortedError.ranks) rides the wire as data, not as
+                # text the driver would have to regex out of the traceback.
+                from ..core.status import failure_record
 
-        client.request(("result", rank, False,
-                        pickle.dumps(failure_record(
-                            exc, traceback.format_exc()))))
-        return 1
+                record = failure_record(exc, traceback.format_exc())
+                try:
+                    client.request(("result", rank, False,
+                                    pickle.dumps(record)))
+                except Exception:  # noqa: BLE001 - best-effort: on a world
+                    # fault the driver may already be tearing this epoch
+                    # down; the recovery barrier (a different service, on
+                    # the long-lived driver process) is the channel that
+                    # must not be skipped
+                    pass
+                from ..elastic.recovery import apply_assignment, maybe_recover
+
+                assignment = maybe_recover(rank, record)
+                if assignment is None:
+                    return 1
+                rank = apply_assignment(assignment)
+                if reporter is not None:
+                    reporter.stop()
+                reporter = reporter_from_env()
+                client.close()
+                port = int(os.environ[_DRIVER_PORT_ENV])
+                client = BasicClient(("127.0.0.1", port),
+                                     secret=default_secret())
+                client.request(("register", rank))
     finally:
         if reporter is not None:
             # goodbye beat: a clean exit must not read as a death while

@@ -287,7 +287,11 @@ def launch(command: Sequence[str], np: int,
            job_timeout_s: Optional[float] = None,
            cancel_event: Optional["threading.Event"] = None,
            capture_stderr: bool = False,
-           exit_codes: Optional[Dict[int, int]] = None) -> int:
+           exit_codes: Optional[Dict[int, int]] = None,
+           spawn_ranks: Optional[Sequence[int]] = None,
+           warm_env_cb: Optional[Any] = None,
+           spare_pids_fn: Optional[Any] = None,
+           spare_grace_s: float = 0.0) -> int:
     """Run ``command`` as ``np`` ranks; return 0 or raise LaunchError.
 
     ``job_timeout_s`` bounds the WHOLE job (leave None for training runs);
@@ -297,6 +301,19 @@ def launch(command: Sequence[str], np: int,
     (``runner.run`` enables this; the CLI keeps the passthrough).
     ``exit_codes``, if given, is filled with every observed rank exit code
     (the owner can tell a silent exit-0 from a still-running rank).
+
+    Surgical recovery hooks (docs/recovery.md): ``spawn_ranks`` limits
+    actual forking to those ranks — every OTHER rank is a warm survivor
+    whose fully-built env block is handed to ``warm_env_cb(rank, env)``
+    instead of a Popen (the elastic driver publishes it through the
+    recovery barrier). Warm ranks cannot inherit pre-bound listener fds
+    across the epoch, so a warm rank 0 / island head gets a probed port
+    to bind in-process (the TOCTOU risk is accepted: a collision
+    surfaces as a prompt failure and the next round goes cold).
+    ``spare_pids_fn``/``spare_grace_s``: at teardown, wait up to the
+    grace for still-running ranks to appear in the spare set (parked
+    survivors) and leave those alive.
+
     Failure semantics follow the reference launcher stack: when any rank
     dies, the rest are terminated (mpirun behavior; also the Spark
     driver's job-group cancel, ``spark/__init__.py:181-188``), and children
@@ -306,12 +323,18 @@ def launch(command: Sequence[str], np: int,
 
     if np < 1:
         raise ValueError("np must be >= 1")
+    spawn = (set(range(np)) if spawn_ranks is None
+             else {int(r) for r in spawn_ranks})
     # TOCTOU fix: bind + listen the controller socket HERE and hand the
     # live socket to rank 0 (HOROVOD_CONTROLLER_FD) — the port cannot be
     # lost to another process between probe and bind, and early worker
     # connects park in the backlog instead of bouncing.
-    listener = _bind_controller_listener()
-    port = listener.getsockname()[1]
+    listener: Optional[socket.socket] = None
+    if 0 in spawn:
+        listener = _bind_controller_listener()
+        port = listener.getsockname()[1]
+    else:
+        port = _free_port()
     secret = make_secret()
     # Hierarchical negotiation tree (docs/hierarchy.md): resolve the
     # topology HERE so each island's sub-coordinator listener gets the
@@ -326,16 +349,44 @@ def launch(command: Sequence[str], np: int,
         os.environ.get(_config.HOROVOD_HIERARCHY, "flat"))
         or "flat").strip().lower()
     if hier_mode not in ("", "flat"):
-        from ..ops.hierarchy import plan_topology
+        from ..ops.hierarchy import (parse_head_overrides, plan_topology)
 
-        hier = plan_topology(np, hier_mode, cross_size=1)
+        # succession overrides (docs/recovery.md): after a head death the
+        # elastic driver re-plans the island under its successor and
+        # publishes the override for every subsequent epoch
+        overrides = parse_head_overrides((env_extra or {}).get(
+            _config.HOROVOD_ISLAND_HEADS,
+            os.environ.get(_config.HOROVOD_ISLAND_HEADS, "")))
+        hier = plan_topology(np, hier_mode, cross_size=1,
+                             head_overrides=overrides)
         if hier.flat:
             hier = None
     sub_listeners: Dict[int, socket.socket] = {}
+    sub_ports: Dict[int, int] = {}
+    standby_listeners: Dict[int, socket.socket] = {}
+    standby_ports: Dict[int, int] = {}
     if hier is not None:
         for island_id in sorted(hier.islands):
-            sub_listeners[island_id] = _bind_controller_listener()
-    procs: List[subprocess.Popen] = []
+            if hier.head_of(island_id) in spawn:
+                sub_listeners[island_id] = _bind_controller_listener()
+                sub_ports[island_id] = \
+                    sub_listeners[island_id].getsockname()[1]
+            else:
+                sub_ports[island_id] = _free_port()
+            # standby island-head succession (docs/recovery.md): islands
+            # with a planned successor get a second, dormant listener the
+            # successor serves — members fail over to it when the head's
+            # service dies but their own ranks survive
+            succ = hier.successor_of(island_id)
+            if succ is None:
+                continue
+            if succ in spawn:
+                standby_listeners[island_id] = _bind_controller_listener()
+                standby_ports[island_id] = \
+                    standby_listeners[island_id].getsockname()[1]
+            else:
+                standby_ports[island_id] = _free_port()
+    procs: Dict[int, subprocess.Popen] = {}
     stderr_files: Dict[int, Any] = {}
     try:
         for rank in range(np):
@@ -344,56 +395,83 @@ def launch(command: Sequence[str], np: int,
                                  env_extra=env_extra)
             popen_kwargs: Dict[str, Any] = {}
             pass_fds: tuple = ()
-            if rank == 0:
+            if rank == 0 and listener is not None:
                 env[_config.HOROVOD_CONTROLLER_FD] = str(listener.fileno())
                 pass_fds += (listener.fileno(),)
             if hier is not None:
                 island_id = hier.island_of[rank]
-                sub = sub_listeners[island_id]
                 env[_config.HOROVOD_HIERARCHY] = hier.mode
                 env[_config.HOROVOD_ISLAND] = str(island_id)
                 env[_config.HOROVOD_SUBCOORD_ADDR] = "127.0.0.1"
                 env[_config.HOROVOD_SUBCOORD_PORT] = str(
-                    sub.getsockname()[1])
-                if hier.head_of(island_id) == rank:
+                    sub_ports[island_id])
+                if hier.head_overrides:
+                    from ..ops.hierarchy import format_head_overrides
+
+                    env[_config.HOROVOD_ISLAND_HEADS] = \
+                        format_head_overrides(hier.head_overrides)
+                if hier.head_of(island_id) == rank and \
+                        island_id in sub_listeners:
                     # the island head inherits its live listener (rank 0
                     # carries BOTH the root's fd and island 0's)
+                    sub = sub_listeners[island_id]
                     env[_config.HOROVOD_SUBCOORD_FD] = str(sub.fileno())
                     pass_fds += (sub.fileno(),)
+                if island_id in standby_ports:
+                    env[_config.HOROVOD_SUBCOORD_STANDBY_PORT] = str(
+                        standby_ports[island_id])
+                    if hier.successor_of(island_id) == rank and \
+                            island_id in standby_listeners:
+                        stand = standby_listeners[island_id]
+                        env[_config.HOROVOD_SUBCOORD_STANDBY_FD] = str(
+                            stand.fileno())
+                        pass_fds += (stand.fileno(),)
+            if rank not in spawn:
+                # warm survivor: no fork — hand the env block back to the
+                # elastic driver for the recovery barrier (never contains
+                # listener-fd vars: only spawned ranks inherit fds)
+                if warm_env_cb is not None:
+                    warm_env_cb(rank, dict(env))
+                continue
             if pass_fds:
                 popen_kwargs["pass_fds"] = pass_fds
             if capture_stderr:
                 stderr_files[rank] = tempfile.TemporaryFile()
                 popen_kwargs["stderr"] = stderr_files[rank]
-            procs.append(subprocess.Popen(
+            procs[rank] = subprocess.Popen(
                 list(command), env=env,
                 start_new_session=True,  # own process group for clean kill
-                **popen_kwargs))
+                **popen_kwargs)
         # rank 0 / the heads inherited the listening sockets; drop the
         # launcher's copies so service shutdown in the workers actually
         # releases the ports
-        listener.close()
-        for sub in sub_listeners.values():
-            sub.close()
+        for sock in _all_listeners(listener, sub_listeners,
+                                   standby_listeners):
+            sock.close()
         return _wait_all(procs, job_timeout_s, cancel_event,
                          stderr_files=stderr_files, exit_codes=exit_codes)
     finally:
-        try:
-            listener.close()
-        except OSError:
-            pass
-        for sub in sub_listeners.values():
+        for sock in _all_listeners(listener, sub_listeners,
+                                   standby_listeners):
             try:
-                sub.close()
+                sock.close()
             except OSError:
                 pass
-        _terminate_all(procs)
+        _terminate_all(list(procs.values()), spare_pids_fn=spare_pids_fn,
+                       spare_grace_s=spare_grace_s)
         _replay_stderr(stderr_files)
         for fh in stderr_files.values():
             try:
                 fh.close()
             except OSError:
                 pass
+
+
+def _all_listeners(listener, *listener_maps) -> List[socket.socket]:
+    socks = [listener] if listener is not None else []
+    for m in listener_maps:
+        socks.extend(m.values())
+    return socks
 
 
 def _replay_stderr(stderr_files: Dict[int, Any],
@@ -446,13 +524,14 @@ def _evidence_grace_s() -> float:
         return 0.0
 
 
-def _wait_all(procs: List[subprocess.Popen],
+def _wait_all(procs: "Dict[int, subprocess.Popen] | List[subprocess.Popen]",
               timeout_s: Optional[float],
               cancel_event: Optional["threading.Event"] = None,
               stderr_files: Optional[Dict[int, Any]] = None,
               exit_codes: Optional[Dict[int, int]] = None) -> int:
     deadline = time.monotonic() + timeout_s if timeout_s else None
-    remaining = {rank: p for rank, p in enumerate(procs)}
+    remaining = (dict(procs) if isinstance(procs, dict)
+                 else {rank: p for rank, p in enumerate(procs)})
     # First nonzero exit observed: (rank, code, stderr tail). Raised
     # after the flight-recorder evidence grace instead of immediately —
     # a hard rank death (os._exit/SIGKILL) otherwise SIGTERMs the
@@ -492,8 +571,29 @@ def _wait_all(procs: List[subprocess.Popen],
     return 0
 
 
-def _terminate_all(procs: List[subprocess.Popen]) -> None:
+def _terminate_all(procs: List[subprocess.Popen],
+                   spare_pids_fn=None, spare_grace_s: float = 0.0) -> None:
+    spared: set = set()
+    if spare_pids_fn is not None:
+        # Surgical teardown (docs/recovery.md): ranks that parked in the
+        # recovery barrier stay ALIVE — killing them would throw away the
+        # warm state the barrier exists to preserve. Wait up to the grace
+        # for every still-running rank to either park or exit; whatever is
+        # left after that is wedged and gets the normal kill.
+        grace_deadline = time.monotonic() + max(0.0, spare_grace_s)
+        while True:
+            try:
+                spared = set(spare_pids_fn())
+            except Exception:  # noqa: BLE001 - sparing is best-effort
+                spared = set()
+            live = [p for p in procs
+                    if p.poll() is None and p.pid not in spared]
+            if not live or time.monotonic() > grace_deadline:
+                break
+            time.sleep(0.05)
     for proc in procs:
+        if proc.pid in spared:
+            continue
         if proc.poll() is None:
             try:
                 os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
@@ -501,6 +601,8 @@ def _terminate_all(procs: List[subprocess.Popen]) -> None:
                 pass
     deadline = time.monotonic() + 5.0
     for proc in procs:
+        if proc.pid in spared:
+            continue
         while proc.poll() is None and time.monotonic() < deadline:
             time.sleep(0.05)
         if proc.poll() is None:

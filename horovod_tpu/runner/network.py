@@ -570,6 +570,25 @@ class BasicService:
         self._server.shutdown()
         self._server.server_close()
 
+    def close_connections(self) -> None:
+        """Hard-close every ACCEPTED connection (``shutdown`` only stops
+        the listener). The recovery plane's succession drill needs both:
+        a head that stops serving must kill its members' established
+        connections too, or their parked requests would wait on a dead
+        service instead of failing over to the standby (docs/recovery.md).
+        Clients see a clean transport EOF and retry under the same seq."""
+        with self._conns_lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
 
 class ReconnectPolicy:
     """Bounded exponential backoff budget for transparent reconnect."""
@@ -653,11 +672,25 @@ class BasicClient:
                  retry_delay_s: float = 0.3,
                  timeout_s: Optional[float] = None,
                  chaos=None,
-                 reconnect: Optional[ReconnectPolicy] = None) -> None:
+                 reconnect: Optional[ReconnectPolicy] = None,
+                 fallback=None) -> None:
+        """``fallback``: a second candidate set (standby island-head
+        succession, docs/recovery.md) tried only during RECONNECTS, after
+        every primary candidate failed the attempt — never on the initial
+        dial, where a standby that binds before the primary would
+        otherwise win the race and activate spuriously. The first
+        successful fallback connect adopts the fallback set as the
+        client's candidates for good: a primary that died stays dead for
+        this client, and flapping back would split the request stream
+        across two services' dedup slots."""
         self._wire = Wire(secret)
         self._lock = threading.Lock()
         self._candidates: Dict[str, Tuple[str, int]] = (
             dict(addr) if isinstance(addr, dict) else {"addr": tuple(addr)})
+        self._fallback: Optional[Dict[str, Tuple[str, int]]] = (
+            None if not fallback else
+            dict(fallback) if isinstance(fallback, dict)
+            else {"addr": tuple(fallback)})
         if not self._candidates:
             raise WireError("no service addresses given (empty candidate "
                             "list — check HOROVOD_CONTROLLER_ADDR)")
@@ -710,21 +743,42 @@ class BasicClient:
                 reachable = candidates
             for intf, target in reachable.items():
                 try:
-                    sock = socket.create_connection(
-                        target, timeout=self._timeout_s)
-                    sock.settimeout(self._timeout_s)
-                    sock.setsockopt(
-                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    self.connected_intf = intf
-                    if self._chaos is not None:
-                        self._chaos.on_connected()
-                    return sock
+                    sock = self._connect_one(intf, target)
                 except OSError as exc:
                     last_err = exc
+                    continue
+                return sock
+            if reconnecting and self._fallback:
+                # Every primary candidate failed this attempt: try the
+                # standby set (docs/recovery.md). Success ADOPTS it — the
+                # succeeded head never comes back for this client.
+                for intf, target in self._fallback.items():
+                    try:
+                        sock = self._connect_one(intf, target)
+                    except OSError as exc:
+                        last_err = exc
+                        continue
+                    import logging
+
+                    logging.getLogger("horovod_tpu").warning(
+                        "failing over to standby service at %s "
+                        "(primary unreachable: %s)", target, last_err)
+                    self._candidates = dict(self._fallback)
+                    self._fallback = None
+                    return sock
             time.sleep(self._retry_delay_s)
         raise WireError(
             f"unable to connect to service at any of "
             f"{sorted(candidates.values())}: {last_err}")
+
+    def _connect_one(self, intf: str, target) -> socket.socket:
+        sock = socket.create_connection(target, timeout=self._timeout_s)
+        sock.settimeout(self._timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.connected_intf = intf
+        if self._chaos is not None:
+            self._chaos.on_connected()
+        return sock
 
     def _reconnect(self) -> None:
         """Replace a latched-broken connection: bounded exponential
@@ -823,6 +877,27 @@ class BasicClient:
         raise WireError(
             f"reconnect failed after {self._policy.attempts} attempts: "
             f"{last_err}") from last_err
+
+    def sever(self) -> None:
+        """Hard-close the live socket but keep the client USABLE: the
+        next request latches the break and reconnects normally. This is
+        the chaos partition primitive (docs/recovery.md) — the peer sees
+        a clean EOF (its reconnect window starts) while this side's
+        request path stays intact for the eventual heal. Never taken on
+        the request lock: a partition must land even while a request is
+        parked — the in-flight read dies with the socket, which is the
+        point."""
+        sock = self._sock
+        self._broken = True
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def enable_keepalive(self, idle_s: int = 60, interval_s: int = 20,
                          count: int = 3) -> None:

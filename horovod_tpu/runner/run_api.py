@@ -186,7 +186,11 @@ def _execute_world(fn, args: Tuple, kwargs: dict, np: int,
                    env_extra: Optional[Dict[str, str]] = None,
                    extra_abort_check: Optional[Callable[[], None]] = None,
                    secret: Optional[str] = None,
-                   capture_stderr: bool = True) -> List[Any]:
+                   capture_stderr: bool = True,
+                   spawn_ranks: Optional[List[int]] = None,
+                   warm_env_cb: Optional[Callable[[int, dict], None]] = None,
+                   spare_pids_fn: Optional[Callable[[], set]] = None,
+                   spare_grace_s: float = 0.0) -> List[Any]:
     """One world attempt: spawn ``np`` ranks, ship ``fn``, collect results.
 
     The building block shared by ``run`` (exactly one attempt) and
@@ -196,7 +200,13 @@ def _execute_world(fn, args: Tuple, kwargs: dict, np: int,
     long-lived services (the elastic driver's health/state store) put the
     whole job on one HMAC key. Worker stderr is captured so a dead rank's
     LaunchError carries its last output instead of surfacing as an opaque
-    result-wait timeout."""
+    result-wait timeout.
+
+    Surgical recovery pass-throughs (docs/recovery.md): ``spawn_ranks``
+    forks only those ranks — the rest are warm survivors whose env blocks
+    go to ``warm_env_cb`` and who join this world by re-registering with
+    this driver in-process; ``spare_pids_fn``/``spare_grace_s`` keep
+    freshly-parked survivors alive through this attempt's teardown."""
     import sys
 
     kwargs = kwargs or {}
@@ -219,7 +229,10 @@ def _execute_world(fn, args: Tuple, kwargs: dict, np: int,
                 launch(worker_cmd, np, env_extra=merged_env,
                        host_data_plane=use_host_data_plane,
                        cancel_event=cancel, capture_stderr=capture_stderr,
-                       exit_codes=exit_codes)
+                       exit_codes=exit_codes, spawn_ranks=spawn_ranks,
+                       warm_env_cb=warm_env_cb,
+                       spare_pids_fn=spare_pids_fn,
+                       spare_grace_s=spare_grace_s)
             except LaunchCancelled:
                 pass
             except BaseException as exc:  # noqa: BLE001
@@ -242,7 +255,12 @@ def _execute_world(fn, args: Tuple, kwargs: dict, np: int,
                 # still missing: a rank died without reporting (e.g.
                 # os._exit(0) in user code). Waiting out the timeout
                 # would be the old opaque failure mode — name the ranks.
-                missing = driver.missing_results()
+                # Warm survivors have no Popen under THIS attempt, so they
+                # never get an exit code here — their deaths are the
+                # heartbeat monitor's job (extra_abort_check), not this
+                # check's; count only ranks the launcher actually reaped.
+                missing = [r for r in driver.missing_results()
+                           if r in exit_codes]
                 if missing:
                     raise WorkerLostError(
                         missing, [exit_codes.get(r) for r in missing])

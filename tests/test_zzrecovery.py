@@ -1,0 +1,416 @@
+"""Surgical recovery plane (docs/recovery.md).
+
+Named ``test_zz*`` past the 870 s tier-1 truncation point on purpose
+(the PR 11–18 convention): the fencing / ledger / grammar units are
+cheap, but the warm-recovery worlds each spawn 4-process elastic runs
+and the dryrun certification spawns two.
+
+Coverage per the ISSUE-19 battery: the worker-side warm gate and its
+documented degrades (native controller, non-elastic jobs, user-code
+faults), the recovery-barrier epoch fencing on the elastic service
+(park, poll verdicts, begin_epoch aging), the in-process env swap of
+``apply_assignment``, the blacklist ledger's ``HOROVOD_BLACKLIST_FORGIVE_S``
+strike decay (evictions NEVER forgiven), the deterministic standby
+successor plan (``successor_of``, ``HOROVOD_ISLAND_HEADS``
+parse/format round-trip, the driver's ``_plan_successions``), the
+``partition@islandN:cycleK:durS`` chaos grammar (parse/describe/replay
+determinism, loud rejections, exclusion from the wire injector), the
+wire-registry rows for the recover/succession RPC tags, the
+metrics-summary recovery section — and, slow tier, the 4-process
+kill-one-rank warm recovery on BOTH negotiation cores plus the full
+``dryrun_recovery`` certification.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.chaos import (
+    ChaosSpecError,
+    injector_from_env,
+    parse_chaos_spec,
+    partition_for_island,
+)
+from horovod_tpu.elastic.driver import _plan_successions, _SlotLedger
+from horovod_tpu.elastic.recovery import (
+    apply_assignment,
+    recovery_window_s,
+    warm_enabled_env,
+)
+from horovod_tpu.ops.hierarchy import (
+    format_head_overrides,
+    parse_head_overrides,
+    plan_topology,
+)
+
+pytestmark = pytest.mark.recovery
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- worker-side warm gate (the degrade matrix) --------------------------------
+
+
+def test_warm_gate_default_on_and_opt_out():
+    assert warm_enabled_env({})
+    assert warm_enabled_env({"HOROVOD_RECOVERY_WARM": "1"})
+    assert not warm_enabled_env({"HOROVOD_RECOVERY_WARM": "0"})
+    assert not warm_enabled_env({"HOROVOD_RECOVERY_WARM": "false"})
+    assert not warm_enabled_env({"HOROVOD_RECOVERY_WARM": ""})
+
+
+def test_warm_gate_native_controller_degrades_to_cold():
+    # the native controller's binary wire has no re-hello path: warm
+    # must never engage there, whatever the opt-in says
+    assert not warm_enabled_env({"HOROVOD_NATIVE_CONTROLLER": "1"})
+    assert not warm_enabled_env({"HOROVOD_NATIVE_CONTROLLER": "1",
+                                 "HOROVOD_RECOVERY_WARM": "1"})
+    assert warm_enabled_env({"HOROVOD_NATIVE_CONTROLLER": "0"})
+
+
+def test_recovery_window_parse_and_defaults():
+    assert recovery_window_s({}) == 15.0
+    assert recovery_window_s({"HOROVOD_RECOVERY_WINDOW_S": "3.5"}) == 3.5
+    assert recovery_window_s({"HOROVOD_RECOVERY_WINDOW_S": "bogus"}) == 15.0
+
+
+def test_maybe_recover_refuses_outside_elastic_or_user_faults(monkeypatch):
+    from horovod_tpu.elastic.recovery import maybe_recover
+
+    # not an elastic job: nobody to park with
+    monkeypatch.delenv("HOROVOD_ELASTIC_PORT", raising=False)
+    assert maybe_recover(0, {"world_fault": True}) is None
+    # user-code failure: fail fast, never park (port present but the
+    # record says the fn itself raised)
+    monkeypatch.setenv("HOROVOD_ELASTIC_PORT", "1")
+    assert maybe_recover(0, {"world_fault": False}) is None
+
+
+def test_apply_assignment_swaps_managed_env_in_process(monkeypatch):
+    monkeypatch.setenv("HOROVOD_RANK", "3")
+    monkeypatch.setenv("HOROVOD_ELASTIC_EPOCH", "0")
+    monkeypatch.setenv("HOROVOD_CONTROLLER_FD", "7")  # dead epoch's fd
+    monkeypatch.setenv("TPU_STALE_KEY", "x")
+    monkeypatch.setenv("PATH_LIKE_UNMANAGED", "keep")
+    new_rank = apply_assignment({
+        "HOROVOD_RANK": "1", "HOROVOD_ELASTIC_EPOCH": "1",
+        "HOROVOD_CONTROLLER_ADDR": "127.0.0.1"})
+    assert new_rank == 1
+    assert os.environ["HOROVOD_ELASTIC_EPOCH"] == "1"
+    # managed keys absent from the block are REMOVED — critically the
+    # launcher-inherited listener fds of the dead epoch
+    assert "HOROVOD_CONTROLLER_FD" not in os.environ
+    assert "TPU_STALE_KEY" not in os.environ
+    # unmanaged keys are never touched
+    assert os.environ["PATH_LIKE_UNMANAGED"] == "keep"
+
+
+def test_world_epoch_reads_env_live(monkeypatch):
+    from horovod_tpu.basics import world_epoch
+
+    monkeypatch.setenv("HOROVOD_ELASTIC_EPOCH", "0")
+    assert world_epoch() == 0
+    # the warm path bumps the epoch IN-PROCESS: a cached read would
+    # re-fire epoch-0-gated chaos in the recovered world
+    monkeypatch.setenv("HOROVOD_ELASTIC_EPOCH", "2")
+    assert world_epoch() == 2
+
+
+# -- the recovery barrier (driver side, epoch fencing) -------------------------
+
+
+def _service():
+    from horovod_tpu.elastic.health import ElasticService
+    from horovod_tpu.runner.network import make_secret
+
+    return ElasticService(bytes.fromhex(make_secret()),
+                          heartbeat_interval_s=0.2, miss_limit=3)
+
+
+def test_recovery_barrier_park_poll_and_verdicts():
+    service = _service()
+    try:
+        assert service._handle(("recover", 0, 2, 4242), None) == ("ok",)
+        assert service.parked(0) == {2: 4242}
+        assert service.parked_pids(0) == {4242}
+        assert service.parked_epochs() == [0]
+        # no plan yet: poll parks
+        assert service._handle(("recover_poll", 0, 2), None) == ("wait",)
+        service.publish_recovery(0, {2: {"HOROVOD_RANK": "2"}})
+        kind, env = service._handle(("recover_poll", 0, 2), None)
+        assert kind == "assign" and env == {"HOROVOD_RANK": "2"}
+        # a parked rank NOT in the plan is told to exit
+        service._handle(("recover", 0, 3, 4243), None)
+        kind, reason = service._handle(("recover_poll", 0, 3), None)
+        assert kind == "exit" and "not reused" in reason
+        # the empty plan is the explicit everyone-out verdict
+        service.publish_recovery(0, {})
+        assert service._handle(("recover_poll", 0, 2), None)[0] == "exit"
+    finally:
+        service.shutdown()
+
+
+def test_recovery_barrier_epoch_fencing_and_aging():
+    service = _service()
+    try:
+        service._handle(("recover", 0, 1, 100), None)
+        # epoch 0's survivors park WHILE begin_epoch(1) runs: the barrier
+        # must survive exactly one successor epoch...
+        service.begin_epoch(1)
+        assert service.parked(0) == {1: 100}
+        # ...and age out after two (a finished or abandoned round)
+        service.begin_epoch(2)
+        assert service.parked(0) == {}
+        assert service.parked_epochs() == []
+        # distinct epochs are distinct barriers
+        service._handle(("recover", 2, 0, 200), None)
+        service._handle(("recover", 3, 0, 300), None)
+        assert service.parked(2) == {0: 200}
+        assert service.parked(3) == {0: 300}
+    finally:
+        service.shutdown()
+
+
+def test_wait_parked_returns_early_on_full_set():
+    import time
+
+    service = _service()
+    try:
+        service._handle(("recover", 0, 0, 10), None)
+        service._handle(("recover", 0, 1, 11), None)
+        t0 = time.monotonic()
+        got = service.wait_parked(0, {0, 1}, deadline_s=5.0)
+        assert got == {0: 10, 1: 11}
+        assert time.monotonic() - t0 < 1.0  # early exit, not the deadline
+    finally:
+        service.shutdown()
+
+
+# -- blacklist ledger: strike decay, evictions permanent -----------------------
+
+
+def test_slot_ledger_permanent_without_forgiveness():
+    ledger = _SlotLedger(np=3, limit=2, forgive_s=0.0)
+    ledger.strike(1, now=0.0)
+    ledger.strike(1, now=1.0)
+    assert ledger.active(now=2.0) == [0, 2]
+    # no decay, ever: the original PR 2 semantics
+    assert ledger.active(now=1e9) == [0, 2]
+    assert ledger.blacklisted(now=1e9) == [1]
+
+
+def test_slot_ledger_forgiveness_ages_strikes_out():
+    ledger = _SlotLedger(np=2, limit=2, forgive_s=10.0)
+    ledger.strike(0, now=0.0)
+    ledger.strike(0, now=1.0)
+    assert ledger.active(now=2.0) == [1]
+    # 10s after the FIRST strike it decays: one live strike < limit
+    assert ledger.active(now=10.5) == [0, 1]
+    assert ledger.blacklisted(now=12.0) == []
+
+
+def test_slot_ledger_evictions_are_never_forgiven():
+    ledger = _SlotLedger(np=2, limit=2, forgive_s=1.0)
+    ledger.evict(1)  # an enforced StragglerEvictError verdict
+    assert ledger.active(now=0.0) == [0]
+    assert ledger.active(now=1e9) == [0]
+    assert ledger.blacklisted(now=1e9) == [1]
+
+
+def test_blacklist_forgive_env_parse(monkeypatch):
+    from horovod_tpu.elastic.driver import _blacklist_forgive_s
+
+    monkeypatch.delenv("HOROVOD_BLACKLIST_FORGIVE_S", raising=False)
+    assert _blacklist_forgive_s() == 0.0
+    monkeypatch.setenv("HOROVOD_BLACKLIST_FORGIVE_S", "30")
+    assert _blacklist_forgive_s() == 30.0
+    monkeypatch.setenv("HOROVOD_BLACKLIST_FORGIVE_S", "junk")
+    assert _blacklist_forgive_s() == 0.0
+
+
+# -- standby succession plan (deterministic at plan time) ----------------------
+
+
+def test_successor_is_lowest_non_head_member():
+    topo = plan_topology(8, "islands:2")
+    for island, members in topo.islands.items():
+        head = topo.head_of(island)
+        assert topo.successor_of(island) == min(
+            r for r in members if r != head)
+    # a single-member island has nobody to succeed
+    solo = plan_topology(4, "islands:4")
+    assert all(solo.successor_of(i) is None for i in solo.islands)
+
+
+def test_successor_tracks_head_overrides():
+    # after succession the OLD successor is the head; the next standby
+    # must re-derive deterministically from the surviving membership
+    topo = plan_topology(8, "islands:2", head_overrides={1: 5})
+    assert topo.head_of(1) == 5
+    assert topo.successor_of(1) == min(
+        r for r in topo.islands[1] if r != 5)
+    # an override naming a rank outside the island is ignored
+    bogus = plan_topology(8, "islands:2", head_overrides={1: 0})
+    assert bogus.head_of(1) == min(bogus.islands[1])
+
+
+def test_head_overrides_parse_format_round_trip():
+    overrides = {0: 1, 1: 3}
+    raw = format_head_overrides(overrides)
+    assert raw == "0:1,1:3"
+    assert parse_head_overrides(raw) == overrides
+    assert parse_head_overrides("") == {}
+    assert parse_head_overrides(None) == {}
+    # torn values degrade to the planned heads, never crash launch
+    assert parse_head_overrides("1:3,junk,:,8") == {1: 3}
+
+
+def test_plan_successions_promotes_standby_for_dead_head():
+    env = {"HOROVOD_HIERARCHY": "islands:2"}
+    # rank 2 heads island 1 of the 4-rank world; its death promotes 3
+    out = _plan_successions({}, failed={2}, world=4, env=env)
+    assert out == {1: 3}
+    # a dead MEMBER plans nothing
+    assert _plan_successions({}, failed={3}, world=4, env=env) == {}
+    # flat worlds have no heads to succeed
+    assert _plan_successions({}, failed={2}, world=4,
+                             env={"HOROVOD_HIERARCHY": "flat"}) == {}
+    # an already-promoted head dying promotes the NEXT survivor
+    out = _plan_successions({1: 3}, failed={3}, world=4, env=env)
+    assert out == {1: 2}
+
+
+# -- partition chaos grammar ---------------------------------------------------
+
+
+def test_partition_clause_parses_and_replays_deterministically():
+    spec = "partition@island1:cycle3:dur0.4s"
+    plan = parse_chaos_spec(spec)
+    (rule,) = plan.rules
+    assert rule.kind == "partition"
+    assert rule.rank == 1          # island, in the partition grammar
+    assert rule.ordinal == 3       # cycle
+    assert rule.delay_s == pytest.approx(0.4)
+    # replay determinism: the same spec parses to the same plan, and
+    # describe() round-trips the clause for the injection note
+    again = parse_chaos_spec(spec)
+    assert again.rules[0].describe() == rule.describe()
+    ms = parse_chaos_spec("partition@island0:cycle1:dur250ms").rules[0]
+    assert ms.delay_s == pytest.approx(0.25)
+
+
+@pytest.mark.parametrize("bad", [
+    "partition@island1:cycle3",          # no duration
+    "partition@island1:cycle3:0.4s",     # missing dur prefix
+    "partition@islandX:cycle3:dur1s",    # island not an int
+    "partition@island1:cycleX:dur1s",    # cycle not an int
+    "partition@rank1:cycle3:dur1s",      # partitions target islands
+])
+def test_partition_malformed_clauses_fail_loudly(bad):
+    with pytest.raises(ChaosSpecError):
+        parse_chaos_spec(bad)
+
+
+def test_partition_excluded_from_wire_injector(monkeypatch):
+    # island-level faults fire in the sub-coordinator, not per-message:
+    # the wire injector must NOT arm them
+    monkeypatch.setenv("HOROVOD_CHAOS",
+                       "partition@island1:cycle2:dur1s")
+    injector = injector_from_env(rank=1)
+    assert injector is None or not injector._rules
+
+
+def test_partition_for_island_reads_env(monkeypatch):
+    monkeypatch.setenv("HOROVOD_CHAOS",
+                       "partition@island1:cycle3:dur0.4s")
+    assert partition_for_island(1) == (3, pytest.approx(0.4))
+    assert partition_for_island(0) is None
+    monkeypatch.setenv("HOROVOD_CHAOS", "")
+    assert partition_for_island(1) is None
+
+
+# -- registry / docs / tooling rows --------------------------------------------
+
+
+def test_wire_registry_names_recovery_rpc_tags():
+    from horovod_tpu.analysis.wire_registry import ELASTIC_RPC_TAGS
+
+    for tag in ("recover", "recover_poll"):
+        assert tag in ELASTIC_RPC_TAGS and ELASTIC_RPC_TAGS[tag].strip()
+        assert "recovery" in ELASTIC_RPC_TAGS[tag].lower()
+
+
+def test_recovery_grid_shape():
+    from horovod_tpu.chaos.matrix import RECOVERY_GRID
+
+    cells = dict(RECOVERY_GRID)
+    assert set(cells) == {"kill-rank-warm", "partition-heal",
+                          "partition-escalate", "head-kill",
+                          "succession-live"}
+    # every cell lands in exactly one certified bucket — never a hang
+    assert set(cells.values()) <= {"healed", "recovered"}
+
+
+def test_metrics_summary_renders_recovery_section(tmp_path):
+    from horovod_tpu.elastic import driver as _driver
+    from horovod_tpu.obs.registry import registry
+    from horovod_tpu.ops import hierarchy as hier
+
+    _driver._RECOVERY_WARM.inc()
+    _driver._RECOVERY_SURVIVORS.inc(3)
+    _driver._RECOVERY_MTTR.labels(mode="warm").observe(2.5)
+    hier.SUCCESSIONS.inc()
+    snap = registry().snapshot()
+    assert "horovod_recovery_warm_relaunches_total" in snap, sorted(snap)
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(snap))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "metrics_summary.py"), str(path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "recovery plane" in proc.stdout
+    assert "horovod_recovery_warm_relaunches_total" in proc.stdout
+    assert "horovod_recovery_successions_total" in proc.stdout
+
+
+def test_recovery_docs_exist_with_the_ladder_and_knobs():
+    docs = os.path.join(REPO, "docs", "recovery.md")
+    with open(docs, encoding="utf-8") as fh:
+        text = fh.read()
+    for needle in ("HOROVOD_RECOVERY_WARM", "HOROVOD_RECOVERY_WINDOW_S",
+                   "HOROVOD_BLACKLIST_FORGIVE_S", "HOROVOD_ISLAND_HEADS",
+                   "partition@island", "headstop@",
+                   "reconnect", "succession", "cold"):
+        assert needle in text, needle
+
+
+# -- multi-process warm recovery (slow tier) -----------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("native_core", [0, 1])
+def test_kill_one_rank_warm_recovers_bit_exact(native_core):
+    from horovod_tpu.chaos.matrix import run_recovery_cell
+
+    cell = run_recovery_cell("kill-rank-warm", native_core=native_core)
+    assert cell["outcome"] == "recovered", cell
+    assert cell["verdict"] == "recovered@epoch1 survivors=3/4", cell
+    by_rank = {r["rank"]: r for r in cell["results"]}
+    # bit-exact to the full-job answer, restored from a SEALED commit
+    assert all(r["w0"] == by_rank[0]["w0"] for r in cell["results"])
+    assert any("sealed" in str(r["restore"]) for r in cell["results"])
+
+
+@pytest.mark.slow
+def test_dryrun_recovery_certification():
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from __graft_entry__ import dryrun_recovery
+
+    dryrun_recovery()

@@ -58,6 +58,30 @@ if [ "${1:-}" = "--checkpoint" ]; then
   exit $rc
 fi
 
+# --recovery sweeps the recovery-plane grid (docs/recovery.md) instead:
+# kill-one-rank and partition-past-the-window must WARM-relaunch
+# (survivor PIDs unchanged, sealed restore bit-exact), the partition
+# inside the reconnect window and the headstop succession drill must
+# heal with zero relaunches, and a head kill must recover with the
+# island under its planned standby successor — never a hang. Blackbox
+# assertion rides along: a recovered cell rode a world abort, so it
+# owes a classifiable incident dump exactly like an escalation. The
+# recovery RPCs need the Python controller, so only
+# HOROVOD_NATIVE_CORE varies.
+if [ "${1:-}" = "--recovery" ]; then
+  shift
+  rc=0
+  for core in 0 1; do
+    echo "=== recovery plane: HOROVOD_NATIVE_CORE=$core ==="
+    if ! JAX_PLATFORMS=cpu HOROVOD_NATIVE_CONTROLLER=0 \
+        HOROVOD_NATIVE_CORE=$core \
+        python -m horovod_tpu.chaos.matrix --recovery --blackbox "$@"; then
+      rc=1
+    fi
+  done
+  exit $rc
+fi
+
 # --blackbox runs the flight-recorder assertion mode (docs/blackbox.md):
 # the escalation cell and the data-plane grid on both negotiation cores,
 # where every ESCALATED cell must also leave a classifiable

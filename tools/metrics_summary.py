@@ -112,6 +112,13 @@ NUMERICS_PREFIXES = ("horovod_tensorwatch_", "horovod_tensor_",
 # collapse signal the evidence gate reverts on.
 SPARSE_PREFIXES = ("horovod_sparse_",)
 
+# Recovery-plane families (docs/recovery.md): warm-vs-cold relaunch
+# counters, survivors reused, the MTTR histogram, and standby head
+# successions — the "did the last fault cost a full cold restart?"
+# glance. Warm pacing cold means survivors keep being reused; an MTTR
+# p99 near the cold relaunches' is the warm path silently degrading.
+RECOVERY_PREFIXES = ("horovod_recovery_",)
+
 # Hierarchy-plane families (docs/hierarchy.md): the resolved island
 # gauge, merged-vs-raw island cycle counters, the root's absorbed
 # message count, and head pass-throughs — the "is the negotiation tree
@@ -217,6 +224,16 @@ def _render_hier_section(families: Dict[str, dict], prefix: str,
     _render_section("hierarchy plane", hier, prefix, out)
 
 
+def _render_recovery_section(families: Dict[str, dict], prefix: str,
+                             out) -> None:
+    recovery = {n: f for n, f in families.items()
+                if n.startswith(RECOVERY_PREFIXES)
+                and n.startswith(prefix)}
+    if not recovery:
+        return  # no recovery plane in this snapshot: no empty section
+    _render_section("recovery plane", recovery, prefix, out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="pretty-print a saved /metrics.json or "
@@ -248,11 +265,13 @@ def main(argv=None) -> int:
     _render_sparse_section(world, args.family, sys.stdout)
     _render_ckpt_section(world, args.family, sys.stdout)
     _render_hier_section(world, args.family, sys.stdout)
+    _render_recovery_section(world, args.family, sys.stdout)
     _render_section("world", world, args.family, sys.stdout,
                     skip=TUNING_PREFIXES + INTEGRITY_PREFIXES
                     + SERVING_PREFIXES + FLIGHTREC_PREFIXES
                     + NUMERICS_PREFIXES + SPARSE_PREFIXES
-                    + CKPT_PREFIXES + HIER_PREFIXES)
+                    + CKPT_PREFIXES + HIER_PREFIXES
+                    + RECOVERY_PREFIXES)
     # JSON round-trips rank keys as strings; accept either
     by_rank = {int(k): v for k, v in ranks.items()}
     wanted = sorted(by_rank) if args.all else (

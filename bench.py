@@ -545,6 +545,17 @@ def _parse_args(argv=None):
                              "apply-batch and dispatch provenance lands "
                              "in the BENCH json. Governs the eager "
                              "plane; SPMD steps fuse inside XLA.")
+    parser.add_argument("--zero1", action="store_true",
+                        default=False,
+                        help="arm the ZeRO-1 partitioned-optimizer plane "
+                             "for this run (HOROVOD_ZERO=1, "
+                             "docs/sharding.md): hvd.apply_step shards "
+                             "optimizer state across ranks and flushes "
+                             "batches as one reduce-scatter+apply+"
+                             "all-gather program; zero1 batch and "
+                             "per-rank slot-residency provenance lands "
+                             "in the BENCH json. Implies the fused "
+                             "reduce+apply plane.")
     parser.add_argument("--grad-sentry", default="",
                         choices=["", "off", "warn", "skip", "zero",
                                  "abort"],
@@ -644,6 +655,7 @@ def _supervise(args) -> None:
         (["--grad-sentry", args.grad_sentry] if args.grad_sentry else []) + \
         (["--subbuffers", str(args.subbuffers)] if args.subbuffers else []) + \
         (["--fused-apply"] if args.fused_apply else []) + \
+        (["--zero1"] if args.zero1 else []) + \
         (["--tensorwatch", str(args.tensorwatch)]
          if args.tensorwatch else []) + \
         (["--hierarchy", args.hierarchy] if args.hierarchy else [])
@@ -810,6 +822,17 @@ def main() -> None:
         _log(f"fused reduce+apply armed: HOROVOD_FUSED_APPLY="
              f"{os.environ['HOROVOD_FUSED_APPLY']} (apply-batch and "
              f"dispatch provenance lands in the BENCH json)")
+
+    if args.zero1:
+        # ZeRO-1 partitioned optimizer state (docs/sharding.md): like
+        # --fused-apply, BEFORE hvd.init() reads the config; setdefault
+        # so an operator's explicit pin wins. The zero1 flush IS a
+        # fused program, so the fused-apply plane is armed alongside.
+        os.environ.setdefault("HOROVOD_ZERO", "1")
+        os.environ.setdefault("HOROVOD_FUSED_APPLY", "1")
+        _log(f"ZeRO-1 sharding armed: HOROVOD_ZERO="
+             f"{os.environ['HOROVOD_ZERO']} (zero1 batch and slot-"
+             f"residency provenance lands in the BENCH json)")
 
     if args.tensorwatch:
         # Gradient numerics observatory (docs/tensorwatch.md): like
@@ -1028,6 +1051,8 @@ def main() -> None:
         provenance["subbuffers"] = args.subbuffers
     if args.fused_apply:
         provenance["fused_apply"] = True
+    if args.zero1:
+        provenance["zero1"] = True
     if args.tensorwatch:
         provenance["tensorwatch"] = args.tensorwatch
     if args.hierarchy:
@@ -1126,6 +1151,23 @@ def main() -> None:
         batches = ap["fused_batches"] + ap["split_batches"]
         result["apply_dispatches_per_batch"] = round(
             ap["apply_dispatches"] / batches, 3) if batches else 0.0
+    if args.zero1:
+        # zero1 audit beside the number (docs/sharding.md): batches that
+        # flushed as one reduce-scatter+apply+all-gather program and this
+        # rank's resident slot bytes, read off the LIVE engine and the
+        # sharding-plane gauges (the --fused-apply pattern).
+        from horovod_tpu.obs.registry import registry as _reg
+        from horovod_tpu.ops import engine as _engine_mod
+
+        eng = _engine_mod._engine
+        ap = eng.apply_stats() if eng is not None else {
+            "exec_zero1": False, "zero1_batches": 0}
+        result["zero1_exec"] = bool(ap.get("exec_zero1"))
+        result["zero1_batches"] = ap.get("zero1_batches", 0)
+        fams = _reg().snapshot()
+        slot_fam = fams.get("horovod_shard_slot_bytes") or {}
+        samples = slot_fam.get("samples") or [{}]
+        result["zero1_slot_bytes"] = samples[0].get("value", 0)
     if args.tensorwatch:
         # numerics-observatory audit beside the number
         # (docs/tensorwatch.md): sampled-batch count off the LIVE

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""ZeRO-1 sharding benchmark: per-rank optimizer-state bytes + step time.
+
+The sharding plane's headline claim (docs/sharding.md): partitioning
+optimizer state across the world cuts each rank's slot residency to
+~1/N of the replicated footprint, while the flush stays ONE compiled
+reduce-scatter → apply → all-gather program — so the step-time cost
+beside the memory win is visible in the same table. Four cells:
+
+* ``replicated``  — the fused reduce+apply reference (HOROVOD_ZERO=0):
+  every rank applies the full tree, slots replicated everywhere.
+* ``zero1``       — HOROVOD_ZERO=1: every rank owns one contiguous shard
+  of the flattened slots; ``horovod_shard_slot_bytes`` is the residency.
+
+at world sizes 2 and 4 (``--quick`` keeps world 2 only). Adam is the
+measured rule — two slot trees, the largest replicated footprint the
+plane can halve. Slot residency is read off ONE accounting definition
+(``sharding.zero1.resident_bytes`` — the same math behind the
+``horovod_shard_slot_bytes`` gauge), not re-derived here. Final line is
+the JSON contract ``tools/bench_table.py`` renders::
+
+    python benchmarks/sharding_bench.py            # worlds 2 and 4
+    python benchmarks/sharding_bench.py --quick    # world 2, fewer rounds
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+# repo-root import, the benchmarks/ convention (run as a script)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _worker() -> None:
+    """Rank body: timed ``hvd.apply_step`` rounds over an Adam tree;
+    rank 0 reports wall seconds + this rank's slot residency."""
+    os.environ.pop("JAX_PLATFORMS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("SHARDING_BENCH_JAX_COORD"):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.distributed.initialize(
+            os.environ["SHARDING_BENCH_JAX_COORD"],
+            num_processes=int(os.environ["HOROVOD_SIZE"]),
+            process_id=int(os.environ["HOROVOD_RANK"]))
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import horovod_tpu as hvd
+    from horovod_tpu.sharding import zero1 as z1
+
+    n_tensors = int(os.environ["SHARDING_BENCH_TENSORS"])
+    n_elems = int(os.environ["SHARDING_BENCH_ELEMS"])
+    rounds = int(os.environ["SHARDING_BENCH_ROUNDS"])
+    hvd.init()
+
+    tx = hvd.DistributedOptimizer(hvd.fused_adam(1e-3))
+    params = {f"t{i}": np.full((n_elems,), 0.5, np.float32)
+              for i in range(n_tensors)}
+    opt_state = tx.init(params)
+    # deterministic per-rank gradients, so replicated and zero1 runs
+    # reduce identical sums and the step loop does identical math
+    grads = {f"t{i}": np.full((n_elems,), 0.001 * (i + 1)
+                              * (hvd.rank() + 1), np.float32)
+             for i in range(n_tensors)}
+
+    def one_round() -> None:
+        nonlocal params, opt_state
+        params, opt_state = hvd.apply_step(tx, grads, opt_state, params)
+
+    one_round()  # warm the compile cache / connections
+    one_round()
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        one_round()
+    dt = time.perf_counter() - t0
+
+    # Residency off the one accounting definition the gauge uses: shard
+    # leaves count their shard only, replicated leaves their full size.
+    slot_bytes = z1.resident_bytes(opt_state.inner.slots)
+    param_bytes = n_tensors * n_elems * 4
+    from horovod_tpu.ops.engine import get_engine
+
+    ap = get_engine().apply_stats()
+    if hvd.rank() == 0:
+        print(json.dumps({
+            "seconds": dt,
+            "steps_per_s": rounds / dt,
+            "slot_bytes": slot_bytes,
+            "param_bytes": param_bytes,
+            "zero1_batches": ap.get("zero1_batches", 0),
+            "exec_zero1": bool(ap.get("exec_zero1")),
+        }), flush=True)
+    hvd.shutdown()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_world(world: int, zero1: bool, args) -> dict:
+    port = _free_port()
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(world):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(world),
+            "HOROVOD_LOCAL_RANK": str(rank),
+            "HOROVOD_LOCAL_SIZE": str(world),
+            "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
+            "HOROVOD_CONTROLLER_PORT": str(port),
+            "HOROVOD_DATA_PLANE": "xla",
+            "HOROVOD_CYCLE_TIME": "1",
+            "HOROVOD_FUSED_APPLY": "1",
+            "HOROVOD_ZERO": "1" if zero1 else "0",
+            "SHARDING_BENCH_WORKER": "1",
+            "SHARDING_BENCH_TENSORS": str(args.tensors),
+            "SHARDING_BENCH_ELEMS": str(args.elems),
+            "SHARDING_BENCH_ROUNDS": str(args.rounds),
+            "SHARDING_BENCH_JAX_COORD": coord,
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = [p.communicate(timeout=600) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        if p.returncode != 0:
+            raise RuntimeError(f"worker failed:\n{err}")
+    return json.loads(outs[0][0].strip().splitlines()[-1])
+
+
+def _git_sha() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            timeout=10).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001 - sha is cosmetic
+        return "unknown"
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--tensors", type=int, default=16,
+                        help="parameter leaves (Adam: 2 slot trees)")
+    parser.add_argument("--elems", type=int, default=65_536,
+                        help="float32 elements per leaf (~256 KB)")
+    parser.add_argument("--rounds", type=int, default=8)
+    parser.add_argument("--quick", action="store_true",
+                        help="world 2 only, fewer rounds")
+    args = parser.parse_args()
+    if args.quick:
+        args.rounds = min(args.rounds, 4)
+
+    mb = args.tensors * args.elems * 4 / 1e6
+    worlds = (2,) if args.quick else (2, 4)
+    print(f"# sharding benchmark: {args.tensors} x "
+          f"{args.elems * 4 / 1e3:.0f} KB Adam leaves ({mb:.1f} MB "
+          f"params, {2 * mb:.1f} MB replicated slots), "
+          f"{args.rounds} rounds")
+    print(f"{'world':>5} {'mode':<11} {'steps/s':>8} {'slot MB/rank':>13} "
+          f"{'vs replicated':>14}")
+    cells = []
+    for world in worlds:
+        base_bytes = None
+        for zero1 in (False, True):
+            r = _run_world(world, zero1, args)
+            mode = "zero1" if zero1 else "replicated"
+            if base_bytes is None:
+                base_bytes = r["slot_bytes"]
+            frac = r["slot_bytes"] / base_bytes if base_bytes else 0.0
+            print(f"{world:>5} {mode:<11} {r['steps_per_s']:>8.2f} "
+                  f"{r['slot_bytes'] / 1e6:>13.2f} {frac:>13.2%}")
+            cells.append({"world": world, "mode": mode,
+                          "steps_per_s": round(r["steps_per_s"], 3),
+                          "slot_bytes": r["slot_bytes"],
+                          "slot_fraction": round(frac, 4),
+                          "zero1_batches": r["zero1_batches"],
+                          "exec_zero1": r["exec_zero1"]})
+    print("BENCH " + json.dumps({
+        "bench": "sharding", "git": _git_sha(),
+        "tensors": args.tensors, "elems": args.elems,
+        "rounds": args.rounds, "cells": cells}))
+
+
+if __name__ == "__main__":
+    if os.environ.get("SHARDING_BENCH_WORKER"):
+        _worker()
+    else:
+        main()

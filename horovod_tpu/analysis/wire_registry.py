@@ -132,6 +132,15 @@ ELASTIC_RPC_TAGS: Dict[str, str] = {
                         "ckpt_journal_put, same in-memory degrade",
     "ckpt_journal_del": "checkpoint plane: journal cleanup twin of "
                         "ckpt_journal_put, same in-memory degrade",
+    "shard_manifest": "sharding plane (docs/sharding.md): per-rank "
+                      "ZeRO-1 shard-digest vote folded into the seal "
+                      "meta as partition provenance; a driver that "
+                      "predates the tag errors the put, State warns "
+                      "once and commits proceed with the whole-tree "
+                      "digest only (the manifest never gates a seal, "
+                      "so restore semantics are unchanged). Replicated "
+                      "worlds never send it — the tag rides only "
+                      "commits of sharded state",
     "recover": "recovery plane (docs/recovery.md): a warm survivor "
                "parking in the driver's epoch-fenced recovery barrier "
                "after a world fault; a driver that predates the tag "

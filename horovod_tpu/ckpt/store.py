@@ -64,7 +64,8 @@ _JOURNAL_FILE = "journal.json"
 class _Partial:
     """One in-flight (unsealed) commit: chunk assembly + digest votes."""
 
-    __slots__ = ("meta", "world", "digests", "chunks", "n_chunks")
+    __slots__ = ("meta", "world", "digests", "chunks", "n_chunks",
+                 "shard_digests", "shard_world")
 
     def __init__(self) -> None:
         self.meta: dict = {}
@@ -72,6 +73,11 @@ class _Partial:
         self.digests: Dict[int, str] = {}
         self.chunks: Dict[int, bytes] = {}
         self.n_chunks: int = -1
+        # ZeRO-1 partition manifest (docs/sharding.md): per-rank digests
+        # of the RESIDENT shard bytes, folded into the seal meta so the
+        # partition that produced a sealed commit is on the record
+        self.shard_digests: Dict[int, str] = {}
+        self.shard_world: int = 0
 
     def complete(self) -> bool:
         if self.world <= 0 or len(self.digests) < self.world:
@@ -166,6 +172,23 @@ class SealLedger:
             callback()
         return sealed_no
 
+    def ingest_shard_manifest(self, epoch: int, ckpt_no: int, rank: int,
+                              world: int, digest: str) -> None:
+        """ZeRO-1 partition manifest vote (docs/sharding.md): each rank
+        of a sharded world digests the shard bytes it OWNS for this
+        commit. The votes are folded (``consensus.fold_digest``) into
+        the seal meta — the partition provenance a resharding restore
+        can audit — without joining the seal condition itself: the
+        sealed payload is the CANONICAL expanded tree, whose whole-tree
+        digest votes already gate the seal, so a replicated run (which
+        never sends manifests) seals exactly as before."""
+        with self._lock:
+            if not self._admit_locked(epoch, ckpt_no):
+                return
+            part = self._partials.setdefault(int(ckpt_no), _Partial())
+            part.shard_digests[int(rank)] = str(digest)
+            part.shard_world = max(part.shard_world, int(world))
+
     def _admit_locked(self, epoch: int, ckpt_no: int) -> bool:
         # Epoch fence (the beat discipline): a stream from a previous
         # world attempt is a ghost — acknowledged, ignored. Monotonic
@@ -189,6 +212,11 @@ class SealLedger:
         meta = dict(part.meta)
         meta["digest"] = next(iter(votes))
         meta["world"] = part.world
+        if part.shard_digests:
+            from ..integrity.consensus import fold_digest
+
+            meta["shard_digest"] = fold_digest(part.shard_digests)
+            meta["shard_world"] = part.shard_world
         del self._partials[ckpt_no]
         self._sealed_no = ckpt_no
         self._sealed_meta = meta

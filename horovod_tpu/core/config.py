@@ -388,13 +388,13 @@ HOROVOD_SERVING_CHAOS = "HOROVOD_SERVING_CHAOS"
 # (default 0) — the serving twin of HOROVOD_ELASTIC_FAULT.
 HOROVOD_SERVING_FAULT = "HOROVOD_SERVING_FAULT"
 
-# --- sparse top-k gradient wire (ops/sparse.py; ours, docs/compression.md) ---
+# --- sparse top-k gradient wire (ops/sparse_wire.py; ours, docs/compression.md) ---
 # Top-k fraction of the "topk" sparse codec, as a PERCENT key matching the
 # tensorwatch sparse-readiness curve: "0.1" / "1" / "10" (default "1") —
 # each fused allreduce entry ships its k = ceil(f * n) largest-magnitude
 # entries as (index, value) pairs over the reference allgather shape and
 # every rank decodes the dense mean locally. Unknown keys fail loudly at
-# codec construction (ops/sparse.py), never silently rescale.
+# codec construction (ops/sparse_wire.py), never silently rescale.
 HOROVOD_SPARSE_TOPK = "HOROVOD_SPARSE_TOPK"
 # Evidence floor of the sparse codec's gate: the fraction (0..1) of
 # gradient energy the top-k selection must certifiably cover (the
@@ -432,6 +432,23 @@ HOROVOD_FUSION_SUBBUFFERS = "HOROVOD_FUSION_SUBBUFFERS"
 # apply) additionally sits on the autotune ladder as ``fused_apply``
 # (numerics-exact, so never pinned by this env; docs/autotune.md).
 HOROVOD_FUSED_APPLY = "HOROVOD_FUSED_APPLY"
+
+# --- sharding plane (ours; docs/sharding.md) ---------------------------------
+# Mesh grammar for the 2-D GSPMD planner (sharding/meshplan.py):
+# "batch" (default) keeps the flat 1-D data-parallel world byte-
+# identically; "batch,model:K" grows a K-way named model axis (K must
+# divide the device count). The planner validates the spec loudly at
+# plan time — a typo never silently falls back to an unsharded mesh.
+HOROVOD_MESH = "HOROVOD_MESH"
+# ZeRO stage-1 partitioned optimizer state (sharding/zero1.py): "1"
+# makes apply-capable batches run reduce-scatter → local shard apply →
+# all-gather as ONE donated compiled program on the XLA device plane,
+# with each rank holding only its 1/N shard of the optimizer slots.
+# Applied parameters are bit-exact vs the replicated fused plane (the
+# single-definition ApplyRule math over a slice). Requires
+# HOROVOD_FUSED_APPLY=1 to have any effect; degrades loudly to
+# replicated execution on the host plane and in worlds of one.
+HOROVOD_ZERO = "HOROVOD_ZERO"
 
 # --- implementation selection + developer knobs (ours) -----------------------
 # Negotiation-core selection: "0" forces the pure-Python negotiator;
@@ -504,6 +521,10 @@ class Config:
     # front-end opt-in; the fused-vs-split execution strategy inside the
     # armed plane belongs to the autotune ladder, not this env
     fused_apply: bool = False
+    # sharding plane (docs/sharding.md): the 2-D mesh grammar and the
+    # ZeRO-1 partitioned-optimizer opt-in
+    mesh: str = "batch"
+    zero1: bool = False
     timeline_path: str = ""
     timeline_mark_cycles: bool = False
     timeline_all_ranks: bool = False
@@ -592,6 +613,8 @@ class Config:
             fusion_subbuffers_explicit=bool(
                 os.environ.get(HOROVOD_FUSION_SUBBUFFERS)),
             fused_apply=_env_bool(HOROVOD_FUSED_APPLY),
+            mesh=os.environ.get(HOROVOD_MESH, "batch"),
+            zero1=_env_bool(HOROVOD_ZERO),
             timeline_path=os.environ.get(HOROVOD_TIMELINE, ""),
             timeline_mark_cycles=_env_bool(HOROVOD_TIMELINE_MARK_CYCLES),
             timeline_all_ranks=_env_bool(HOROVOD_TIMELINE_ALL_RANKS),

@@ -58,6 +58,9 @@ class ElasticService:
       ("ckpt_journal_get", key)           -> ("entry", entry | None)
       ("ckpt_journal_del", key)           -> ("ok",)
 
+    plus the sharding plane's partition manifest (docs/sharding.md):
+      ("shard_manifest", epoch, no, rank, world, dig) -> ("ok",)
+
     Beats are tagged with the world epoch so a straggler from a torn-down
     attempt cannot resurrect itself into the successor world's liveness
     table. A rank is dead when its beats STOPPED: ranks that never beat at
@@ -144,6 +147,14 @@ class ElasticService:
         if kind == "ckpt_fetch":
             sealed_no, meta, payload = self.ckpt.fetch_sealed()
             return ("ckpt", sealed_no, meta, payload)
+        if kind == "shard_manifest":
+            # ZeRO-1 partition manifest (docs/sharding.md): per-rank
+            # shard-digest vote for a pending commit, folded into the
+            # seal meta. Epoch-fenced by the ledger like ckpt frames.
+            _, epoch, ckpt_no, rank, world, digest = req
+            self.ckpt.ingest_shard_manifest(epoch, ckpt_no, rank, world,
+                                            digest)
+            return ("ok",)
         if kind == "ckpt_journal_put":
             _, key, entry = req
             self.ckpt.journal.put(key, entry)

@@ -74,7 +74,14 @@ def _is_array(leaf: Any) -> bool:
 
 def _host_copy(leaf: Any) -> Any:
     """Private host-side snapshot of a leaf (D2H for jax arrays — the
-    committed copy must survive donation/deletion of the live buffers)."""
+    committed copy must survive donation/deletion of the live buffers).
+    ZeRO-1 shard leaves copy their resident shard (docs/sharding.md):
+    the snapshot stays 1/N-sized and communication-free; the canonical
+    expansion happens only on the commit persist path."""
+    from ..sharding.zero1 import ShardLeaf, is_shard
+
+    if is_shard(leaf):
+        return ShardLeaf(np.array(leaf.data, copy=True), leaf.spec)
     if _is_array(leaf):
         return np.array(np.asarray(leaf), copy=True)
     return leaf
@@ -119,6 +126,7 @@ class State:
         self._synced = False
         self._store: Optional[BasicClient] = None
         self._committer = None  # lazy ckpt.AsyncCommitter (async path)
+        self._manifest_warned = False  # warn once on old-driver degrade
         # restore provenance, set by _fetch_commit for tests/postmortems:
         # "sealed" (checkpoint-plane ledger) or "legacy" (synchronous
         # whole-tree store), plus the adopted commit number
@@ -153,6 +161,17 @@ class State:
         self._commit_no += 1
         _maybe_inject_fault(self._commit_no)
         self._committed = self._snapshot()
+        # ZeRO-1 (docs/sharding.md): the LOCAL snapshot keeps shard
+        # leaves (communication-free restore at 1/N memory); everything
+        # that leaves this process — the consensus digest, the driver
+        # push, the async stream — uses the CANONICAL expanded tree,
+        # which is byte-identical on every rank and byte-identical to
+        # what a replicated world would commit, so digest votes agree,
+        # observe_commit semantics are unchanged, and a relaunch at a
+        # DIFFERENT world size restores it by simply re-cutting. The
+        # expansion is collective (one negotiated allgather per shard
+        # leaf): every rank reaches this line each commit.
+        canonical = self._canonical_commit()
         # Consensus verification of the recovery point itself
         # (docs/integrity.md): every rank folds the committed tree's
         # digest into its live consensus window, so relaunch-and-restore
@@ -161,7 +180,7 @@ class State:
         # engine is running.
         from ..integrity.consensus import observe_commit
 
-        observe_commit(self._committed, self._commit_no)
+        observe_commit(canonical, self._commit_no)
         # flight recorder (docs/blackbox.md): the commit ordinal is the
         # restore point a postmortem reader reasons back from
         from ..obs import flightrec as _flightrec
@@ -169,9 +188,9 @@ class State:
         _flightrec.record(_flightrec.EV_COMMIT, self._commit_no,
                           aux=basics.world_epoch())
         if self._async_enabled():
-            self._submit_async()
+            self._submit_async(canonical)
         elif basics.rank() == 0:
-            self._push_commit()
+            self._push_commit(canonical)
         # both paths report the stall the TRAINING LOOP paid — the bench
         # headline (docs/checkpoint.md): ~flat vs state size when async,
         # linear when synchronous
@@ -240,7 +259,48 @@ class State:
         return _env_bool(_config.HOROVOD_CKPT_ASYNC) and \
             bool(os.environ.get(_config.HOROVOD_ELASTIC_PORT))
 
-    def _submit_async(self) -> None:
+    def _canonical_commit(self) -> Dict[str, Any]:
+        """The commit tree every byte-level consumer sees: identical to
+        ``self._committed`` for replicated state; for ZeRO-1 sharded
+        state, the expanded canonical tree (COLLECTIVE — one negotiated
+        allgather per shard leaf), plus this rank's partition-manifest
+        vote to the driver's seal ledger (best-effort: an old driver
+        errors the tag, warned once, and the commit proceeds with the
+        whole-tree digest only)."""
+        from ..sharding import zero1 as _z1
+
+        if not _z1.has_shards(self._committed):
+            return self._committed
+        from .. import ops as _ops
+
+        tag = f"zero1.commit.{world_epoch()}.{self._commit_no}"
+        canonical = {
+            key: _z1.expand_tree(val, _ops.allgather, tag=f"{tag}.{key}")
+            for key, val in self._committed.items()}
+        self._push_shard_manifest()
+        return canonical
+
+    def _push_shard_manifest(self) -> None:
+        client = self._store_client()
+        if client is None:
+            return
+        from ..sharding import zero1 as _z1
+
+        digest = _z1.shard_digest(self._committed).hex()
+        try:
+            client.request(("shard_manifest", world_epoch(),
+                            self._commit_no, basics.rank(),
+                            basics.size(), digest))
+        except Exception as exc:  # noqa: BLE001 - provenance, not safety
+            self._drop_store_client()
+            if not self._manifest_warned:
+                self._manifest_warned = True
+                LOG.warning(
+                    "shard manifest push failed: %s (driver predates the "
+                    "sharding plane? commits proceed with the whole-tree "
+                    "digest only)", exc)
+
+    def _submit_async(self, tree: Optional[Dict[str, Any]] = None) -> None:
         """Hand the committed snapshot to the background stream (every
         rank — the ledger needs the full world's digest votes to seal)."""
         from ..ckpt.committer import AsyncCommitter
@@ -252,20 +312,28 @@ class State:
             self._committer = AsyncCommitter(
                 (addr, port), rank=basics.rank(), world=basics.size(),
                 secret=default_secret())
-        self._committer.submit(self._commit_no, self._committed,
-                               world_epoch())
+        self._committer.submit(
+            self._commit_no,
+            self._committed if tree is None else tree, world_epoch())
         _flightrec.record(_flightrec.EV_CKPT_SUBMIT, self._commit_no,
                           aux=world_epoch())
 
-    def _push_commit(self) -> None:
+    def _push_commit(self, tree: Optional[Dict[str, Any]] = None) -> None:
         client = self._store_client()
         if client is None:
             return
         meta = {"commit_no": self._commit_no}
+        from ..sharding import zero1 as _z1
+
+        if _z1.has_shards(self._committed):
+            # Provenance only — the pushed tree is already canonical
+            # (expanded), so restore needs no world-size translation.
+            meta["zero1"] = {"world": basics.size()}
         try:
             client.request(("commit", world_epoch(), meta,
-                            pickle.dumps(self._committed,
-                                         protocol=pickle.HIGHEST_PROTOCOL)))
+                            pickle.dumps(
+                                self._committed if tree is None else tree,
+                                protocol=pickle.HIGHEST_PROTOCOL)))
         except Exception as exc:  # noqa: BLE001 - commits are best-effort
             self._drop_store_client()
             LOG.warning("elastic commit push failed: %s (recovery will "
@@ -358,6 +426,28 @@ class State:
         ``broadcast_object``."""
         import jax
 
+        from ..sharding import zero1 as _z1
+
+        # ZeRO-1: the pickle/broadcast wire below moves plain arrays, and
+        # every rank must flatten the SAME leaf flavors (arr_mask is
+        # computed locally). Expand sharded keys to canonical full trees
+        # first — collective, so it runs before the root-only fetch can
+        # make leaf flavors diverge — and re-localize after the merge.
+        # Each rank shards uniformly (same apply_step path), so
+        # has_shards() agrees across the world.
+        shard_templates: Dict[str, Any] = {}
+        live = self._tree()
+        if _z1.has_shards(live):
+            from .. import ops as _ops
+
+            tag = f"zero1.sync.{world_epoch()}.{self._sync_no + 1}"
+            for key, val in live.items():
+                if not _z1.has_shards(val):
+                    continue
+                shard_templates[key] = val
+                setattr(self, key, _z1.expand_tree(
+                    val, _ops.allgather, tag=f"{tag}.{key}"))
+
         if not self._synced and basics.rank() == root_rank:
             stored = self._fetch_commit()
             if stored is not None:
@@ -387,6 +477,13 @@ class State:
         tree = jax.tree_util.tree_unflatten(treedef, merged)
         for key in self._keys:
             setattr(self, key, tree[key])
+        # Re-localize keys that were sharded going in: adopt_tree cuts
+        # this rank's shard of the (now world-identical) full tree —
+        # repartitioning for the CURRENT world size, which is how an
+        # N -> N-1 relaunch reshards the last sealed commit.
+        for key, template in shard_templates.items():
+            setattr(self, key, _z1.adopt_tree(
+                template, getattr(self, key), basics.size(), basics.rank()))
         # The synced state is the recovery point (local snapshot only: a
         # push here would overwrite the driver's commit with itself).
         self._committed = self._snapshot()

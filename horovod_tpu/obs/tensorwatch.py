@@ -65,6 +65,17 @@ FAMILY_TENSOR_NORM2 = "horovod_tensor_norm2"
 FAMILY_TENSOR_PRENORM2 = "horovod_tensor_prenorm2"
 FAMILY_TENSOR_SNR = "horovod_tensor_snr_db"
 
+# Sharding-plane families (registered in ``sharding/zero1.py`` — a
+# deliberate small copy so this module's exec-fallback load never
+# imports the package). When a metrics document carries
+# ``horovod_shard_ranks`` the run is ZeRO-1 sharded, and the per-rank
+# prenorm spread above doubles as a SHARD-IMBALANCE detector: under
+# ZeRO-1 every rank both feeds its own data shard and owns a slice of
+# the optimizer state, so a rank whose pre-reduce norms persistently
+# dwarf its peers' is the rank whose partition is doing outsized work.
+FAMILY_SHARD_RANKS = "horovod_shard_ranks"
+FAMILY_SHARD_IMBALANCE = "horovod_shard_imbalance_ratio"
+
 # The knob name the evidence gate guards on the autotune ladder — a
 # deliberate small copy of ``tune.policy.KNOB_CODEC`` (cross-pinned by
 # test), so this module's exec-fallback load never imports the package.
@@ -852,14 +863,25 @@ def build_tensor_report(ranks: Dict[int, dict], top: int = 20) -> dict:
     contract, so zero-valued labels are skipped. ``spread`` is the
     max/min ratio of per-rank PRE-reduce norms — a persistent ratio far
     from 1 is the data-skew signal (one rank's shard feeds much larger
-    gradients than its peers')."""
+    gradients than its peers'). When the document carries the
+    sharding-plane families the same spread is relabeled as the
+    shard-imbalance detector (``shard_imbalance`` section)."""
     rows: Dict[str, dict] = {}
     codec_snr: Dict[str, float] = {}
     topk: Dict[str, float] = {}
+    shard_ratios: Dict[str, float] = {}
+    sharded = False
     samples = 0.0
     present = False
     for rank in sorted(ranks):
         fams = ranks[rank] or {}
+        if (fams.get(FAMILY_SHARD_RANKS) or {}).get("samples"):
+            sharded = True
+        for sample in (fams.get(FAMILY_SHARD_IMBALANCE) or
+                       {}).get("samples", []):
+            value = sample.get("value", 0)
+            if value > 0:
+                shard_ratios[str(rank)] = value
         sample_fam = fams.get(FAMILY_SAMPLES)
         if sample_fam:
             present = True
@@ -915,4 +937,14 @@ def build_tensor_report(ranks: Dict[int, dict], top: int = 20) -> dict:
         "tensor_count": len(table),
         "codec_snr_db": codec_snr,
         "topk_mass": topk,
+        # The prenorm spread, relabeled: in a ZeRO-1 world each rank's
+        # pre-reduce norm is its partition's contribution, so the same
+        # ratio that reads "data skew" replicated reads "shard
+        # imbalance" sharded. ``worst`` is the highest per-rank
+        # contribution ratio (1.0 = balanced).
+        "shard_imbalance": {
+            "sharded": sharded,
+            "per_rank": shard_ratios,
+            "worst": max(shard_ratios.values()) if shard_ratios else None,
+        },
     }

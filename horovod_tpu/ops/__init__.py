@@ -218,7 +218,8 @@ def allreduce_async(tensor: Any, average: bool = True,
 
 def fused_apply_async(grad: Any, param: Any, slots, rule, count: int,
                       name: Optional[str] = None, average: bool = True,
-                      compression=Compression.none) -> int:
+                      compression=Compression.none,
+                      zero1: bool = False) -> int:
     """Submit one gradient leaf for an apply-capable allreduce: the
     engine lands the APPLIED parameter and fresh optimizer slots from a
     fused reduce+apply program (or its split degrade) instead of
@@ -257,7 +258,7 @@ def fused_apply_async(grad: Any, param: Any, slots, rule, count: int,
         RequestType.ALLREDUCE, arr, name, codec=codec,
         apply=ApplyContext(rule=rule_obj, param=param,
                            slots=tuple(slots), count=int(count),
-                           average=average))
+                           average=average, zero1=zero1))
     with _ctx_lock:
         _handle_ctx[handle] = {"apply": True, "jax_out": _is_jax(param),
                                "engine": engine}
@@ -288,6 +289,16 @@ def apply_synchronize(handle: int):
     # to ~2x param+slot memory on the caller's long-lived state trees
     return (np.array(result.param),
             tuple(np.array(s) for s in result.slots))
+
+
+def zero1_active() -> bool:
+    """True when the running engine armed ZeRO-1 execution — config
+    opt-in AND the XLA device plane AND a world bigger than one
+    (docs/sharding.md). The runtime answer front-ends MUST consult
+    before localizing optimizer state: ``HOROVOD_ZERO=1`` alone is
+    intent, not capability, and shard slots submitted to an unarmed
+    engine fail loudly."""
+    return bool(getattr(get_engine(), "_zero1_exec", False))
 
 
 # -- allgather ----------------------------------------------------------------

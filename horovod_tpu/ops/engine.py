@@ -199,9 +199,16 @@ class ApplyContext:
 
     rule: Any  # fused_apply.ApplyRule
     param: Any  # np.ndarray | jax.Array
-    slots: tuple  # rule.nslots leaves, same shape as param
+    slots: tuple  # rule.nslots leaves, same shape as param — or, when
+    # ``zero1`` is set, this rank's 1-D shard of each slot
     count: int
     average: bool = True
+    # ZeRO-1 submission (docs/sharding.md): slots are this rank's shard
+    # rows and the batch must run the reduce-scatter → shard-apply →
+    # all-gather program. Rank-local routing state — it never rides the
+    # wire (the negotiated fingerprint + the init-pinned exec flag keep
+    # the fused/zero1 decision rank-identical), so no registry row.
+    zero1: bool = False
 
 
 class ApplyResult:
@@ -968,7 +975,27 @@ class Engine:
         # collective programs, so the value is pinned at init
         # (_apply_tuned_knobs ignores the retune there, warned once).
         self._fused_apply_exec = True
-        self._apply_counts = {"fused": 0, "split": 0, "dispatches": 0}
+        # ZeRO-1 execution capability (docs/sharding.md): init-pinned
+        # like the device plane's fused_apply strategy — the sharded and
+        # replicated programs issue DIFFERENT compiled collectives, so
+        # the decision must be rank-identical for the life of the world.
+        # Requires the XLA device plane (the reduce-scatter/all-gather
+        # pair is a compiled program, not a TCP exchange) and a world
+        # big enough to shard; the front-end consults this through
+        # ops.zero1_active() before localizing any state, so an unarmed
+        # world simply keeps replicated slots.
+        self._zero1_exec = bool(cfg.zero1) and self._plane is not None \
+            and self._size > 1
+        if cfg.zero1 and not self._zero1_exec:
+            LOG.warning(
+                "HOROVOD_ZERO=1 requested but not armed (%s): optimizer "
+                "state stays replicated; applied parameters are "
+                "identical either way.",
+                "world of one" if self._size <= 1
+                else "host data plane — ZeRO-1 needs the XLA device "
+                     "plane")
+        self._apply_counts = {"fused": 0, "split": 0, "dispatches": 0,
+                              "zero1": 0}
         self._flush_worker: Optional[_DevicePlaneWorker] = None
         self._flush_clock: Optional[_FlushClock] = None
         self._inflight: "deque" = deque()
@@ -2439,9 +2466,45 @@ class Engine:
         uniform = all(c is not None for c in ctxs) and len(
             {(c.rule.fingerprint, c.count, c.average)
              for c in ctxs if c is not None}) == 1
+        # ZeRO-1 batch (docs/sharding.md): every context carries shard
+        # slots and the init-pinned capability is armed. A MIXED batch
+        # (some shard, some full) is a submission bug — shard slots
+        # cannot take the split path (their shapes are 1/N of the leaf),
+        # so it must fail loudly, never degrade.
+        zero1 = self._zero1_exec and uniform and \
+            all(c.zero1 for c in ctxs)
+        if not zero1 and any(c is not None and c.zero1 for c in ctxs):
+            raise RuntimeError(
+                f"ZeRO-1 batch cannot execute: zero1 submissions mixed "
+                f"with non-zero1 contexts or the capability is unarmed "
+                f"(exec={self._zero1_exec}) for batch "
+                f"{[e.name for e in entries]}")
         fused = bool(fingerprint) and uniform and self._fused_apply_exec
+        if zero1 and not fused and uniform and self._fused_apply_exec:
+            # the native controller wire predates the fingerprint field,
+            # so its responses cannot negotiate the fused kind — but a
+            # zero1 batch has no split fallback (shard slots), and the
+            # rank-side uniformity decision is deterministic and
+            # rank-identical (same replicated front-end state, same
+            # init-pinned flags), so arming fused here is safe on every
+            # rank at once.
+            self._warn_apply_once(
+                "zero1-wire",
+                "ZeRO-1 batch on a controller wire without the apply "
+                "fingerprint field: arming the fused route from "
+                "rank-side uniformity (deterministic on every rank).")
+            fused = True
         if fused and _is_sparse_codec(
                 getattr(resp, "tensor_codec", "none")):
+            if zero1:
+                # shard slots cannot take the split path the sparse
+                # downgrade needs; apply_step's fusable gate keeps
+                # sparse codecs off the zero1 route, so reaching here
+                # means a mid-run codec change — fail loudly.
+                raise RuntimeError(
+                    "ZeRO-1 batches cannot ride a sparse (top-k) codec; "
+                    "keep HOROVOD_ZERO=1 runs on a dense or quantized "
+                    "compression")
             # Sparse batches downgrade to the two-dispatch split (the
             # existing _downgrade_codec composition rule): the sparse
             # decode is a gather+scatter, not a psum, so it cannot ride
@@ -2464,7 +2527,8 @@ class Engine:
                 _flightrec.EV_FUSED_APPLY,
                 ordinal=-1 if cycle_no is None else cycle_no,
                 detail=("fused:" if fused else "split:") + fingerprint[:16])
-        if fused and fingerprint != ctxs[0].rule.fingerprint:
+        if fused and fingerprint and \
+                fingerprint != ctxs[0].rule.fingerprint:
             # the coordinator negotiated a different apply program than
             # this rank submitted — a bug, never a silent divergence
             raise RuntimeError(
@@ -2531,7 +2595,40 @@ class Engine:
         need_views = self._consensus_acc is not None or \
             self._sentry is not None or \
             (watch is not None and watch.sampling)
-        if self._plane is not None and self._plane.supports(
+        if zero1:
+            # ZeRO-1 device route (docs/sharding.md): shard-major
+            # packing, then ONE compiled reduce-scatter → shard-apply →
+            # all-gather dispatch with donated param/slot buckets. The
+            # host-side interleave forces a D2H per leaf — acceptable on
+            # the proof surface; device-resident packing is the
+            # follow-on optimization the layout was designed for.
+            from ..sharding import zero1 as _z1
+
+            sh_lens = [_z1.shard_len(n, self._size) for n in sizes]
+            sbucket = _next_bucket(int(sum(sh_lens)))
+            grad_rows = _z1.pack_rows(
+                [np.asarray(e.array) for e in entries],
+                self._size, sbucket)
+            param_full = _z1.pack_rows(
+                [c.param for c in ctxs], self._size, sbucket)
+            slot_rows = [
+                _z1.pack_shard_row([c.slots[k] for c in ctxs], sbucket)
+                for k in range(rule.nslots)]
+            red_rows, newp_rows, nan, inf, slot_out_rows = \
+                self._device_call(
+                    self._plane.reduce_scatter_apply, grad_rows,
+                    param_full, count, slot_rows, rule, codec, gate,
+                    denom)
+            new_p_leaves = _z1.unpack_rows(
+                np.asarray(newp_rows), shapes, self._size, sbucket)
+            _z1.record_imbalance(grad_rows, np.asarray(red_rows),
+                                 self._size)
+            slot_shards = [_z1.split_shard_row(np.asarray(r), sh_lens)
+                           for r in slot_out_rows]
+            red_leaves = _z1.unpack_rows(
+                np.asarray(red_rows), shapes, self._size, sbucket) \
+                if need_views else None
+        elif self._plane is not None and self._plane.supports(
                 dtype_of(entries[0].array)):
             # device route: pack grad/param/slot buckets, ONE compiled
             # psum+apply dispatch with donated buckets
@@ -2611,15 +2708,24 @@ class Engine:
             read = lambda buf, shape, n, off: \
                 buf[off:off + n].reshape(shape)  # noqa: E731
         self._apply_counts["fused"] += 1
+        if zero1:
+            self._apply_counts["zero1"] += 1
         self._apply_counts["dispatches"] += 1
-        _REDUCE_APPLY_BATCHES.labels(mode="fused").inc()
+        _REDUCE_APPLY_BATCHES.labels(
+            mode="zero1" if zero1 else "fused").inc()
         _APPLY_DISPATCHES.inc()
         names = [e.name for e in entries]
         if need_views:
-            views, off = [], 0
-            for shape, n in zip(shapes, sizes):
-                views.append(red_host[off:off + n].reshape(shape))
-                off += n
+            if zero1:
+                # the program all-gathers the raw reduced bucket, so
+                # every rank digests identical PRE-apply bytes — the
+                # same consensus framing as the replicated routes
+                views = red_leaves
+            else:
+                views, off = [], 0
+                for shape, n in zip(shapes, sizes):
+                    views.append(red_host[off:off + n].reshape(shape))
+                    off += n
             if watch is not None and watch.sampling:
                 # numerics observatory: the reduced gradients as
                 # received, PRE-apply (the consensus framing)
@@ -2638,25 +2744,52 @@ class Engine:
                                                        int(inf)))
                 if gate and int(nan) + int(inf) == 0 and \
                         len(self._sentry.trips) > trips_before:
-                    # The COLLECTIVE verdict says bad but this rank's
-                    # local census was clean — a peer-divergent reduced
-                    # buffer (the sentry's "peer" kind): the in-program
-                    # gate fired on the bad rank but not here, so the
-                    # full update already landed locally. Recompute the
-                    # zero-gradient step from the UNTOUCHED submission
-                    # contexts (collective-free — never a psum re-run)
-                    # so every rank converges on the identical no-op
-                    # update the gated rank applied.
-                    new_p, new_slots = self._zero_grad_apply(
-                        rule, ctxs, sizes, total, bucket, count, denom)
-                    read = lambda buf, shape, n, off: \
-                        buf[off:off + n].reshape(shape)  # noqa: E731
-        results, off = [], 0
-        for shape, n in zip(shapes, sizes):
-            results.append(ApplyResult(
-                read(new_p, shape, n, off),
-                tuple(read(s, shape, n, off) for s in new_slots)))
-            off += n
+                    if zero1:
+                        # the sharded program's census is already
+                        # GLOBAL (shard counts psum-med in-program), so
+                        # every rank's gate fired on the same collective
+                        # verdict — a trip with a clean global census
+                        # means the sentry's exchange saw something the
+                        # census cannot express; consensus names the
+                        # divergence, and a collective-free local
+                        # rewrite is impossible without peer slot
+                        # shards, so keep the landed result.
+                        self._warn_apply_once(
+                            "zero1-trip",
+                            "sentry tripped on a ZeRO-1 batch with a "
+                            "clean global census; keeping the landed "
+                            "update (the in-program gate verdict is "
+                            "already collective under ZeRO-1).")
+                    else:
+                        # The COLLECTIVE verdict says bad but this
+                        # rank's local census was clean — a
+                        # peer-divergent reduced buffer (the sentry's
+                        # "peer" kind): the in-program gate fired on
+                        # the bad rank but not here, so the full update
+                        # already landed locally. Recompute the
+                        # zero-gradient step from the UNTOUCHED
+                        # submission contexts (collective-free — never
+                        # a psum re-run) so every rank converges on the
+                        # identical no-op update the gated rank
+                        # applied.
+                        new_p, new_slots = self._zero_grad_apply(
+                            rule, ctxs, sizes, total, bucket, count,
+                            denom)
+                        read = lambda buf, shape, n, off: \
+                            buf[off:off + n].reshape(shape)  # noqa: E731
+        if zero1:
+            results = [
+                ApplyResult(new_p_leaves[i],
+                            tuple(slot_shards[k][i]
+                                  for k in range(rule.nslots)))
+                for i in range(len(entries))]
+        else:
+            results, off = [], 0
+            for shape, n in zip(shapes, sizes):
+                results.append(ApplyResult(
+                    read(new_p, shape, n, off),
+                    tuple(read(s, shape, n, off) for s in new_slots)))
+                off += n
         for e in entries:
             tl.activity_end(e.name)
         return results
@@ -2698,8 +2831,10 @@ class Engine:
         ran)."""
         return {
             "exec_fused": self._fused_apply_exec,
+            "exec_zero1": self._zero1_exec,
             "fused_batches": self._apply_counts["fused"],
             "split_batches": self._apply_counts["split"],
+            "zero1_batches": self._apply_counts["zero1"],
             "apply_dispatches": self._apply_counts["dispatches"],
         }
 

@@ -136,12 +136,31 @@ class ApplyRule:
 
         nans = jnp.isnan(g).sum()
         infs = (~jnp.isfinite(g)).sum() - nans
+        new_p, new_slots = self.shard_apply_body(g, p, count, slots,
+                                                 gate, denom, nans, infs)
+        return (new_p, nans, infs) + tuple(new_slots)
+
+    def shard_apply_body(self, g, p, count, slots: Tuple, gate: bool,
+                         denom: int, nans, infs) -> Tuple[Any, Tuple]:
+        """The gate→divide→update tail of :meth:`apply_body`, with the
+        nonfinite census supplied by the caller — the ZeRO-1 sharded
+        program computes the census over its reduce-scattered shard and
+        psums it to the GLOBAL batch counts before gating, so every
+        rank's shard gates on the identical collective verdict. Same jnp
+        expressions in the same order as the replicated body: a shard of
+        the bucket lands bit-identically to the same slice of the
+        replicated bucket's output (elementwise math, scalar
+        hyperparameters).
+
+        Returns ``(new_p, new_slots)``."""
+        import jax.numpy as jnp
+
         if gate:
             g = jnp.where(nans + infs > 0, jnp.zeros_like(g), g)
         if denom != 1:
             g = g / denom
         u, new_slots = self.update_math(g, count, slots)
-        return (p + u, nans, infs) + tuple(new_slots)
+        return p + u, tuple(new_slots)
 
 
 class FusedApplyState(NamedTuple):

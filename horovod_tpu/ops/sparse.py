@@ -1,75 +1,20 @@
-"""Sparse (indexed-slices) gradient support via allgather.
+"""DEPRECATED location — ``ops/sparse_wire.py`` owns sparse gradients now.
 
-Rebuild of the reference's only sparse path: TF ``tf.IndexedSlices``
-gradients are allreduced as allgather(values) + allgather(indices)
-(``tensorflow/__init__.py:72-83``) — summing is deferred to whoever applies
-the slices, and duplicate indices across ranks are legal. JAX has no
-IndexedSlices type; embedding-style gradients appear as (indices, values)
-pairs, modeled here by ``IndexedSlices``.
+This module is a compatibility shim (the ``checkpoint.py`` precedent):
+the tf.IndexedSlices rebuild — allgather(values) + allgather(indices)
+with summing deferred to densify, Horovod's only sparse path
+(``tensorflow/__init__.py:72-83``) — moved verbatim to
+:mod:`horovod_tpu.ops.sparse_wire` when the top-k sparse wire landed
+(docs/compression.md §sparse), so there is exactly one sparse-gradient
+implementation. ``IndexedSlices``/``allreduce_sparse`` keep working from
+here unchanged; new code should import ``ops.sparse_wire`` — which also
+carries what this module never had: the top-k selection, the
+error-feedback residual, and the byte-exact wire decode both the engine
+and the consensus authority screen.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Any, Optional, Tuple
+from .sparse_wire import IndexedSlices, allreduce_sparse  # noqa: F401
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from . import allgather, allgather_async, spmd, synchronize
-
-
-@dataclass
-class IndexedSlices:
-    """A sparse tensor: ``values[i]`` belongs to row ``indices[i]`` of a
-    dense tensor of shape ``dense_shape`` (mirror of tf.IndexedSlices)."""
-
-    indices: Any   # int array [n]
-    values: Any    # array [n, ...]
-    dense_shape: Tuple[int, ...]
-
-    def to_dense(self):
-        out = jnp.zeros(self.dense_shape,
-                        dtype=jnp.asarray(self.values).dtype)
-        return out.at[jnp.asarray(self.indices)].add(
-            jnp.asarray(self.values))
-
-
-def allreduce_sparse(slices: IndexedSlices, average: bool = True,
-                     name: Optional[str] = None,
-                     axis_name: Optional[spmd.AxisName] = None) -> IndexedSlices:
-    """Allreduce an IndexedSlices by gathering every rank's (indices,
-    values); duplicate rows sum when densified. ``average`` scales values by
-    1/size, matching the dense allreduce contract
-    (``tensorflow/__init__.py:76-83``)."""
-    name = name or "allreduce_sparse"
-    if axis_name is not None:
-        gathered_values = spmd.allgather(slices.values, axis_name)
-        gathered_indices = spmd.allgather(
-            jnp.asarray(slices.indices).reshape(-1, 1), axis_name).reshape(-1)
-        if average:
-            from jax import lax
-
-            # Divide by the product of ALL named axis sizes: a tuple
-            # axis_name gathers size(a)·size(b)·… contributions, so
-            # scaling by only the first axis under-divides multi-axis
-            # meshes (pinned by tests/test_zzsparse.py).
-            denom = 1
-            for ax in ((axis_name,) if isinstance(axis_name, str)
-                       else tuple(axis_name)):
-                denom = denom * lax.axis_size(ax)
-            gathered_values = gathered_values / denom
-        return IndexedSlices(gathered_indices, gathered_values,
-                             slices.dense_shape)
-
-    from .. import basics
-
-    values_handle = allgather_async(slices.values, name=f"{name}.values")
-    indices_handle = allgather_async(
-        np.asarray(slices.indices).reshape(-1, 1), name=f"{name}.indices")
-    values = synchronize(values_handle)
-    indices = np.asarray(synchronize(indices_handle)).reshape(-1)
-    if average:
-        values = values / basics.size()
-    return IndexedSlices(indices, values, slices.dense_shape)
+__all__ = ["IndexedSlices", "allreduce_sparse"]

@@ -1,4 +1,4 @@
-"""Host-side wire math of the top-k sparse gradient codec.
+"""The sparse gradient home: top-k wire math + indexed-slices allgather.
 
 ONE module holds the byte layout and the decode arithmetic of the sparse
 wire, because two very different callers must agree bit-for-bit on both:
@@ -26,11 +26,21 @@ same K (k is a function of the negotiated shapes), so the coordinator
 combines by rank-ordered concatenation — the reference allgather shape
 (Horovod ``tensorflow/__init__.py:72-83``) — and decode is a single
 scatter-add of all ``size·K`` pairs into ``zeros(n_dense)``.
+
+This module also carries the OTHER sparse path — the reference's
+tf.IndexedSlices rebuild (:class:`IndexedSlices` /
+:func:`allreduce_sparse`, formerly ``ops/sparse.py``, now a shim): both
+defer summing to whoever applies the gathered pairs, so one module owns
+"sparse gradients" end to end.  The module level stays numpy-only (the
+bit-for-bit constraint above — the engine imports this file while
+``ops/__init__`` is still initializing), so the indexed-slices half does
+its jax / ops-package imports inside the functions that need them.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
 
 import numpy as np
 
@@ -157,3 +167,67 @@ def select_with_feedback(flat: np.ndarray, residual, k: int,
     new_residual = np.array(corrected, dtype=np.float32, copy=True)
     new_residual[idx] = 0.0
     return idx, vals, new_residual
+
+
+# -- indexed-slices allgather path (formerly ops/sparse.py) -------------------
+
+@dataclass
+class IndexedSlices:
+    """A sparse tensor: ``values[i]`` belongs to row ``indices[i]`` of a
+    dense tensor of shape ``dense_shape`` (mirror of tf.IndexedSlices)."""
+
+    indices: Any   # int array [n]
+    values: Any    # array [n, ...]
+    dense_shape: Tuple[int, ...]
+
+    def to_dense(self):
+        import jax.numpy as jnp
+
+        out = jnp.zeros(self.dense_shape,
+                        dtype=jnp.asarray(self.values).dtype)
+        return out.at[jnp.asarray(self.indices)].add(
+            jnp.asarray(self.values))
+
+
+def allreduce_sparse(slices: IndexedSlices, average: bool = True,
+                     name: Optional[str] = None,
+                     axis_name: Any = None) -> IndexedSlices:
+    """Allreduce an IndexedSlices by gathering every rank's (indices,
+    values); duplicate rows sum when densified. ``average`` scales values by
+    1/size, matching the dense allreduce contract
+    (``tensorflow/__init__.py:76-83``)."""
+    name = name or "allreduce_sparse"
+    if axis_name is not None:
+        import jax.numpy as jnp
+
+        from . import spmd
+
+        gathered_values = spmd.allgather(slices.values, axis_name)
+        gathered_indices = spmd.allgather(
+            jnp.asarray(slices.indices).reshape(-1, 1), axis_name).reshape(-1)
+        if average:
+            from jax import lax
+
+            # Divide by the product of ALL named axis sizes: a tuple
+            # axis_name gathers size(a)·size(b)·… contributions, so
+            # scaling by only the first axis under-divides multi-axis
+            # meshes (pinned by tests/test_zzsparse.py).
+            denom = 1
+            for ax in ((axis_name,) if isinstance(axis_name, str)
+                       else tuple(axis_name)):
+                denom = denom * lax.axis_size(ax)
+            gathered_values = gathered_values / denom
+        return IndexedSlices(gathered_indices, gathered_values,
+                             slices.dense_shape)
+
+    from .. import basics
+    from . import allgather_async, synchronize
+
+    values_handle = allgather_async(slices.values, name=f"{name}.values")
+    indices_handle = allgather_async(
+        np.asarray(slices.indices).reshape(-1, 1), name=f"{name}.indices")
+    values = synchronize(values_handle)
+    indices = np.asarray(synchronize(indices_handle)).reshape(-1)
+    if average:
+        values = values / basics.size()
+    return IndexedSlices(indices, values, slices.dense_shape)

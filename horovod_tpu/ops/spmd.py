@@ -188,6 +188,67 @@ def reducescatter(x: jax.Array, axis_name: AxisName, average: bool = False) -> j
     return out
 
 
+def quantized_reducescatter(x: jax.Array, axis: str, codec) -> jax.Array:
+    """Block-quantized reduce-scatter of a flat f32 bucket: steps 1-3 of
+    the EQuARX factoring (see :func:`quantized_allreduce`) WITHOUT the
+    gather leg — each rank keeps the dequantized SUM of its own chunk.
+    This is the scatter half the ZeRO-1 sharded apply rides
+    (``XlaDataPlane.reduce_scatter_apply``): the gradient moves as wire
+    dtype, the applied parameters gather back at full f32 (parameters
+    are the training state; quantizing them would change numerics).
+
+    Skipping the gather leg's re-quantization means the per-chunk sum
+    carries ONE quantization error instead of two — strictly less error
+    than :func:`quantized_allreduce`, but therefore NOT bit-identical to
+    the replicated quantized wire (docs/sharding.md; the bit-exact
+    contract of ZeRO-1 applies to the f32 wire).
+
+    ``x`` must be 1-D with length divisible into whole codec blocks per
+    rank — the engine's power-of-two apply buckets guarantee this."""
+    size = int(lax.axis_size(axis))
+    wire_dt = codec.wire_dtype()
+    n_elems = x.shape[0]
+    block, padded = codec.block_layout(n_elems, size)
+    if padded != n_elems:
+        raise ValueError(
+            f"quantized_reducescatter needs whole blocks per rank: "
+            f"n={n_elems} pads to {padded} (block={block}, size={size})")
+    pre_b, post_b = codec.wire_cost(n_elems, size)
+    _SPMD_WIRE_PRE.inc(pre_b)
+    _SPMD_WIRE_POST.inc(post_b)
+    n_blocks = padded // block
+    blocks = x.reshape(n_blocks, block)
+
+    # 1. shared block scales (the only f32 wire, ~n/block elements)
+    absmax = jnp.max(jnp.abs(blocks), axis=1)
+    shared_max = lax.pmax(absmax, axis)
+    scale = jnp.where(shared_max > 0, shared_max / codec.QMAX,
+                      jnp.ones_like(shared_max)).astype(codec.SCALE_DTYPE)
+    inv = (1.0 / scale.astype(jnp.float32))[:, None]
+
+    # 2. quantize + scatter leg (wire dtype operand)
+    if jnp.issubdtype(wire_dt, jnp.floating):  # fp8: saturating cast
+        q = (blocks * inv).astype(wire_dt)
+    else:
+        q = jnp.clip(jnp.round(blocks * inv),
+                     -codec.QMAX, codec.QMAX).astype(wire_dt)
+    received = lax.all_to_all(q.reshape(size, padded // size), axis,
+                              split_axis=0, concat_axis=0)
+
+    # 3. widened accumulator (exact for int8), dequantized with THIS
+    # chunk's slice of the shared scales — no gather leg
+    acc_dt = jnp.float32 if jnp.issubdtype(wire_dt, jnp.floating) \
+        else jnp.int32
+    chunk_sum = received.astype(acc_dt).sum(axis=0)
+    nb_chunk = n_blocks // size
+    r = lax.axis_index(axis)
+    scale_chunk = lax.dynamic_slice(
+        scale.astype(jnp.float32), (r * nb_chunk,), (nb_chunk,))
+    out = chunk_sum.astype(jnp.float32).reshape(nb_chunk, block) * \
+        scale_chunk[:, None]
+    return out.reshape(-1)
+
+
 def quantized_allreduce(x: jax.Array, axis_name: AxisName,
                         average: bool = True, codec=None) -> jax.Array:
     """Allreduce whose wire payload is block-quantized int8/fp8 (EQuARX,

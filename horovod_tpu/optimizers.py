@@ -316,6 +316,15 @@ def _fused_apply_armed() -> bool:
     return Config.from_env().fused_apply
 
 
+def _zero1_armed() -> bool:
+    """The ``HOROVOD_ZERO`` opt-in (docs/sharding.md), resolved exactly
+    like :func:`_fused_apply_armed`; capability (XLA plane, world > 1)
+    is the engine's call via ``ops.zero1_active``."""
+    from .sharding.zero1 import armed
+
+    return armed()
+
+
 def apply_step(tx: optax.GradientTransformation, grads: Any, state: Any,
                params: Any):
     """One distributed optimizer step that LANDS applied parameters:
@@ -354,31 +363,77 @@ def apply_step(tx: optax.GradientTransformation, grads: Any, state: Any,
     fusable = rule is not None and meta.get("axis_name") is None and \
         meta.get("n_acc", 1) == 1 and quantized_ok
     if fusable and _fused_apply_armed():
-        from .ops import apply_synchronize, fused_apply_async
+        from .ops import apply_synchronize, fused_apply_async, \
+            zero1_active
         from .ops.fused_apply import FusedApplyState
 
         inner = state.inner
         count_next = int(inner.count) + 1
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         p_leaves = jax.tree_util.tree_flatten(params)[0]
-        slot_leaves = [jax.tree_util.tree_flatten(s)[0]
-                       for s in inner.slots]
+        # ZeRO-1 (docs/sharding.md): when the engine armed the sharded
+        # plane, optimizer slots live as this rank's ShardLeaf shards —
+        # localized lazily on the first armed step (init_fn still builds
+        # full zeros; elastic restore re-cuts whatever world committed),
+        # and the engine runs reduce-scatter → shard apply → all-gather
+        # instead of the replicated reduce+apply. Parameters land fully
+        # replicated and bit-exact either way.
+        z1 = _zero1_armed() and zero1_active()
+        slot_trees = inner.slots
+        if z1:
+            from .sharding import zero1 as _z1
+
+            if slot_trees and not _z1.has_shards(slot_trees):
+                slot_trees = tuple(
+                    _z1.localize_tree(s, basics.size(), basics.rank())
+                    for s in slot_trees)
+            _z1.note_slot_residency(slot_trees)
+            shard_cols = [jax.tree_util.tree_flatten(
+                s, is_leaf=_z1.is_shard)[0] for s in slot_trees]
+            slot_leaves = [[sl.data for sl in col]
+                           for col in shard_cols]
+        else:
+            slot_leaves = [jax.tree_util.tree_flatten(s)[0]
+                           for s in slot_trees]
         handles = [
             fused_apply_async(
                 g, p_leaves[i], tuple(s[i] for s in slot_leaves), rule,
                 count_next, name=f"DistributedOptimizer.apply.{i}",
-                average=meta.get("average", True), compression=comp)
+                average=meta.get("average", True), compression=comp,
+                zero1=z1)
             for i, g in enumerate(leaves)]
         outs = [apply_synchronize(h) for h in handles]
         unflatten = jax.tree_util.tree_unflatten
         new_params = unflatten(treedef, [o[0] for o in outs])
-        new_slots = tuple(
-            unflatten(treedef, [o[1][k] for o in outs])
-            for k in range(rule.nslots))
+        if z1:
+            import numpy as _np
+
+            new_slots = tuple(
+                unflatten(treedef, [
+                    _z1.ShardLeaf(_np.asarray(o[1][k]),
+                                  shard_cols[k][i].spec)
+                    for i, o in enumerate(outs)])
+                for k in range(rule.nslots))
+        else:
+            new_slots = tuple(
+                unflatten(treedef, [o[1][k] for o in outs])
+                for k in range(rule.nslots))
         new_inner = FusedApplyState(count=inner.count + 1,
                                     slots=new_slots)
         return new_params, DistributedOptState(
             inner=new_inner, accum=state.accum, counter=state.counter)
+    if rule is not None:
+        # replicated paths below cannot consume ZeRO-1 shard slots
+        # (their shapes are 1/N of each leaf) — reaching them with a
+        # sharded state means the knobs or codec changed mid-run
+        from .sharding import zero1 as _z1guard
+
+        if _z1guard.has_shards(getattr(state.inner, "slots", ())):
+            raise RuntimeError(
+                "ZeRO-1 sharded optimizer state cannot take the "
+                "replicated two-dispatch path; keep HOROVOD_ZERO=1 "
+                "runs on a fusable configuration (dense or quantized "
+                "codec, HOROVOD_FUSED_APPLY=1)")
     if fusable:
         # the two-dispatch REFERENCE path: one reduce dispatch (summed
         # wire, the fused plane's exact input), then one jitted apply

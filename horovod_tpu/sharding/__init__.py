@@ -1,0 +1,47 @@
+"""Sharding plane: 2-D GSPMD mesh planning + ZeRO-1 partitioned
+optimizer state with elastic resharding (docs/sharding.md).
+
+Two sub-planes behind two knobs:
+
+* :mod:`.meshplan` (``HOROVOD_MESH``) — grows the 1-D data axis into a
+  named ``(batch, model)`` mesh with ``NamedSharding`` specs; the flat
+  default is byte-identical to today's world.
+* :mod:`.zero1` (``HOROVOD_ZERO``) — each rank owns a contiguous shard
+  of the flattened optimizer state; the eager flush runs reduce-scatter
+  → local apply → all-gather as ONE donated compiled program, and
+  elastic commits store the world-size-independent canonical form so a
+  relaunch at a different size just repartitions the sealed state.
+"""
+
+from .meshplan import (  # noqa: F401
+    BATCH_AXIS,
+    MODEL_AXIS,
+    MeshPlan,
+    activation_sharding,
+    build_mesh,
+    param_sharding,
+    parse_mesh_spec,
+    plan,
+)
+from .zero1 import (  # noqa: F401
+    ShardLeaf,
+    ShardSpec,
+    adopt_tree,
+    expand_tree,
+    has_shards,
+    is_shard,
+    localize_tree,
+    padded_len,
+    resident_bytes,
+    shard_digest,
+    shard_len,
+    shard_slice,
+)
+
+__all__ = [
+    "BATCH_AXIS", "MODEL_AXIS", "MeshPlan", "activation_sharding",
+    "build_mesh", "param_sharding", "parse_mesh_spec", "plan",
+    "ShardLeaf", "ShardSpec", "adopt_tree", "expand_tree", "has_shards",
+    "is_shard", "localize_tree", "padded_len", "resident_bytes",
+    "shard_digest", "shard_len", "shard_slice",
+]

@@ -1,0 +1,159 @@
+"""2-D GSPMD mesh planner (docs/sharding.md §mesh grammar).
+
+The repo's meshes so far are 1-D data-parallel (``parallel/mesh.py``
+``('data',)``) or hosts×chips (``('dcn','ici')``). SNIPPETS [2]'s
+exemplar scales "from 8-chip v4 to 6000-chip v5p without changing
+application code" by naming a ``(batch, model)`` mesh once and letting
+GSPMD propagate shardings — this module grows the 1-D data axis into
+that named 2-D mesh from ``core/topology`` + ``parallel/mesh.py``
+device facts, governed by one knob:
+
+    HOROVOD_MESH=batch            # flat default: model axis of size 1
+    HOROVOD_MESH=batch,model:K    # K-way model axis, batch gets the rest
+
+The flat default is byte-identical to today's 1-D world: a model axis
+of size 1 shards nothing (every ``PartitionSpec`` over it is a no-op),
+so existing programs compile to the same HLO. The planner only PLANS —
+it returns the named mesh and ``NamedSharding`` specs; callers (SPMD
+front-ends, the ZeRO-1 plane's future model-sharded stage) decide what
+to place where. Nothing here opens a socket or owns a device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+from ..core import config as _config
+
+BATCH_AXIS = "batch"
+MODEL_AXIS = "model"
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """One planned ``(batch, model)`` factoring of the device world."""
+
+    batch: int
+    model: int
+
+    def __post_init__(self) -> None:
+        if self.batch < 1 or self.model < 1:
+            raise ValueError(
+                f"mesh axes must be positive, got batch={self.batch} "
+                f"model={self.model}")
+
+    @property
+    def devices(self) -> int:
+        return self.batch * self.model
+
+    @property
+    def axes(self) -> Tuple[str, str]:
+        return (BATCH_AXIS, MODEL_AXIS)
+
+    @property
+    def flat(self) -> bool:
+        """True when the model axis is degenerate — the byte-identical
+        1-D data-parallel world."""
+        return self.model == 1
+
+    def describe(self) -> str:
+        return f"{BATCH_AXIS}={self.batch}x{MODEL_AXIS}={self.model}"
+
+
+def parse_mesh_spec(spec: str) -> int:
+    """Model-axis size from the ``HOROVOD_MESH`` grammar.
+
+    ``"batch"`` → 1 (flat); ``"batch,model:K"`` → K. Anything else is a
+    loud ValueError at plan time — a mesh typo must never silently fall
+    back to an unsharded world."""
+    s = (spec or BATCH_AXIS).strip()
+    if s == BATCH_AXIS:
+        return 1
+    prefix = f"{BATCH_AXIS},{MODEL_AXIS}:"
+    if s.startswith(prefix):
+        try:
+            k = int(s[len(prefix):])
+        except ValueError:
+            k = 0
+        if k >= 1:
+            return k
+    raise ValueError(
+        f"bad {_config.HOROVOD_MESH} spec {spec!r}; expected "
+        f"'{BATCH_AXIS}' or '{BATCH_AXIS},{MODEL_AXIS}:K' with K >= 1")
+
+
+def plan(n_devices: int, spec: Optional[str] = None) -> MeshPlan:
+    """Factor ``n_devices`` per the spec (config/env when ``None``):
+    the model axis takes K, the batch axis the rest — K must divide the
+    device count, the same divisibility contract GSPMD itself enforces
+    at compile time, surfaced here with the knob's name on it."""
+    if spec is None:
+        from ..core import basics
+
+        if basics.is_initialized():
+            spec = basics.config().mesh
+        else:
+            from ..core.config import Config
+
+            spec = Config.from_env().mesh
+    model = parse_mesh_spec(spec)
+    if n_devices % model != 0:
+        raise ValueError(
+            f"{_config.HOROVOD_MESH}={spec!r}: model axis {model} does "
+            f"not divide the {n_devices}-device world")
+    return MeshPlan(batch=n_devices // model, model=model)
+
+
+def build_mesh(mesh_plan: MeshPlan, devices: Optional[Sequence] = None):
+    """Materialize the named 2-D ``jax.sharding.Mesh`` for a plan.
+
+    Device order comes from ``parallel/mesh.py``'s world enumeration
+    (``jax.devices()`` — the MPI_COMM_WORLD analog) reshaped
+    ``(batch, model)`` row-major, so model-axis neighbours are
+    consecutive devices: on a TPU slice those are the ICI-closest pairs,
+    which is where the model axis's latency-critical collectives belong
+    (the dcn/ici factoring argument of ``hierarchical_mesh``)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    if devs.size != mesh_plan.devices:
+        raise ValueError(
+            f"plan {mesh_plan.describe()} wants {mesh_plan.devices} "
+            f"devices, got {devs.size}")
+    grid = devs.reshape(mesh_plan.batch, mesh_plan.model)
+    return Mesh(grid, mesh_plan.axes)
+
+
+def param_sharding(mesh, shape: Tuple[int, ...]):
+    """``NamedSharding`` for a parameter: model axis over the LARGEST
+    divisible dimension, replicated otherwise (GSPMD's propagation fills
+    in the rest). Flat meshes always replicate — byte-identical to the
+    1-D world."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    model = mesh.shape[MODEL_AXIS]
+    if model == 1 or not shape:
+        return NamedSharding(mesh, PartitionSpec())
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for dim in order:
+        if shape[dim] % model == 0:
+            spec = [None] * len(shape)
+            spec[dim] = MODEL_AXIS
+            return NamedSharding(mesh, PartitionSpec(*spec))
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def activation_sharding(mesh, ndim: int = 2):
+    """``NamedSharding`` for activations: batch axis on dim 0 (the
+    per-example dimension every data-parallel program already has),
+    remaining dims replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if ndim < 1:
+        return NamedSharding(mesh, PartitionSpec())
+    spec = [None] * ndim
+    spec[0] = BATCH_AXIS
+    return NamedSharding(mesh, PartitionSpec(*spec))

@@ -128,6 +128,14 @@ RECOVERY_PREFIXES = ("horovod_recovery_",)
 # absorbing near-flat load.
 HIER_PREFIXES = ("horovod_hier_",)
 
+# Sharding-plane families (docs/sharding.md): per-rank shard
+# geometry/residency gauges, pad + repartition counters, and the
+# contribution-ratio gauge — the "is ZeRO-1 actually saving memory, and
+# is any rank's partition doing outsized work?" glance. Slot bytes near
+# the replicated footprint means sharding silently degraded; a reshard
+# counter tick is an elastic world-size change repartitioning state.
+SHARD_PREFIXES = ("horovod_shard_",)
+
 # Checkpoint-plane families (docs/checkpoint.md): commit/seal counters,
 # the sealed-commit watermark, digest mismatches, stream bytes/seconds,
 # the commit-stall histogram, and journal depth — the "is training
@@ -206,6 +214,15 @@ def _render_sparse_section(families: Dict[str, dict], prefix: str,
     _render_section("sparse wire", sparse, prefix, out)
 
 
+def _render_shard_section(families: Dict[str, dict], prefix: str,
+                          out) -> None:
+    shard = {n: f for n, f in families.items()
+             if n.startswith(SHARD_PREFIXES) and n.startswith(prefix)}
+    if not shard:
+        return  # no sharding plane in this snapshot: no empty section
+    _render_section("sharding plane", shard, prefix, out)
+
+
 def _render_ckpt_section(families: Dict[str, dict], prefix: str,
                          out) -> None:
     ckpt = {n: f for n, f in families.items()
@@ -263,6 +280,7 @@ def main(argv=None) -> int:
     _render_flightrec_section(world, args.family, sys.stdout)
     _render_numerics_section(world, args.family, sys.stdout)
     _render_sparse_section(world, args.family, sys.stdout)
+    _render_shard_section(world, args.family, sys.stdout)
     _render_ckpt_section(world, args.family, sys.stdout)
     _render_hier_section(world, args.family, sys.stdout)
     _render_recovery_section(world, args.family, sys.stdout)
@@ -270,7 +288,7 @@ def main(argv=None) -> int:
                     skip=TUNING_PREFIXES + INTEGRITY_PREFIXES
                     + SERVING_PREFIXES + FLIGHTREC_PREFIXES
                     + NUMERICS_PREFIXES + SPARSE_PREFIXES
-                    + CKPT_PREFIXES + HIER_PREFIXES
+                    + SHARD_PREFIXES + CKPT_PREFIXES + HIER_PREFIXES
                     + RECOVERY_PREFIXES)
     # JSON round-trips rank keys as strings; accept either
     by_rank = {int(k): v for k, v in ranks.items()}
